@@ -1,0 +1,19 @@
+"""elasticdl_tpu — a TPU-native elastic deep-learning framework.
+
+A from-scratch JAX/XLA/Pallas/pjit re-design of the capabilities of ElasticDL
+(reference: frankiegu/elasticdl): a Kubernetes-native master performing dynamic
+data sharding and pod lifecycle management, workers that survive preemption by
+re-queuing tasks, sync/async data-parallel training, and sharded sparse
+embedding tables with lazy row initialization.
+
+Where the reference centralizes state in a gRPC parameter server
+(reference: elasticdl/python/ps/, elasticdl/pkg/), this framework shards
+parameters and optimizer state across a ``jax.sharding.Mesh`` and exchanges
+gradients with XLA collectives over ICI; the control plane (task dispatch,
+liveness, versions) stays on gRPC because those messages are tiny and
+elasticity requires membership tracking outside the mesh.
+"""
+
+__version__ = "0.1.0"
+
+from elasticdl_tpu.common import constants  # noqa: F401

@@ -1,0 +1,177 @@
+"""LocalExecutor: in-process training without any RPC.
+
+Counterpart of the reference's ``elasticdl/python/elasticdl/local_executor.py``
+(:23-195) — `--distribution_strategy=Local` runs the whole job in one process:
+read shards directly, run the jitted train step on the local device(s), and
+evaluate periodically. Everything the distributed path uses (step fns, reader,
+batcher, metrics) is exercised here first.
+"""
+
+import time
+from typing import Optional
+
+import jax
+import numpy as np
+
+from elasticdl_tpu.common.constants import Mode
+from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.common.task import Task
+from elasticdl_tpu.common.timing import Timing
+from elasticdl_tpu.core.model_spec import get_model_spec
+from elasticdl_tpu.core.step import (
+    build_eval_step,
+    build_train_step,
+    evaluate_metrics,
+)
+from elasticdl_tpu.core.train_state import init_train_state
+from elasticdl_tpu.data.batcher import batch_records
+from elasticdl_tpu.data.factory import (
+    create_data_reader,
+    parse_data_reader_params,
+)
+
+
+class LocalExecutor:
+    def __init__(self, args):
+        self._args = args
+        self._logger = get_logger("local_executor", args.log_level)
+        self._spec = get_model_spec(
+            model_zoo=args.model_zoo,
+            model_def=args.model_def,
+            dataset_fn=args.dataset_fn,
+            loss=args.loss,
+            optimizer=args.optimizer,
+            eval_metrics_fn=args.eval_metrics_fn,
+            callbacks=args.callbacks,
+            custom_data_reader=args.custom_data_reader,
+        )
+        reader_params = parse_data_reader_params(args.data_reader_params)
+        self._train_reader = create_data_reader(
+            data_origin=args.training_data,
+            custom_reader=self._spec.custom_data_reader,
+            **reader_params,
+        )
+        self._eval_reader = None
+        if getattr(args, "validation_data", ""):
+            self._eval_reader = create_data_reader(
+                data_origin=args.validation_data,
+                custom_reader=self._spec.custom_data_reader,
+                **reader_params,
+            )
+        self._batch_size = args.minibatch_size
+        self._epochs = args.num_epochs
+        self._max_steps = getattr(args, "max_steps", 0)
+        self._evaluation_steps = getattr(args, "evaluation_steps", 0)
+        self._timing = Timing(args.log_level.upper() == "DEBUG", self._logger)
+        self.state = None
+        self._train_step = build_train_step(self._spec.loss)
+        self._eval_step = build_eval_step()
+        self.last_train_metrics = None
+
+    def _task_batches(self, reader, mode):
+        shards = reader.create_shards()
+        task_id = 0
+        for shard_name, (start, count) in shards.items():
+            task = Task(
+                task_id=task_id, shard_name=shard_name,
+                start=start, end=start + count, type=mode,
+            )
+            task_id += 1
+            yield from batch_records(
+                reader.read_records(task),
+                self._batch_size,
+                self._spec.dataset_fn,
+                mode,
+                reader.metadata,
+            )
+
+    def _maybe_init_state(self, batch):
+        if self.state is None:
+            tx = self._spec.make_optimizer()
+            self.state = init_train_state(
+                self._spec.model, tx, batch,
+                seed=getattr(self._args, "random_seed", 0),
+            )
+
+    def train(self) -> dict:
+        start_time = time.monotonic()
+        steps = 0
+        examples = 0
+        stop = False
+        for epoch in range(self._epochs):
+            if stop:
+                break
+            for batch in self._task_batches(self._train_reader, Mode.TRAINING):
+                self._maybe_init_state(batch)
+                with self._timing.record("batch_process"):
+                    self.state, metrics = self._train_step(self.state, batch)
+                self.last_train_metrics = metrics
+                steps += 1
+                examples += int(np.sum(batch["mask"]))
+                if steps % 100 == 0:
+                    self._logger.info(
+                        "step=%d loss=%.5f", steps, float(metrics["loss"])
+                    )
+                if self._evaluation_steps and (
+                    steps % self._evaluation_steps == 0
+                ):
+                    self.evaluate()
+                if self._max_steps and steps >= self._max_steps:
+                    stop = True
+                    break
+        if self.state is None:
+            raise ValueError(
+                f"Training data {self._args.training_data!r} produced no "
+                "batches; nothing was trained"
+            )
+        jax.block_until_ready(self.state.params)
+        elapsed = time.monotonic() - start_time
+        eval_result = self.evaluate() if self._eval_reader else None
+        self._timing.report_timing()
+        return {
+            "steps": steps,
+            "examples": examples,
+            "elapsed_secs": elapsed,
+            "examples_per_sec": examples / max(elapsed, 1e-9),
+            "final_loss": (
+                float(self.last_train_metrics["loss"])
+                if self.last_train_metrics is not None else None
+            ),
+            "eval_metrics": eval_result,
+        }
+
+    def evaluate(self) -> Optional[dict]:
+        if self._eval_reader is None or self._spec.eval_metrics_fn is None:
+            return None
+        if self.state is None:
+            raise RuntimeError("evaluate() before any training step")
+        all_outputs, all_labels = [], []
+        for batch in self._task_batches(self._eval_reader, Mode.EVALUATION):
+            preds = self._eval_step(self.state, batch)
+            real = int(np.sum(batch["mask"]))
+            all_outputs.append(np.asarray(preds)[:real])
+            all_labels.append(
+                jax.tree.map(lambda x: np.asarray(x)[:real], batch["labels"])
+            )
+        if not all_outputs:
+            self._logger.warning(
+                "Validation data produced no batches; skipping evaluation"
+            )
+            return None
+        outputs = np.concatenate(all_outputs, axis=0)
+        labels = (
+            np.concatenate(all_labels, axis=0)
+            if not isinstance(all_labels[0], dict)
+            else {
+                k: np.concatenate([d[k] for d in all_labels], axis=0)
+                for k in all_labels[0]
+            }
+        )
+        metrics = evaluate_metrics(
+            self._spec.eval_metrics_fn(), labels, outputs
+        )
+        self._logger.info("Eval metrics: %s", metrics)
+        return metrics
+
+    def run(self):
+        return self.train()

@@ -1,0 +1,243 @@
+"""The flag system: argparse groups per role + arg re-serialization.
+
+Counterpart of the reference's ``elasticdl/python/common/args.py`` (721 LoC,
+~70 flags). Same structure: shared arg groups composed into per-role parsers
+(client train/evaluate/predict/clean, master, worker), plus
+``build_arguments_from_parsed_result`` so the master can re-serialize its own
+parsed args into the CLI of the pods it spawns, and ``parse_envs`` for k=v
+env plumbing (reference args.py:61-87).
+
+TPU-specific flags replace the PS flags: ``--num_workers`` describes TPU-VM
+worker pods, ``--mesh_shape``/``--dp_axis`` describe the device mesh, and the
+sync-SGD knobs (``grads_to_wait``, staleness) map onto gradient-accumulation +
+LR modulation in the mesh step.
+"""
+
+import argparse
+from itertools import chain
+
+
+def pos_int(value):
+    res = int(value)
+    if res <= 0:
+        raise ValueError(f"Positive integer required, got {value}")
+    return res
+
+
+def non_neg_int(value):
+    res = int(value)
+    if res < 0:
+        raise ValueError(f"Non-negative integer required, got {value}")
+    return res
+
+
+def pos_float(value):
+    res = float(value)
+    if res <= 0:
+        raise ValueError(f"Positive float required, got {value}")
+    return res
+
+
+def parse_envs(arg):
+    """Parse ``key1=val1,key2=val2`` into a dict (reference args.py:61-87)."""
+    envs = {}
+    if not arg:
+        return envs
+    for kv in arg.split(","):
+        kv = kv.strip()
+        if not kv:
+            continue
+        if "=" not in kv:
+            raise ValueError(f"Malformed env entry {kv!r}; expected k=v")
+        key, _, value = kv.partition("=")
+        envs[key.strip()] = value.strip()
+    return envs
+
+
+def str2bool(value):
+    if isinstance(value, bool):
+        return value
+    if value.lower() in ("yes", "true", "t", "y", "1"):
+        return True
+    if value.lower() in ("no", "false", "f", "n", "0"):
+        return False
+    raise argparse.ArgumentTypeError(f"Boolean value expected, got {value!r}")
+
+
+def add_bool_param(parser, name, default, help_msg):
+    parser.add_argument(
+        name, type=str2bool, nargs="?", const=True, default=default, help=help_msg
+    )
+
+
+def add_common_params(parser):
+    """Flags shared by every role (reference args.py add_common_params)."""
+    parser.add_argument(
+        "--model_zoo", help="Directory containing user-defined model modules",
+        required=True,
+    )
+    parser.add_argument(
+        "--model_def",
+        help="Model module path, e.g. mnist.custom_model",
+        required=True,
+    )
+    parser.add_argument("--dataset_fn", default="dataset_fn")
+    parser.add_argument("--loss", default="loss")
+    parser.add_argument("--optimizer", default="optimizer")
+    parser.add_argument("--eval_metrics_fn", default="eval_metrics_fn")
+    parser.add_argument("--custom_data_reader", default="custom_data_reader")
+    parser.add_argument(
+        "--prediction_outputs_processor", default="PredictionOutputsProcessor"
+    )
+    parser.add_argument("--callbacks", default="callbacks")
+    parser.add_argument(
+        "--distribution_strategy",
+        default="Local",
+        choices=["Local", "MeshStrategy", "ParameterServerStrategy",
+                 "AllreduceStrategy"],
+    )
+    parser.add_argument("--job_name", default="elasticdl-tpu-job")
+    parser.add_argument("--envs", type=str, default="",
+                        help="Runtime environment variables, k1=v1,k2=v2")
+    parser.add_argument("--data_reader_params", type=str, default="")
+    parser.add_argument("--log_level", default="INFO",
+                        choices=["DEBUG", "INFO", "WARNING", "ERROR"])
+    parser.add_argument("--image_name", default="",
+                        help="Container image for spawned pods")
+    parser.add_argument("--namespace", default="default")
+    parser.add_argument("--num_workers", type=pos_int, default=1)
+    parser.add_argument("--worker_resource_request",
+                        default="cpu=1,memory=4096Mi")
+    parser.add_argument("--worker_resource_limit", default="")
+    parser.add_argument("--master_resource_request",
+                        default="cpu=0.1,memory=1024Mi")
+    parser.add_argument("--master_resource_limit", default="")
+    parser.add_argument("--volume", default="")
+    parser.add_argument("--restart_policy", default="Never")
+    parser.add_argument("--master_addr", default="localhost:50001")
+    parser.add_argument("--docker_image_repository", default="")
+    add_bool_param(parser, "--force_use_kube_config_file", False,
+                   "Use kube config file instead of in-cluster config")
+    parser.add_argument("--cluster_spec", default="")
+    # Mesh flags (TPU-native replacement for the PS flags).
+    parser.add_argument(
+        "--mesh_shape", default="",
+        help="Device mesh shape, e.g. '8' (dp) or '2,4' (dp,mp); empty = all "
+             "devices on one dp axis",
+    )
+    parser.add_argument(
+        "--mesh_axes", default="dp",
+        help="Comma-separated mesh axis names matching --mesh_shape",
+    )
+    add_bool_param(parser, "--use_bf16", True,
+                   "Run matmuls in bfloat16 on the MXU")
+
+
+def add_train_params(parser):
+    parser.add_argument("--tensorboard_log_dir", default="")
+    parser.add_argument("--num_epochs", type=pos_int, default=1)
+    parser.add_argument("--grads_to_wait", type=pos_int, default=1,
+                        help="Gradient accumulation count before a sync apply")
+    parser.add_argument("--training_data", default="")
+    parser.add_argument("--validation_data", default="")
+    parser.add_argument("--evaluation_steps", type=non_neg_int, default=0)
+    parser.add_argument("--evaluation_start_delay_secs", type=pos_int,
+                        default=100)
+    parser.add_argument("--evaluation_throttle_secs", type=non_neg_int,
+                        default=0)
+    parser.add_argument("--checkpoint_steps", type=non_neg_int, default=0)
+    parser.add_argument("--checkpoint_dir", default="")
+    parser.add_argument("--keep_checkpoint_max", type=non_neg_int, default=3)
+    parser.add_argument("--checkpoint_dir_for_init", default="")
+    parser.add_argument("--output", default="",
+                        help="Export directory for the trained model")
+    parser.add_argument("--minibatch_size", type=pos_int, required=True)
+    parser.add_argument("--num_minibatches_per_task", type=pos_int, default=2)
+    add_bool_param(parser, "--use_async", False,
+                   "Async apply (staleness-modulated LR) instead of sync")
+    parser.add_argument("--lr_staleness_modulation", type=str2bool,
+                        nargs="?", const=True, default=False)
+    parser.add_argument("--sync_version_tolerance", type=non_neg_int, default=0)
+    parser.add_argument("--get_model_steps", type=pos_int, default=1,
+                        help=">1 enables SSP-style local updates between syncs")
+    parser.add_argument("--random_seed", type=non_neg_int, default=0)
+    parser.add_argument("--max_steps", type=non_neg_int, default=0)
+    parser.add_argument("--task_timeout_secs", type=pos_float, default=300.0)
+
+
+def add_evaluate_params(parser):
+    parser.add_argument("--validation_data", default="", required=False)
+    parser.add_argument("--checkpoint_dir_for_init", required=True)
+    parser.add_argument("--minibatch_size", type=pos_int, required=True)
+    parser.add_argument("--num_minibatches_per_task", type=pos_int, default=2)
+
+
+def add_predict_params(parser):
+    parser.add_argument("--prediction_data", required=True)
+    parser.add_argument("--checkpoint_dir_for_init", required=True)
+    parser.add_argument("--minibatch_size", type=pos_int, required=True)
+    parser.add_argument("--num_minibatches_per_task", type=pos_int, default=2)
+
+
+def add_clean_params(parser):
+    add_bool_param(parser, "--force", False, "Force-delete job resources")
+    parser.add_argument("--job_name", default="")
+
+
+def add_worker_params(parser):
+    parser.add_argument("--worker_id", type=non_neg_int, required=True)
+
+
+def build_parser(role: str) -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(prog=f"elasticdl_tpu-{role}",
+                                     allow_abbrev=False)
+    if role == "clean":
+        add_clean_params(parser)
+        return parser
+    add_common_params(parser)
+    if role in ("train", "master"):
+        add_train_params(parser)
+    elif role == "evaluate":
+        add_evaluate_params(parser)
+    elif role == "predict":
+        add_predict_params(parser)
+    elif role == "worker":
+        add_train_params(parser)
+        add_worker_params(parser)
+    else:
+        raise ValueError(f"Unknown role {role}")
+    return parser
+
+
+def parse_master_args(args=None):
+    return build_parser("master").parse_args(args=args)
+
+
+def parse_worker_args(args=None):
+    return build_parser("worker").parse_args(args=args)
+
+
+def build_arguments_from_parsed_result(args, filter_args=None):
+    """Reserialize parsed args back into a CLI list for spawning child pods
+    (reference args.py build_arguments_from_parsed_result)."""
+    items = vars(args).items()
+    if filter_args:
+        items = filter(lambda kv: kv[0] not in filter_args, items)
+
+    def _to_pair(key, value):
+        if isinstance(value, bool):
+            return [f"--{key}", "true" if value else "false"]
+        return [f"--{key}", str(value)]
+
+    return list(chain.from_iterable(_to_pair(k, v) for k, v in items))
+
+
+def wrap_python_args_with_string(args):
+    """Quote arg values so they survive a shell command line."""
+    out = []
+    for item in args:
+        if not item.startswith("--"):
+            out.append(f"'{item}'")
+        else:
+            out.append(item)
+    return out

@@ -1,0 +1,53 @@
+"""Dtype tables mapping framework dtype names to numpy/JAX dtypes.
+
+Counterpart of the reference's ``elasticdl/python/common/dtypes.py`` and
+``elasticdl/pkg/common/types.go`` — but keyed on canonical string names rather
+than TF ``DataType`` enums, with bfloat16 first-class (it is the TPU MXU's
+native matmul dtype).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+# Canonical name -> (numpy dtype, byte size)
+_DTYPES = {
+    "bool": (np.dtype(np.bool_), 1),
+    "int8": (np.dtype(np.int8), 1),
+    "uint8": (np.dtype(np.uint8), 1),
+    "int16": (np.dtype(np.int16), 2),
+    "int32": (np.dtype(np.int32), 4),
+    "int64": (np.dtype(np.int64), 8),
+    "float16": (np.dtype(np.float16), 2),
+    "bfloat16": (np.dtype(jnp.bfloat16), 2),
+    "float32": (np.dtype(np.float32), 4),
+    "float64": (np.dtype(np.float64), 8),
+}
+
+_NP_TO_NAME = {v[0]: k for k, v in _DTYPES.items()}
+
+
+def dtype_size(name: str) -> int:
+    """Byte size of one element of the named dtype."""
+    return _DTYPES[name][1]
+
+
+def np_dtype(name: str) -> np.dtype:
+    """Numpy dtype for a canonical name."""
+    return _DTYPES[name][0]
+
+
+def dtype_name(dtype) -> str:
+    """Canonical name for a numpy/JAX dtype (raises KeyError if unsupported)."""
+    return _NP_TO_NAME[np.dtype(dtype)]
+
+
+def is_floating(name: str) -> bool:
+    return name in ("float16", "bfloat16", "float32", "float64")
+
+
+def is_allowed_param_dtype(dtype) -> bool:
+    """Trainable parameters must be floating point (reference dtypes.py)."""
+    try:
+        return is_floating(dtype_name(dtype))
+    except KeyError:
+        return False

@@ -1,0 +1,29 @@
+"""Uniform stderr logging (reference elasticdl/python/common/log_utils.py)."""
+
+import logging
+import sys
+
+_DEFAULT_FMT = (
+    "[%(asctime)s] [%(levelname)s] "
+    "[%(filename)s:%(lineno)d:%(funcName)s] %(message)s"
+)
+
+_loggers = {}
+
+
+def get_logger(name: str, level: str = "INFO") -> logging.Logger:
+    """Get/create the named logger. The level is applied on first creation
+    only (loggers are shared per name process-wide)."""
+    if name not in _loggers:
+        logger = logging.getLogger(name)
+        logger.setLevel(level.upper())
+        if not logger.handlers:
+            handler = logging.StreamHandler(sys.stderr)
+            handler.setFormatter(logging.Formatter(_DEFAULT_FMT))
+            logger.addHandler(handler)
+        logger.propagate = False
+        _loggers[name] = logger
+    return _loggers[name]
+
+
+default_logger = get_logger("elasticdl_tpu")

@@ -1,0 +1,125 @@
+"""Array / pytree serialization and sparse-gradient merging.
+
+Counterpart of the reference's ``elasticdl/python/common/tensor_utils.py``
+(ndarray⇄TensorProto, IndexedSlices merge/dedup) — but the wire format is
+msgpack with raw buffers instead of TF ``TensorProto``: this framework only
+ships tensors over the network for checkpoints and eval outputs, never on the
+training hot path (gradients ride XLA collectives on the mesh).
+"""
+
+from dataclasses import dataclass
+from typing import Any, Dict
+
+import msgpack
+import numpy as np
+
+from elasticdl_tpu.common import dtypes
+
+
+@dataclass
+class IndexedSlices:
+    """A sparse update: ``values[i]`` applies to row ``ids[i]`` of a table.
+
+    Mirror of the reference's IndexedSlices (tensor_utils.py, tensor.go:222)
+    as a host-side container; on-device sparse grads stay as (ids, values)
+    JAX arrays. A dataclass (not NamedTuple) so msgpack routes it through the
+    custom encoder instead of flattening it to a list.
+    """
+
+    values: np.ndarray  # (n, dim)
+    ids: np.ndarray  # (n,)
+
+
+def serialize_ndarray(arr: np.ndarray) -> dict:
+    arr = np.ascontiguousarray(arr)
+    return {
+        "dtype": dtypes.dtype_name(arr.dtype),
+        "shape": list(arr.shape),
+        "data": arr.tobytes(),
+    }
+
+
+def deserialize_ndarray(obj: dict) -> np.ndarray:
+    arr = np.frombuffer(obj["data"], dtype=dtypes.np_dtype(obj["dtype"]))
+    return arr.reshape(obj["shape"]).copy()
+
+
+def _encode(obj):
+    if isinstance(obj, np.ndarray):
+        return {"__nd__": serialize_ndarray(obj)}
+    if isinstance(obj, (np.integer,)):
+        return int(obj)
+    if isinstance(obj, (np.floating,)):
+        return float(obj)
+    if isinstance(obj, IndexedSlices):
+        return {
+            "__is__": {
+                "values": serialize_ndarray(obj.values),
+                "ids": serialize_ndarray(obj.ids),
+            }
+        }
+    raise TypeError(f"Cannot serialize {type(obj)}")
+
+
+def _decode(obj):
+    if "__nd__" in obj:
+        return deserialize_ndarray(obj["__nd__"])
+    if "__is__" in obj:
+        return IndexedSlices(
+            values=deserialize_ndarray(obj["__is__"]["values"]),
+            ids=deserialize_ndarray(obj["__is__"]["ids"]),
+        )
+    return obj
+
+
+def dumps(tree: Any) -> bytes:
+    """Serialize a pytree of ndarrays/scalars/strings to bytes."""
+    return msgpack.packb(tree, default=_encode, use_bin_type=True)
+
+
+def loads(data: bytes) -> Any:
+    return msgpack.unpackb(data, object_hook=_decode, raw=False, strict_map_key=False)
+
+
+def merge_indexed_slices(*slices: IndexedSlices) -> IndexedSlices:
+    """Concatenate sparse updates (reference tensor.go:222 MergeIndexedSlices)."""
+    values = np.concatenate([s.values for s in slices], axis=0)
+    ids = np.concatenate([s.ids for s in slices], axis=0)
+    return IndexedSlices(values=values, ids=ids)
+
+
+def deduplicate_indexed_slices(values: np.ndarray, ids: np.ndarray):
+    """Sum values belonging to duplicated ids (reference tensor_utils.py).
+
+    Returns (summed_values, unique_ids) where ``summed_values[i]`` is the sum
+    of all rows whose id == ``unique_ids[i]``.
+    """
+    unique_ids, inverse = np.unique(ids, return_inverse=True)
+    summed = np.zeros((unique_ids.shape[0],) + values.shape[1:], values.dtype)
+    np.add.at(summed, inverse, values)
+    return summed, unique_ids
+
+
+def flatten_named(tree: Dict[str, Any], prefix: str = "") -> Dict[str, np.ndarray]:
+    """Flatten a nested dict pytree to {'a/b/c': leaf} with '/'-joined names."""
+    out = {}
+    for key in sorted(tree):
+        value = tree[key]
+        name = f"{prefix}/{key}" if prefix else str(key)
+        if isinstance(value, dict):
+            out.update(flatten_named(value, name))
+        else:
+            out[name] = value
+    return out
+
+
+def unflatten_named(flat: Dict[str, Any]) -> Dict[str, Any]:
+    """Inverse of :func:`flatten_named`."""
+    tree: Dict[str, Any] = {}
+    for name, leaf in flat.items():
+        parts = name.split("/")
+        node = tree
+        for part in parts[:-1]:
+            node = node.setdefault(part, {})
+        node[parts[-1]] = leaf
+    return tree

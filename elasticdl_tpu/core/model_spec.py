@@ -1,0 +1,115 @@
+"""The model-zoo user contract and its loader.
+
+Counterpart of the reference's ``elasticdl/python/common/model_utils.py``
+(get_model_spec:126, load_module:14): a user model is a Python module in the
+model-zoo directory defining, by name:
+
+- ``custom_model()`` -> a ``flax.linen.Module`` whose ``__call__`` takes the
+  feature pytree and a ``training`` kwarg,
+- ``loss(labels, predictions, mask)`` -> scalar JAX loss (mask weights padded
+  rows of the final partial batch — XLA needs static shapes, so partial
+  batches are padded and masked rather than shape-varying),
+- ``optimizer()`` -> an ``optax.GradientTransformation``,
+- ``dataset_fn(records, mode, metadata)`` -> ``(features, labels)`` numpy
+  pytrees for a list of decoded records,
+- ``eval_metrics_fn()`` -> dict of metric name -> fn(labels, predictions),
+- optional: ``callbacks()``, ``custom_data_reader(**kwargs)``,
+  ``PredictionOutputsProcessor``.
+
+The reference loads TF Keras models; here the contract is JAX/flax-native but
+keeps the same names so a reference user maps their module one-to-one.
+"""
+
+import importlib.util
+import os
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional
+
+
+def load_module(module_file):
+    """Import a python file by path (reference model_utils.py:14)."""
+    spec = importlib.util.spec_from_file_location(module_file, module_file)
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+def load_model_zoo_module(model_zoo: str, model_def: str):
+    """Resolve ``pkg.module.func`` under the model-zoo dir and import it."""
+    parts = model_def.split(".")
+    if len(parts) < 2:
+        raise ValueError(
+            f"model_def must be like 'module.function', got {model_def!r}"
+        )
+    module_rel = os.path.join(*parts[:-1]) + ".py"
+    module_file = os.path.join(model_zoo, module_rel)
+    if not os.path.exists(module_file):
+        raise FileNotFoundError(f"No model module at {module_file}")
+    return load_module(module_file), parts[-1]
+
+
+def _get_spec_value(module, name, required=False, call=False):
+    value = getattr(module, name, None)
+    if value is None:
+        if required:
+            raise ValueError(
+                f"Model zoo module is missing required symbol {name!r}"
+            )
+        return None
+    return value() if call else value
+
+
+@dataclass
+class ModelSpec:
+    """Everything loaded from the user's model-zoo module."""
+
+    model: Any
+    model_fn_name: str
+    loss: Callable
+    optimizer_fn: Callable
+    dataset_fn: Callable
+    eval_metrics_fn: Optional[Callable] = None
+    callbacks_fn: Optional[Callable] = None
+    custom_data_reader: Optional[Callable] = None
+    prediction_outputs_processor: Any = None
+    module: Any = None
+    extras: Dict[str, Any] = field(default_factory=dict)
+
+    def make_optimizer(self, **kwargs):
+        return self.optimizer_fn(**kwargs)
+
+
+def get_model_spec(
+    model_zoo: str,
+    model_def: str,
+    dataset_fn: str = "dataset_fn",
+    loss: str = "loss",
+    optimizer: str = "optimizer",
+    eval_metrics_fn: str = "eval_metrics_fn",
+    callbacks: str = "callbacks",
+    custom_data_reader: str = "custom_data_reader",
+    prediction_outputs_processor: str = "PredictionOutputsProcessor",
+) -> ModelSpec:
+    """Load the user module and resolve the contract symbols by name
+    (reference model_utils.py:126-185)."""
+    module, model_fn_name = load_model_zoo_module(model_zoo, model_def)
+    model_fn = getattr(module, model_fn_name, None)
+    if model_fn is None:
+        raise ValueError(
+            f"{model_def}: function {model_fn_name!r} not found in module"
+        )
+    processor_cls = getattr(module, prediction_outputs_processor, None)
+    return ModelSpec(
+        model=model_fn(),
+        model_fn_name=model_fn_name,
+        loss=_get_spec_value(module, loss, required=True),
+        optimizer_fn=_get_spec_value(module, optimizer, required=True),
+        dataset_fn=_get_spec_value(module, dataset_fn, required=True),
+        eval_metrics_fn=_get_spec_value(module, eval_metrics_fn),
+        callbacks_fn=_get_spec_value(module, callbacks),
+        custom_data_reader=_get_spec_value(module, custom_data_reader),
+        prediction_outputs_processor=(
+            processor_cls() if processor_cls is not None else None
+        ),
+        module=module,
+    )

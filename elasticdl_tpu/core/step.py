@@ -1,0 +1,149 @@
+"""Jit-compiled train / evaluate / predict step builders.
+
+Counterpart of the reference worker's ``training_process`` /
+``forward_process`` (``worker/worker.py:713-755``): where the reference runs a
+TF2 ``GradientTape`` eagerly and ships gradients to a parameter server, here
+the whole step — forward, backward, optimizer apply — is one XLA program.
+Batches are padded to a static shape and carry a ``mask`` so partial final
+batches don't break compilation caching (XLA static-shape semantics).
+"""
+
+import inspect
+from functools import partial
+from typing import Callable, Dict
+
+import jax
+import jax.numpy as jnp
+
+
+def _call_loss(loss_fn, labels, predictions, mask):
+    """Call the user loss; pass the padding mask iff it accepts 3 args."""
+    try:
+        nparams = len(inspect.signature(loss_fn).parameters)
+    except (TypeError, ValueError):
+        nparams = 2
+    if nparams >= 3:
+        return loss_fn(labels, predictions, mask)
+    return loss_fn(labels, predictions)
+
+
+def _apply_model(state, params, batch, training, rng):
+    variables = {"params": params}
+    has_batch_stats = bool(state.batch_stats)
+    if has_batch_stats:
+        variables["batch_stats"] = state.batch_stats
+    mutable = ["batch_stats"] if (training and has_batch_stats) else False
+    out = state.apply_fn(
+        variables,
+        batch["features"],
+        training=training,
+        rngs={"dropout": rng} if rng is not None else None,
+        mutable=mutable,
+    )
+    if mutable:
+        preds, updates = out
+        return preds, updates.get("batch_stats", state.batch_stats)
+    return out, state.batch_stats
+
+
+def build_train_step(loss_fn: Callable) -> Callable:
+    """Build ``(state, batch) -> (state, metrics)``, jitted.
+
+    The returned function is pure and jit/pjit-compatible: the mesh layer
+    (parallel/) wraps it with sharding constraints unchanged.
+    """
+
+    def train_step(state, batch):
+        state, rng = state.next_rng()
+
+        def compute_loss(params):
+            preds, new_batch_stats = _apply_model(
+                state, params, batch, training=True, rng=rng
+            )
+            loss = _call_loss(loss_fn, batch["labels"], preds, batch["mask"])
+            return loss, (preds, new_batch_stats)
+
+        grad_fn = jax.value_and_grad(compute_loss, has_aux=True)
+        (loss, (_, new_batch_stats)), grads = grad_fn(state.params)
+        # Padded rows are masked out of the loss but BatchNorm would still
+        # fold them into running stats — keep the old stats for any batch
+        # that contains padding.
+        if state.batch_stats:
+            is_full = jnp.all(batch["mask"] > 0)
+            new_batch_stats = jax.tree.map(
+                lambda new, old: jnp.where(is_full, new, old),
+                new_batch_stats, state.batch_stats,
+            )
+        new_state = state.apply_gradients(
+            grads=grads, batch_stats=new_batch_stats
+        )
+        return new_state, {"loss": loss}
+
+    return jax.jit(train_step, donate_argnums=(0,))
+
+
+def build_grad_step(loss_fn: Callable) -> Callable:
+    """Build ``(state, batch) -> (grads, metrics)`` without applying.
+
+    Used by the accumulation path (reference sync-SGD ``grads_to_wait``
+    semantics, ps/servicer.py:151-214) and by SSP local updates.
+    """
+
+    def grad_step(state, batch, rng):
+        def compute_loss(params):
+            preds, _ = _apply_model(
+                state, params, batch, training=True, rng=rng
+            )
+            return _call_loss(loss_fn, batch["labels"], preds, batch["mask"])
+
+        loss, grads = jax.value_and_grad(compute_loss)(state.params)
+        return grads, {"loss": loss}
+
+    return jax.jit(grad_step)
+
+
+def build_eval_step() -> Callable:
+    """Build ``(state, batch) -> predictions`` (reference forward_process)."""
+
+    def eval_step(state, batch):
+        preds, _ = _apply_model(
+            state, state.params, batch, training=False, rng=None
+        )
+        return preds
+
+    return jax.jit(eval_step)
+
+
+def build_apply_gradients() -> Callable:
+    @partial(jax.jit, donate_argnums=(0,))
+    def apply_step(state, grads, lr_scale):
+        scaled = jax.tree.map(lambda g: g * lr_scale, grads)
+        return state.apply_gradients(grads=scaled)
+
+    return apply_step
+
+
+def tree_add(a, b):
+    return jax.tree.map(jnp.add, a, b)
+
+
+def tree_scale(tree, scale):
+    return jax.tree.map(lambda x: x * scale, tree)
+
+
+def tree_zeros_like(tree):
+    return jax.tree.map(jnp.zeros_like, tree)
+
+
+def evaluate_metrics(
+    metrics_fns: Dict[str, Callable], labels, predictions
+) -> Dict[str, float]:
+    """Apply stateless metric fns to accumulated raw outputs.
+
+    Counterpart of the reference's master-side metric computation over
+    worker-reported raw outputs (common/evaluation_utils.py:50-97).
+    """
+    out = {}
+    for name, fn in metrics_fns.items():
+        out[name] = float(fn(labels, predictions))
+    return out

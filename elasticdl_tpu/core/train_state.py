@@ -1,0 +1,78 @@
+"""Training state: params + optimizer state + model version.
+
+The reference scatters this state across parameter-server pods
+(``ps/parameters.py``); here it is a single pytree the mesh shards. The
+``step`` field doubles as the reference's *model version* counter
+(``ps/servicer.py`` version semantics): one sync apply == one version.
+"""
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+
+
+class TrainState(struct.PyTreeNode):
+    step: jnp.ndarray
+    apply_fn: Callable = struct.field(pytree_node=False)
+    params: Any
+    batch_stats: Any
+    tx: optax.GradientTransformation = struct.field(pytree_node=False)
+    opt_state: Any
+    rng: jax.Array
+
+    @property
+    def version(self):
+        """Model version == number of optimizer applies (reference semantics)."""
+        return self.step
+
+    def apply_gradients(self, *, grads, **kwargs):
+        updates, new_opt_state = self.tx.update(
+            grads, self.opt_state, self.params
+        )
+        new_params = optax.apply_updates(self.params, updates)
+        return self.replace(
+            step=self.step + 1,
+            params=new_params,
+            opt_state=new_opt_state,
+            **kwargs,
+        )
+
+    def next_rng(self):
+        new_rng, sub = jax.random.split(self.rng)
+        return self.replace(rng=new_rng), sub
+
+    @classmethod
+    def create(cls, *, apply_fn, params, tx, batch_stats=None, seed: int = 0):
+        return cls(
+            step=jnp.zeros((), jnp.int32),
+            apply_fn=apply_fn,
+            params=params,
+            batch_stats=batch_stats if batch_stats is not None else {},
+            tx=tx,
+            opt_state=tx.init(params),
+            rng=jax.random.PRNGKey(seed),
+        )
+
+
+def init_train_state(
+    model,
+    tx,
+    example_batch,
+    seed: int = 0,
+    init_rng: Optional[jax.Array] = None,
+) -> TrainState:
+    """Initialize variables by tracing the model on one example batch."""
+    rng = init_rng if init_rng is not None else jax.random.PRNGKey(seed)
+    variables = model.init(
+        {"params": rng, "dropout": rng}, example_batch["features"],
+        training=False,
+    )
+    params = variables["params"]
+    batch_stats = variables.get("batch_stats", {})
+    return TrainState.create(
+        apply_fn=model.apply, params=params, tx=tx,
+        batch_stats=batch_stats, seed=seed,
+    )

@@ -1,0 +1,67 @@
+"""Static-shape batching for XLA.
+
+The reference feeds variable final batches into eager TF
+(worker/task_data_service.py → tf.data). XLA compiles one program per input
+shape, so this framework pads every batch to ``batch_size`` and carries a
+float ``mask`` (1.0 = real row, 0.0 = padding) that the loss and metrics
+weight by. Padding replicates row 0 so dtypes/shapes are trivially right.
+"""
+
+from typing import Any, Callable, Dict, Iterator, List, Optional
+
+import numpy as np
+
+
+def pad_batch(features, labels, actual: int, batch_size: int):
+    """Pad feature/label pytrees along axis 0 up to batch_size + build mask."""
+
+    def _pad(arr):
+        arr = np.asarray(arr)
+        if arr.shape[0] == batch_size:
+            return arr
+        pad_rows = np.repeat(arr[:1], batch_size - arr.shape[0], axis=0)
+        return np.concatenate([arr, pad_rows], axis=0)
+
+    import jax
+
+    mask = np.zeros((batch_size,), np.float32)
+    mask[:actual] = 1.0
+    return {
+        "features": jax.tree.map(_pad, features),
+        "labels": jax.tree.map(_pad, labels),
+        "mask": mask,
+    }
+
+
+def batch_records(
+    records: Iterator[Any],
+    batch_size: int,
+    dataset_fn: Callable,
+    mode: str,
+    metadata,
+    drop_remainder: bool = False,
+) -> Iterator[Dict[str, Any]]:
+    """Group raw records into padded, masked batches via the user dataset_fn.
+
+    ``dataset_fn(records, mode, metadata) -> (features, labels)`` converts a
+    list of raw payloads into numpy pytrees (the JAX-native analog of the
+    reference's tf.data map stage).
+    """
+    buf: List[Any] = []
+    for record in records:
+        buf.append(record)
+        if len(buf) == batch_size:
+            features, labels = dataset_fn(buf, mode, metadata)
+            yield pad_batch(features, labels, batch_size, batch_size)
+            buf = []
+    if buf and not drop_remainder:
+        features, labels = dataset_fn(buf, mode, metadata)
+        yield pad_batch(features, labels, len(buf), batch_size)
+
+
+def masked_mean(values, mask) -> Any:
+    """Mean over real rows only — helper for user losses/metrics."""
+    import jax.numpy as jnp
+
+    values = values * mask
+    return jnp.sum(values) / jnp.maximum(jnp.sum(mask), 1.0)

@@ -1,0 +1,73 @@
+"""MNIST CNN — the minimum end-to-end model-zoo workload.
+
+Counterpart of the reference's
+``model_zoo/mnist_functional_api/mnist_functional_api.py:9-17`` (Conv2D(32)
+→ Conv2D(64) → BatchNorm → MaxPool → Dense(10)), expressed as a flax module
+with bfloat16 compute for the MXU.
+"""
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from elasticdl_tpu.common import tensor_utils
+from elasticdl_tpu.common.constants import Mode
+from elasticdl_tpu.data.batcher import masked_mean
+
+
+class MnistModel(nn.Module):
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, features, training=False):
+        x = features.astype(self.compute_dtype)
+        if x.ndim == 3:
+            x = x[..., None]
+        x = nn.Conv(32, (3, 3), dtype=self.compute_dtype)(x)
+        x = nn.relu(x)
+        x = nn.Conv(64, (3, 3), dtype=self.compute_dtype)(x)
+        x = nn.relu(x)
+        x = nn.BatchNorm(
+            use_running_average=not training, dtype=self.compute_dtype
+        )(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(10, dtype=self.compute_dtype)(x)
+        return x.astype(jnp.float32)
+
+
+def custom_model():
+    return MnistModel()
+
+
+def loss(labels, predictions, mask):
+    per_example = optax.softmax_cross_entropy_with_integer_labels(
+        predictions, labels
+    )
+    return masked_mean(per_example, mask)
+
+
+def optimizer(lr=0.1):
+    return optax.sgd(lr, momentum=0.9)
+
+
+def dataset_fn(records, mode, metadata):
+    images, labels = [], []
+    for payload in records:
+        rec = tensor_utils.loads(payload)
+        images.append(np.asarray(rec["image"], np.float32) / 255.0)
+        labels.append(int(rec.get("label", 0)))
+    features = np.stack(images).astype(np.float32)
+    labels = np.asarray(labels, np.int32)
+    if mode == Mode.PREDICTION:
+        return features, np.zeros_like(labels)
+    return features, labels
+
+
+def eval_metrics_fn():
+    return {
+        "accuracy": lambda labels, outputs: float(
+            np.mean(np.argmax(outputs, axis=1) == labels)
+        )
+    }

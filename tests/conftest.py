@@ -1,0 +1,24 @@
+"""Test env: force an 8-device virtual CPU mesh before JAX initializes.
+
+Mesh/sharding logic must be testable without TPU hardware (SURVEY.md §7
+"hard parts" (a)); bench.py and real runs use the TPU backend instead.
+
+Note: the environment's sitecustomize registers the 'axon' TPU plugin and
+calls ``jax.config.update("jax_platforms", "axon,cpu")`` in every process,
+which overrides the JAX_PLATFORMS env var — so we must override the config
+back to cpu here, not just set the env var, or tests silently run on the
+TPU tunnel (and hang when it is unavailable).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

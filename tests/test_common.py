@@ -1,0 +1,152 @@
+"""Stage-1 unit tests: hashing, dtypes, serde, args, timing.
+
+Mirrors the reference's pure-unit layer (tests/hash_utils_test.py,
+tensor_utils_test.py, args_test.py).
+"""
+
+import numpy as np
+import pytest
+
+from elasticdl_tpu.common import dtypes, hash_utils, tensor_utils
+from elasticdl_tpu.common.args import (
+    build_arguments_from_parsed_result,
+    build_parser,
+    parse_envs,
+)
+from elasticdl_tpu.common.tensor_utils import IndexedSlices
+from elasticdl_tpu.common.timing import Timing
+
+
+class TestHashUtils:
+    def test_string_to_id_stable_and_in_range(self):
+        for n in (1, 2, 7, 64):
+            for name in ("dense/kernel", "dense/bias", "emb", ""):
+                a = hash_utils.string_to_id(name, n)
+                assert a == hash_utils.string_to_id(name, n)
+                assert 0 <= a < n
+
+    def test_string_to_id_spreads(self):
+        ids = {hash_utils.string_to_id(f"var_{i}", 8) for i in range(100)}
+        assert len(ids) == 8
+
+    def test_int_to_id(self):
+        assert hash_utils.int_to_id(13, 4) == 1
+        assert hash_utils.int_to_id(0, 4) == 0
+        with pytest.raises(ValueError):
+            hash_utils.int_to_id(1, 0)
+
+
+class TestDtypes:
+    def test_roundtrip(self):
+        for name in ("float32", "bfloat16", "int64", "bool"):
+            assert dtypes.dtype_name(dtypes.np_dtype(name)) == name
+
+    def test_sizes(self):
+        assert dtypes.dtype_size("bfloat16") == 2
+        assert dtypes.dtype_size("float64") == 8
+
+    def test_param_dtype_gate(self):
+        assert dtypes.is_allowed_param_dtype(np.float32)
+        assert not dtypes.is_allowed_param_dtype(np.int32)
+
+
+class TestTensorUtils:
+    def test_ndarray_roundtrip(self):
+        arr = np.random.rand(3, 4).astype(np.float32)
+        out = tensor_utils.loads(tensor_utils.dumps(arr))
+        np.testing.assert_array_equal(arr, out)
+
+    def test_bfloat16_roundtrip(self):
+        arr = np.arange(6, dtype=dtypes.np_dtype("bfloat16")).reshape(2, 3)
+        out = tensor_utils.loads(tensor_utils.dumps(arr))
+        assert out.dtype == dtypes.np_dtype("bfloat16")
+        np.testing.assert_array_equal(
+            arr.astype(np.float32), out.astype(np.float32)
+        )
+
+    def test_pytree_roundtrip(self):
+        tree = {
+            "dense": {"kernel": np.ones((2, 2), np.float32), "bias": 3},
+            "name": "model",
+            "ids": np.arange(5, dtype=np.int64),
+        }
+        out = tensor_utils.loads(tensor_utils.dumps(tree))
+        np.testing.assert_array_equal(out["dense"]["kernel"],
+                                      tree["dense"]["kernel"])
+        assert out["name"] == "model"
+        np.testing.assert_array_equal(out["ids"], tree["ids"])
+
+    def test_indexed_slices_roundtrip_and_merge(self):
+        s1 = IndexedSlices(np.ones((2, 3), np.float32),
+                           np.array([0, 5], np.int64))
+        s2 = IndexedSlices(2 * np.ones((1, 3), np.float32),
+                           np.array([5], np.int64))
+        merged = tensor_utils.merge_indexed_slices(s1, s2)
+        assert merged.values.shape == (3, 3)
+        out = tensor_utils.loads(tensor_utils.dumps(s1))
+        np.testing.assert_array_equal(out.ids, s1.ids)
+
+    def test_deduplicate_indexed_slices(self):
+        values = np.array([[1.0], [2.0], [4.0]], np.float32)
+        ids = np.array([5, 3, 5], np.int64)
+        summed, uids = tensor_utils.deduplicate_indexed_slices(values, ids)
+        np.testing.assert_array_equal(uids, [3, 5])
+        np.testing.assert_allclose(summed, [[2.0], [5.0]])
+
+    def test_flatten_unflatten(self):
+        tree = {"a": {"b": 1, "c": {"d": 2}}, "e": 3}
+        flat = tensor_utils.flatten_named(tree)
+        assert flat == {"a/b": 1, "a/c/d": 2, "e": 3}
+        assert tensor_utils.unflatten_named(flat) == tree
+
+
+class TestArgs:
+    def test_parse_envs(self):
+        assert parse_envs("a=1, b=x=y") == {"a": "1", "b": "x=y"}
+        assert parse_envs("") == {}
+        with pytest.raises(ValueError):
+            parse_envs("novalue")
+
+    def test_train_parser_and_reserialize(self):
+        argv = [
+            "--model_zoo", "mz", "--model_def", "m.f",
+            "--minibatch_size", "32", "--num_epochs", "2",
+            "--use_async", "true",
+        ]
+        args = build_parser("train").parse_args(argv)
+        assert args.minibatch_size == 32
+        assert args.use_async is True
+        rebuilt = build_arguments_from_parsed_result(
+            args, filter_args=["use_async"]
+        )
+        assert "--minibatch_size" in rebuilt
+        assert "--use_async" not in rebuilt
+        # Round-trip: the worker parser accepts the rebuilt args.
+        args2 = build_parser("worker").parse_args(
+            rebuilt + ["--worker_id", "0"]
+        )
+        assert args2.minibatch_size == 32
+
+    def test_worker_requires_id(self):
+        with pytest.raises(SystemExit):
+            build_parser("worker").parse_args(
+                ["--model_zoo", "a", "--model_def", "b.c",
+                 "--minibatch_size", "1"]
+            )
+
+
+class TestTiming:
+    def test_accumulates(self):
+        t = Timing(enabled=True)
+        with t.record("batch_process"):
+            pass
+        with t.record("batch_process"):
+            pass
+        s = t.summary()
+        assert s["batch_process"]["count"] == 2
+
+    def test_disabled_noop(self):
+        t = Timing(enabled=False)
+        with t.record("x"):
+            pass
+        assert t.summary() == {}

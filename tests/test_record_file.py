@@ -1,0 +1,114 @@
+"""RecordFile format + reader/factory/batcher tests."""
+
+import numpy as np
+import pytest
+
+from elasticdl_tpu.common.task import Task
+from elasticdl_tpu.data.batcher import batch_records, pad_batch
+from elasticdl_tpu.data.factory import (
+    create_data_reader,
+    parse_data_reader_params,
+)
+from elasticdl_tpu.data.reader import CSVDataReader, RecordFileDataReader
+from elasticdl_tpu.data.record_file import (
+    RecordFileScanner,
+    RecordFileWriter,
+    num_records_in_file,
+)
+from elasticdl_tpu.testing.data import create_iris_csv
+
+
+@pytest.fixture
+def record_path(tmp_path):
+    path = str(tmp_path / "data.rec")
+    with RecordFileWriter(path) as w:
+        for i in range(23):
+            w.write(f"record-{i}".encode())
+    return path
+
+
+class TestRecordFile:
+    def test_full_scan(self, record_path):
+        with RecordFileScanner(record_path) as s:
+            records = list(s)
+        assert records == [f"record-{i}".encode() for i in range(23)]
+
+    def test_seek_range(self, record_path):
+        with RecordFileScanner(record_path, start=10, count=5) as s:
+            records = list(s)
+        assert records == [f"record-{i}".encode() for i in range(10, 15)]
+
+    def test_range_past_end_clamped(self, record_path):
+        with RecordFileScanner(record_path, start=20, count=100) as s:
+            assert len(list(s)) == 3
+
+    def test_num_records(self, record_path):
+        assert num_records_in_file(record_path) == 23
+
+    def test_bad_magic(self, tmp_path):
+        path = str(tmp_path / "bad.rec")
+        with open(path, "wb") as f:
+            f.write(b"garbage-that-is-long-enough-to-have-a-footer")
+        with pytest.raises(ValueError):
+            RecordFileScanner(path)
+
+    def test_empty_file(self, tmp_path):
+        path = str(tmp_path / "empty.rec")
+        RecordFileWriter(path).close()
+        assert num_records_in_file(path) == 0
+        with RecordFileScanner(path) as s:
+            assert list(s) == []
+
+
+class TestReaders:
+    def test_record_reader_shards_and_read(self, record_path):
+        reader = RecordFileDataReader(data_origin=record_path)
+        shards = reader.create_shards()
+        assert shards == {record_path: (0, 23)}
+        task = Task(shard_name=record_path, start=5, end=8)
+        assert list(reader.read_records(task)) == [
+            b"record-5", b"record-6", b"record-7"
+        ]
+
+    def test_csv_reader(self, tmp_path):
+        path = create_iris_csv(str(tmp_path / "iris.csv"), 12)
+        reader = CSVDataReader(data_origin=path)
+        shards = reader.create_shards()
+        assert shards[path] == (0, 12)
+        task = Task(shard_name=path, start=0, end=3)
+        rows = list(reader.read_records(task))
+        assert len(rows) == 3
+        assert reader.metadata.column_names[0] == "sepal_length"
+
+    def test_factory_by_extension(self, tmp_path, record_path):
+        csv_path = create_iris_csv(str(tmp_path / "iris.csv"), 3)
+        assert isinstance(create_data_reader(csv_path), CSVDataReader)
+        assert isinstance(
+            create_data_reader(record_path), RecordFileDataReader
+        )
+
+    def test_parse_reader_params(self):
+        assert parse_data_reader_params("reader_type=CSV;sep=|") == {
+            "reader_type": "CSV", "sep": "|"
+        }
+
+
+class TestBatcher:
+    def test_pad_batch_masks(self):
+        features = np.ones((3, 4), np.float32)
+        labels = np.ones((3,), np.int32)
+        batch = pad_batch(features, labels, 3, 8)
+        assert batch["features"].shape == (8, 4)
+        assert batch["mask"].sum() == 3.0
+
+    def test_batch_records_final_partial(self):
+        def dataset_fn(records, mode, metadata):
+            arr = np.array([float(r) for r in records], np.float32)
+            return arr[:, None], (arr > 0).astype(np.int32)
+
+        batches = list(
+            batch_records(iter([b"1"] * 10), 4, dataset_fn, "training", None)
+        )
+        assert len(batches) == 3
+        assert all(b["features"].shape == (4, 1) for b in batches)
+        assert batches[-1]["mask"].sum() == 2.0
