@@ -1,0 +1,82 @@
+"""Benchmark: examples/sec/chip on the MNIST CNN training step.
+
+Prints ONE JSON line {"metric","value","unit","vs_baseline"}. The reference
+publishes no numbers (BASELINE.md), so the regression floor is this repo's
+own first TPU run, recorded in BENCH_FLOOR.json; until that file exists
+vs_baseline is 1.0 and the floor is written on a TPU run.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+
+BATCH = 512
+WARMUP_STEPS = 5
+MEASURE_STEPS = 30
+FLOOR_FILE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "BENCH_FLOOR.json")
+
+
+def main():
+    import jax
+
+    from elasticdl_tpu.core.model_spec import get_model_spec
+    from elasticdl_tpu.core.step import build_train_step
+    from elasticdl_tpu.core.train_state import init_train_state
+    from elasticdl_tpu.testing.data import model_zoo_dir
+
+    platform = jax.devices()[0].platform
+    spec = get_model_spec(
+        model_zoo_dir(), "mnist.mnist_functional.custom_model"
+    )
+    rng = np.random.RandomState(0)
+    batch = {
+        "features": rng.rand(BATCH, 28, 28).astype(np.float32) * 255.0,
+        "labels": rng.randint(0, 10, BATCH).astype(np.int32),
+        "mask": np.ones((BATCH,), np.float32),
+    }
+    state = init_train_state(
+        spec.model, spec.make_optimizer(), batch, seed=0
+    )
+    step = build_train_step(spec.loss)
+
+    for _ in range(WARMUP_STEPS):
+        state, metrics = step(state, batch)
+    jax.block_until_ready(state.params)
+
+    start = time.perf_counter()
+    for _ in range(MEASURE_STEPS):
+        state, metrics = step(state, batch)
+    jax.block_until_ready(state.params)
+    elapsed = time.perf_counter() - start
+
+    examples_per_sec = BATCH * MEASURE_STEPS / elapsed
+    vs_baseline = 1.0
+    floor = None
+    if os.path.exists(FLOOR_FILE):
+        try:
+            with open(FLOOR_FILE) as f:
+                floor = json.load(f).get("examples_per_sec")
+        except Exception:
+            floor = None
+    if floor:
+        vs_baseline = examples_per_sec / floor
+    elif platform != "cpu":
+        with open(FLOOR_FILE, "w") as f:
+            json.dump(
+                {"examples_per_sec": examples_per_sec,
+                 "platform": platform, "batch": BATCH},
+                f,
+            )
+    print(json.dumps({
+        "metric": f"mnist_cnn_train_examples_per_sec_per_chip[{platform}]",
+        "value": round(examples_per_sec, 2),
+        "unit": "examples/sec/chip",
+        "vs_baseline": round(vs_baseline, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,160 @@
+"""Msgpack-over-gRPC control-plane RPC.
+
+The reference ships protobuf messages over gRPC (elasticdl.proto Master /
+Pserver services). This framework's control messages (tasks, versions,
+metrics) are tiny dicts, so instead of generated proto classes it uses
+gRPC's generic handler API with the framework's msgpack serde
+(common/tensor_utils.py) — same wire substrate, no codegen step, and
+ndarrays (eval raw outputs) ride the same encoding as checkpoints.
+
+Server: ``RpcServer(addr, {service_name: {method: handler}})``.
+Client: ``RpcStub(addr, service_name).call(method, **fields)``.
+Handlers take and return plain dicts. Errors raise ``RpcError`` client-side.
+"""
+
+import threading
+from concurrent import futures
+from typing import Callable, Dict, Optional
+
+import grpc
+
+from elasticdl_tpu.common import tensor_utils
+from elasticdl_tpu.common.constants import GRPC
+
+_CHANNEL_OPTIONS = [
+    ("grpc.max_send_message_length", GRPC.MAX_SEND_MESSAGE_LENGTH),
+    ("grpc.max_receive_message_length", GRPC.MAX_RECEIVE_MESSAGE_LENGTH),
+]
+
+
+class RpcError(RuntimeError):
+    pass
+
+
+def _serialize(obj: dict) -> bytes:
+    return tensor_utils.dumps(obj)
+
+
+def _deserialize(data: bytes) -> dict:
+    return tensor_utils.loads(data)
+
+
+class _GenericService(grpc.GenericRpcHandler):
+    def __init__(self, service_name: str, handlers: Dict[str, Callable]):
+        self._service_name = service_name
+        self._handlers = handlers
+
+    def service(self, handler_call_details):
+        # Path format: /<service_name>/<method>
+        parts = handler_call_details.method.lstrip("/").split("/")
+        if len(parts) != 2 or parts[0] != self._service_name:
+            return None
+        method = parts[1]
+        handler = self._handlers.get(method)
+        if handler is None:
+            return None
+
+        def unary_unary(request: dict, context):
+            try:
+                response = handler(request)
+                return response if response is not None else {}
+            except Exception as exc:  # surface handler errors to the client
+                context.abort(
+                    grpc.StatusCode.INTERNAL,
+                    f"{type(exc).__name__}: {exc}",
+                )
+
+        return grpc.unary_unary_rpc_method_handler(
+            unary_unary,
+            request_deserializer=_deserialize,
+            response_serializer=_serialize,
+        )
+
+
+class RpcServer:
+    def __init__(
+        self,
+        addr: str,
+        services: Dict[str, Dict[str, Callable]],
+        max_workers: int = 64,
+    ):
+        """``services`` maps service name -> {method name -> handler}."""
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=max_workers),
+            handlers=[
+                _GenericService(name, handlers)
+                for name, handlers in services.items()
+            ],
+            options=_CHANNEL_OPTIONS,
+        )
+        self.port = self._server.add_insecure_port(addr)
+        if self.port == 0:
+            raise RuntimeError(f"Could not bind RPC server to {addr}")
+
+    def start(self):
+        self._server.start()
+        return self
+
+    def stop(self, grace: Optional[float] = None):
+        self._server.stop(grace)
+
+    def wait(self):
+        self._server.wait_for_termination()
+
+
+def build_channel(addr: str) -> grpc.Channel:
+    return grpc.insecure_channel(addr, options=_CHANNEL_OPTIONS)
+
+
+class RpcStub:
+    """Client for one service on one channel; thread-safe."""
+
+    def __init__(self, target, service_name: str):
+        if isinstance(target, str):
+            self._channel = build_channel(target)
+            self._owns_channel = True
+        else:
+            self._channel = target
+            self._owns_channel = False
+        self._service_name = service_name
+        self._methods = {}
+        self._lock = threading.Lock()
+
+    def _method(self, name: str):
+        with self._lock:
+            if name not in self._methods:
+                self._methods[name] = self._channel.unary_unary(
+                    f"/{self._service_name}/{name}",
+                    request_serializer=_serialize,
+                    response_deserializer=_deserialize,
+                )
+            return self._methods[name]
+
+    def call(self, method: str, timeout: Optional[float] = None, **fields):
+        try:
+            return self._method(method)(fields, timeout=timeout)
+        except grpc.RpcError as exc:
+            raise RpcError(
+                f"{self._service_name}.{method} failed: "
+                f"{exc.code().name}: {exc.details()}"
+            ) from exc
+
+    def close(self):
+        if self._owns_channel:
+            self._channel.close()
+
+
+def wait_for_channel_ready(addr: str, timeout: float = 300.0,
+                           retries: int = 3):
+    """Block until the server is reachable (reference worker/main.py:8-59
+    connects master with 3×300s retries)."""
+    last_exc = None
+    for _ in range(retries):
+        channel = build_channel(addr)
+        try:
+            grpc.channel_ready_future(channel).result(timeout=timeout)
+            return channel
+        except grpc.FutureTimeoutError as exc:
+            last_exc = exc
+            channel.close()
+    raise TimeoutError(f"Channel to {addr} not ready: {last_exc}")

@@ -1,0 +1,187 @@
+"""Master-side evaluation: triggers + metric accumulation.
+
+Counterpart of the reference's ``master/evaluation_service.py``:
+- step-based trigger: every ``eval_steps`` model versions (reported by the
+  training plane via ``report_version``) a batch of EVALUATION tasks is
+  queued (reference :171-186),
+- time-based trigger: a thread queues eval jobs every ``throttle_secs``
+  after ``start_delay_secs`` (reference ``_EvaluationTrigger`` :52-85),
+- workers report *raw model outputs and labels*; metrics are computed on
+  the master (reference evaluation_utils.py:50-97) in chunks.
+"""
+
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+from elasticdl_tpu.common.constants import TaskType
+from elasticdl_tpu.common.log_utils import get_logger
+
+logger = get_logger("evaluation_service")
+
+# Metric update chunk size (reference evaluation_utils.py:83-97 uses 500 to
+# bound per-call memory).
+_CHUNK = 500
+
+
+class EvaluationMetrics:
+    """Accumulates raw outputs/labels and computes metric fns lazily."""
+
+    def __init__(self, metrics_fns: Dict[str, Callable]):
+        self._metrics_fns = metrics_fns
+        self._outputs = []
+        self._labels = []
+
+    def update(self, outputs, labels):
+        outputs = np.asarray(outputs)
+        labels = np.asarray(labels)
+        for i in range(0, outputs.shape[0], _CHUNK):
+            self._outputs.append(outputs[i:i + _CHUNK])
+            self._labels.append(labels[i:i + _CHUNK])
+
+    def result(self) -> Dict[str, float]:
+        if not self._outputs:
+            return {}
+        outputs = np.concatenate(self._outputs, axis=0)
+        labels = np.concatenate(self._labels, axis=0)
+        return {
+            name: float(fn(labels, outputs))
+            for name, fn in self._metrics_fns.items()
+        }
+
+
+class EvaluationJob:
+    """One evaluation round at one model version (reference :11-49)."""
+
+    def __init__(self, metrics_fns: Dict[str, Callable], model_version: int,
+                 total_tasks: int = -1):
+        self.model_version = model_version
+        self._total_tasks = total_tasks
+        self._completed_tasks = 0
+        self.evaluation_metrics = EvaluationMetrics(metrics_fns)
+
+    def complete_task(self):
+        self._completed_tasks += 1
+
+    def finished(self) -> bool:
+        return (
+            self._total_tasks >= 0
+            and self._completed_tasks >= self._total_tasks
+        )
+
+    def report_evaluation_metrics(self, outputs, labels):
+        self.evaluation_metrics.update(outputs, labels)
+
+
+class EvaluationService:
+    def __init__(
+        self,
+        task_dispatcher,
+        metrics_fns: Dict[str, Callable],
+        eval_steps: int = 0,
+        start_delay_secs: int = 0,
+        throttle_secs: int = 0,
+        eval_only: bool = False,
+        summary_writer=None,
+    ):
+        self._task_d = task_dispatcher
+        self._metrics_fns = metrics_fns or {}
+        self._eval_steps = eval_steps
+        self._start_delay_secs = start_delay_secs
+        self._throttle_secs = throttle_secs
+        self._eval_only = eval_only
+        self._summary_writer = summary_writer
+        self._lock = threading.Lock()
+        self._eval_job: Optional[EvaluationJob] = None
+        self._last_eval_version = -1
+        self.completed_results: Dict[int, Dict[str, float]] = {}
+        self._trigger_thread = None
+        self._stop = threading.Event()
+        if eval_only:
+            # Evaluation-only jobs: the dispatcher queued the EVALUATION
+            # tasks at construction; open the job that will collect their
+            # results (reference evaluation_service.py init_eval_only path).
+            self._eval_job = EvaluationJob(
+                self._metrics_fns, model_version=-1,
+                total_tasks=self._count_eval_tasks(),
+            )
+
+    # ---- triggers ------------------------------------------------------
+
+    def start_time_trigger(self):
+        """Time-based eval trigger thread (reference _EvaluationTrigger)."""
+        if self._throttle_secs <= 0:
+            return
+
+        def _loop():
+            time.sleep(self._start_delay_secs)
+            while not self._stop.is_set():
+                self.try_to_create_new_job(model_version=-1)
+                if self._stop.wait(self._throttle_secs):
+                    return
+
+        self._trigger_thread = threading.Thread(target=_loop, daemon=True)
+        self._trigger_thread.start()
+
+    def stop(self):
+        self._stop.set()
+
+    def add_evaluation_task_if_needed(self, model_version: int):
+        """Step-based trigger, called on report_version
+        (reference evaluation_service.py:171-186)."""
+        if self._eval_steps <= 0:
+            return False
+        # Elapsed-steps check rather than exact modulo: workers may report
+        # versions at a coarser granularity than every step.
+        if model_version - max(self._last_eval_version, 0) >= self._eval_steps:
+            return self.try_to_create_new_job(model_version)
+        return False
+
+    def try_to_create_new_job(self, model_version: int) -> bool:
+        with self._lock:
+            if self._eval_job is not None and not self._eval_job.finished():
+                return False  # previous round still running
+            num_tasks = self._count_eval_tasks()
+            if num_tasks == 0:
+                return False
+            self._eval_job = EvaluationJob(
+                self._metrics_fns, model_version, total_tasks=num_tasks
+            )
+            self._last_eval_version = model_version
+        self._task_d.create_tasks(TaskType.EVALUATION, model_version)
+        return True
+
+    def _count_eval_tasks(self) -> int:
+        shards = self._task_d._shards_for(TaskType.EVALUATION)
+        per_task = self._task_d._records_per_task
+        count = 0
+        for _name, (_start, n) in shards.items():
+            count += (n + per_task - 1) // per_task
+        return count
+
+    # ---- worker reports ------------------------------------------------
+
+    def report_evaluation_metrics(self, outputs, labels) -> bool:
+        with self._lock:
+            if self._eval_job is None:
+                return False
+            self._eval_job.report_evaluation_metrics(outputs, labels)
+            return True
+
+    def complete_task(self) -> Optional[Dict[str, float]]:
+        with self._lock:
+            if self._eval_job is None:
+                return None
+            self._eval_job.complete_task()
+            if not self._eval_job.finished():
+                return None
+            results = self._eval_job.evaluation_metrics.result()
+            version = self._eval_job.model_version
+            self.completed_results[version] = results
+            self._eval_job = None
+        logger.info("Eval @version %d: %s", version, results)
+        if self._summary_writer is not None:
+            self._summary_writer.write_eval_metrics(version, results)
+        return results

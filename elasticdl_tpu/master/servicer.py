@@ -1,0 +1,139 @@
+"""Master service handlers.
+
+Counterpart of the reference's ``master/servicer.py`` (MasterServicer): the
+four control RPCs — get_task, report_task_result, report_evaluation_metrics,
+report_version — plus worker-liveness and mean-task-time tracking used for
+timeout-based straggler detection (reference servicer.py:107-124).
+
+Handlers take/return plain dicts (see comm/rpc.py); ``InProcessMaster`` in
+testing/ calls them directly, the RpcServer serves them over gRPC.
+"""
+
+import threading
+import time
+from typing import Dict, Optional
+
+from elasticdl_tpu.common.constants import TaskType
+from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.common.task import Task
+
+logger = get_logger("master_servicer")
+
+SERVICE_NAME = "elasticdl_tpu.Master"
+
+
+class MasterServicer:
+    def __init__(self, task_dispatcher, evaluation_service=None,
+                 task_timeout_secs: float = 300.0):
+        self._task_d = task_dispatcher
+        self._eval_service = evaluation_service
+        self._lock = threading.Lock()
+        self._worker_liveness: Dict[int, float] = {}
+        # Running mean of task duration, for straggler detection
+        # (reference servicer.py:107-121: default 300s until enough data).
+        self._default_task_secs = task_timeout_secs
+        self._task_secs_sum = 0.0
+        self._task_count = 0
+        self._task_start_times: Dict[int, float] = {}
+        self.model_version = 0
+
+    # ---- handler table -------------------------------------------------
+
+    def handlers(self):
+        return {
+            "get_task": self.get_task,
+            "report_task_result": self.report_task_result,
+            "report_evaluation_metrics": self.report_evaluation_metrics,
+            "report_version": self.report_version,
+            "ping": lambda req: {"ok": True},
+        }
+
+    # ---- RPC handlers --------------------------------------------------
+
+    def get_task(self, request: dict) -> dict:
+        worker_id = int(request.get("worker_id", -1))
+        self._record_liveness(worker_id)
+        task = self._task_d.get(worker_id)
+        if task is not None:
+            with self._lock:
+                self._task_start_times[task.task_id] = time.time()
+            return {"task": task.to_dict(), "finished": False}
+        if self._task_d.finished():
+            return {"task": None, "finished": True}
+        # Queue temporarily empty (doing tasks may re-queue on failure):
+        # tell the worker to wait (reference servicer.py:60-68).
+        wait = Task(task_id=-1, type=TaskType.WAIT)
+        return {"task": wait.to_dict(), "finished": False}
+
+    def report_task_result(self, request: dict) -> dict:
+        task_id = int(request["task_id"])
+        err_reason = request.get("err_reason", "")
+        success = not err_reason
+        with self._lock:
+            start = self._task_start_times.pop(task_id, None)
+            if success and start is not None:
+                self._task_secs_sum += time.time() - start
+                self._task_count += 1
+        task, _worker, requeued = self._task_d.report(
+            task_id, success, err_reason
+        )
+        # An eval task counts toward its EvaluationJob when it succeeds OR
+        # fails permanently (dropped after retry cap) — otherwise one bad
+        # eval shard would wedge the evaluation service forever.
+        if (
+            task is not None
+            and not requeued
+            and task.type == TaskType.EVALUATION
+            and self._eval_service is not None
+        ):
+            self._eval_service.complete_task()
+        return {"accepted": task is not None}
+
+    def report_evaluation_metrics(self, request: dict) -> dict:
+        if self._eval_service is None:
+            return {"accepted": False}
+        ok = self._eval_service.report_evaluation_metrics(
+            request["model_outputs"], request["labels"]
+        )
+        return {"accepted": ok}
+
+    def report_version(self, request: dict) -> dict:
+        version = int(request["model_version"])
+        worker_id = int(request.get("worker_id", -1))
+        self._record_liveness(worker_id)
+        with self._lock:
+            self.model_version = max(self.model_version, version)
+        self._task_d.record_worker_version(worker_id, version)
+        if self._eval_service is not None:
+            self._eval_service.add_evaluation_task_if_needed(version)
+        return {"ok": True}
+
+    # ---- liveness / straggler detection --------------------------------
+
+    def _record_liveness(self, worker_id: int):
+        if worker_id >= 0:
+            with self._lock:
+                self._worker_liveness[worker_id] = time.time()
+
+    def worker_liveness(self) -> Dict[int, float]:
+        with self._lock:
+            return dict(self._worker_liveness)
+
+    def average_task_secs(self) -> float:
+        with self._lock:
+            if self._task_count < 3:
+                return self._default_task_secs
+            return self._task_secs_sum / self._task_count
+
+    def find_timeout_tasks(self, factor: float = 3.0):
+        """(task_id, worker_id) pairs running > factor × mean task time
+        (reference master.py:487-509 _check_timeout_tasks)."""
+        threshold = factor * self.average_task_secs()
+        now = time.time()
+        out = []
+        for task_id, (worker_id, start) in (
+            self._task_d.doing_start_times().items()
+        ):
+            if now - start > threshold:
+                out.append((task_id, worker_id))
+        return out

@@ -1,0 +1,256 @@
+"""Dynamic data sharding: the master's task queues.
+
+Counterpart of the reference's ``elasticdl/python/master/task_dispatcher.py``
+(``_TaskDispatcher``): shards are split into tasks of
+``records_per_task`` records; workers pull tasks from ``todo``, the master
+tracks them in ``doing``; failed/dead-worker tasks are re-queued with a
+retry cap; training tasks regenerate per epoch; when all training work is
+done a deferred TRAIN_END_CALLBACK task is created (reference
+task_dispatcher.py:206-241). This mechanism — not checkpoint-restart — is
+what makes preemption cheap.
+"""
+
+import dataclasses
+import random
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from elasticdl_tpu.common.constants import MAX_TASK_RETRIES, TaskType
+from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.common.task import Task
+
+logger = get_logger("task_dispatcher")
+
+
+class JobCounters:
+    """Per-task-type record counters (reference task_dispatcher.py:40-61)."""
+
+    def __init__(self):
+        self.total_records = {}
+        self.failed_records = {}
+
+    def add_completed(self, task_type: str, n: int):
+        self.total_records[task_type] = (
+            self.total_records.get(task_type, 0) + n
+        )
+
+    def add_failed(self, task_type: str, n: int):
+        self.failed_records[task_type] = (
+            self.failed_records.get(task_type, 0) + n
+        )
+
+
+class TaskDispatcher:
+    def __init__(
+        self,
+        training_shards: Dict[str, Tuple[int, int]],
+        evaluation_shards: Optional[Dict[str, Tuple[int, int]]] = None,
+        prediction_shards: Optional[Dict[str, Tuple[int, int]]] = None,
+        records_per_task: int = 64,
+        num_epochs: int = 1,
+        shuffle: bool = True,
+        seed: int = 0,
+    ):
+        self._lock = threading.Lock()
+        self._training_shards = dict(training_shards or {})
+        self._evaluation_shards = dict(evaluation_shards or {})
+        self._prediction_shards = dict(prediction_shards or {})
+        self._records_per_task = records_per_task
+        self._epochs_todo = num_epochs
+        self._shuffle = shuffle
+        self._rng = random.Random(seed)
+
+        self._todo: List[Task] = []
+        # task_id -> (task, worker_id, start_time)
+        self._doing: Dict[int, Tuple[Task, int, float]] = {}
+        self._task_id = 0
+        self._task_retry_count: Dict[str, int] = {}
+        self._deferred_callbacks: List[Callable] = []
+        self._worker_version: Dict[int, int] = {}
+        self.counters = JobCounters()
+
+        if self._training_shards:
+            self.create_tasks(TaskType.TRAINING)
+            self._epochs_todo -= 1
+        elif self._evaluation_shards:
+            self.create_tasks(TaskType.EVALUATION)
+        elif self._prediction_shards:
+            self.create_tasks(TaskType.PREDICTION)
+
+    # ---- task creation -------------------------------------------------
+
+    def _shards_for(self, task_type: str) -> Dict[str, Tuple[int, int]]:
+        return {
+            TaskType.TRAINING: self._training_shards,
+            TaskType.EVALUATION: self._evaluation_shards,
+            TaskType.PREDICTION: self._prediction_shards,
+        }[task_type]
+
+    def _build_tasks(self, task_type: str,
+                     model_version: int = -1) -> List[Task]:
+        """Split shards into records_per_task-sized tasks (pure; shared by
+        initial creation and per-epoch regeneration)."""
+        tasks = []
+        for shard_name, (start, count) in self._shards_for(
+            task_type
+        ).items():
+            for begin in range(start, start + count,
+                               self._records_per_task):
+                end = min(begin + self._records_per_task, start + count)
+                tasks.append(
+                    Task(
+                        shard_name=shard_name,
+                        start=begin,
+                        end=end,
+                        type=task_type,
+                        model_version=model_version,
+                    )
+                )
+        if self._shuffle and task_type == TaskType.TRAINING:
+            self._rng.shuffle(tasks)
+        return tasks
+
+    def create_tasks(self, task_type: str, model_version: int = -1):
+        """Split shards into tasks and queue them
+        (reference task_dispatcher.py:134-204)."""
+        with self._lock:
+            tasks = self._build_tasks(task_type, model_version)
+            if task_type == TaskType.EVALUATION:
+                # Eval tasks jump the queue so they run close to the version
+                # that triggered them (reference prepends eval tasks).
+                self._todo = tasks + self._todo
+            else:
+                self._todo.extend(tasks)
+            logger.info("Created %d %s tasks", len(tasks), task_type)
+
+    def add_deferred_callback(self, callback: Callable):
+        with self._lock:
+            self._deferred_callbacks.append(callback)
+
+    def create_train_end_callback_task(self):
+        """One final task so a worker can run callbacks_list.on_train_end
+        (reference task_dispatcher.py:206-241)."""
+        with self._lock:
+            if not self._training_shards:
+                return
+            name = next(iter(self._training_shards))
+            self._todo.append(
+                Task(shard_name=name, start=0, end=0,
+                     type=TaskType.TRAIN_END_CALLBACK)
+            )
+
+    # ---- worker-facing -------------------------------------------------
+
+    def get(self, worker_id: int) -> Optional[Task]:
+        """Pop a task for a worker; None when nothing is available
+        (the servicer converts None into a WAIT task while unfinished)."""
+        with self._lock:
+            if not self._todo and self._epochs_todo > 0 and (
+                self._training_shards
+            ):
+                self._create_training_tasks_locked()
+                self._epochs_todo -= 1
+            if not self._todo:
+                return None
+            task = self._todo.pop(0)
+            self._task_id += 1
+            task.task_id = self._task_id
+            self._doing[task.task_id] = (task, worker_id, time.time())
+            return task
+
+    def _create_training_tasks_locked(self):
+        tasks = self._build_tasks(TaskType.TRAINING)
+        self._todo.extend(tasks)
+        logger.info("Created %d training tasks (new epoch)", len(tasks))
+
+    def report(self, task_id: int, success: bool,
+               err_reason: str = "") -> Tuple[Optional[Task], int, bool]:
+        """Worker reports task completion (reference :286-350). Failed tasks
+        re-queue at the front, up to MAX_TASK_RETRIES per shard range.
+        Returns (task, worker_id, requeued)."""
+        callbacks = []
+        requeued = False
+        with self._lock:
+            entry = self._doing.pop(task_id, None)
+            if entry is None:
+                logger.warning("Unknown task id %d reported", task_id)
+                return None, -1, False
+            task, worker_id, _start = entry
+            if success:
+                self.counters.add_completed(task.type, task.num_records)
+            else:
+                key = f"{task.shard_name}:{task.start}:{task.end}"
+                retries = self._task_retry_count.get(key, 0) + 1
+                self._task_retry_count[key] = retries
+                if retries <= MAX_TASK_RETRIES:
+                    logger.info(
+                        "Task %d failed (%s), re-queueing (retry %d)",
+                        task_id, err_reason, retries,
+                    )
+                    # Fresh copy: the popped object is still referenced by
+                    # the reporting worker; re-dispatch must not mutate it.
+                    self._todo.insert(0, dataclasses.replace(task))
+                    requeued = True
+                else:
+                    self.counters.add_failed(task.type, task.num_records)
+                    logger.error(
+                        "Task %d failed permanently after %d retries (%s)",
+                        task_id, MAX_TASK_RETRIES, err_reason,
+                    )
+            epochs_pending = (
+                self._epochs_todo > 0 and bool(self._training_shards)
+            )
+            if (
+                not self._todo
+                and not self._doing
+                and not epochs_pending
+                and self._deferred_callbacks
+            ):
+                callbacks, self._deferred_callbacks = (
+                    self._deferred_callbacks, []
+                )
+        # Fired outside the lock: callbacks typically append new tasks
+        # (e.g. create_train_end_callback_task re-acquires the lock).
+        for cb in callbacks:
+            cb()
+        return task, worker_id, requeued
+
+    def recover_tasks(self, worker_id: int):
+        """Re-queue all doing tasks of a dead worker
+        (reference task_dispatcher.py:352-364)."""
+        with self._lock:
+            ids = [
+                tid for tid, (_t, wid, _s) in self._doing.items()
+                if wid == worker_id
+            ]
+        for tid in ids:
+            self.report(tid, False, err_reason="worker_dead")
+
+    # ---- status --------------------------------------------------------
+
+    def finished(self) -> bool:
+        with self._lock:
+            epochs_pending = (
+                self._epochs_todo > 0 and bool(self._training_shards)
+            )
+            return not self._todo and not self._doing and not epochs_pending
+
+    def doing_tasks_of(self, worker_id: int) -> List[int]:
+        with self._lock:
+            return [
+                tid for tid, (_t, wid, _s) in self._doing.items()
+                if wid == worker_id
+            ]
+
+    def doing_start_times(self) -> Dict[int, Tuple[int, float]]:
+        """task_id -> (worker_id, start_time) for timeout detection."""
+        with self._lock:
+            return {
+                tid: (wid, start)
+                for tid, (_t, wid, start) in self._doing.items()
+            }
+
+    def record_worker_version(self, worker_id: int, version: int):
+        with self._lock:
+            self._worker_version[worker_id] = version

@@ -1,0 +1,243 @@
+"""MeshRunner: SPMD train/eval steps over a device mesh.
+
+This is the TPU-native replacement for the reference's entire parameter-
+server data plane (``ps/servicer.py`` push/pull RPCs): the minibatch is
+sharded over the ``dp`` axis, parameters stay replicated, optimizer state
+is ZeRO-sharded over ``dp``, and XLA inserts the gradient all-reduce /
+reduce-scatter / param all-gather collectives over ICI inside one compiled
+step. The model "version" is the replicated step counter — there is no
+central store to push to or pull from, hence nothing to lose when a
+worker dies (recovery = sharded checkpoint + task re-queue, stage 5).
+
+Sync semantics map (SURVEY.md §2.7):
+- sync SGD ``grads_to_wait``  → ``accum_steps`` gradient accumulation,
+- async staleness LR modulation → ``lr_scale`` hook on the accumulated
+  apply (per-host accumulation + delayed sync is the principled mapping
+  of async SGD onto SPMD; documented rather than pretending RPC async),
+- SSP ``get_model_steps``      → planned local-apply window (stage 4+).
+"""
+
+from functools import partial
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from elasticdl_tpu.core import step as step_lib
+from elasticdl_tpu.core.train_state import TrainState, init_train_state
+from elasticdl_tpu.parallel import mesh as mesh_lib
+
+
+class MeshRunner:
+    """Implements the Worker ``step_runner`` interface over a Mesh."""
+
+    def __init__(
+        self,
+        mesh: Optional[Mesh] = None,
+        data_axis: str = "dp",
+        accum_steps: int = 1,
+        donate_state: bool = True,
+    ):
+        self.mesh = mesh if mesh is not None else mesh_lib.make_mesh()
+        self.data_axis = data_axis
+        self.accum_steps = accum_steps
+        self._donate_state = donate_state
+        self._state_shardings = None
+
+    # ---- sharding rules ------------------------------------------------
+
+    def _batch_sharding(self):
+        return mesh_lib.batch_sharding(self.mesh, self.data_axis)
+
+    def _shard_batch_tree(self, batch):
+        sharding = self._batch_sharding()
+        return jax.tree.map(
+            lambda _: sharding, batch
+        )
+
+    def state_shardings(self, state: TrainState):
+        """Params/batch_stats/rng/step replicated; optimizer state
+        ZeRO-sharded over the data axis."""
+        replicated = mesh_lib.replicated(self.mesh)
+
+        def opt_leaf(leaf):
+            return mesh_lib.shard_leaf_over_axis(
+                self.mesh, leaf, self.data_axis
+            )
+
+        return state.replace(
+            step=replicated,
+            params=jax.tree.map(lambda _: replicated, state.params),
+            batch_stats=jax.tree.map(lambda _: replicated,
+                                     state.batch_stats),
+            opt_state=jax.tree.map(opt_leaf, state.opt_state),
+            rng=replicated,
+        )
+
+    # ---- runner interface ---------------------------------------------
+
+    def init_state(self, model, tx, example_batch, seed: int = 0):
+        """Initialize state already laid out on the mesh."""
+        state = init_train_state(model, tx, example_batch, seed=seed)
+        shardings = self.state_shardings(state)
+        self._state_shardings = shardings
+        return jax.device_put(state, shardings)
+
+    def place_batch(self, batch):
+        """Shard a host batch over the dp axis (leading dim)."""
+        return jax.device_put(batch, self._batch_sharding())
+
+    def train_step(self, loss_fn: Callable) -> Callable:
+        if self.accum_steps > 1:
+            return self._accum_train_step(loss_fn)
+        return self._plain_train_step(loss_fn)
+
+    def _plain_train_step(self, loss_fn: Callable) -> Callable:
+        base_step = self._build_step(loss_fn)
+        runner = self
+
+        def wrapped(state, batch):
+            batch = runner.place_batch(batch)
+            return base_step(state, batch)
+
+        return wrapped
+
+    def _build_step(self, loss_fn: Callable):
+        shardings = self._require_shardings()
+
+        def train_step(state, batch):
+            state, rng = state.next_rng()
+
+            def compute_loss(params):
+                preds, new_bs = step_lib._apply_model(
+                    state, params, batch, training=True, rng=rng
+                )
+                loss = step_lib._call_loss(
+                    loss_fn, batch["labels"], preds, batch["mask"]
+                )
+                return loss, new_bs
+
+            (loss, new_bs), grads = jax.value_and_grad(
+                compute_loss, has_aux=True
+            )(state.params)
+            if state.batch_stats:
+                is_full = jnp.all(batch["mask"] > 0)
+                new_bs = jax.tree.map(
+                    lambda new, old: jnp.where(is_full, new, old),
+                    new_bs, state.batch_stats,
+                )
+            new_state = state.apply_gradients(
+                grads=grads, batch_stats=new_bs
+            )
+            return new_state, {"loss": loss}
+
+        batch_shardings = None  # inferred from placed batch
+        return jax.jit(
+            train_step,
+            in_shardings=(shardings, batch_shardings),
+            out_shardings=(shardings, None),
+            donate_argnums=(0,) if self._donate_state else (),
+        )
+
+    def _accum_train_step(self, loss_fn: Callable):
+        """Gradient accumulation: the mesh-native mapping of the reference
+        sync-SGD ``grads_to_wait`` (ps/servicer.py:151-214). Each call
+        accumulates one microbatch; the optimizer applies every
+        ``accum_steps`` calls, scaled by 1/accum_steps."""
+        shardings = self._require_shardings()
+        accum_steps = self.accum_steps
+
+        def micro_step(carry, batch):
+            state, grad_acc, count = carry
+            state, rng = state.next_rng()
+
+            def compute_loss(params):
+                preds, new_bs = step_lib._apply_model(
+                    state, params, batch, training=True, rng=rng
+                )
+                loss = step_lib._call_loss(
+                    loss_fn, batch["labels"], preds, batch["mask"]
+                )
+                return loss, new_bs
+
+            (loss, new_bs), grads = jax.value_and_grad(
+                compute_loss, has_aux=True
+            )(state.params)
+            # BatchNorm stats update every microbatch (guarded against
+            # padded rows), independent of the delayed optimizer apply.
+            if state.batch_stats:
+                is_full = jnp.all(batch["mask"] > 0)
+                new_bs = jax.tree.map(
+                    lambda new, old: jnp.where(is_full, new, old),
+                    new_bs, state.batch_stats,
+                )
+                state = state.replace(batch_stats=new_bs)
+            grad_acc = jax.tree.map(jnp.add, grad_acc, grads)
+            count = count + 1
+
+            def apply(args):
+                state, grad_acc, count = args
+                mean_grads = jax.tree.map(
+                    lambda g: g / accum_steps, grad_acc
+                )
+                new_state = state.apply_gradients(grads=mean_grads)
+                zeros = jax.tree.map(jnp.zeros_like, grad_acc)
+                return new_state, zeros, jnp.zeros_like(count)
+
+            def keep(args):
+                return args
+
+            state, grad_acc, count = jax.lax.cond(
+                count >= accum_steps, apply, keep, (state, grad_acc, count)
+            )
+            return (state, grad_acc, count), loss
+
+        jit_micro = jax.jit(
+            micro_step,
+            donate_argnums=(0,) if self._donate_state else (),
+        )
+        runner = self
+        carry_box = {"grad_acc": None, "count": None}
+
+        def wrapped(state, batch):
+            batch = runner.place_batch(batch)
+            if carry_box["grad_acc"] is None:
+                carry_box["grad_acc"] = jax.device_put(
+                    jax.tree.map(jnp.zeros_like, state.params),
+                    jax.tree.map(lambda _: mesh_lib.replicated(runner.mesh),
+                                 state.params),
+                )
+                carry_box["count"] = jnp.zeros((), jnp.int32)
+            (state, grad_acc, count), loss = jit_micro(
+                (state, carry_box["grad_acc"], carry_box["count"]), batch
+            )
+            carry_box["grad_acc"] = grad_acc
+            carry_box["count"] = count
+            return state, {"loss": loss}
+
+        return wrapped
+
+    def eval_step(self) -> Callable:
+        shardings = self._require_shardings()
+        runner = self
+
+        def eval_step(state, batch):
+            preds, _ = step_lib._apply_model(
+                state, state.params, batch, training=False, rng=None
+            )
+            return preds
+
+        jitted = jax.jit(eval_step, in_shardings=(shardings, None))
+
+        def wrapped(state, batch):
+            return jitted(state, runner.place_batch(batch))
+
+        return wrapped
+
+    def _require_shardings(self):
+        if self._state_shardings is None:
+            raise RuntimeError(
+                "MeshRunner.init_state must run before building steps"
+            )
+        return self._state_shardings

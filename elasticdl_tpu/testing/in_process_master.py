@@ -1,0 +1,58 @@
+"""In-process master: servicer methods called directly, no network.
+
+Counterpart of the reference's ``tests/in_process_master.py:5-33`` — the
+worker's master client becomes direct calls into ``MasterServicer``, with
+optional test callbacks interposed per RPC.
+"""
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from elasticdl_tpu.common.task import Task
+
+
+class InProcessMaster:
+    def __init__(self, servicer, worker_id: int = 0, callbacks=None):
+        """``callbacks``: dict rpc_name -> fn(request_dict) invoked before
+        the real handler (used by tests to inject faults/asserts)."""
+        self._servicer = servicer
+        self._worker_id = worker_id
+        self._callbacks = callbacks or {}
+
+    def _call(self, name: str, request: dict) -> dict:
+        if name in self._callbacks:
+            self._callbacks[name](request)
+        return self._servicer.handlers()[name](request)
+
+    def get_task(self) -> Tuple[Optional[Task], bool]:
+        resp = self._call("get_task", {"worker_id": self._worker_id})
+        task = Task.from_dict(resp["task"]) if resp.get("task") else None
+        return task, bool(resp.get("finished"))
+
+    def report_task_result(self, task_id: int, err_reason: str = "") -> bool:
+        resp = self._call(
+            "report_task_result",
+            {"task_id": task_id, "err_reason": err_reason},
+        )
+        return bool(resp.get("accepted"))
+
+    def report_evaluation_metrics(self, model_outputs, labels) -> bool:
+        resp = self._call(
+            "report_evaluation_metrics",
+            {
+                "model_outputs": np.asarray(model_outputs),
+                "labels": np.asarray(labels),
+            },
+        )
+        return bool(resp.get("accepted"))
+
+    def report_version(self, model_version: int) -> None:
+        self._call(
+            "report_version",
+            {"model_version": int(model_version),
+             "worker_id": self._worker_id},
+        )
+
+    def close(self):
+        pass

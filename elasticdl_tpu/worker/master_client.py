@@ -1,0 +1,58 @@
+"""Worker-side client for the Master service.
+
+One interface, two transports: gRPC (`MasterClient`) for real jobs and
+direct method calls (`testing.in_process_master.InProcessMaster`) for the
+in-process test harness — the same trick the reference uses
+(tests/in_process_master.py:5-33) so every distributed path is drivable
+in one process.
+"""
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from elasticdl_tpu.common.task import Task
+from elasticdl_tpu.comm.rpc import RpcStub, wait_for_channel_ready
+from elasticdl_tpu.master.servicer import SERVICE_NAME
+
+
+class MasterClient:
+    def __init__(self, addr: str, worker_id: int,
+                 connect_timeout: float = 300.0, retries: int = 3):
+        # The channel is owned here (RpcStub only closes channels it
+        # created itself) — close() must release it.
+        self._channel = wait_for_channel_ready(
+            addr, timeout=connect_timeout, retries=retries
+        )
+        self._stub = RpcStub(self._channel, SERVICE_NAME)
+        self._worker_id = worker_id
+
+    def get_task(self) -> Tuple[Optional[Task], bool]:
+        resp = self._stub.call("get_task", worker_id=self._worker_id)
+        task = Task.from_dict(resp["task"]) if resp.get("task") else None
+        return task, bool(resp.get("finished"))
+
+    def report_task_result(self, task_id: int, err_reason: str = "") -> bool:
+        resp = self._stub.call(
+            "report_task_result", task_id=task_id, err_reason=err_reason
+        )
+        return bool(resp.get("accepted"))
+
+    def report_evaluation_metrics(self, model_outputs, labels) -> bool:
+        resp = self._stub.call(
+            "report_evaluation_metrics",
+            model_outputs=np.asarray(model_outputs),
+            labels=np.asarray(labels),
+        )
+        return bool(resp.get("accepted"))
+
+    def report_version(self, model_version: int) -> None:
+        self._stub.call(
+            "report_version",
+            model_version=int(model_version),
+            worker_id=self._worker_id,
+        )
+
+    def close(self):
+        self._stub.close()
+        self._channel.close()

@@ -1,0 +1,218 @@
+"""Master servicer + RPC transport + evaluation service tests.
+
+Mirrors the reference's in-process fakes pattern (tests/test_utils.py):
+the same servicer is driven both directly (InProcessMaster) and over a
+real localhost gRPC server (RpcServer/MasterClient).
+"""
+
+import numpy as np
+import pytest
+
+from elasticdl_tpu.common.constants import TaskType
+from elasticdl_tpu.comm.rpc import RpcError, RpcServer, RpcStub
+from elasticdl_tpu.master.evaluation_service import EvaluationService
+from elasticdl_tpu.master.servicer import SERVICE_NAME, MasterServicer
+from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+from elasticdl_tpu.testing.in_process_master import InProcessMaster
+from elasticdl_tpu.worker.master_client import MasterClient
+
+
+def make_servicer(records=30, per_task=10, eval_records=0, eval_steps=0,
+                  metrics_fns=None):
+    d = TaskDispatcher(
+        training_shards={"f1": (0, records)},
+        evaluation_shards={"e1": (0, eval_records)} if eval_records else None,
+        records_per_task=per_task,
+        num_epochs=1,
+        shuffle=False,
+    )
+    ev = EvaluationService(
+        d,
+        metrics_fns or {"mean_out": lambda labels, outputs: outputs.mean()},
+        eval_steps=eval_steps,
+    )
+    return MasterServicer(d, ev), d, ev
+
+
+class TestInProcessMaster:
+    def test_get_and_report(self):
+        servicer, d, _ = make_servicer()
+        master = InProcessMaster(servicer, worker_id=0)
+        task, finished = master.get_task()
+        assert task.type == TaskType.TRAINING and not finished
+        assert master.report_task_result(task.task_id)
+        while True:
+            task, finished = master.get_task()
+            if task is None:
+                assert finished
+                break
+            master.report_task_result(task.task_id)
+
+    def test_wait_task_when_queue_drained_but_doing(self):
+        servicer, d, _ = make_servicer(records=10, per_task=10)
+        master = InProcessMaster(servicer, worker_id=0)
+        t, _ = master.get_task()
+        # Queue empty, one doing -> WAIT, not finished.
+        wait_task, finished = master.get_task()
+        assert wait_task.type == TaskType.WAIT and not finished
+        master.report_task_result(t.task_id)
+        none_task, finished = master.get_task()
+        assert none_task is None and finished
+
+    def test_callbacks_injected(self):
+        servicer, _, _ = make_servicer()
+        calls = []
+        master = InProcessMaster(
+            servicer, worker_id=0,
+            callbacks={"get_task": lambda req: calls.append(req)},
+        )
+        master.get_task()
+        assert calls and calls[0]["worker_id"] == 0
+
+    def test_version_triggers_eval(self):
+        servicer, d, ev = make_servicer(
+            records=10, per_task=10, eval_records=10, eval_steps=2
+        )
+        master = InProcessMaster(servicer, worker_id=0)
+        master.report_version(2)
+        task, _ = master.get_task()
+        assert task.type == TaskType.EVALUATION
+        assert task.model_version == 2
+        # Worker reports raw outputs; master computes metrics on complete.
+        master.report_evaluation_metrics(
+            np.full((10, 1), 0.5, np.float32), np.zeros((10,), np.int32)
+        )
+        master.report_task_result(task.task_id)
+        assert ev.completed_results[2]["mean_out"] == pytest.approx(0.5)
+
+    def test_eval_not_retriggered_for_same_version(self):
+        servicer, d, ev = make_servicer(
+            records=10, per_task=10, eval_records=10, eval_steps=2
+        )
+        master = InProcessMaster(servicer, worker_id=0)
+        master.report_version(2)
+        master.report_version(2)
+        tasks = []
+        while (t := master.get_task())[0] is not None:
+            task = t[0]
+            if task.type == TaskType.WAIT:
+                break
+            tasks.append(task)
+            master.report_task_result(task.task_id)
+        eval_tasks = [t for t in tasks if t.type == TaskType.EVALUATION]
+        assert len(eval_tasks) == 1
+
+    def test_eval_task_permanent_failure_does_not_wedge(self):
+        from elasticdl_tpu.common.constants import MAX_TASK_RETRIES
+
+        servicer, d, ev = make_servicer(
+            records=10, per_task=10, eval_records=10, eval_steps=1
+        )
+        master = InProcessMaster(servicer, worker_id=0)
+        master.report_version(1)
+        # Fail the eval task past the retry cap.
+        for _ in range(MAX_TASK_RETRIES + 1):
+            task, _ = master.get_task()
+            assert task.type == TaskType.EVALUATION
+            master.report_task_result(task.task_id, err_reason="corrupt")
+        # The eval job completed (empty) instead of wedging; the next
+        # version report triggers a fresh round.
+        assert ev._eval_job is None
+        master.report_version(2)
+        task, _ = master.get_task()
+        assert task.type == TaskType.EVALUATION
+        assert task.model_version == 2
+
+    def test_eval_triggers_with_coarse_version_reports(self):
+        servicer, d, ev = make_servicer(
+            records=10, per_task=10, eval_records=10, eval_steps=4
+        )
+        master = InProcessMaster(servicer, worker_id=0)
+        # Worker reports every 3 versions; eval_steps=4 must still fire.
+        assert not ev.add_evaluation_task_if_needed(3)
+        assert ev.add_evaluation_task_if_needed(6)
+
+    def test_eval_only_job_produces_metrics(self):
+        d = TaskDispatcher(
+            training_shards={},
+            evaluation_shards={"e1": (0, 10)},
+            records_per_task=10,
+            num_epochs=1,
+            shuffle=False,
+        )
+        ev = EvaluationService(
+            d, {"mean_out": lambda labels, outputs: outputs.mean()},
+            eval_only=True,
+        )
+        servicer = MasterServicer(d, ev)
+        master = InProcessMaster(servicer, worker_id=0)
+        task, _ = master.get_task()
+        assert task.type == TaskType.EVALUATION
+        assert master.report_evaluation_metrics(
+            np.full((10, 1), 2.0, np.float32), np.zeros((10,), np.int32)
+        )
+        master.report_task_result(task.task_id)
+        assert ev.completed_results[-1]["mean_out"] == pytest.approx(2.0)
+        _, finished = master.get_task()
+        assert finished
+
+    def test_straggler_detection(self):
+        servicer, d, _ = make_servicer(records=20, per_task=10)
+        servicer._default_task_secs = 0.0  # everything is instantly late
+        master = InProcessMaster(servicer, worker_id=7)
+        t, _ = master.get_task()
+        timeouts = servicer.find_timeout_tasks(factor=3.0)
+        assert (t.task_id, 7) in timeouts
+
+
+class TestRpcTransport:
+    @pytest.fixture
+    def server_and_client(self):
+        servicer, d, ev = make_servicer(
+            records=20, per_task=10, eval_records=10, eval_steps=1
+        )
+        server = RpcServer(
+            "localhost:0", {SERVICE_NAME: servicer.handlers()}
+        ).start()
+        client = MasterClient(f"localhost:{server.port}", worker_id=3,
+                              connect_timeout=10, retries=1)
+        yield servicer, d, ev, client
+        client.close()
+        server.stop(0)
+
+    def test_full_roundtrip_over_grpc(self, server_and_client):
+        servicer, d, ev, client = server_and_client
+        done = 0
+        while True:
+            task, finished = client.get_task()
+            if task is None:
+                assert finished
+                break
+            if task.type == TaskType.WAIT:
+                continue
+            client.report_task_result(task.task_id)
+            done += 1
+        assert done == 2
+        assert servicer.worker_liveness().get(3) is not None
+
+    def test_ndarray_payload_over_grpc(self, server_and_client):
+        servicer, d, ev, client = server_and_client
+        client.report_version(1)
+        task, _ = client.get_task()
+        assert task.type == TaskType.EVALUATION
+        outputs = np.random.rand(700, 4).astype(np.float32)  # > chunk size
+        labels = np.random.randint(0, 2, 700).astype(np.int64)
+        assert client.report_evaluation_metrics(outputs, labels)
+        client.report_task_result(task.task_id)
+        assert 1 in ev.completed_results
+
+    def test_error_propagates_as_rpc_error(self, server_and_client):
+        servicer, d, ev, client = server_and_client
+        # Missing required field -> handler KeyError -> INTERNAL RpcError.
+        with pytest.raises(RpcError):
+            client._stub.call("report_task_result")  # no task_id
+
+    def test_unknown_method_is_unimplemented(self, server_and_client):
+        servicer, d, ev, client = server_and_client
+        with pytest.raises(RpcError):
+            client._stub.call("no_such_method")

@@ -1,0 +1,126 @@
+"""Task dispatcher state machine (reference tests/task_dispatcher_test.py)."""
+
+from elasticdl_tpu.common.constants import MAX_TASK_RETRIES, TaskType
+from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+
+
+def make_dispatcher(records=100, per_task=10, epochs=1, **kw):
+    return TaskDispatcher(
+        training_shards={"f1": (0, records)},
+        records_per_task=per_task,
+        num_epochs=epochs,
+        shuffle=False,
+        **kw,
+    )
+
+
+class TestTaskDispatcher:
+    def test_create_get_report_complete(self):
+        d = make_dispatcher(records=30, per_task=10)
+        tasks = []
+        while True:
+            t = d.get(worker_id=0)
+            if t is None:
+                break
+            tasks.append(t)
+        assert len(tasks) == 3
+        assert [t.start for t in tasks] == [0, 10, 20]
+        assert not d.finished()  # all doing
+        for t in tasks:
+            d.report(t.task_id, True)
+        assert d.finished()
+        assert d.counters.total_records[TaskType.TRAINING] == 30
+
+    def test_uneven_split(self):
+        d = make_dispatcher(records=25, per_task=10)
+        sizes = []
+        while (t := d.get(0)) is not None:
+            sizes.append(t.num_records)
+        assert sizes == [10, 10, 5]
+
+    def test_failure_requeues_at_front(self):
+        d = make_dispatcher(records=20, per_task=10)
+        t1 = d.get(0)
+        t2 = d.get(0)
+        assert d.get(0) is None
+        d.report(t1.task_id, False, err_reason="boom")
+        t1b = d.get(1)
+        assert (t1b.start, t1b.end) == (t1.start, t1.end)
+        assert t1b.task_id != t1.task_id  # new id on re-dispatch
+        d.report(t1b.task_id, True)
+        d.report(t2.task_id, True)
+        assert d.finished()
+
+    def test_retry_cap(self):
+        d = make_dispatcher(records=10, per_task=10)
+        for _ in range(MAX_TASK_RETRIES + 1):
+            t = d.get(0)
+            d.report(t.task_id, False, err_reason="always fails")
+        # After cap exceeded, task is dropped and counted failed.
+        assert d.get(0) is None
+        assert d.finished()
+        assert d.counters.failed_records[TaskType.TRAINING] == 10
+
+    def test_epoch_regeneration(self):
+        d = make_dispatcher(records=10, per_task=10, epochs=3)
+        seen = 0
+        while True:
+            t = d.get(0)
+            if t is None:
+                break
+            seen += 1
+            d.report(t.task_id, True)
+        assert seen == 3
+        assert d.finished()
+
+    def test_recover_tasks_for_dead_worker(self):
+        d = make_dispatcher(records=30, per_task=10)
+        t0 = d.get(worker_id=0)
+        t1 = d.get(worker_id=1)
+        t2 = d.get(worker_id=0)
+        d.recover_tasks(worker_id=0)
+        # t0 and t2 re-queued; t1 still doing.
+        requeued = {(t0.start, t0.end), (t2.start, t2.end)}
+        got = set()
+        while (t := d.get(2)) is not None:
+            got.add((t.start, t.end))
+        assert requeued <= got
+        assert d.doing_tasks_of(1) == [t1.task_id]
+
+    def test_eval_tasks_jump_queue(self):
+        d = TaskDispatcher(
+            training_shards={"f1": (0, 20)},
+            evaluation_shards={"e1": (0, 10)},
+            records_per_task=10,
+            num_epochs=1,
+            shuffle=False,
+        )
+        d.create_tasks(TaskType.EVALUATION, model_version=5)
+        t = d.get(0)
+        assert t.type == TaskType.EVALUATION
+        assert t.model_version == 5
+
+    def test_deferred_train_end_callback(self):
+        d = make_dispatcher(records=10, per_task=10)
+        d.add_deferred_callback(d.create_train_end_callback_task)
+        t = d.get(0)
+        d.report(t.task_id, True)
+        # finished() is False because the callback queued one more task.
+        end_task = d.get(0)
+        assert end_task.type == TaskType.TRAIN_END_CALLBACK
+        d.report(end_task.task_id, True)
+        assert d.finished()
+
+    def test_unknown_task_report(self):
+        d = make_dispatcher()
+        task, worker, requeued = d.report(9999, True)
+        assert task is None and worker == -1 and not requeued
+
+    def test_report_returns_requeued_flag(self):
+        d = make_dispatcher(records=10, per_task=10)
+        t = d.get(0)
+        _, _, requeued = d.report(t.task_id, False, err_reason="x")
+        assert requeued
+        t = d.get(0)
+        _, _, requeued = d.report(t.task_id, True)
+        assert not requeued
