@@ -1,0 +1,39 @@
+"""Sparse embedding engine (TPU-native).
+
+The reference implements sparse embeddings as an external key-value store on
+parameter-server pods with lazy row init and a worker-side delegate that
+captures gradients (``elasticdl/python/ps/embedding_table.py``,
+``elasticdl/python/elasticdl/embedding_delegate.py``). On TPU the table is a
+dense ``(vocab, dim)`` array living in HBM, row-sharded over the device mesh,
+and gradients flow through the gather inside the jit-compiled step — no RPC
+plane, no delegate.
+
+Two tiers:
+
+- **In-HBM tier** (`layer.Embedding`): the table is a flax param; the
+  auto-partition pass (`partition.py`, counterpart of the reference
+  ModelHandler's 2MB rewrite) row-shards big tables over the mesh.
+- **Host tier** (`table.EmbeddingTable`): a lazy, dict-backed row store
+  mirroring the reference PS table semantics, used for >HBM tables and for
+  checkpoint repartitioning.
+"""
+
+from elasticdl_tpu.embedding.combiner import RaggedIds, combine
+from elasticdl_tpu.embedding.layer import Embedding
+from elasticdl_tpu.embedding.partition import (
+    DEFAULT_PARTITION_THRESHOLD_BYTES,
+    embedding_partition_rule,
+    tree_partition_specs,
+)
+from elasticdl_tpu.embedding.table import EmbeddingTable, get_slot_table_name
+
+__all__ = [
+    "RaggedIds",
+    "combine",
+    "Embedding",
+    "EmbeddingTable",
+    "get_slot_table_name",
+    "DEFAULT_PARTITION_THRESHOLD_BYTES",
+    "embedding_partition_rule",
+    "tree_partition_specs",
+]

@@ -1,0 +1,83 @@
+"""Ragged id batches and combiner reductions.
+
+Counterpart of the reference's SparseTensor inputs + combiner handling
+(``elasticdl/python/elasticdl/embedding_delegate.py:95-217``,
+``safe_embedding_lookup_sparse`` re-implementation). XLA needs static
+shapes, so a ragged batch of ids is stored padded to ``(batch, max_ids)``
+with per-slot weights; weight 0 marks padding. Empty rows combine to the
+zero vector (the reference's ``safe_`` default-row behavior).
+"""
+
+from typing import List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+COMBINERS = ("sum", "mean", "sqrtn")
+
+
+class RaggedIds(struct.PyTreeNode):
+    """A padded ragged batch of embedding ids.
+
+    ``ids``:     (batch, max_ids) int32, padded with 0,
+    ``weights``: (batch, max_ids) float32, 0.0 on padded slots. For unweighted
+    sparse input the weights are 1.0 on real slots (reference treats missing
+    weights as 1, embedding_delegate.py:116-120).
+    """
+
+    ids: jnp.ndarray
+    weights: jnp.ndarray
+
+    @classmethod
+    def from_lists(
+        cls,
+        id_lists: Sequence[Sequence[int]],
+        weight_lists: Optional[Sequence[Sequence[float]]] = None,
+        max_ids: Optional[int] = None,
+    ) -> "RaggedIds":
+        """Pad a list-of-lists (host-side, numpy) into a RaggedIds batch."""
+        batch = len(id_lists)
+        width = max_ids
+        if width is None:
+            width = max((len(r) for r in id_lists), default=1) or 1
+        ids = np.zeros((batch, width), np.int32)
+        weights = np.zeros((batch, width), np.float32)
+        for i, row in enumerate(id_lists):
+            row = list(row)[:width]
+            n = len(row)
+            ids[i, :n] = row
+            if weight_lists is not None:
+                weights[i, :n] = list(weight_lists[i])[:n]
+            else:
+                weights[i, :n] = 1.0
+        return cls(ids=ids, weights=weights)
+
+    @property
+    def batch_size(self):
+        return self.ids.shape[0]
+
+
+def combine(embeddings, weights, combiner: str):
+    """Reduce per-slot embeddings ``(batch, max_ids, dim)`` with weights
+    ``(batch, max_ids)`` to ``(batch, dim)``.
+
+    sum   = Σ w·e
+    mean  = Σ w·e / Σ w
+    sqrtn = Σ w·e / sqrt(Σ w²)
+    (reference combiner semantics, embedding_delegate.py:189-217). Empty
+    rows (all weights 0) produce zeros instead of NaN.
+    """
+    if combiner not in COMBINERS:
+        raise ValueError(
+            f"combiner must be one of {COMBINERS}, got {combiner!r}"
+        )
+    w = weights[..., None]
+    summed = jnp.sum(embeddings * w, axis=-2)
+    if combiner == "sum":
+        return summed
+    if combiner == "mean":
+        denom = jnp.sum(weights, axis=-1, keepdims=True)
+    else:  # sqrtn
+        denom = jnp.sqrt(jnp.sum(weights * weights, axis=-1, keepdims=True))
+    return jnp.where(denom > 0, summed / jnp.where(denom > 0, denom, 1.0), 0.0)

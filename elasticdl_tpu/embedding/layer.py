@@ -1,0 +1,71 @@
+"""The `Embedding` layer (in-HBM tier).
+
+Counterpart of ``elasticdl.layers.Embedding``
+(``elasticdl/python/elasticdl/layers/embedding.py:7-150``) and
+``SparseEmbedding`` (``keras/layers/sparse_embedding.py:7-71``). The
+reference splits these: the EDL layer owns *no* weights and delegates
+lookup to the parameter server; SparseEmbedding owns weights locally. On
+TPU there is one layer that always owns its table as a flax param — the
+distribution question ("is this table sharded?") is answered by the
+auto-partition pass (partition.py) annotating the param's sharding, not by
+swapping layer classes (the ModelHandler clone-rewrite becomes a no-op).
+
+Input forms:
+- int ids of any shape -> embeddings with a trailing ``dim`` axis
+  (dense-input path, layer.call:104),
+- `RaggedIds` + ``combiner`` -> ``(batch, dim)`` reduced rows
+  (sparse-input path, _sparse_input_call:111).
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from elasticdl_tpu.embedding.combiner import RaggedIds, combine
+
+# Keras Embedding default init == RandomUniform(-0.05, 0.05); the reference
+# Go PS lazy row init uses the same range (pkg/common/embedding_table.go:36-44).
+EMBEDDING_INIT_SCALE = 0.05
+
+# Param name the auto-partition pass matches on (partition.py).
+EMBEDDING_PARAM_NAME = "embedding"
+
+
+def embedding_init(key, shape, dtype=jnp.float32):
+    return jax.random.uniform(
+        key, shape, dtype, -EMBEDDING_INIT_SCALE, EMBEDDING_INIT_SCALE
+    )
+
+
+class Embedding(nn.Module):
+    """Embedding lookup with optional ragged-input combiner.
+
+    ``input_dim``  — vocabulary size (rows),
+    ``output_dim`` — embedding dimension,
+    ``combiner``   — sum | mean | sqrtn, required for RaggedIds input.
+    """
+
+    input_dim: int
+    output_dim: int
+    combiner: Optional[str] = None
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, ids):
+        table = self.param(
+            EMBEDDING_PARAM_NAME,
+            embedding_init,
+            (self.input_dim, self.output_dim),
+            self.param_dtype,
+        )
+        if isinstance(ids, RaggedIds):
+            if self.combiner is None:
+                raise ValueError(
+                    "RaggedIds input requires a combiner "
+                    "(reference embedding.py:111-133)"
+                )
+            rows = jnp.take(table, ids.ids, axis=0)
+            return combine(rows, ids.weights, self.combiner)
+        return jnp.take(table, jnp.asarray(ids), axis=0)

@@ -1,0 +1,71 @@
+"""Auto-partition pass: which tables get sharded over the mesh.
+
+Counterpart of the reference ModelHandler's rewrite
+(``elasticdl/python/common/model_handler.py:85-89``, ``:222-232``): Keras
+embeddings bigger than 2MB are swapped for PS-backed EDL embeddings. Here
+no layer is swapped — the pass walks the param pytree and assigns a
+``PartitionSpec`` per leaf: embedding tables over the threshold are
+row-sharded over the data axis (rows live once across the mesh, the
+gather/scatter ride ICI), everything else is replicated.
+
+MeshRunner consumes the resulting spec tree for param/optimizer-state
+placement, which also co-shards optimizer slot rows with their table
+(reference slot co-location, ps/parameters.py:156).
+"""
+
+from typing import Callable, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from elasticdl_tpu.embedding.layer import EMBEDDING_PARAM_NAME
+
+# model_handler.py:85-89 threshold parity.
+DEFAULT_PARTITION_THRESHOLD_BYTES = 2 * 1024 * 1024
+
+
+def _leaf_nbytes(leaf) -> int:
+    shape = getattr(leaf, "shape", ())
+    dtype = np.dtype(getattr(leaf, "dtype", np.float32))
+    return int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
+
+
+def embedding_partition_rule(
+    threshold_bytes: int = DEFAULT_PARTITION_THRESHOLD_BYTES,
+    axis: str = "dp",
+    axis_size: Optional[int] = None,
+) -> Callable:
+    """Build a ``(path, leaf) -> PartitionSpec`` rule.
+
+    A leaf is a shardable table iff its param name is the Embedding layer's
+    table param, it is 2-D, its row count divides the mesh axis, and it
+    exceeds the size threshold.
+    """
+
+    def rule(path, leaf):
+        names = [getattr(p, "key", getattr(p, "name", str(p))) for p in path]
+        shape = getattr(leaf, "shape", ())
+        if (
+            names
+            and names[-1] == EMBEDDING_PARAM_NAME
+            and len(shape) == 2
+            and _leaf_nbytes(leaf) > threshold_bytes
+            and (axis_size is None or shape[0] % axis_size == 0)
+        ):
+            return P(axis, None)
+        return P()
+
+    return rule
+
+
+def tree_partition_specs(params, rule) -> "jax.tree_util.PyTreeDef":
+    """Map the rule over a param pytree -> pytree of PartitionSpecs."""
+    return jax.tree_util.tree_map_with_path(rule, params)
+
+
+def tree_shardings(params, mesh: Mesh, rule):
+    """Same, but as NamedShardings for device_put/jit."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: NamedSharding(mesh, rule(path, leaf)), params
+    )

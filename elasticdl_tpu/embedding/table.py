@@ -1,0 +1,110 @@
+"""Host-side lazy embedding table (host tier).
+
+Counterpart of the reference PS tables (``elasticdl/python/ps/
+embedding_table.py:10-124``, ``elasticdl/pkg/common/embedding_table.go``):
+a dict id -> 1-D row, materialized on first get with a deterministic
+initializer, plus constant-initialized slot-table variants for optimizer
+state. On TPU this tier backs tables too large for HBM (rows are pulled
+into the device batch and scattered back by the sparse engine) and is the
+unit the checkpoint repartitioner works over; the default in-HBM path does
+not use it.
+
+Rows initialize deterministically from (table name, id) so a re-created
+shard produces identical values — the reference instead relied on the PS
+pod surviving; we cannot (SURVEY.md §7 stage 5).
+"""
+
+from typing import Dict, Iterable, Optional
+
+import numpy as np
+
+from elasticdl_tpu.embedding.layer import EMBEDDING_INIT_SCALE
+
+
+def get_slot_table_name(table_name: str, slot_name: str) -> str:
+    """Reference naming: ps/embedding_table.py:122."""
+    return f"{table_name}-{slot_name}"
+
+
+def _row_seed(name: str, row_id: int) -> int:
+    import zlib
+
+    return (zlib.crc32(name.encode("utf-8")) * 2654435761 + int(row_id)) % (
+        2**32
+    )
+
+
+class EmbeddingTable:
+    """Lazy id->row store with deterministic per-row init."""
+
+    def __init__(
+        self,
+        name: str,
+        dim: int,
+        initializer: str = "uniform",
+        is_slot: bool = False,
+        slot_init_value: float = 0.0,
+        dtype=np.float32,
+    ):
+        self.name = name
+        self.dim = int(dim)
+        self.initializer = initializer
+        self.is_slot = is_slot
+        self.slot_init_value = float(slot_init_value)
+        self.dtype = np.dtype(dtype)
+        self.vectors: Dict[int, np.ndarray] = {}
+
+    def _init_row(self, row_id: int) -> np.ndarray:
+        if self.is_slot or self.initializer == "zeros":
+            return np.full((self.dim,), self.slot_init_value, self.dtype)
+        rng = np.random.RandomState(_row_seed(self.name, row_id))
+        if self.initializer == "normal":
+            return rng.normal(0.0, 0.05, self.dim).astype(self.dtype)
+        return rng.uniform(
+            -EMBEDDING_INIT_SCALE, EMBEDDING_INIT_SCALE, self.dim
+        ).astype(self.dtype)
+
+    def get(self, ids: Iterable[int]) -> np.ndarray:
+        """Batch lookup; lazily initializes unseen rows
+        (ps/embedding_table.py:51-62)."""
+        ids = list(ids)
+        out = np.empty((len(ids), self.dim), self.dtype)
+        for i, row_id in enumerate(ids):
+            row = self.vectors.get(int(row_id))
+            if row is None:
+                row = self._init_row(int(row_id))
+                self.vectors[int(row_id)] = row
+            out[i] = row
+        return out
+
+    def set(self, ids: Iterable[int], values: np.ndarray) -> None:
+        values = np.asarray(values, self.dtype)
+        for i, row_id in enumerate(ids):
+            self.vectors[int(row_id)] = values[i].copy()
+
+    @property
+    def num_rows(self) -> int:
+        return len(self.vectors)
+
+    def to_arrays(self):
+        """(ids, rows) sorted by id — checkpoint serialization unit."""
+        if not self.vectors:
+            return (np.zeros((0,), np.int64),
+                    np.zeros((0, self.dim), self.dtype))
+        ids = np.array(sorted(self.vectors), np.int64)
+        rows = np.stack([self.vectors[int(i)] for i in ids])
+        return ids, rows
+
+    @classmethod
+    def from_arrays(cls, name, ids, rows, **kwargs):
+        table = cls(name, rows.shape[1] if rows.ndim == 2 else 0, **kwargs)
+        for row_id, row in zip(ids, rows):
+            table.vectors[int(row_id)] = np.asarray(row, table.dtype)
+        return table
+
+    def debug_info(self) -> str:
+        size = self.num_rows * self.dim * self.dtype.itemsize
+        return (
+            f"EmbeddingTable {self.name}: rows={self.num_rows} "
+            f"dim={self.dim} bytes={size}"
+        )

@@ -26,6 +26,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from elasticdl_tpu.core import step as step_lib
 from elasticdl_tpu.core.train_state import TrainState, init_train_state
+from elasticdl_tpu.embedding import partition as partition_lib
 from elasticdl_tpu.parallel import mesh as mesh_lib
 
 
@@ -38,12 +39,23 @@ class MeshRunner:
         data_axis: str = "dp",
         accum_steps: int = 1,
         donate_state: bool = True,
+        param_rule=None,
     ):
         self.mesh = mesh if mesh is not None else mesh_lib.make_mesh()
         self.data_axis = data_axis
         self.accum_steps = accum_steps
         self._donate_state = donate_state
         self._state_shardings = None
+        # Auto-partition pass (reference ModelHandler 2MB rewrite,
+        # model_handler.py:85-89): big embedding tables row-shard over the
+        # data axis, everything else replicates.
+        self.param_rule = (
+            param_rule
+            if param_rule is not None
+            else partition_lib.embedding_partition_rule(
+                axis=data_axis, axis_size=self.mesh.shape[data_axis]
+            )
+        )
 
     # ---- sharding rules ------------------------------------------------
 
@@ -57,8 +69,11 @@ class MeshRunner:
         )
 
     def state_shardings(self, state: TrainState):
-        """Params/batch_stats/rng/step replicated; optimizer state
-        ZeRO-sharded over the data axis."""
+        """Params placed by the partition rule (big embedding tables
+        row-sharded, rest replicated); batch_stats/rng/step replicated;
+        optimizer state ZeRO-sharded over the data axis (slot tables get
+        their first divisible dim — i.e. rows — so slots co-shard with
+        their table, reference ps/parameters.py:156)."""
         replicated = mesh_lib.replicated(self.mesh)
 
         def opt_leaf(leaf):
@@ -68,7 +83,9 @@ class MeshRunner:
 
         return state.replace(
             step=replicated,
-            params=jax.tree.map(lambda _: replicated, state.params),
+            params=partition_lib.tree_shardings(
+                state.params, self.mesh, self.param_rule
+            ),
             batch_stats=jax.tree.map(lambda _: replicated,
                                      state.batch_stats),
             opt_state=jax.tree.map(opt_leaf, state.opt_state),
@@ -78,11 +95,20 @@ class MeshRunner:
     # ---- runner interface ---------------------------------------------
 
     def init_state(self, model, tx, example_batch, seed: int = 0):
-        """Initialize state already laid out on the mesh."""
-        state = init_train_state(model, tx, example_batch, seed=seed)
-        shardings = self.state_shardings(state)
+        """Initialize state already laid out on the mesh.
+
+        Shardings are derived from an abstract eval_shape pass and the init
+        runs under jit with those out_shardings, so a table sized for the
+        whole mesh (plus its optimizer slots) never has to materialize
+        unsharded on one device first."""
+
+        def make_state(batch):
+            return init_train_state(model, tx, batch, seed=seed)
+
+        abstract = jax.eval_shape(make_state, example_batch)
+        shardings = self.state_shardings(abstract)
         self._state_shardings = shardings
-        return jax.device_put(state, shardings)
+        return jax.jit(make_state, out_shardings=shardings)(example_batch)
 
     def place_batch(self, batch):
         """Shard a host batch over the dp axis (leading dim)."""
@@ -203,10 +229,11 @@ class MeshRunner:
         def wrapped(state, batch):
             batch = runner.place_batch(batch)
             if carry_box["grad_acc"] is None:
-                carry_box["grad_acc"] = jax.device_put(
-                    jax.tree.map(jnp.zeros_like, state.params),
-                    jax.tree.map(lambda _: mesh_lib.replicated(runner.mesh),
-                                 state.params),
+                # zeros_like preserves the params' sharding, so the grad
+                # accumulator co-shards with (possibly row-sharded) params
+                # instead of replicating a mesh-sized table per device.
+                carry_box["grad_acc"] = jax.tree.map(
+                    jnp.zeros_like, state.params
                 )
                 carry_box["count"] = jnp.zeros((), jnp.int32)
             (state, grad_acc, count), loss = jit_micro(
