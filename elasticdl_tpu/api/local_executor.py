@@ -25,6 +25,7 @@ from elasticdl_tpu.core.step import (
 )
 from elasticdl_tpu.core.train_state import init_train_state
 from elasticdl_tpu.data.batcher import batch_records
+from elasticdl_tpu.checkpoint import CheckpointHook, restore_from_dir
 from elasticdl_tpu.data.factory import (
     create_data_reader,
     parse_data_reader_params,
@@ -67,6 +68,18 @@ class LocalExecutor:
         self._train_step = build_train_step(self._spec.loss)
         self._eval_step = build_eval_step()
         self.last_train_metrics = None
+        # Checkpointing (reference save inside push_gradients every
+        # checkpoint_steps versions, ps/servicer.py:242-257; restore-at-init
+        # from --checkpoint_dir_for_init, ps/parameter_server.py:49-66).
+        self._checkpoint = CheckpointHook(
+            checkpoint_dir=getattr(args, "checkpoint_dir", ""),
+            checkpoint_steps=getattr(args, "checkpoint_steps", 0),
+            num_shards=getattr(args, "checkpoint_shards", 1) or 1,
+            keep_max=getattr(args, "keep_checkpoint_max", 3) or 3,
+        )
+        self._init_checkpoint_dir = getattr(
+            args, "checkpoint_dir_for_init", ""
+        )
 
     def _task_batches(self, reader, mode):
         shards = reader.create_shards()
@@ -92,6 +105,14 @@ class LocalExecutor:
                 self._spec.model, tx, batch,
                 seed=getattr(self._args, "random_seed", 0),
             )
+            if self._init_checkpoint_dir:
+                self.state = restore_from_dir(
+                    self.state, self._init_checkpoint_dir
+                )
+
+    def _maybe_checkpoint(self):
+        with self._timing.record("checkpoint"):
+            self._checkpoint.maybe_save(self.state)
 
     def train(self) -> dict:
         start_time = time.monotonic()
@@ -108,6 +129,7 @@ class LocalExecutor:
                 self.last_train_metrics = metrics
                 steps += 1
                 examples += int(np.sum(batch["mask"]))
+                self._maybe_checkpoint()
                 if steps % 100 == 0:
                     self._logger.info(
                         "step=%d loss=%.5f", steps, float(metrics["loss"])
@@ -125,6 +147,7 @@ class LocalExecutor:
                 "batches; nothing was trained"
             )
         jax.block_until_ready(self.state.params)
+        self._checkpoint.save_final(self.state)
         elapsed = time.monotonic() - start_time
         eval_result = self.evaluate() if self._eval_reader else None
         self._timing.report_timing()

@@ -1,0 +1,20 @@
+"""Sharded checkpoint/restore with cross-N repartitioning.
+
+Counterpart of ``elasticdl/python/common/save_utils.py:70-271`` and the Go
+PS checkpoint (``elasticdl/pkg/ps/checkpoint.go``).
+"""
+
+from elasticdl_tpu.checkpoint.hooks import CheckpointHook, restore_from_dir
+from elasticdl_tpu.checkpoint.saver import CheckpointSaver
+from elasticdl_tpu.checkpoint.state_io import (
+    named_leaves_from_state,
+    restore_state_from_named_leaves,
+)
+
+__all__ = [
+    "CheckpointHook",
+    "CheckpointSaver",
+    "named_leaves_from_state",
+    "restore_from_dir",
+    "restore_state_from_named_leaves",
+]

@@ -1,0 +1,81 @@
+"""Shared checkpoint wiring for executors and workers.
+
+One implementation of "restore at init / save every N versions / final
+save" so the Local and distributed paths cannot drift (reference spreads
+this across ps/parameter_server.py:49-66 and ps/servicer.py:242-257).
+"""
+
+from typing import Optional
+
+from elasticdl_tpu.checkpoint.saver import CheckpointSaver
+from elasticdl_tpu.checkpoint.state_io import (
+    named_leaves_from_state,
+    restore_state_from_named_leaves,
+)
+from elasticdl_tpu.common.log_utils import get_logger
+
+logger = get_logger(__name__)
+
+
+def restore_from_dir(state, checkpoint_dir: str):
+    """Restore a TrainState's leaves from the latest valid version."""
+    _, dense, _ = CheckpointSaver(checkpoint_dir).restore()
+    state = restore_state_from_named_leaves(state, dense)
+    logger.info(
+        "Restored state at version %d from %s",
+        int(state.step), checkpoint_dir,
+    )
+    return state
+
+
+class CheckpointHook:
+    """Periodic + final checkpoint writer. ``maybe_save`` is a no-op when
+    no dir or no interval is configured; ``save_final`` always writes the
+    current version when a dir is configured (so the last steps of a run
+    are never lost to interval rounding)."""
+
+    def __init__(
+        self,
+        checkpoint_dir: str = "",
+        checkpoint_steps: int = 0,
+        num_shards: int = 1,
+        keep_max: int = 3,
+        saver: Optional[CheckpointSaver] = None,
+    ):
+        if saver is None and checkpoint_dir:
+            saver = CheckpointSaver(
+                checkpoint_dir, num_shards=num_shards, keep_max=keep_max
+            )
+        self.saver = saver
+        self.checkpoint_steps = int(checkpoint_steps)
+        self._last_saved = None
+
+    @property
+    def enabled(self) -> bool:
+        return self.saver is not None
+
+    def maybe_save(self, state) -> bool:
+        if (
+            self.saver is None
+            or not self.checkpoint_steps
+            or state is None
+        ):
+            return False
+        version = int(state.step)
+        if version == 0 or version % self.checkpoint_steps != 0:
+            return False
+        self._save(version, state)
+        return True
+
+    def save_final(self, state) -> bool:
+        if self.saver is None or state is None:
+            return False
+        version = int(state.step)
+        if self._last_saved == version:
+            return False
+        self._save(version, state)
+        return True
+
+    def _save(self, version: int, state):
+        self.saver.save(version, named_leaves_from_state(state))
+        self._last_saved = version

@@ -1,0 +1,191 @@
+"""Checkpoint layout, GC, validity, and repartitioning restore.
+
+Layout parity with the reference (``common/save_utils.py:101-118``,
+``pkg/ps/checkpoint.go:122-127``):
+
+    {dir}/version-{v}/variables-{i}-of-{N}.ckpt
+
+Each shard file is msgpack of
+
+    {"meta": {"version": v, "shard": i, "num_shards": N},
+     "dense": {leaf_name: ndarray},           # by string_to_id(name) % N
+     "embeddings": {table: IndexedSlices}}    # rows by id % N
+
+Restore reads *all* shard files of a version, so loading onto a different
+shard count (the reference's repartition restore, save_utils.py:206-259)
+is the natural path, with the same hash functions guaranteeing stable
+placement. A version is valid iff the file count equals every file's
+recorded ``num_shards`` ("slowest-PS-wins" validity, save_utils.py:154-167).
+"""
+
+import os
+import re
+import shutil
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from elasticdl_tpu.common import tensor_utils
+from elasticdl_tpu.common.hash_utils import int_to_id, string_to_id
+from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.embedding.table import EmbeddingTable
+
+logger = get_logger(__name__)
+
+_VERSION_RE = re.compile(r"^version-(\d+)$")
+_SHARD_RE = re.compile(r"^variables-(\d+)-of-(\d+)\.ckpt$")
+
+
+def _version_dir(checkpoint_dir: str, version: int) -> str:
+    return os.path.join(checkpoint_dir, f"version-{version}")
+
+
+class CheckpointSaver:
+    """Save/restore named dense leaves + host embedding tables."""
+
+    def __init__(
+        self,
+        checkpoint_dir: str,
+        num_shards: int = 1,
+        keep_max: int = 3,
+    ):
+        if not checkpoint_dir:
+            raise ValueError("checkpoint_dir must be non-empty")
+        self.checkpoint_dir = checkpoint_dir
+        self.num_shards = max(1, int(num_shards))
+        self.keep_max = int(keep_max)
+        os.makedirs(checkpoint_dir, exist_ok=True)
+
+    # ---- save ----------------------------------------------------------
+
+    def save(
+        self,
+        version: int,
+        dense: Dict[str, np.ndarray],
+        embeddings: Optional[Dict[str, EmbeddingTable]] = None,
+    ) -> str:
+        """Write all shards of one version, then GC old versions."""
+        vdir = _version_dir(self.checkpoint_dir, version)
+        tmp = vdir + ".tmp"
+        if os.path.exists(tmp):
+            shutil.rmtree(tmp)
+        os.makedirs(tmp)
+        n = self.num_shards
+        for shard in range(n):
+            payload = {
+                "meta": {
+                    "version": int(version),
+                    "shard": shard,
+                    "num_shards": n,
+                },
+                "dense": {
+                    name: np.asarray(arr)
+                    for name, arr in dense.items()
+                    if string_to_id(name, n) == shard
+                },
+                "embeddings": {},
+            }
+            for tname, table in (embeddings or {}).items():
+                ids, rows = table.to_arrays()
+                keep = np.asarray(
+                    [int_to_id(int(i), n) == shard for i in ids], bool
+                )
+                payload["embeddings"][tname] = tensor_utils.IndexedSlices(
+                    values=rows[keep], ids=ids[keep]
+                )
+            path = os.path.join(tmp, f"variables-{shard}-of-{n}.ckpt")
+            with open(path, "wb") as f:
+                f.write(tensor_utils.dumps(payload))
+        # Atomic-ish publish: the version dir appears only when complete.
+        if os.path.exists(vdir):
+            shutil.rmtree(vdir)
+        os.rename(tmp, vdir)
+        logger.info("Saved checkpoint version %s (%s shards)", version, n)
+        self.gc()
+        return vdir
+
+    # ---- enumerate / validate -----------------------------------------
+
+    def list_versions(self):
+        out = []
+        if not os.path.isdir(self.checkpoint_dir):
+            return out
+        for entry in os.listdir(self.checkpoint_dir):
+            m = _VERSION_RE.match(entry)
+            if m and os.path.isdir(
+                os.path.join(self.checkpoint_dir, entry)
+            ):
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    def is_valid_version(self, version: int) -> bool:
+        """Valid iff shard file count matches the recorded num_shards
+        (save_utils.py:154-167)."""
+        vdir = _version_dir(self.checkpoint_dir, version)
+        if not os.path.isdir(vdir):
+            return False
+        shards = [f for f in os.listdir(vdir) if _SHARD_RE.match(f)]
+        if not shards:
+            return False
+        counts = {int(_SHARD_RE.match(f).group(2)) for f in shards}
+        return len(counts) == 1 and counts.pop() == len(shards)
+
+    def get_valid_latest_version(self) -> Optional[int]:
+        for version in reversed(self.list_versions()):
+            if self.is_valid_version(version):
+                return version
+        return None
+
+    # ---- restore -------------------------------------------------------
+
+    def restore(
+        self, version: Optional[int] = None
+    ) -> Tuple[int, Dict[str, np.ndarray], Dict[str, EmbeddingTable]]:
+        """Read every shard of a version and merge — shard-count agnostic
+        (repartition restore, save_utils.py:206-259)."""
+        if version is None:
+            version = self.get_valid_latest_version()
+            if version is None:
+                raise FileNotFoundError(
+                    f"No valid checkpoint under {self.checkpoint_dir}"
+                )
+        vdir = _version_dir(self.checkpoint_dir, version)
+        if not self.is_valid_version(version):
+            raise FileNotFoundError(f"Invalid checkpoint version {vdir}")
+        dense: Dict[str, np.ndarray] = {}
+        embeddings: Dict[str, EmbeddingTable] = {}
+        for fname in sorted(os.listdir(vdir)):
+            if not _SHARD_RE.match(fname):
+                continue
+            with open(os.path.join(vdir, fname), "rb") as f:
+                payload = tensor_utils.loads(f.read())
+            dense.update(payload.get("dense", {}))
+            for tname, slices in payload.get("embeddings", {}).items():
+                if slices.values.size == 0 and tname in embeddings:
+                    continue
+                table = embeddings.get(tname)
+                if table is None:
+                    dim = (
+                        slices.values.shape[1]
+                        if slices.values.ndim == 2 and slices.values.size
+                        else 0
+                    )
+                    table = EmbeddingTable(tname, dim)
+                    embeddings[tname] = table
+                if slices.ids.size:
+                    table.set([int(i) for i in slices.ids], slices.values)
+        return int(version), dense, embeddings
+
+    # ---- GC ------------------------------------------------------------
+
+    def gc(self):
+        """Keep the newest ``keep_max`` valid versions
+        (save_utils.py:188-204)."""
+        if self.keep_max <= 0:
+            return
+        versions = self.list_versions()
+        for version in versions[: -self.keep_max]:
+            shutil.rmtree(
+                _version_dir(self.checkpoint_dir, version),
+                ignore_errors=True,
+            )

@@ -1,0 +1,80 @@
+"""TrainState ⇄ named-leaf dict conversion for checkpointing.
+
+The reference checkpoints a Model protobuf keyed by variable name
+(``ps/parameters.py:172``, ``pkg/ps/model.go:77``). The TPU TrainState is
+an arbitrary pytree (params + batch_stats + optimizer state + step + rng),
+so leaves are keyed by their tree path — stable across runs because the
+structure is determined by the model definition — and restore fills a
+freshly initialized state's leaves by path, which also revalidates
+structure compatibility.
+"""
+
+from typing import Dict
+
+import jax
+import numpy as np
+
+
+def _leaf_name(prefix: str, path) -> str:
+    return prefix + jax.tree_util.keystr(path)
+
+
+def named_leaves_from_state(state) -> Dict[str, np.ndarray]:
+    """Flatten state into {path_name: host ndarray}."""
+    out = {}
+    for prefix, tree in (
+        ("step", state.step),
+        ("params", state.params),
+        ("batch_stats", state.batch_stats),
+        ("opt_state", state.opt_state),
+        ("rng", state.rng),
+    ):
+        leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
+        for path, leaf in leaves:
+            out[_leaf_name(prefix, path)] = np.asarray(leaf)
+    return out
+
+
+def restore_state_from_named_leaves(state, named: Dict[str, np.ndarray],
+                                    strict: bool = True):
+    """Fill ``state``'s leaves from the named dict.
+
+    ``state`` supplies the tree structure (and the shardings of its
+    leaves: jax re-places restored values to match via the caller's
+    device_put). Missing names raise when ``strict`` (reference restore
+    asserts variable presence, save_utils.py:230-247).
+    """
+    new_fields = {}
+    for prefix, tree in (
+        ("step", state.step),
+        ("params", state.params),
+        ("batch_stats", state.batch_stats),
+        ("opt_state", state.opt_state),
+        ("rng", state.rng),
+    ):
+        paths, treedef = jax.tree_util.tree_flatten_with_path(tree)
+        new_leaves = []
+        for path, leaf in paths:
+            name = _leaf_name(prefix, path)
+            if name in named:
+                value = np.asarray(named[name])
+                if tuple(value.shape) != tuple(np.shape(leaf)):
+                    raise ValueError(
+                        f"Checkpoint leaf {name} shape {value.shape} != "
+                        f"state shape {np.shape(leaf)}"
+                    )
+                new_leaves.append(value.astype(np.asarray(leaf).dtype))
+            elif strict:
+                raise KeyError(f"Checkpoint missing leaf {name}")
+            else:
+                new_leaves.append(leaf)
+        new_fields[prefix] = jax.tree_util.tree_unflatten(
+            treedef, new_leaves
+        )
+    return state.replace(
+        step=new_fields["step"],
+        params=new_fields["params"],
+        batch_stats=new_fields["batch_stats"],
+        opt_state=new_fields["opt_state"],
+        rng=new_fields["rng"],
+    )

@@ -106,6 +106,9 @@ def add_common_params(parser):
                         help="Container image for spawned pods")
     parser.add_argument("--namespace", default="default")
     parser.add_argument("--num_workers", type=pos_int, default=1)
+    parser.add_argument("--checkpoint_shards", type=pos_int, default=1,
+                        help="Shard files per checkpoint version "
+                             "(reference: one file per PS pod)")
     parser.add_argument("--worker_resource_request",
                         default="cpu=1,memory=4096Mi")
     parser.add_argument("--worker_resource_limit", default="")

@@ -15,8 +15,11 @@ _DTYPES = {
     "int8": (np.dtype(np.int8), 1),
     "uint8": (np.dtype(np.uint8), 1),
     "int16": (np.dtype(np.int16), 2),
+    "uint16": (np.dtype(np.uint16), 2),
     "int32": (np.dtype(np.int32), 4),
+    "uint32": (np.dtype(np.uint32), 4),
     "int64": (np.dtype(np.int64), 8),
+    "uint64": (np.dtype(np.uint64), 8),
     "float16": (np.dtype(np.float16), 2),
     "bfloat16": (np.dtype(jnp.bfloat16), 2),
     "float32": (np.dtype(np.float32), 4),

@@ -31,7 +31,9 @@ class IndexedSlices:
 
 
 def serialize_ndarray(arr: np.ndarray) -> dict:
-    arr = np.ascontiguousarray(arr)
+    # np.ascontiguousarray would promote 0-d arrays to 1-d; asarray with
+    # order="C" keeps scalar shape () intact.
+    arr = np.asarray(arr, order="C")
     return {
         "dtype": dtypes.dtype_name(arr.dtype),
         "shape": list(arr.shape),

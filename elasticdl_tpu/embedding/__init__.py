@@ -25,9 +25,23 @@ from elasticdl_tpu.embedding.partition import (
     embedding_partition_rule,
     tree_partition_specs,
 )
+from elasticdl_tpu.embedding.optimizer import (
+    HostOptimizerWrapper,
+    RowOptimizer,
+    init_slot_tables,
+    make_row_optimizer,
+    sparse_apply,
+    unique_pad,
+)
 from elasticdl_tpu.embedding.table import EmbeddingTable, get_slot_table_name
 
 __all__ = [
+    "HostOptimizerWrapper",
+    "RowOptimizer",
+    "init_slot_tables",
+    "make_row_optimizer",
+    "sparse_apply",
+    "unique_pad",
     "RaggedIds",
     "combine",
     "Embedding",

@@ -44,7 +44,12 @@ class RaggedIds(struct.PyTreeNode):
         ids = np.zeros((batch, width), np.int32)
         weights = np.zeros((batch, width), np.float32)
         for i, row in enumerate(id_lists):
-            row = list(row)[:width]
+            row = list(row)
+            if len(row) > width:
+                raise ValueError(
+                    f"row {i} has {len(row)} ids > max_ids={width}; "
+                    "raise max_ids (silent truncation would drop features)"
+                )
             n = len(row)
             ids[i, :n] = row
             if weight_lists is not None:

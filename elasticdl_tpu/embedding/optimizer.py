@@ -1,0 +1,248 @@
+"""Row optimizers: sparse updates touching only looked-up rows.
+
+Counterpart of two reference components:
+
+- ``elasticdl/python/ps/optimizer_wrapper.py:57-338`` — make a vanilla
+  optimizer update *externally stored* embedding rows plus their slot
+  rows (momentum/m/v/accumulator), creating slots lazily;
+- the Go/C++ PS update kernels (``elasticdl/pkg/ps/optimizer.go``,
+  ``pkg/kernel/capi/kernel_api.cc:6-96``) — SGD, Momentum(+Nesterov),
+  Adam(+amsgrad, bias correction), Adagrad.
+
+Here the update math is pure array code, so the same functions serve
+- the **device path**: scatter-apply on a (possibly mesh-sharded) in-HBM
+  table inside a jit step, touching only unique looked-up rows,
+- the **host path**: numpy rows pulled from a lazy `EmbeddingTable`
+  (apply → write back, mirroring OptimizerWrapper.apply_gradients).
+"""
+
+from dataclasses import dataclass, field
+from typing import Dict, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from elasticdl_tpu.embedding.table import EmbeddingTable, get_slot_table_name
+
+
+@dataclass(frozen=True)
+class RowOptimizer:
+    """Per-row update rule. ``slot_names`` mirrors the reference per-opt
+    slot tables (optimizer_wrapper.py:103-133); slots are created
+    zero-initialized (constant-init slot tables, ps/parameters.py:156)."""
+
+    name: str = "sgd"
+    lr: float = 0.01
+    momentum: float = 0.0
+    nesterov: bool = False
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+    amsgrad: bool = False
+    slot_names: Tuple[str, ...] = ()
+
+    def apply_rows(self, rows, grads, slots: Dict[str, "jnp.ndarray"],
+                   step):
+        """(rows, slots) -> (new_rows, new_slots); ``step`` is the 1-based
+        apply count used for Adam bias correction (kernel_api.cc:52-55)."""
+        raise NotImplementedError
+
+
+@dataclass(frozen=True)
+class SGD(RowOptimizer):
+    name: str = "sgd"
+
+    def apply_rows(self, rows, grads, slots, step):
+        return rows - self.lr * grads, slots
+
+
+@dataclass(frozen=True)
+class Momentum(RowOptimizer):
+    name: str = "momentum"
+    momentum: float = 0.9
+    slot_names: Tuple[str, ...] = ("momentum",)
+
+    def apply_rows(self, rows, grads, slots, step):
+        vel = self.momentum * slots["momentum"] + grads
+        if self.nesterov:
+            update = self.momentum * vel + grads
+        else:
+            update = vel
+        return rows - self.lr * update, {"momentum": vel}
+
+
+@dataclass(frozen=True)
+class Adam(RowOptimizer):
+    name: str = "adam"
+    lr: float = 0.001
+    slot_names: Tuple[str, ...] = ("m", "v")
+
+    def apply_rows(self, rows, grads, slots, step):
+        xp = jnp if isinstance(rows, jnp.ndarray) else np
+        m = self.beta1 * slots["m"] + (1.0 - self.beta1) * grads
+        v = self.beta2 * slots["v"] + (1.0 - self.beta2) * grads * grads
+        new_slots = {"m": m, "v": v}
+        step = xp.asarray(step, rows.dtype)
+        m_hat = m / (1.0 - self.beta1**step)
+        v_hat = v / (1.0 - self.beta2**step)
+        if self.amsgrad:
+            vmax = xp.maximum(slots["max_v"], v_hat)
+            new_slots["max_v"] = vmax
+            v_hat = vmax
+        new_rows = rows - self.lr * m_hat / (xp.sqrt(v_hat) + self.epsilon)
+        return new_rows, new_slots
+
+
+@dataclass(frozen=True)
+class AdamAmsgrad(Adam):
+    amsgrad: bool = True
+    slot_names: Tuple[str, ...] = ("m", "v", "max_v")
+
+
+@dataclass(frozen=True)
+class Adagrad(RowOptimizer):
+    name: str = "adagrad"
+    slot_names: Tuple[str, ...] = ("accumulator",)
+    initial_accumulator: float = 0.1
+
+    def apply_rows(self, rows, grads, slots, step):
+        xp = jnp if isinstance(rows, jnp.ndarray) else np
+        acc = slots["accumulator"] + grads * grads
+        new_rows = rows - self.lr * grads / (xp.sqrt(acc) + self.epsilon)
+        return new_rows, {"accumulator": acc}
+
+
+_OPTIMIZERS = {
+    "SGD": SGD,
+    "sgd": SGD,
+    "Momentum": Momentum,
+    "momentum": Momentum,
+    "Adam": Adam,
+    "adam": Adam,
+    "Adagrad": Adagrad,
+    "adagrad": Adagrad,
+}
+
+
+def make_row_optimizer(opt_type: str, **kwargs) -> RowOptimizer:
+    """Flag-string construction (reference pkg/ps/optimizer.go:290-312:
+    the master serializes the user optimizer to -opt_type/-opt_args)."""
+    if opt_type in ("Adam", "adam") and kwargs.pop("amsgrad", False):
+        return AdamAmsgrad(**kwargs)
+    cls = _OPTIMIZERS.get(opt_type)
+    if cls is None:
+        raise ValueError(
+            f"Unsupported row optimizer {opt_type!r}; "
+            f"have {sorted(set(_OPTIMIZERS))}"
+        )
+    return cls(**kwargs)
+
+
+def slot_init_value(opt: RowOptimizer, slot_name: str) -> float:
+    if isinstance(opt, Adagrad) and slot_name == "accumulator":
+        return opt.initial_accumulator
+    return 0.0
+
+
+# ---- device path: sparse scatter apply on an in-HBM table ----------------
+
+
+def sparse_apply(opt: RowOptimizer, table, slot_tables: Dict[str, "jnp.ndarray"],
+                 unique_ids, row_grads, step):
+    """Scatter-update only ``unique_ids`` rows of a full ``(V, D)`` table.
+
+    ``unique_ids`` must be deduplicated with padding set to an
+    OUT-OF-RANGE id (``unique_pad(ids, fill_id=vocab)``): pad gathers
+    clamp (their grads are zero so values are irrelevant) and pad
+    scatters are dropped — a pad id aliasing a real row would otherwise
+    race its duplicate scatter and, for Adam/Adagrad, corrupt slot state
+    even with zero grad.
+    """
+    rows = table.at[unique_ids].get(mode="clip")
+    slots = {
+        name: slot_tables[name].at[unique_ids].get(mode="clip")
+        for name in opt.slot_names
+    }
+    new_rows, new_slots = opt.apply_rows(rows, row_grads, slots, step)
+    table = table.at[unique_ids].set(new_rows, mode="drop")
+    slot_tables = dict(slot_tables)
+    for name in opt.slot_names:
+        slot_tables[name] = slot_tables[name].at[unique_ids].set(
+            new_slots[name], mode="drop"
+        )
+    return table, slot_tables
+
+
+def init_slot_tables(opt: RowOptimizer, vocab: int, dim: int,
+                     dtype=jnp.float32) -> Dict[str, "jnp.ndarray"]:
+    return {
+        name: jnp.full((vocab, dim), slot_init_value(opt, name), dtype)
+        for name in opt.slot_names
+    }
+
+
+def unique_pad(ids, fill_id: int):
+    """Static-shape dedup: ``jnp.unique`` padded to ``ids.size`` with
+    ``fill_id`` (pass the vocab size — an out-of-range sentinel, see
+    ``sparse_apply``); returns (unique_ids, inverse) with inverse mapping
+    each original position to its unique slot (XLA static-shape
+    requirement; reference dedups with dynamic shapes in
+    tensor_utils.py:66-101)."""
+    flat = jnp.ravel(ids)
+    uniq, inverse = jnp.unique(
+        flat, size=flat.size, fill_value=fill_id, return_inverse=True
+    )
+    return uniq, jnp.reshape(inverse, jnp.shape(ids))
+
+
+# ---- host path: apply to lazy EmbeddingTables ----------------------------
+
+
+class HostOptimizerWrapper:
+    """Apply row updates to host-tier lazy tables
+    (OptimizerWrapper.apply_gradients:143 semantics: lookup rows, create
+    slots lazily, apply, write rows+slots back)."""
+
+    def __init__(self, opt: RowOptimizer):
+        self.opt = opt
+        self._slot_tables: Dict[str, EmbeddingTable] = {}
+        # Per-table apply counts: one wrapper serves many tables, and Adam
+        # bias correction needs each table's own step (the reference's
+        # optimizer.iterations covers all variables of one training step;
+        # per-table counting is equivalent when every table is updated
+        # each step and correct when some are skipped).
+        self._steps: Dict[str, int] = {}
+
+    def _slot_table(self, table: EmbeddingTable, slot_name: str):
+        key = get_slot_table_name(table.name, slot_name)
+        if key not in self._slot_tables:
+            self._slot_tables[key] = EmbeddingTable(
+                key,
+                table.dim,
+                is_slot=True,
+                slot_init_value=slot_init_value(self.opt, slot_name),
+                dtype=table.dtype,
+            )
+        return self._slot_tables[key]
+
+    def apply_gradients(self, table: EmbeddingTable, ids, grads):
+        """ids must be unique; grads is (len(ids), dim)."""
+        ids = [int(i) for i in ids]
+        if len(set(ids)) != len(ids):
+            raise ValueError("ids must be deduplicated before apply")
+        step = self._steps.get(table.name, 0) + 1
+        self._steps[table.name] = step
+        rows = table.get(ids)
+        slots = {
+            name: self._slot_table(table, name).get(ids)
+            for name in self.opt.slot_names
+        }
+        new_rows, new_slots = self.opt.apply_rows(
+            rows, np.asarray(grads, table.dtype), slots, step
+        )
+        table.set(ids, np.asarray(new_rows))
+        for name in self.opt.slot_names:
+            self._slot_table(table, name).set(
+                ids, np.asarray(new_slots[name])
+            )
+        return table

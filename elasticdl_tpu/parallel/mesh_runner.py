@@ -114,6 +114,14 @@ class MeshRunner:
         """Shard a host batch over the dp axis (leading dim)."""
         return jax.device_put(batch, self._batch_sharding())
 
+    def place_state(self, state):
+        """Re-place a (host-restored) state onto the mesh shardings.
+
+        Used after checkpoint restore: restored leaves are numpy arrays
+        with no sharding; without re-placement a row-sharded table would
+        be committed whole to one device."""
+        return jax.device_put(state, self._require_shardings())
+
     def train_step(self, loss_fn: Callable) -> Callable:
         if self.accum_steps > 1:
             return self._accum_train_step(loss_fn)
@@ -219,8 +227,16 @@ class MeshRunner:
             )
             return (state, grad_acc, count), loss
 
+        # Pin the carry's shardings so a host-restored state (numpy
+        # leaves) re-places onto the mesh instead of committing to one
+        # device; grad accumulator co-shards with params.
+        carry_shardings = (
+            shardings, shardings.params, mesh_lib.replicated(self.mesh)
+        )
         jit_micro = jax.jit(
             micro_step,
+            in_shardings=(carry_shardings, None),
+            out_shardings=(carry_shardings, None),
             donate_argnums=(0,) if self._donate_state else (),
         )
         runner = self

@@ -42,6 +42,9 @@ class MiniCluster:
         step_runner_factory=None,
         worker_callbacks: Optional[Dict[str, callable]] = None,
         shuffle: bool = False,
+        checkpoint_dir: str = "",
+        checkpoint_steps: int = 0,
+        checkpoint_dir_for_init: str = "",
     ):
         self.spec = get_model_spec(model_zoo, model_def)
         reader_of = lambda origin: create_data_reader(
@@ -92,6 +95,14 @@ class MiniCluster:
         task_reader = (
             self.train_reader or self.eval_reader or self.predict_reader
         )
+        hook = None
+        if checkpoint_dir:
+            from elasticdl_tpu.checkpoint import CheckpointHook
+
+            hook = CheckpointHook(
+                checkpoint_dir=checkpoint_dir,
+                checkpoint_steps=checkpoint_steps,
+            )
         self.workers: List[Worker] = []
         for wid in range(num_workers):
             if use_rpc:
@@ -122,6 +133,9 @@ class MiniCluster:
                         self.spec.callbacks_fn()
                         if self.spec.callbacks_fn else []
                     ),
+                    # One writer: worker 0 (state is shared/replicated).
+                    checkpoint_hook=hook if wid == 0 else None,
+                    checkpoint_dir_for_init=checkpoint_dir_for_init,
                 )
             )
 

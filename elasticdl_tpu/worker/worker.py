@@ -58,6 +58,8 @@ class Worker:
         prediction_outputs_processor=None,
         callbacks=None,
         timing: Optional[Timing] = None,
+        checkpoint_hook=None,
+        checkpoint_dir_for_init: str = "",
     ):
         self._id = worker_id
         self._master = master_client
@@ -79,6 +81,15 @@ class Worker:
             minibatch_size,
         )
         self.last_metrics = None
+        # Periodic sharded checkpoint (reference PS saves inside
+        # push_gradients every checkpoint_steps versions,
+        # ps/servicer.py:242-257); the job runner passes a hook only to
+        # one worker (host 0) — state is replicated/sharded on the mesh,
+        # so one writer suffices.
+        from elasticdl_tpu.checkpoint import CheckpointHook
+
+        self._checkpoint = checkpoint_hook or CheckpointHook()
+        self._checkpoint_dir_for_init = checkpoint_dir_for_init
 
     # ---- state init ----------------------------------------------------
 
@@ -95,6 +106,18 @@ class Worker:
         else:
             self.state = init_train_state(self._spec.model, tx, batch)
             self._train_step = build_train_step(self._spec.loss)
+        if self._checkpoint_dir_for_init:
+            from elasticdl_tpu.checkpoint import restore_from_dir
+
+            self.state = restore_from_dir(
+                self.state, self._checkpoint_dir_for_init
+            )
+            # Restored leaves are host arrays; re-place them with the
+            # runner's shardings or a mesh-sized table lands on one device.
+            if self._step_runner is not None and hasattr(
+                self._step_runner, "place_state"
+            ):
+                self.state = self._step_runner.place_state(self.state)
 
     def set_state(self, state):
         """Install restored state (checkpoint resume / elastic re-init)."""
@@ -131,6 +154,8 @@ class Worker:
             if version % self._version_report_steps == 0:
                 with self._timing.record("report_version"):
                     self._master.report_version(version)
+            with self._timing.record("checkpoint"):
+                self._checkpoint.maybe_save(self.state)
         return count
 
     def _process_eval_task(self, task, batches):
@@ -201,6 +226,8 @@ class Worker:
                     task.task_id,
                     err_reason=f"{type(exc).__name__}: {exc}",
                 )
+        if self.state is not None and trained_batches:
+            self._checkpoint.save_final(self.state)
         self._timing.report_timing()
         return {
             "worker_id": self._id,

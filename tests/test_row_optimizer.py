@@ -1,0 +1,225 @@
+"""Row-optimizer tests.
+
+Mirrors the reference's optimizer_wrapper_test.py (equivalence of the
+external-row update path against the stock optimizer) and the Go kernel
+tests (pkg/kernel/kernel_test.go: updates vs hand-computed math).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from elasticdl_tpu.embedding.optimizer import (
+    Adagrad,
+    Adam,
+    AdamAmsgrad,
+    HostOptimizerWrapper,
+    Momentum,
+    SGD,
+    init_slot_tables,
+    make_row_optimizer,
+    sparse_apply,
+    unique_pad,
+)
+from elasticdl_tpu.embedding.table import EmbeddingTable
+
+
+def _run_rows(opt, rows, grads_seq):
+    slots = {
+        name: np.full_like(rows, 0.0)
+        if name != "accumulator"
+        else np.full_like(rows, getattr(opt, "initial_accumulator", 0.0))
+        for name in opt.slot_names
+    }
+    for step, grads in enumerate(grads_seq, start=1):
+        rows, slots = opt.apply_rows(rows, grads, slots, step)
+    return rows
+
+
+def _run_optax(tx, rows, grads_seq):
+    state = tx.init(rows)
+    for grads in grads_seq:
+        updates, state = tx.update(grads, state, rows)
+        rows = optax.apply_updates(rows, updates)
+    return rows
+
+
+@pytest.fixture
+def rows_and_grads():
+    rng = np.random.RandomState(0)
+    rows = rng.randn(6, 4).astype(np.float32)
+    grads_seq = [rng.randn(6, 4).astype(np.float32) for _ in range(5)]
+    return rows, grads_seq
+
+
+class TestOptaxEquivalence:
+    def test_sgd(self, rows_and_grads):
+        rows, grads = rows_and_grads
+        ours = _run_rows(SGD(lr=0.1), jnp.asarray(rows), grads)
+        ref = _run_optax(optax.sgd(0.1), jnp.asarray(rows), grads)
+        np.testing.assert_allclose(ours, ref, rtol=1e-5)
+
+    def test_momentum(self, rows_and_grads):
+        rows, grads = rows_and_grads
+        ours = _run_rows(
+            Momentum(lr=0.1, momentum=0.9), jnp.asarray(rows), grads
+        )
+        ref = _run_optax(
+            optax.sgd(0.1, momentum=0.9), jnp.asarray(rows), grads
+        )
+        np.testing.assert_allclose(ours, ref, rtol=1e-5)
+
+    def test_nesterov(self, rows_and_grads):
+        rows, grads = rows_and_grads
+        ours = _run_rows(
+            Momentum(lr=0.1, momentum=0.9, nesterov=True),
+            jnp.asarray(rows), grads,
+        )
+        ref = _run_optax(
+            optax.sgd(0.1, momentum=0.9, nesterov=True),
+            jnp.asarray(rows), grads,
+        )
+        np.testing.assert_allclose(ours, ref, rtol=1e-5)
+
+    def test_adam(self, rows_and_grads):
+        rows, grads = rows_and_grads
+        ours = _run_rows(Adam(lr=0.01), jnp.asarray(rows), grads)
+        ref = _run_optax(
+            optax.adam(0.01, eps_root=0.0), jnp.asarray(rows), grads
+        )
+        np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-6)
+
+    def test_adagrad(self, rows_and_grads):
+        rows, grads = rows_and_grads
+        ours = _run_rows(
+            Adagrad(lr=0.1, epsilon=1e-7), jnp.asarray(rows), grads
+        )
+        ref = _run_optax(
+            optax.adagrad(0.1, initial_accumulator_value=0.1, eps=1e-7),
+            jnp.asarray(rows), grads,
+        )
+        np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-6)
+
+    def test_amsgrad_bounds_update(self, rows_and_grads):
+        rows, grads = rows_and_grads
+        opt = AdamAmsgrad(lr=0.01)
+        assert opt.slot_names == ("m", "v", "max_v")
+        out = _run_rows(opt, jnp.asarray(rows), grads)
+        assert np.all(np.isfinite(np.asarray(out)))
+
+
+class TestFactory:
+    def test_known_types(self):
+        assert isinstance(make_row_optimizer("SGD", lr=0.5), SGD)
+        assert isinstance(make_row_optimizer("Adam"), Adam)
+        assert isinstance(
+            make_row_optimizer("Adam", amsgrad=True), AdamAmsgrad
+        )
+
+    def test_unknown_raises(self):
+        with pytest.raises(ValueError):
+            make_row_optimizer("LBFGS")
+
+
+class TestSparseApply:
+    def test_only_touched_rows_change(self):
+        vocab, dim = 16, 4
+        opt = Adam(lr=0.1)
+        table = jnp.asarray(
+            np.random.RandomState(0).randn(vocab, dim), jnp.float32
+        )
+        slots = init_slot_tables(opt, vocab, dim)
+        ids = jnp.array([3, 7, 3, 7], jnp.int32)
+        uniq, inverse = unique_pad(ids, fill_id=vocab)
+        # Per-unique grads: real slots get ones, pad slots zeros.
+        grads = jnp.where(
+            (uniq < vocab)[:, None], jnp.ones((uniq.size, dim)), 0.0
+        )
+        new_table, new_slots = sparse_apply(
+            opt, table, slots, uniq, grads, step=1
+        )
+        changed = np.nonzero(
+            np.abs(np.asarray(new_table - table)).sum(axis=1)
+        )[0]
+        assert set(changed) == {3, 7}
+        # Slot state only on touched rows.
+        m_changed = np.nonzero(
+            np.abs(np.asarray(new_slots["m"])).sum(axis=1)
+        )[0]
+        assert set(m_changed) == {3, 7}
+
+    def test_pad_id_never_corrupts_row_zero(self):
+        vocab, dim = 8, 2
+        opt = Adagrad(lr=0.1)
+        table = jnp.ones((vocab, dim), jnp.float32)
+        slots = init_slot_tables(opt, vocab, dim)
+        ids = jnp.array([2, 2, 2, 2], jnp.int32)
+        uniq, _ = unique_pad(ids, fill_id=vocab)
+        grads = jnp.where(
+            (uniq < vocab)[:, None], jnp.ones((uniq.size, dim)), 0.0
+        )
+        new_table, new_slots = sparse_apply(
+            opt, table, slots, uniq, grads, step=1
+        )
+        np.testing.assert_array_equal(np.asarray(new_table[0]), [1.0, 1.0])
+        np.testing.assert_array_equal(
+            np.asarray(new_slots["accumulator"][0]),
+            np.asarray(slots["accumulator"][0]),
+        )
+
+    def test_matches_dense_apply_on_touched_rows(self):
+        vocab, dim = 12, 3
+        opt = Momentum(lr=0.05, momentum=0.9)
+        rng = np.random.RandomState(1)
+        table = jnp.asarray(rng.randn(vocab, dim), jnp.float32)
+        slots = init_slot_tables(opt, vocab, dim)
+        dense_rows = table[jnp.array([1, 5])]
+        dense_slots = {"momentum": jnp.zeros((2, dim))}
+        grads2 = jnp.asarray(rng.randn(2, dim), jnp.float32)
+        expect, _ = opt.apply_rows(dense_rows, grads2, dense_slots, 1)
+
+        ids = jnp.array([1, 5], jnp.int32)
+        uniq, _ = unique_pad(ids, fill_id=vocab)
+        order = np.argsort(np.asarray(ids))
+        grads_u = grads2[jnp.asarray(order)]
+        new_table, _ = sparse_apply(opt, table, slots, uniq, grads_u, 1)
+        np.testing.assert_allclose(
+            np.asarray(new_table[jnp.array([1, 5])]),
+            np.asarray(expect), rtol=1e-5,
+        )
+
+
+class TestHostWrapper:
+    def test_lazy_slots_and_device_equivalence(self):
+        dim = 4
+        opt = Adam(lr=0.01)
+        table = EmbeddingTable("tbl", dim)
+        wrapper = HostOptimizerWrapper(opt)
+        rng = np.random.RandomState(2)
+        ids = [3, 9]
+        initial = table.get(ids).copy()
+        grads1 = rng.randn(2, dim).astype(np.float32)
+        grads2 = rng.randn(2, dim).astype(np.float32)
+        wrapper.apply_gradients(table, ids, grads1)
+        wrapper.apply_gradients(table, ids, grads2)
+
+        # Same trajectory on the device path.
+        dev_rows = jnp.asarray(initial)
+        dev_slots = {"m": jnp.zeros((2, dim)), "v": jnp.zeros((2, dim))}
+        dev_rows, dev_slots = opt.apply_rows(dev_rows, grads1, dev_slots, 1)
+        dev_rows, dev_slots = opt.apply_rows(dev_rows, grads2, dev_slots, 2)
+        np.testing.assert_allclose(
+            table.get(ids), np.asarray(dev_rows), rtol=1e-5
+        )
+        # Slot tables created lazily with reference naming.
+        assert "tbl-m" in wrapper._slot_tables
+        assert "tbl-v" in wrapper._slot_tables
+
+    def test_duplicate_ids_rejected(self):
+        wrapper = HostOptimizerWrapper(SGD(lr=0.1))
+        table = EmbeddingTable("t", 2)
+        with pytest.raises(ValueError):
+            wrapper.apply_gradients(
+                table, [1, 1], np.ones((2, 2), np.float32)
+            )
