@@ -65,6 +65,7 @@ class LocalExecutor:
         self._evaluation_steps = getattr(args, "evaluation_steps", 0)
         self._timing = Timing(args.log_level.upper() == "DEBUG", self._logger)
         self.state = None
+        self.last_batch = None
         self._train_step = build_train_step(self._spec.loss)
         self._eval_step = build_eval_step()
         self.last_train_metrics = None
@@ -75,11 +76,40 @@ class LocalExecutor:
             checkpoint_dir=getattr(args, "checkpoint_dir", ""),
             checkpoint_steps=getattr(args, "checkpoint_steps", 0),
             num_shards=getattr(args, "checkpoint_shards", 1) or 1,
-            keep_max=getattr(args, "keep_checkpoint_max", 3) or 3,
+            # 0 is a legal explicit value meaning "keep everything"
+            # (CheckpointSaver.gc); only an absent flag falls back to 3.
+            keep_max=getattr(args, "keep_checkpoint_max", 3),
         )
         self._init_checkpoint_dir = getattr(
             args, "checkpoint_dir_for_init", ""
         )
+        # Callbacks (reference callbacks.py + model_utils.py:44-63):
+        # MaxStepsStopping becomes a dispatch bound, LearningRateScheduler
+        # folds into the optax chain at state init, behavioral hooks run
+        # at train end.
+        from elasticdl_tpu.callbacks import (
+            MaxStepsStopping,
+            find_callback,
+            set_callback_parameters,
+        )
+
+        self._callbacks = (
+            self._spec.callbacks_fn() if self._spec.callbacks_fn else []
+        )
+        set_callback_parameters(
+            self._callbacks, batch_size=self._batch_size,
+            epochs=self._epochs,
+        )
+        max_steps_cb = find_callback(self._callbacks, MaxStepsStopping)
+        if max_steps_cb is not None and not self._max_steps:
+            self._max_steps = max_steps_cb.max_steps
+        self._tb_service = None
+        if getattr(args, "tensorboard_log_dir", ""):
+            from elasticdl_tpu.master.tensorboard_service import (
+                TensorboardService,
+            )
+
+            self._tb_service = TensorboardService(args.tensorboard_log_dir)
 
     def _task_batches(self, reader, mode):
         shards = reader.create_shards()
@@ -100,7 +130,11 @@ class LocalExecutor:
 
     def _maybe_init_state(self, batch):
         if self.state is None:
-            tx = self._spec.make_optimizer()
+            from elasticdl_tpu.callbacks import apply_callbacks_to_optimizer
+
+            tx = apply_callbacks_to_optimizer(
+                self._spec.make_optimizer(), self._callbacks
+            )
             self.state = init_train_state(
                 self._spec.model, tx, batch,
                 seed=getattr(self._args, "random_seed", 0),
@@ -124,6 +158,7 @@ class LocalExecutor:
                 break
             for batch in self._task_batches(self._train_reader, Mode.TRAINING):
                 self._maybe_init_state(batch)
+                self.last_batch = batch
                 with self._timing.record("batch_process"):
                     self.state, metrics = self._train_step(self.state, batch)
                 self.last_train_metrics = metrics
@@ -134,6 +169,10 @@ class LocalExecutor:
                     self._logger.info(
                         "step=%d loss=%.5f", steps, float(metrics["loss"])
                     )
+                    if self._tb_service is not None:
+                        self._tb_service.write_dict_to_summary(
+                            {"train/loss": float(metrics["loss"])}, steps
+                        )
                 if self._evaluation_steps and (
                     steps % self._evaluation_steps == 0
                 ):
@@ -150,6 +189,14 @@ class LocalExecutor:
         self._checkpoint.save_final(self.state)
         elapsed = time.monotonic() - start_time
         eval_result = self.evaluate() if self._eval_reader else None
+        if eval_result and self._tb_service is not None:
+            self._tb_service.write_eval_metrics(steps, eval_result)
+        for cb in self._callbacks:
+            on_end = getattr(cb, "on_train_end", None)
+            if on_end is not None:
+                on_end(self)
+        if self._tb_service is not None:
+            self._tb_service.close()
         self._timing.report_timing()
         return {
             "steps": steps,

@@ -1,0 +1,138 @@
+"""User-facing callbacks (reference elasticdl/python/elasticdl/callbacks.py).
+
+The reference ships three callbacks plus a Keras ``CallbackList`` wiring
+(reference callbacks.py:12-141, common/model_utils.py:44-63):
+
+- ``SavedModelExporter`` — a TRAIN_END_CALLBACK task exports a SavedModel
+  (reference callbacks.py:26-54). Here the export is a TPU-native serving
+  bundle (see serving/export.py): flax-serialized params + metadata +
+  a ``jax.export`` StableHLO artifact of the predict function.
+- ``MaxStepsStopping`` — stop the job once the model version reaches
+  ``max_steps`` (reference callbacks.py:57-98). In the reference the worker
+  raises at a version threshold; here it is declarative — executors read
+  ``max_steps`` and stop dispatching, which is exact rather than best-effort.
+- ``LearningRateScheduler`` — the reference mutates ``optimizer.lr`` per
+  batch from the model version (reference callbacks.py:101-141). Mutating a
+  live optimizer is impossible (and an antipattern) under jit, so the
+  schedule compiles into the optimizer: ``schedule(version) -> multiplier``
+  becomes an ``optax.scale_by_schedule`` stage over the user optimizer's
+  updates. Same semantics (version-indexed LR), zero host round-trips.
+
+Executors translate the declarative callbacks when building the optimizer /
+job config (``apply_callbacks_to_optimizer``, ``find_callback``); behavioral
+hooks (``on_train_end``) run on the worker that receives the
+TRAIN_END_CALLBACK task, exactly like the reference (worker.py:957-962).
+"""
+
+from typing import Callable, List, Optional
+
+import optax
+
+from elasticdl_tpu.common.log_utils import get_logger
+
+logger = get_logger("callbacks")
+
+
+class Callback:
+    """Minimal callback protocol. Subclasses override what they need."""
+
+    # Populated by set_callback_parameters (reference model_utils.py:44-63).
+    params: dict = {}
+
+    def set_params(self, params: dict):
+        self.params = dict(params)
+
+    def on_train_end(self, owner=None):  # owner: Worker or LocalExecutor
+        pass
+
+
+class SavedModelExporter(Callback):
+    """Export a serving bundle when training ends
+    (reference callbacks.py:26-54 exports a tf SavedModel)."""
+
+    def __init__(self, output_dir: str, batch_example=None):
+        self._output_dir = output_dir
+        self._batch_example = batch_example
+
+    def on_train_end(self, owner=None):
+        from elasticdl_tpu.serving.export import export_serving_bundle
+
+        if owner is None or getattr(owner, "state", None) is None:
+            logger.warning("SavedModelExporter: no trained state to export")
+            return
+        spec = getattr(owner, "_spec", None) or getattr(owner, "spec", None)
+        export_serving_bundle(
+            self._output_dir,
+            model=spec.model if spec is not None else None,
+            state=owner.state,
+            batch_example=(
+                self._batch_example
+                if self._batch_example is not None
+                else getattr(owner, "last_batch", None)
+            ),
+            model_def=getattr(spec, "model_fn_name", ""),
+        )
+        logger.info("Exported serving bundle to %s", self._output_dir)
+
+
+class MaxStepsStopping(Callback):
+    """Stop training at ``max_steps`` model versions
+    (reference callbacks.py:57-98)."""
+
+    def __init__(self, max_steps: int):
+        if max_steps <= 0:
+            raise ValueError("max_steps must be positive")
+        self.max_steps = int(max_steps)
+
+
+class LearningRateScheduler(Callback):
+    """Version-indexed LR multiplier compiled into the optimizer
+    (reference callbacks.py:101-141 mutates optimizer.lr per batch).
+
+    ``schedule(version) -> float`` multiplies the base optimizer's updates
+    at that version; it must be JAX-traceable (jnp ops, lax.cond — no
+    Python branches on the version value).
+    """
+
+    def __init__(self, schedule: Callable[[int], float]):
+        self.schedule = schedule
+
+    def wrap(self, tx: optax.GradientTransformation):
+        return optax.chain(tx, optax.scale_by_schedule(self.schedule))
+
+
+def find_callback(callbacks: Optional[List[Callback]], cls):
+    for cb in callbacks or []:
+        if isinstance(cb, cls):
+            return cb
+    return None
+
+
+def apply_callbacks_to_optimizer(
+    tx: optax.GradientTransformation, callbacks: Optional[List[Callback]]
+) -> optax.GradientTransformation:
+    """Fold every LearningRateScheduler into the optax chain."""
+    for cb in callbacks or []:
+        if isinstance(cb, LearningRateScheduler):
+            tx = cb.wrap(tx)
+    return tx
+
+
+def set_callback_parameters(
+    callbacks: Optional[List[Callback]],
+    batch_size: int = 0,
+    epochs: int = 0,
+    verbose: int = 0,
+    mode: str = "training",
+):
+    """Inject job params into each callback
+    (reference common/model_utils.py:44-63)."""
+    params = {
+        "batch_size": batch_size,
+        "epochs": epochs,
+        "verbose": verbose,
+        "mode": mode,
+    }
+    for cb in callbacks or []:
+        cb.set_params(params)
+    return callbacks

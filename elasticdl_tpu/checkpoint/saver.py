@@ -71,6 +71,15 @@ class CheckpointSaver:
             shutil.rmtree(tmp)
         os.makedirs(tmp)
         n = self.num_shards
+        # Materialize each table once; per-shard masks are vectorized
+        # (int_to_id is id % n for non-negative row ids).
+        table_arrays = {
+            tname: table.to_arrays()
+            for tname, table in (embeddings or {}).items()
+        }
+        table_shard_of = {
+            tname: ids % n for tname, (ids, _rows) in table_arrays.items()
+        }
         for shard in range(n):
             payload = {
                 "meta": {
@@ -85,11 +94,8 @@ class CheckpointSaver:
                 },
                 "embeddings": {},
             }
-            for tname, table in (embeddings or {}).items():
-                ids, rows = table.to_arrays()
-                keep = np.asarray(
-                    [int_to_id(int(i), n) == shard for i in ids], bool
-                )
+            for tname, (ids, rows) in table_arrays.items():
+                keep = table_shard_of[tname] == shard
                 payload["embeddings"][tname] = tensor_utils.IndexedSlices(
                     values=rows[keep], ids=ids[keep]
                 )
@@ -161,15 +167,15 @@ class CheckpointSaver:
                 payload = tensor_utils.loads(f.read())
             dense.update(payload.get("dense", {}))
             for tname, slices in payload.get("embeddings", {}).items():
-                if slices.values.size == 0 and tname in embeddings:
-                    continue
+                # An empty (0, D) slice still carries the row dim; a shard
+                # that happens to own zero rows of a table must not pin the
+                # table to dim 0 (all its rows may live in later shards).
+                dim = (
+                    slices.values.shape[1]
+                    if slices.values.ndim == 2 else 0
+                )
                 table = embeddings.get(tname)
-                if table is None:
-                    dim = (
-                        slices.values.shape[1]
-                        if slices.values.ndim == 2 and slices.values.size
-                        else 0
-                    )
+                if table is None or (table.dim == 0 and dim):
                     table = EmbeddingTable(tname, dim)
                     embeddings[tname] = table
                 if slices.ids.size:

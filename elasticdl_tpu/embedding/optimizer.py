@@ -77,6 +77,13 @@ class Adam(RowOptimizer):
     lr: float = 0.001
     slot_names: Tuple[str, ...] = ("m", "v")
 
+    def __post_init__(self):
+        if self.amsgrad and "max_v" not in self.slot_names:
+            raise ValueError(
+                "amsgrad needs the 'max_v' slot table: use AdamAmsgrad or "
+                "make_row_optimizer('Adam', amsgrad=True)"
+            )
+
     def apply_rows(self, rows, grads, slots, step):
         xp = jnp if isinstance(rows, jnp.ndarray) else np
         m = self.beta1 * slots["m"] + (1.0 - self.beta1) * grads

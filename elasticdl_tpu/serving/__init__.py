@@ -1,0 +1,4 @@
+from elasticdl_tpu.serving.export import (  # noqa: F401
+    export_serving_bundle,
+    load_predictor,
+)

@@ -1,0 +1,17 @@
+"""Prediction output sink contract
+(reference worker/prediction_outputs_processor.py:4-23).
+
+Users subclass this in their model-zoo module as
+``PredictionOutputsProcessor`` and the worker calls ``process`` with each
+prediction batch (reference worker.py: _process_predict_task); typical
+implementations write to files, tables, or queues.
+"""
+
+import abc
+
+
+class BasePredictionOutputsProcessor(abc.ABC):
+    @abc.abstractmethod
+    def process(self, predictions, worker_id: int):
+        """Handle one batch of predictions produced by ``worker_id``."""
+        raise NotImplementedError

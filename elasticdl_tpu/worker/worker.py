@@ -74,6 +74,7 @@ class Worker:
         # None = plain jit on the local device.
         self._step_runner = step_runner
         self.state = None
+        self.last_batch = None
         self._train_step = None
         self._eval_step = build_eval_step()
         self._task_data = TaskDataService(
@@ -96,7 +97,11 @@ class Worker:
     def _maybe_init(self, batch):
         if self.state is not None:
             return
-        tx = self._spec.make_optimizer()
+        from elasticdl_tpu.callbacks import apply_callbacks_to_optimizer
+
+        tx = apply_callbacks_to_optimizer(
+            self._spec.make_optimizer(), self._callbacks
+        )
         if self._step_runner is not None:
             self.state = self._step_runner.init_state(
                 self._spec.model, tx, batch
@@ -147,6 +152,7 @@ class Worker:
         count = 0
         for batch in batches:
             self._maybe_init(batch)
+            self.last_batch = batch
             with self._timing.record("batch_process"):
                 self._process_train_batch(batch)
             count += 1

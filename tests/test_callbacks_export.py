@@ -1,0 +1,287 @@
+"""Stage 8 tests: callbacks, serving export, tfevents writer.
+
+Mirrors the reference's callback/export coverage (tests around
+callbacks.py + model_handler export, SURVEY.md §4) plus a binary-level check
+of the tfevents record framing.
+"""
+
+import json
+import os
+import struct
+
+import numpy as np
+import pytest
+
+from elasticdl_tpu.callbacks import (
+    LearningRateScheduler,
+    MaxStepsStopping,
+    SavedModelExporter,
+    apply_callbacks_to_optimizer,
+    find_callback,
+    set_callback_parameters,
+)
+from elasticdl_tpu.core.model_spec import get_model_spec
+from elasticdl_tpu.core.step import build_train_step
+from elasticdl_tpu.core.train_state import init_train_state
+from elasticdl_tpu.master.tensorboard_service import (
+    SummaryWriter,
+    TensorboardService,
+    _crc32c,
+    _masked_crc,
+)
+from elasticdl_tpu.serving.export import (
+    export_serving_bundle,
+    load_predictor,
+)
+from elasticdl_tpu.testing.data import model_zoo_dir
+
+
+def _mnist_batch(n=8, seed=0):
+    rng = np.random.RandomState(seed)
+    return {
+        "features": rng.rand(n, 28, 28).astype(np.float32),
+        "labels": rng.randint(0, 10, n).astype(np.int32),
+        "mask": np.ones((n,), np.float32),
+    }
+
+
+@pytest.fixture(scope="module")
+def mnist_spec():
+    return get_model_spec(
+        model_zoo_dir(), "mnist.mnist_functional.custom_model"
+    )
+
+
+class TestCallbacks:
+    def test_max_steps_stopping(self):
+        cb = MaxStepsStopping(5)
+        assert cb.max_steps == 5
+        with pytest.raises(ValueError):
+            MaxStepsStopping(0)
+        cbs = [MaxStepsStopping(7)]
+        assert find_callback(cbs, MaxStepsStopping).max_steps == 7
+        assert find_callback(cbs, LearningRateScheduler) is None
+
+    def test_set_callback_parameters(self):
+        cbs = [MaxStepsStopping(5)]
+        set_callback_parameters(cbs, batch_size=32, epochs=2)
+        assert cbs[0].params["batch_size"] == 32
+
+    def test_lr_scheduler_scales_updates(self, mnist_spec):
+        """A zero schedule must freeze the params entirely."""
+        import jax.numpy as jnp
+
+        batch = _mnist_batch()
+        cbs = [LearningRateScheduler(lambda v: jnp.zeros(()))]
+        tx = apply_callbacks_to_optimizer(mnist_spec.make_optimizer(), cbs)
+        import jax
+
+        state = init_train_state(mnist_spec.model, tx, batch, seed=0)
+        # Snapshot to host first: the train step donates the input state.
+        before = jax.tree.map(np.asarray, state.params)
+        step = build_train_step(mnist_spec.loss)
+        state2, _ = step(state, batch)
+
+        diffs = jax.tree.map(
+            lambda a, b: float(np.max(np.abs(np.asarray(a) - np.asarray(b)))),
+            before, state2.params,
+        )
+        assert max(jax.tree.leaves(diffs)) == 0.0
+
+
+class TestServingExport:
+    def test_export_and_standalone_predict(self, mnist_spec, tmp_path):
+        batch = _mnist_batch()
+        state = init_train_state(
+            mnist_spec.model, mnist_spec.make_optimizer(), batch, seed=0
+        )
+        out = str(tmp_path / "bundle")
+        export_serving_bundle(
+            out, mnist_spec.model, state, batch_example=batch,
+            model_def="custom_model",
+        )
+        assert os.path.exists(os.path.join(out, "params.msgpack"))
+        assert os.path.exists(os.path.join(out, "predict.stablehlo"))
+        meta = json.load(open(os.path.join(out, "metadata.json")))
+        assert meta["self_contained"]
+
+        # Standalone: no flax module handed to the loader.
+        predict = load_predictor(out)
+        preds = predict(batch["features"])
+        variables = {"params": state.params}
+        if state.batch_stats:
+            variables["batch_stats"] = state.batch_stats
+        ref = mnist_spec.model.apply(
+            variables, batch["features"], training=False
+        )
+        np.testing.assert_allclose(
+            np.asarray(preds), np.asarray(ref), rtol=1e-5, atol=1e-5
+        )
+
+    def test_export_without_example_needs_model(self, mnist_spec, tmp_path):
+        batch = _mnist_batch()
+        state = init_train_state(
+            mnist_spec.model, mnist_spec.make_optimizer(), batch, seed=0
+        )
+        out = str(tmp_path / "bundle2")
+        export_serving_bundle(out, mnist_spec.model, state)
+        with pytest.raises(ValueError):
+            load_predictor(out)
+        predict = load_predictor(out, model=mnist_spec.model)
+        assert np.asarray(predict(batch["features"])).shape == (8, 10)
+
+    def test_saved_model_exporter_callback(self, mnist_spec, tmp_path):
+        from elasticdl_tpu.api.local_executor import LocalExecutor  # noqa
+
+        batch = _mnist_batch()
+
+        class Owner:
+            pass
+
+        owner = Owner()
+        owner._spec = mnist_spec
+        owner.state = init_train_state(
+            mnist_spec.model, mnist_spec.make_optimizer(), batch, seed=0
+        )
+        owner.last_batch = batch
+        out = str(tmp_path / "cb_bundle")
+        SavedModelExporter(out).on_train_end(owner)
+        assert load_predictor(out) is not None
+
+
+_CB_ZOO_MODULE = '''
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from elasticdl_tpu.callbacks import (
+    LearningRateScheduler, MaxStepsStopping, SavedModelExporter,
+)
+
+EXPORT_DIR = {export_dir!r}
+
+
+class Tiny(nn.Module):
+    @nn.compact
+    def __call__(self, x, training=False):
+        return nn.Dense(10)(x.reshape((x.shape[0], -1)))
+
+
+def custom_model():
+    return Tiny()
+
+
+def loss(labels, predictions, mask):
+    ll = optax.softmax_cross_entropy_with_integer_labels(predictions, labels)
+    return jnp.sum(ll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+def optimizer():
+    return optax.sgd(0.1)
+
+
+def dataset_fn(records, mode, metadata):
+    from elasticdl_tpu.common import tensor_utils
+
+    decoded = [tensor_utils.loads(r) for r in records]
+    feats = np.stack(
+        [np.asarray(r["image"], np.float32) for r in decoded]
+    ) / 255.0
+    labels = np.array([int(r["label"]) for r in decoded], np.int32)
+    return feats, labels
+
+
+def eval_metrics_fn():
+    return {{
+        "accuracy": lambda labels, outputs: np.mean(
+            np.argmax(outputs, axis=1) == labels
+        )
+    }}
+
+
+def callbacks():
+    return [
+        MaxStepsStopping(4),
+        LearningRateScheduler(lambda v: jnp.ones(())),
+        SavedModelExporter(EXPORT_DIR),
+    ]
+'''
+
+
+def test_local_executor_runs_callbacks_end_to_end(tmp_path):
+    from elasticdl_tpu.api.local_executor import LocalExecutor
+    from elasticdl_tpu.testing.data import (
+        create_mnist_record_file,
+        make_local_args,
+    )
+
+    zoo = tmp_path / "zoo" / "cbmod"
+    zoo.mkdir(parents=True)
+    export_dir = str(tmp_path / "exported")
+    (zoo / "cbmod.py").write_text(
+        _CB_ZOO_MODULE.format(export_dir=export_dir)
+    )
+    train_path = create_mnist_record_file(str(tmp_path / "t.rec"), 128)
+    tb_dir = str(tmp_path / "tb")
+    args = make_local_args(
+        model_zoo=str(tmp_path / "zoo"),
+        model_def="cbmod.cbmod.custom_model",
+        training_data=train_path,
+        tmpdir=tmp_path,
+        minibatch_size=16,
+        num_epochs=10,
+        extra=["--tensorboard_log_dir", tb_dir],
+    )
+    result = LocalExecutor(args).run()
+    # MaxStepsStopping(4) bound the job without --max_steps on the CLI.
+    assert result["steps"] == 4
+    # SavedModelExporter wrote a standalone bundle.
+    predict = load_predictor(export_dir)
+    preds = predict(np.zeros((16, 28, 28), np.float32))
+    assert np.asarray(preds).shape == (16, 10)
+    # TensorBoard event file + JSONL mirror exist.
+    assert any("tfevents" in f for f in os.listdir(tb_dir))
+
+
+class TestTfEvents:
+    def test_crc32c_known_vectors(self):
+        # Standard CRC-32C check value for "123456789".
+        assert _crc32c(b"123456789") == 0xE3069283
+        assert _crc32c(b"") == 0
+
+    def test_event_file_framing(self, tmp_path):
+        logdir = str(tmp_path / "tb")
+        w = SummaryWriter(logdir)
+        w.add_scalars({"train/loss": 1.5}, step=3)
+        w.close()
+        files = [f for f in os.listdir(logdir) if "tfevents" in f]
+        assert len(files) == 1
+        raw = open(os.path.join(logdir, files[0]), "rb").read()
+        # Walk every record verifying both CRCs.
+        off, n_records = 0, 0
+        while off < len(raw):
+            (length,) = struct.unpack_from("<Q", raw, off)
+            header = raw[off:off + 8]
+            (hcrc,) = struct.unpack_from("<I", raw, off + 8)
+            assert _masked_crc(header) == hcrc
+            payload = raw[off + 12:off + 12 + length]
+            (pcrc,) = struct.unpack_from("<I", raw, off + 12 + length)
+            assert _masked_crc(payload) == pcrc
+            off += 12 + length + 4
+            n_records += 1
+        assert n_records == 2  # file-version event + scalar event
+        # JSONL mirror readable.
+        lines = open(os.path.join(logdir, "scalars.jsonl")).readlines()
+        rec = json.loads(lines[0])
+        assert rec["step"] == 3 and rec["train/loss"] == 1.5
+
+    def test_service_eval_metrics(self, tmp_path):
+        svc = TensorboardService(str(tmp_path / "tb2"))
+        svc.write_eval_metrics(10, {"accuracy": 0.9})
+        svc.write_dict_to_summary({"train/loss": 0.1}, 11)
+        svc.close()
+        lines = open(
+            os.path.join(str(tmp_path / "tb2"), "scalars.jsonl")
+        ).readlines()
+        assert len(lines) == 2

@@ -164,3 +164,47 @@ class TestLocalResume:
         ex2 = LocalExecutor(args2)
         ex2.run()
         assert int(ex2.state.step) == 16  # resumed 8 + 8 new steps
+
+
+class TestReviewRegressions:
+    """Regressions from code review: empty-shard restore, keep_max=0."""
+
+    def test_restore_table_whose_rows_all_land_in_one_shard(self, tmp_path):
+        from elasticdl_tpu.checkpoint.saver import CheckpointSaver
+        from elasticdl_tpu.embedding.table import EmbeddingTable
+
+        # All-odd ids with 2 shards: shard 0's slice for the table is empty.
+        table = EmbeddingTable("t", 4)
+        ids = [1, 3, 5]
+        rows = np.arange(12, dtype=np.float32).reshape(3, 4)
+        table.set(ids, rows)
+        saver = CheckpointSaver(str(tmp_path / "ck"), num_shards=2)
+        saver.save(7, {"w": np.ones((2,), np.float32)}, {"t": table})
+
+        _v, _dense, tables = saver.restore()
+        assert tables["t"].dim == 4
+        np.testing.assert_array_equal(tables["t"].get([3])[0], rows[1])
+
+    def test_keep_checkpoint_max_zero_keeps_everything(self, tmp_path):
+        from elasticdl_tpu.checkpoint.saver import CheckpointSaver
+
+        saver = CheckpointSaver(str(tmp_path / "ck"), keep_max=0)
+        for v in range(6):
+            saver.save(v, {"w": np.full((2,), v, np.float32)}, {})
+        assert saver.list_versions() == list(range(6))
+
+    def test_adam_amsgrad_direct_construction_rejected(self):
+        import pytest
+
+        from elasticdl_tpu.embedding.optimizer import (
+            Adam,
+            AdamAmsgrad,
+            make_row_optimizer,
+        )
+
+        with pytest.raises(ValueError):
+            Adam(amsgrad=True)
+        assert isinstance(
+            make_row_optimizer("Adam", amsgrad=True), AdamAmsgrad
+        )
+        assert "max_v" in AdamAmsgrad().slot_names
