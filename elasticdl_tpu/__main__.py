@@ -1,0 +1,8 @@
+"""``python -m elasticdl_tpu`` → the CLI (reference setup.py:33-35
+console entry point ``elasticdl``)."""
+
+import sys
+
+from elasticdl_tpu.api.client import main
+
+sys.exit(main())

@@ -21,6 +21,7 @@ from elasticdl_tpu.core.model_spec import get_model_spec
 from elasticdl_tpu.core.step import (
     build_eval_step,
     build_train_step,
+    concat_eval_accumulators,
     evaluate_metrics,
 )
 from elasticdl_tpu.core.train_state import init_train_state
@@ -228,15 +229,7 @@ class LocalExecutor:
                 "Validation data produced no batches; skipping evaluation"
             )
             return None
-        outputs = np.concatenate(all_outputs, axis=0)
-        labels = (
-            np.concatenate(all_labels, axis=0)
-            if not isinstance(all_labels[0], dict)
-            else {
-                k: np.concatenate([d[k] for d in all_labels], axis=0)
-                for k in all_labels[0]
-            }
-        )
+        outputs, labels = concat_eval_accumulators(all_outputs, all_labels)
         metrics = evaluate_metrics(
             self._spec.eval_metrics_fn(), labels, outputs
         )

@@ -17,9 +17,23 @@ from elasticdl_tpu.common.log_utils import get_logger
 logger = get_logger(__name__)
 
 
-def restore_from_dir(state, checkpoint_dir: str):
-    """Restore a TrainState's leaves from the latest valid version."""
-    _, dense, _ = CheckpointSaver(checkpoint_dir).restore()
+def restore_from_dir(state, checkpoint_dir: str, required: bool = True):
+    """Restore a TrainState's leaves from the latest valid version.
+
+    ``required=False`` is the elastic-relaunch path: a replacement worker
+    is pointed at the job's checkpoint dir, which legitimately has no
+    valid version yet if the job died before the first checkpoint — start
+    fresh instead of crash-looping the replacement pod.
+    """
+    try:
+        _, dense, _ = CheckpointSaver(checkpoint_dir).restore()
+    except FileNotFoundError:
+        if required:
+            raise
+        logger.warning(
+            "No valid checkpoint under %s; starting fresh", checkpoint_dir
+        )
+        return state
     state = restore_state_from_named_leaves(state, dense)
     logger.info(
         "Restored state at version %d from %s",

@@ -185,6 +185,9 @@ def add_predict_params(parser):
 def add_clean_params(parser):
     add_bool_param(parser, "--force", False, "Force-delete job resources")
     parser.add_argument("--job_name", default="")
+    parser.add_argument("--namespace", default="default")
+    add_bool_param(parser, "--force_use_kube_config_file", False,
+                   "Use kube config file instead of in-cluster config")
 
 
 def add_worker_params(parser):

@@ -135,6 +135,24 @@ def tree_zeros_like(tree):
     return jax.tree.map(jnp.zeros_like, tree)
 
 
+def concat_eval_accumulators(outputs_acc, labels_acc):
+    """Concatenate per-batch (outputs, labels) accumulators; labels may be
+    arrays or dicts of arrays (multi-output models). Shared by the local
+    and eval/predict executors."""
+    import numpy as np
+
+    outputs = np.concatenate(outputs_acc, axis=0)
+    labels = (
+        np.concatenate(labels_acc, axis=0)
+        if not isinstance(labels_acc[0], dict)
+        else {
+            k: np.concatenate([d[k] for d in labels_acc], axis=0)
+            for k in labels_acc[0]
+        }
+    )
+    return outputs, labels
+
+
 def evaluate_metrics(
     metrics_fns: Dict[str, Callable], labels, predictions
 ) -> Dict[str, float]:

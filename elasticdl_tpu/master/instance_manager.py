@@ -1,0 +1,215 @@
+"""Pod lifecycle + elasticity core (reference master/k8s_instance_manager.py).
+
+The reference's ``InstanceManager`` starts worker/PS pods, watches pod
+events, detects preemption (DELETED, or Failed with exit code 137 =
+SIGKILL/OOM, reference k8s_instance_manager.py:250-271), re-queues the dead
+worker's tasks and relaunches it under a **new** worker id
+(reference :297-302). There is no PS here — state lives on the mesh and in
+sharded checkpoints — so only the worker plane is managed; a relaunched
+worker re-enters training by restoring the latest checkpoint and pulling
+tasks (SURVEY.md §7.5).
+
+Events are normalized through ``classify_pod_event`` so tests drive the
+manager with plain dicts and no cluster (SURVEY.md §4 lesson).
+"""
+
+import itertools
+import threading
+from typing import Callable, Dict, List, Optional
+
+from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.platform.k8s_client import (
+    ELASTICDL_REPLICA_INDEX_KEY,
+    ELASTICDL_REPLICA_TYPE_KEY,
+    build_pod_manifest,
+    get_worker_pod_name,
+)
+
+logger = get_logger("instance_manager")
+
+# Exit code meaning "killed" (preemption / OOM), reference :250-271.
+_EXIT_KILLED = 137
+
+
+def classify_pod_event(event) -> Optional[dict]:
+    """Normalize a k8s watch event (V1Pod or dict) to
+    ``{type, name, replica_type, replica_index, phase, exit_code}``."""
+    etype = event.get("type") if isinstance(event, dict) else event["type"]
+    obj = event.get("object") if isinstance(event, dict) else None
+    if obj is None:
+        return None
+    if isinstance(obj, dict):  # test path / raw dict from watch
+        meta = obj.get("metadata", {})
+        labels = meta.get("labels", {})
+        name = meta.get("name", "")
+        phase = obj.get("status", {}).get("phase", "")
+        exit_code = obj.get("status", {}).get("exit_code")
+    else:  # kubernetes V1Pod
+        labels = obj.metadata.labels or {}
+        name = obj.metadata.name
+        phase = obj.status.phase if obj.status else ""
+        exit_code = None
+        statuses = (obj.status.container_statuses or []) if obj.status else []
+        for cs in statuses:
+            term = cs.state.terminated if cs.state else None
+            if term is not None:
+                exit_code = term.exit_code
+    index = labels.get(ELASTICDL_REPLICA_INDEX_KEY)
+    return {
+        "type": etype,
+        "name": name,
+        "replica_type": labels.get(ELASTICDL_REPLICA_TYPE_KEY, ""),
+        "replica_index": int(index) if index is not None else -1,
+        "phase": phase,
+        "exit_code": exit_code,
+    }
+
+
+class InstanceManager:
+    def __init__(
+        self,
+        task_dispatcher,
+        k8s_client,
+        job_name: str,
+        image_name: str,
+        worker_command: Callable[[int], List[str]],
+        num_workers: int = 1,
+        namespace: str = "default",
+        worker_resource_request: str = "cpu=1,memory=4096Mi",
+        worker_resource_limit: str = "",
+        volume: str = "",
+        envs: Optional[Dict[str, str]] = None,
+        restart_policy: str = "Never",
+        owner: Optional[dict] = None,
+        max_relaunches: int = 0,  # 0 = unlimited (reference relaunches
+        # for the life of the job; task retries are capped instead)
+        on_worker_relaunch: Optional[Callable[[int, int], None]] = None,
+    ):
+        self._task_d = task_dispatcher
+        self._client = k8s_client
+        self._job_name = job_name
+        self._image = image_name
+        self._worker_command = worker_command
+        self._num_workers = num_workers
+        self._namespace = namespace
+        self._resource_request = worker_resource_request
+        self._resource_limit = worker_resource_limit
+        self._volume = volume
+        self._envs = envs or {}
+        self._restart_policy = restart_policy
+        self._owner = owner
+        self._max_relaunches = max_relaunches
+        self._on_worker_relaunch = on_worker_relaunch
+        self._lock = threading.Lock()
+        # live worker ids -> pod name; next id is monotonically fresh
+        # (relaunched workers get NEW ids, reference :297-302).
+        self._worker_pods: Dict[int, str] = {}
+        self._next_worker_id = itertools.count(num_workers)
+        self._relaunch_count = 0
+        self._stopped = False
+
+    # ---- pod creation ---------------------------------------------------
+
+    def _start_worker(self, worker_id: int):
+        name = get_worker_pod_name(self._job_name, worker_id)
+        manifest = build_pod_manifest(
+            name=name,
+            job_name=self._job_name,
+            replica_type="worker",
+            replica_index=worker_id,
+            image=self._image,
+            command=self._worker_command(worker_id),
+            namespace=self._namespace,
+            resource_request=self._resource_request,
+            resource_limit=self._resource_limit,
+            volume=self._volume,
+            envs=self._envs,
+            restart_policy=self._restart_policy,
+            owner=self._owner,
+        )
+        self._client.create_pod(manifest)
+        with self._lock:
+            self._worker_pods[worker_id] = name
+        logger.info("Started worker %d (%s)", worker_id, name)
+
+    def start_workers(self):
+        for worker_id in range(self._num_workers):
+            self._start_worker(worker_id)
+
+    # ---- event handling -------------------------------------------------
+
+    def _event_cb(self, event):
+        """k8s watch callback (reference :219-308)."""
+        info = classify_pod_event(event)
+        if info is None or info["replica_type"] != "worker":
+            return
+        worker_id = info["replica_index"]
+        # Relaunch only involuntary deaths: DELETED (preempted pod) or
+        # Failed with exit 137 (SIGKILL/OOM). A worker that failed on its
+        # own exit code crashed on user code — relaunching would loop
+        # (reference :250-271).
+        dead = info["type"] == "DELETED" or (
+            info["phase"] == "Failed" and info["exit_code"] == _EXIT_KILLED
+        )
+        if not dead:
+            return
+        with self._lock:
+            if self._stopped or worker_id not in self._worker_pods:
+                return
+            del self._worker_pods[worker_id]
+        self._handle_dead_worker(worker_id)
+
+    def _handle_dead_worker(self, worker_id: int):
+        requeued = self._task_d.recover_tasks(worker_id)
+        logger.info(
+            "Worker %d died; re-queued %s task(s)", worker_id, requeued
+        )
+        with self._lock:
+            if self._max_relaunches and (
+                self._relaunch_count >= self._max_relaunches
+            ):
+                logger.warning(
+                    "Relaunch budget (%d) exhausted; not replacing "
+                    "worker %d", self._max_relaunches, worker_id,
+                )
+                return
+            self._relaunch_count += 1
+            new_id = next(self._next_worker_id)
+        self._start_worker(new_id)
+        if self._on_worker_relaunch is not None:
+            self._on_worker_relaunch(worker_id, new_id)
+
+    # ---- straggler handling ---------------------------------------------
+
+    def kill_worker(self, worker_id: int):
+        """Delete a stuck worker's pod; the DELETED event then triggers
+        recovery (reference master.py:487-509 timeout path)."""
+        with self._lock:
+            name = self._worker_pods.get(worker_id)
+        if name is not None:
+            self._client.delete_pod(name)
+
+    # ---- lifecycle ------------------------------------------------------
+
+    def start_watch(self):
+        thread = threading.Thread(
+            target=self._client.watch_job_pods,
+            args=(self._job_name, self._event_cb),
+            kwargs={"stop": lambda: self._stopped},
+            daemon=True,
+        )
+        thread.start()
+        return thread
+
+    def stop(self):
+        with self._lock:
+            self._stopped = True
+            pods = list(self._worker_pods.values())
+            self._worker_pods.clear()
+        for name in pods:
+            self._client.delete_pod(name)
+
+    @property
+    def live_workers(self) -> Dict[int, str]:
+        with self._lock:
+            return dict(self._worker_pods)

@@ -1,0 +1,271 @@
+"""Master process entry point (reference master/main.py + master/master.py).
+
+``python -m elasticdl_tpu.master.main <flags>`` builds the whole control
+plane: model-spec load → reader shards → TaskDispatcher → EvaluationService
+(+ TensorBoard) → MasterServicer → gRPC RpcServer → (optionally, on k8s)
+InstanceManager spawning worker pods — then the run loop sleeps until the
+dispatcher drains, checking straggler timeouts each tick (reference
+master.py:218-238, :487-509).
+
+``Master`` is also constructible in-process for tests (no k8s, no RPC port
+conflicts) — the same assembly the reference exercises via
+``distributed_train_and_evaluate``.
+"""
+
+import sys
+import time
+
+from elasticdl_tpu.common.args import (
+    build_arguments_from_parsed_result,
+    parse_envs,
+    parse_master_args,
+)
+from elasticdl_tpu.common.constants import TaskType
+from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.comm.rpc import RpcServer
+from elasticdl_tpu.core.model_spec import get_model_spec
+from elasticdl_tpu.data.factory import (
+    create_data_reader,
+    parse_data_reader_params,
+)
+from elasticdl_tpu.master.evaluation_service import EvaluationService
+from elasticdl_tpu.master.servicer import SERVICE_NAME, MasterServicer
+from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+
+logger = get_logger("master")
+
+
+class Master:
+    def __init__(self, args, k8s_client=None):
+        self._args = args
+        self._spec = get_model_spec(
+            model_zoo=args.model_zoo,
+            model_def=args.model_def,
+            dataset_fn=args.dataset_fn,
+            loss=args.loss,
+            optimizer=args.optimizer,
+            eval_metrics_fn=args.eval_metrics_fn,
+            callbacks=args.callbacks,
+            custom_data_reader=args.custom_data_reader,
+        )
+        reader_params = parse_data_reader_params(
+            getattr(args, "data_reader_params", "")
+        )
+        reader_of = lambda origin: create_data_reader(
+            data_origin=origin,
+            custom_reader=self._spec.custom_data_reader,
+            **reader_params,
+        )
+        training_data = getattr(args, "training_data", "")
+        validation_data = getattr(args, "validation_data", "")
+        prediction_data = getattr(args, "prediction_data", "")
+        self.task_dispatcher = TaskDispatcher(
+            training_shards=(
+                reader_of(training_data).create_shards()
+                if training_data else {}
+            ),
+            evaluation_shards=(
+                reader_of(validation_data).create_shards()
+                if validation_data else {}
+            ),
+            prediction_shards=(
+                reader_of(prediction_data).create_shards()
+                if prediction_data else {}
+            ),
+            records_per_task=(
+                args.minibatch_size * args.num_minibatches_per_task
+            ),
+            num_epochs=getattr(args, "num_epochs", 1),
+        )
+        if training_data:
+            # Queue the train-end callback task when the job drains so a
+            # worker runs on_train_end (SavedModelExporter etc. — reference
+            # task_dispatcher.py:206-241).
+            self.task_dispatcher.add_deferred_callback(
+                self.task_dispatcher.create_train_end_callback_task
+            )
+        if getattr(args, "max_steps", 0):
+            self.task_dispatcher.set_max_steps(
+                args.max_steps, args.minibatch_size
+            )
+        # MaxStepsStopping callback also bounds dispatch
+        # (reference callbacks.py:57-98).
+        from elasticdl_tpu.callbacks import MaxStepsStopping, find_callback
+
+        cbs = self._spec.callbacks_fn() if self._spec.callbacks_fn else []
+        ms = find_callback(cbs, MaxStepsStopping)
+        # CLI --max_steps wins over the callback (same precedence as
+        # LocalExecutor).
+        if ms is not None and not getattr(args, "max_steps", 0):
+            self.task_dispatcher.set_max_steps(
+                ms.max_steps, args.minibatch_size
+            )
+
+        tb_service = None
+        if getattr(args, "tensorboard_log_dir", ""):
+            from elasticdl_tpu.master.tensorboard_service import (
+                TensorboardService,
+            )
+
+            tb_service = TensorboardService(args.tensorboard_log_dir)
+        self.tb_service = tb_service
+        metrics_fns = (
+            self._spec.eval_metrics_fn()
+            if self._spec.eval_metrics_fn else {}
+        )
+        self.evaluation_service = EvaluationService(
+            self.task_dispatcher,
+            metrics_fns,
+            eval_steps=getattr(args, "evaluation_steps", 0),
+            start_delay_secs=getattr(
+                args, "evaluation_start_delay_secs", 0
+            ),
+            throttle_secs=getattr(args, "evaluation_throttle_secs", 0),
+            eval_only=bool(validation_data and not training_data),
+            summary_writer=tb_service,
+        )
+        self.servicer = MasterServicer(
+            self.task_dispatcher,
+            self.evaluation_service,
+            task_timeout_secs=getattr(args, "task_timeout_secs", 300.0),
+        )
+        self._server = None
+        self.instance_manager = None
+        self._k8s_client = k8s_client
+
+    # ---- assembly -------------------------------------------------------
+
+    def _master_port(self) -> int:
+        addr = getattr(self._args, "master_addr", "") or ":50001"
+        try:
+            return int(addr.rsplit(":", 1)[1])
+        except (IndexError, ValueError):
+            return 50001
+
+    def _worker_command(self, worker_id: int):
+        """Re-serialize parsed args into the worker CLI
+        (reference master.py:365-485 + build_arguments_from_parsed_result)."""
+        passthrough = build_arguments_from_parsed_result(
+            self._args,
+            filter_args=[
+                "worker_id", "force", "master_addr",
+                "checkpoint_dir_for_init",
+            ],
+        )
+        command = (
+            [sys.executable, "-m", "elasticdl_tpu.worker.main",
+             "--worker_id", str(worker_id),
+             "--master_addr", self._master_addr_for_workers()]
+            + passthrough
+        )
+        # Every worker boots from the job's rolling checkpoint dir: initial
+        # workers find it empty (fresh start), relaunched workers restore
+        # the latest version — elastic recovery without a PS to survive.
+        ckpt_dir = getattr(self._args, "checkpoint_dir", "")
+        if ckpt_dir:
+            command += ["--checkpoint_dir_for_init", ckpt_dir]
+        return command
+
+    def _master_addr_for_workers(self) -> str:
+        from elasticdl_tpu.platform.k8s_client import (
+            get_master_service_name,
+        )
+
+        return "%s:%d" % (
+            get_master_service_name(self._args.job_name),
+            self._master_port(),
+        )
+
+    def prepare(self):
+        """Start services: eval trigger, RPC server, worker pods
+        (reference Master.prepare, master.py:184-216)."""
+        self.evaluation_service.start_time_trigger()
+        self._server = RpcServer(
+            f"[::]:{self._master_port()}",
+            {SERVICE_NAME: self.servicer.handlers()},
+        ).start()
+        logger.info("Master RPC serving on port %d", self._server.port)
+        if self.tb_service is not None:
+            self.tb_service.start()
+        if self._k8s_client is not None:
+            from elasticdl_tpu.master.instance_manager import (
+                InstanceManager,
+            )
+
+            self.instance_manager = InstanceManager(
+                self.task_dispatcher,
+                self._k8s_client,
+                job_name=self._args.job_name,
+                image_name=self._args.image_name,
+                worker_command=self._worker_command,
+                num_workers=self._args.num_workers,
+                namespace=self._args.namespace,
+                worker_resource_request=(
+                    self._args.worker_resource_request
+                ),
+                worker_resource_limit=self._args.worker_resource_limit,
+                volume=self._args.volume,
+                envs=parse_envs(self._args.envs),
+                restart_policy=self._args.restart_policy,
+            )
+            self.instance_manager.start_watch()
+            self.instance_manager.start_workers()
+
+    def run(self, poll_secs: float = 5.0):
+        """Sleep until the dispatcher drains (reference master.py:218-238);
+        each tick, kill stragglers (3× mean task time, :487-509)."""
+        try:
+            while not self.task_dispatcher.finished():
+                time.sleep(poll_secs)
+                for task_id, worker_id in self.servicer.find_timeout_tasks():
+                    logger.warning(
+                        "Task %d on worker %d timed out; recovering",
+                        task_id, worker_id,
+                    )
+                    if self.instance_manager is not None:
+                        self.instance_manager.kill_worker(worker_id)
+                    else:
+                        self.task_dispatcher.recover_tasks(worker_id)
+        finally:
+            self.stop()
+        return 0
+
+    def stop(self):
+        self.evaluation_service.stop()
+        if self.instance_manager is not None:
+            self.instance_manager.stop()
+        if self._server is not None:
+            self._server.stop(grace=2.0)
+        # Keep serving TensorBoard after training like the reference
+        # master (master.py:256-269) only in the CLI path (main()).
+
+    @property
+    def port(self):
+        return self._server.port if self._server else None
+
+
+def main(argv=None):
+    args = parse_master_args(argv)
+    k8s_client = None
+    if getattr(args, "image_name", ""):
+        from elasticdl_tpu.platform import k8s_client as k8s_mod
+
+        try:
+            k8s_client = k8s_mod.Client(
+                namespace=args.namespace,
+                force_kube_config=args.force_use_kube_config_file,
+            )
+        except k8s_mod.K8sUnavailableError as exc:
+            logger.warning("k8s unavailable (%s); running master-only", exc)
+    master = Master(args, k8s_client=k8s_client)
+    master.prepare()
+    code = master.run()
+    if master.tb_service is not None:
+        while master.tb_service.keep_running():
+            time.sleep(10)
+        master.tb_service.close()
+    return code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -65,6 +65,12 @@ class TaskDispatcher:
         # task_id -> (task, worker_id, start_time)
         self._doing: Dict[int, Tuple[Task, int, float]] = {}
         self._task_id = 0
+        # MaxStepsStopping support (reference callbacks.py:57-98): a cap on
+        # dispatched TRAINING records; 0 = unlimited. Enforced at dispatch,
+        # which is exact — the reference's worker-side version check is
+        # best-effort across workers.
+        self._max_train_records = 0
+        self._train_records_dispatched = 0
         self._task_retry_count: Dict[str, int] = {}
         self._deferred_callbacks: List[Callable] = []
         self._worker_version: Dict[int, int] = {}
@@ -142,22 +148,78 @@ class TaskDispatcher:
 
     # ---- worker-facing -------------------------------------------------
 
+    def set_max_steps(self, max_steps: int, minibatch_size: int):
+        """Bound total dispatched training records to
+        ``max_steps × minibatch_size``."""
+        with self._lock:
+            self._max_train_records = (
+                max_steps * minibatch_size if max_steps > 0 else 0
+            )
+
+    def _train_cap_reached_locked(self) -> bool:
+        return bool(self._max_train_records) and (
+            self._train_records_dispatched >= self._max_train_records
+        )
+
+    def _epochs_pending_locked(self) -> bool:
+        return (
+            self._epochs_todo > 0
+            and bool(self._training_shards)
+            and not self._train_cap_reached_locked()
+        )
+
     def get(self, worker_id: int) -> Optional[Task]:
         """Pop a task for a worker; None when nothing is available
         (the servicer converts None into a WAIT task while unfinished)."""
+        callbacks = []
+        task = None
         with self._lock:
-            if not self._todo and self._epochs_todo > 0 and (
-                self._training_shards
+            while True:
+                if not self._todo and self._epochs_pending_locked():
+                    self._create_training_tasks_locked()
+                    self._epochs_todo -= 1
+                if not self._todo:
+                    break
+                candidate = self._todo.pop(0)
+                if (
+                    candidate.type == TaskType.TRAINING
+                    and self._max_train_records
+                ):
+                    remaining = (
+                        self._max_train_records
+                        - self._train_records_dispatched
+                    )
+                    if remaining <= 0:
+                        continue  # drop: max_steps reached
+                    if candidate.num_records > remaining:
+                        # Trim the final task so the bound is exact at
+                        # record (= step) granularity, not task
+                        # granularity.
+                        candidate.end = candidate.start + remaining
+                task = candidate
+                break
+            if task is not None:
+                if task.type == TaskType.TRAINING:
+                    self._train_records_dispatched += task.num_records
+                self._task_id += 1
+                task.task_id = self._task_id
+                self._doing[task.task_id] = (task, worker_id, time.time())
+            elif (
+                not self._doing
+                and not self._epochs_pending_locked()
+                and self._deferred_callbacks
             ):
-                self._create_training_tasks_locked()
-                self._epochs_todo -= 1
-            if not self._todo:
-                return None
-            task = self._todo.pop(0)
-            self._task_id += 1
-            task.task_id = self._task_id
-            self._doing[task.task_id] = (task, worker_id, time.time())
-            return task
+                # Dropping capped tasks can drain the queue outside
+                # report(); fire deferred callbacks here too so the
+                # train-end task still gets created.
+                callbacks, self._deferred_callbacks = (
+                    self._deferred_callbacks, []
+                )
+        for cb in callbacks:
+            cb()
+        if callbacks:
+            return self.get(worker_id)
+        return task
 
     def _create_training_tasks_locked(self):
         tasks = self._build_tasks(TaskType.TRAINING)
@@ -192,19 +254,27 @@ class TaskDispatcher:
                     # the reporting worker; re-dispatch must not mutate it.
                     self._todo.insert(0, dataclasses.replace(task))
                     requeued = True
+                    if task.type == TaskType.TRAINING:
+                        # Re-queued records will be re-dispatched; release
+                        # them from the max-steps budget.
+                        self._train_records_dispatched -= task.num_records
                 else:
                     self.counters.add_failed(task.type, task.num_records)
                     logger.error(
                         "Task %d failed permanently after %d retries (%s)",
                         task_id, MAX_TASK_RETRIES, err_reason,
                     )
-            epochs_pending = (
-                self._epochs_todo > 0 and bool(self._training_shards)
-            )
+            todo_undroppable = [
+                t for t in self._todo
+                if not (
+                    t.type == TaskType.TRAINING
+                    and self._train_cap_reached_locked()
+                )
+            ]
             if (
-                not self._todo
+                not todo_undroppable
                 and not self._doing
-                and not epochs_pending
+                and not self._epochs_pending_locked()
                 and self._deferred_callbacks
             ):
                 callbacks, self._deferred_callbacks = (
@@ -231,10 +301,18 @@ class TaskDispatcher:
 
     def finished(self) -> bool:
         with self._lock:
-            epochs_pending = (
-                self._epochs_todo > 0 and bool(self._training_shards)
+            remaining = [
+                t for t in self._todo
+                if not (
+                    t.type == TaskType.TRAINING
+                    and self._train_cap_reached_locked()
+                )
+            ]
+            return (
+                not remaining
+                and not self._doing
+                and not self._epochs_pending_locked()
             )
-            return not self._todo and not self._doing and not epochs_pending
 
     def doing_tasks_of(self, worker_id: int) -> List[int]:
         with self._lock:

@@ -1,0 +1,4 @@
+from elasticdl_tpu.ops.losses import (  # noqa: F401
+    masked_sigmoid_cross_entropy,
+    masked_softmax_cross_entropy,
+)

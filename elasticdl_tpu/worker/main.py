@@ -1,0 +1,120 @@
+"""Worker process entry point (reference worker/main.py:8-59).
+
+``python -m elasticdl_tpu.worker.main --worker_id N --master_addr H:P
+<flags>``: connect the master channel with retries, build the Worker (with
+a MeshRunner when --distribution_strategy=MeshStrategy), pull tasks until
+the job drains. A relaunched worker (elastic recovery) lands here too —
+it restores from the latest sharded checkpoint via
+``--checkpoint_dir_for_init`` handed down by the master.
+"""
+
+import sys
+
+from elasticdl_tpu.common.args import parse_worker_args
+from elasticdl_tpu.common.constants import DistributionStrategy
+from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.common.timing import Timing
+from elasticdl_tpu.core.model_spec import get_model_spec
+from elasticdl_tpu.data.factory import (
+    create_data_reader,
+    parse_data_reader_params,
+)
+from elasticdl_tpu.worker.master_client import MasterClient
+from elasticdl_tpu.worker.worker import Worker
+
+logger = get_logger("worker_main")
+
+
+def build_worker(args, master_client=None) -> Worker:
+    """Assemble a Worker from parsed args (shared with tests)."""
+    spec = get_model_spec(
+        model_zoo=args.model_zoo,
+        model_def=args.model_def,
+        dataset_fn=args.dataset_fn,
+        loss=args.loss,
+        optimizer=args.optimizer,
+        eval_metrics_fn=args.eval_metrics_fn,
+        callbacks=args.callbacks,
+        custom_data_reader=args.custom_data_reader,
+    )
+    reader_params = parse_data_reader_params(
+        getattr(args, "data_reader_params", "")
+    )
+    data_origin = (
+        getattr(args, "training_data", "")
+        or getattr(args, "validation_data", "")
+        or getattr(args, "prediction_data", "")
+    )
+    reader = create_data_reader(
+        data_origin=data_origin,
+        custom_reader=spec.custom_data_reader,
+        **reader_params,
+    )
+    step_runner = None
+    if args.distribution_strategy == DistributionStrategy.MESH:
+        from elasticdl_tpu.parallel.mesh import make_mesh, parse_mesh_args
+        from elasticdl_tpu.parallel.mesh_runner import MeshRunner
+
+        shape, axes = parse_mesh_args(args.mesh_shape, args.mesh_axes)
+        step_runner = MeshRunner(
+            make_mesh(shape, axes),
+            # grads_to_wait maps onto gradient accumulation before the
+            # sync apply (SURVEY.md §7.4).
+            accum_steps=getattr(args, "grads_to_wait", 1),
+        )
+    if master_client is None:
+        master_client = MasterClient(
+            args.master_addr, worker_id=args.worker_id
+        )
+    checkpoint_hook = None
+    if getattr(args, "checkpoint_dir", "") and args.worker_id == 0:
+        from elasticdl_tpu.checkpoint import CheckpointHook
+
+        checkpoint_hook = CheckpointHook(
+            checkpoint_dir=args.checkpoint_dir,
+            checkpoint_steps=getattr(args, "checkpoint_steps", 0),
+            num_shards=getattr(args, "checkpoint_shards", 1) or 1,
+            keep_max=getattr(args, "keep_checkpoint_max", 3),
+        )
+    callbacks = spec.callbacks_fn() if spec.callbacks_fn else []
+    from elasticdl_tpu.callbacks import set_callback_parameters
+
+    set_callback_parameters(
+        callbacks,
+        batch_size=args.minibatch_size,
+        epochs=getattr(args, "num_epochs", 1),
+    )
+    return Worker(
+        worker_id=args.worker_id,
+        master_client=master_client,
+        model_spec=spec,
+        data_reader=reader,
+        minibatch_size=args.minibatch_size,
+        step_runner=step_runner,
+        prediction_outputs_processor=spec.prediction_outputs_processor,
+        callbacks=callbacks,
+        timing=Timing(args.log_level.upper() == "DEBUG"),
+        checkpoint_hook=checkpoint_hook,
+        checkpoint_dir_for_init=getattr(
+            args, "checkpoint_dir_for_init", ""
+        ),
+        # When pointed at the job's own rolling checkpoint dir (the
+        # elastic-relaunch path wired by Master._worker_command), an empty
+        # dir is a legitimate fresh start, not an error.
+        checkpoint_init_required=(
+            getattr(args, "checkpoint_dir_for_init", "")
+            != getattr(args, "checkpoint_dir", "")
+        ),
+    )
+
+
+def main(argv=None):
+    args = parse_worker_args(argv)
+    worker = build_worker(args)
+    result = worker.run()
+    logger.info("Worker %d done: %s", args.worker_id, result)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -60,6 +60,7 @@ class Worker:
         timing: Optional[Timing] = None,
         checkpoint_hook=None,
         checkpoint_dir_for_init: str = "",
+        checkpoint_init_required: bool = True,
     ):
         self._id = worker_id
         self._master = master_client
@@ -91,6 +92,7 @@ class Worker:
 
         self._checkpoint = checkpoint_hook or CheckpointHook()
         self._checkpoint_dir_for_init = checkpoint_dir_for_init
+        self._checkpoint_init_required = checkpoint_init_required
 
     # ---- state init ----------------------------------------------------
 
@@ -115,7 +117,8 @@ class Worker:
             from elasticdl_tpu.checkpoint import restore_from_dir
 
             self.state = restore_from_dir(
-                self.state, self._checkpoint_dir_for_init
+                self.state, self._checkpoint_dir_for_init,
+                required=self._checkpoint_init_required,
             )
             # Restored leaves are host arrays; re-place them with the
             # runner's shardings or a mesh-sized table lands on one device.

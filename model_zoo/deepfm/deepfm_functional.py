@@ -16,6 +16,7 @@ import optax
 from elasticdl_tpu.common import tensor_utils
 from elasticdl_tpu.common.constants import Mode
 from elasticdl_tpu.data.batcher import masked_mean
+from elasticdl_tpu.ops import masked_sigmoid_cross_entropy
 from elasticdl_tpu.embedding import Embedding
 
 INPUT_LENGTH = 10
@@ -61,10 +62,7 @@ def custom_model():
 
 
 def loss(labels, predictions, mask):
-    per_example = optax.sigmoid_binary_cross_entropy(
-        predictions, labels.astype(jnp.float32)
-    )
-    return masked_mean(per_example, mask)
+    return masked_sigmoid_cross_entropy(labels, predictions, mask)
 
 
 def optimizer(lr=0.001):

@@ -14,6 +14,7 @@ import optax
 from elasticdl_tpu.common import tensor_utils
 from elasticdl_tpu.common.constants import Mode
 from elasticdl_tpu.data.batcher import masked_mean
+from elasticdl_tpu.ops import masked_softmax_cross_entropy
 
 
 class MnistModel(nn.Module):
@@ -42,10 +43,8 @@ def custom_model():
 
 
 def loss(labels, predictions, mask):
-    per_example = optax.softmax_cross_entropy_with_integer_labels(
-        predictions, labels
-    )
-    return masked_mean(per_example, mask)
+    # log-softmax form: rewrite-stable on TPU (see ops/losses.py).
+    return masked_softmax_cross_entropy(labels, predictions, mask)
 
 
 def optimizer(lr=0.1):

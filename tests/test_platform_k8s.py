@@ -1,0 +1,277 @@
+"""Stage 9 tests: resource/volume parsing, manifests, instance manager
+elasticity (fake k8s client), dispatcher max-steps capping."""
+
+import pytest
+
+from elasticdl_tpu.common.constants import TaskType
+from elasticdl_tpu.master.instance_manager import (
+    InstanceManager,
+    classify_pod_event,
+)
+from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+from elasticdl_tpu.platform.k8s_client import (
+    build_master_service_manifest,
+    build_pod_manifest,
+    get_master_pod_name,
+    get_worker_pod_name,
+    render_job_manifests,
+)
+from elasticdl_tpu.platform.k8s_resource import (
+    parse_resource,
+    resource_requirements,
+)
+from elasticdl_tpu.platform.k8s_volume import parse_volume
+
+
+class TestResourceParsing:
+    def test_basic(self):
+        out = parse_resource("cpu=1,memory=4096Mi")
+        assert out == {"cpu": "1", "memory": "4096Mi"}
+
+    def test_aliases_and_tpu(self):
+        out = parse_resource("disk=1Gi,gpu=1,tpu=8")
+        assert out["ephemeral-storage"] == "1Gi"
+        assert out["nvidia.com/gpu"] == "1"
+        assert out["google.com/tpu"] == "8"
+
+    def test_rejects_bad_name_and_quantity(self):
+        with pytest.raises(ValueError):
+            parse_resource("flux=1")
+        with pytest.raises(ValueError):
+            parse_resource("cpu=abc")
+
+    def test_limits_default_to_requests(self):
+        frag = resource_requirements("cpu=2,memory=1Gi")
+        assert frag["limits"] == frag["requests"]
+        frag2 = resource_requirements("cpu=2", "cpu=4")
+        assert frag2["limits"] == {"cpu": "4"}
+
+
+class TestVolumeParsing:
+    def test_pvc_and_hostpath(self):
+        vols, mounts = parse_volume(
+            "claim_name=pvc0,mount_path=/data;"
+            "host_path=/tmp/x,mount_path=/x,sub_path=sub"
+        )
+        assert vols[0]["persistentVolumeClaim"]["claimName"] == "pvc0"
+        assert vols[1]["hostPath"]["path"] == "/tmp/x"
+        assert mounts[0]["mountPath"] == "/data"
+        assert mounts[1]["subPath"] == "sub"
+
+    def test_requires_exactly_one_source(self):
+        with pytest.raises(ValueError):
+            parse_volume("mount_path=/data")
+        with pytest.raises(ValueError):
+            parse_volume(
+                "claim_name=a,host_path=/b,mount_path=/c"
+            )
+
+    def test_empty(self):
+        assert parse_volume("") == ([], [])
+
+
+class TestManifests:
+    def test_pod_manifest_labels_and_owner(self):
+        pod = build_pod_manifest(
+            name=get_worker_pod_name("job1", 3),
+            job_name="job1",
+            replica_type="worker",
+            replica_index=3,
+            image="img:latest",
+            command=["python", "-m", "x"],
+            resource_request="cpu=1",
+            volume="host_path=/d,mount_path=/d",
+            envs={"A": "1"},
+            owner={"name": "master-pod", "uid": "uid-1"},
+        )
+        labels = pod["metadata"]["labels"]
+        assert labels["elasticdl-tpu-job-name"] == "job1"
+        assert labels["elasticdl-tpu-replica-index"] == "3"
+        assert pod["metadata"]["ownerReferences"][0]["uid"] == "uid-1"
+        assert pod["spec"]["containers"][0]["volumeMounts"]
+        assert pod["spec"]["restartPolicy"] == "Never"
+
+    def test_service_manifest_and_yaml_render(self):
+        svc = build_master_service_manifest("job1")
+        assert svc["spec"]["clusterIP"] == "None"
+        text = render_job_manifests([
+            build_pod_manifest(
+                name=get_master_pod_name("job1"), job_name="job1",
+                replica_type="master", image="i", command=["c"],
+            ),
+            svc,
+        ])
+        import yaml
+
+        docs = list(yaml.safe_load_all(text))
+        assert len(docs) == 2 and docs[1]["kind"] == "Service"
+
+
+class FakeK8sClient:
+    """Record-only client; tests feed events to the manager directly."""
+
+    def __init__(self):
+        self.created = []
+        self.deleted = []
+
+    def create_pod(self, manifest):
+        self.created.append(manifest)
+
+    def delete_pod(self, name, **kw):
+        self.deleted.append(name)
+
+    def watch_job_pods(self, *a, **kw):
+        pass
+
+
+def _dispatcher(n_records=64, records_per_task=16):
+    return TaskDispatcher(
+        training_shards={"f": (0, n_records)},
+        records_per_task=records_per_task,
+        shuffle=False,
+    )
+
+
+def _dead_event(job, worker_id, etype="DELETED", phase="", exit_code=None):
+    return {
+        "type": etype,
+        "object": {
+            "metadata": {
+                "name": get_worker_pod_name(job, worker_id),
+                "labels": {
+                    "elasticdl-tpu-replica-type": "worker",
+                    "elasticdl-tpu-replica-index": str(worker_id),
+                },
+            },
+            "status": {"phase": phase, "exit_code": exit_code},
+        },
+    }
+
+
+class TestInstanceManager:
+    def _manager(self, dispatcher, n=2, **kw):
+        client = FakeK8sClient()
+        mgr = InstanceManager(
+            dispatcher, client, job_name="j", image_name="img",
+            worker_command=lambda wid: ["run", str(wid)],
+            num_workers=n, **kw,
+        )
+        return mgr, client
+
+    def test_start_workers(self):
+        mgr, client = self._manager(_dispatcher())
+        mgr.start_workers()
+        assert len(client.created) == 2
+        assert set(mgr.live_workers) == {0, 1}
+
+    def test_deleted_worker_requeues_and_relaunches_with_new_id(self):
+        disp = _dispatcher()
+        mgr, client = self._manager(disp)
+        mgr.start_workers()
+        t = disp.get(worker_id=1)
+        assert t is not None
+        mgr._event_cb(_dead_event("j", 1))
+        # Task went back to todo; new worker id 2 replaced worker 1.
+        assert disp.doing_tasks_of(1) == []
+        assert set(mgr.live_workers) == {0, 2}
+        t2 = disp.get(worker_id=2)
+        assert (t2.shard_name, t2.start) == (t.shard_name, t.start)
+
+    def test_oom_kill_relaunches_but_user_crash_does_not(self):
+        disp = _dispatcher()
+        mgr, client = self._manager(disp)
+        mgr.start_workers()
+        mgr._event_cb(
+            _dead_event("j", 0, etype="MODIFIED", phase="Failed",
+                        exit_code=137)
+        )
+        assert 2 in mgr.live_workers  # replaced
+        mgr._event_cb(
+            _dead_event("j", 1, etype="MODIFIED", phase="Failed",
+                        exit_code=1)
+        )
+        assert 1 in mgr.live_workers  # user crash: NOT replaced
+
+    def test_relaunch_budget(self):
+        disp = _dispatcher()
+        mgr, client = self._manager(disp, n=1, max_relaunches=1)
+        mgr.start_workers()
+        mgr._event_cb(_dead_event("j", 0))
+        assert set(mgr.live_workers) == {1}
+        mgr._event_cb(_dead_event("j", 1))
+        assert mgr.live_workers == {}  # budget exhausted
+
+    def test_kill_worker_deletes_pod(self):
+        mgr, client = self._manager(_dispatcher())
+        mgr.start_workers()
+        mgr.kill_worker(0)
+        assert get_worker_pod_name("j", 0) in client.deleted
+
+    def test_classify_v1pod_style_dict(self):
+        info = classify_pod_event(_dead_event("j", 4))
+        assert info["replica_index"] == 4
+        assert info["replica_type"] == "worker"
+
+
+class TestMaxStepsDispatch:
+    def test_cap_bounds_dispatched_records(self):
+        disp = _dispatcher(n_records=64, records_per_task=16)
+        disp.set_max_steps(max_steps=2, minibatch_size=16)  # cap: 32 records
+        tasks = []
+        while True:
+            t = disp.get(worker_id=0)
+            if t is None:
+                break
+            tasks.append(t)
+            disp.report(t.task_id, True)
+        train = [t for t in tasks if t.type == TaskType.TRAINING]
+        assert sum(t.num_records for t in train) == 32
+        assert disp.finished()
+
+    def test_requeued_task_returns_budget(self):
+        disp = _dispatcher(n_records=32, records_per_task=16)
+        disp.set_max_steps(max_steps=2, minibatch_size=16)
+        t1 = disp.get(0)
+        disp.report(t1.task_id, False, err_reason="boom")  # re-queue
+        seen = 0
+        while True:
+            t = disp.get(0)
+            if t is None:
+                break
+            seen += t.num_records
+            disp.report(t.task_id, True)
+        assert seen == 32  # the retry did not eat the budget
+        assert disp.finished()
+
+    def test_train_end_callback_still_fires_when_capped(self):
+        disp = _dispatcher(n_records=64, records_per_task=16)
+        disp.set_max_steps(max_steps=1, minibatch_size=16)
+        disp.add_deferred_callback(disp.create_train_end_callback_task)
+        types = []
+        while True:
+            t = disp.get(0)
+            if t is None:
+                break
+            types.append(t.type)
+            disp.report(t.task_id, True)
+        assert types[-1] == TaskType.TRAIN_END_CALLBACK
+        assert types.count(TaskType.TRAINING) == 1
+
+    def test_cap_trims_final_task_for_exact_bound(self):
+        # records_per_task (32) not aligned with the cap (48): the final
+        # task must be trimmed, not dispatched whole.
+        disp = TaskDispatcher(
+            training_shards={"f": (0, 128)}, records_per_task=32,
+            shuffle=False,
+        )
+        disp.set_max_steps(max_steps=3, minibatch_size=16)  # cap: 48
+        total = 0
+        while True:
+            t = disp.get(0)
+            if t is None:
+                break
+            if t.type == TaskType.TRAINING:
+                total += t.num_records
+            disp.report(t.task_id, True)
+        assert total == 48
+        assert disp.finished()
