@@ -111,7 +111,7 @@ def _clean(args) -> int:
         Client(
             namespace=args.namespace,
             force_kube_config=args.force_use_kube_config_file,
-        ).delete_job(args.job_name)
+        ).delete_job(args.job_name, force=args.force)
     except K8sUnavailableError as exc:
         logger.error("clean needs the kubernetes package: %s", exc)
         return 2

@@ -165,6 +165,11 @@ class InstanceManager:
             "Worker %d died; re-queued %s task(s)", worker_id, requeued
         )
         with self._lock:
+            # Re-check under the lock: a concurrent stop() may have run
+            # since the event was classified — relaunching now would leak
+            # a pod nothing will ever delete.
+            if self._stopped:
+                return
             if self._max_relaunches and (
                 self._relaunch_count >= self._max_relaunches
             ):
@@ -183,11 +188,22 @@ class InstanceManager:
 
     def kill_worker(self, worker_id: int):
         """Delete a stuck worker's pod; the DELETED event then triggers
-        recovery (reference master.py:487-509 timeout path)."""
+        recovery (reference master.py:487-509 timeout path). If the pod
+        is already gone (delete returns None on 404 — e.g. it was
+        preempted during a watch-stream reconnect gap, whose DELETED
+        event was lost), run the dead-worker path directly: without this
+        the task would sit in `doing` forever and the job would hang."""
         with self._lock:
             name = self._worker_pods.get(worker_id)
-        if name is not None:
-            self._client.delete_pod(name)
+        if name is None:
+            return
+        result = self._client.delete_pod(name)
+        if result is None:
+            with self._lock:
+                if worker_id not in self._worker_pods:
+                    return
+                del self._worker_pods[worker_id]
+            self._handle_dead_worker(worker_id)
 
     # ---- lifecycle ------------------------------------------------------
 

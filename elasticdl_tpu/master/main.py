@@ -147,24 +147,18 @@ class Master:
         (reference master.py:365-485 + build_arguments_from_parsed_result)."""
         passthrough = build_arguments_from_parsed_result(
             self._args,
-            filter_args=[
-                "worker_id", "force", "master_addr",
-                "checkpoint_dir_for_init",
-            ],
+            filter_args=["worker_id", "force", "master_addr"],
         )
-        command = (
+        # The user's --checkpoint_dir_for_init (warm start) passes through
+        # untouched; elastic relaunch resume comes from the worker itself
+        # preferring the rolling --checkpoint_dir when it holds a valid
+        # version (worker/main.py resolve_init_checkpoint).
+        return (
             [sys.executable, "-m", "elasticdl_tpu.worker.main",
              "--worker_id", str(worker_id),
              "--master_addr", self._master_addr_for_workers()]
             + passthrough
         )
-        # Every worker boots from the job's rolling checkpoint dir: initial
-        # workers find it empty (fresh start), relaunched workers restore
-        # the latest version — elastic recovery without a PS to survive.
-        ckpt_dir = getattr(self._args, "checkpoint_dir", "")
-        if ckpt_dir:
-            command += ["--checkpoint_dir_for_init", ckpt_dir]
-        return command
 
     def _master_addr_for_workers(self) -> str:
         from elasticdl_tpu.platform.k8s_client import (
@@ -191,6 +185,25 @@ class Master:
             from elasticdl_tpu.master.instance_manager import (
                 InstanceManager,
             )
+            from elasticdl_tpu.platform.k8s_client import (
+                get_master_pod_name,
+            )
+
+            # Owner reference master→workers so deleting the master pod
+            # garbage-collects the whole job (reference
+            # k8s_client.py:329-344). Absent when not running as a pod.
+            owner = None
+            try:
+                me = self._k8s_client.get_pod(
+                    get_master_pod_name(self._args.job_name)
+                )
+                if me is not None:
+                    owner = {
+                        "name": me.metadata.name,
+                        "uid": me.metadata.uid,
+                    }
+            except Exception as exc:
+                logger.warning("No master pod owner reference: %s", exc)
 
             self.instance_manager = InstanceManager(
                 self.task_dispatcher,
@@ -207,6 +220,7 @@ class Master:
                 volume=self._args.volume,
                 envs=parse_envs(self._args.envs),
                 restart_policy=self._args.restart_policy,
+                owner=owner,
             )
             self.instance_manager.start_watch()
             self.instance_manager.start_workers()

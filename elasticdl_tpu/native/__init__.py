@@ -1,0 +1,101 @@
+"""Native (C++) components: build + ctypes loading.
+
+The reference builds its C++ kernels with ``g++ -O3`` into a static lib
+linked from Go (elasticdl/Makefile:22-24). Here the shared library builds
+lazily on first import (cached next to the source, keyed by source mtime)
+and binds via ctypes — pybind11 is not in the image.
+
+``native_available()`` gates every caller; set ELASTICDL_TPU_NO_NATIVE=1
+to force the pure-Python fallbacks.
+"""
+
+import ctypes
+import os
+import subprocess
+import tempfile
+
+from elasticdl_tpu.common.log_utils import get_logger
+
+logger = get_logger("native")
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "row_store.cc")
+_LIB = os.path.join(_HERE, "_librowstore.so")
+
+_lib = None
+_load_attempted = False
+
+
+def _build() -> bool:
+    # Compile to a temp file, atomic-rename into place (concurrent
+    # importers race benignly).
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=_HERE)
+    os.close(fd)
+    cmd = [
+        "g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+        "-o", tmp, _SRC,
+    ]
+    try:
+        subprocess.run(
+            cmd, check=True, capture_output=True, timeout=120
+        )
+        os.replace(tmp, _LIB)
+        return True
+    except (subprocess.CalledProcessError, subprocess.TimeoutExpired,
+            FileNotFoundError) as exc:
+        detail = getattr(exc, "stderr", b"")
+        logger.warning(
+            "native build failed (%s) %s — using pure-Python row store",
+            exc, detail.decode() if detail else "",
+        )
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        return False
+
+
+def _bind(lib):
+    c = ctypes
+    i64, u32, f32 = c.c_int64, c.c_uint32, c.c_float
+    p, i64p, f32p = c.c_void_p, c.POINTER(c.c_int64), c.POINTER(c.c_float)
+    lib.rs_create.restype = p
+    lib.rs_create.argtypes = [i64, u32, c.c_int, f32, f32]
+    lib.rs_destroy.argtypes = [p]
+    lib.rs_num_rows.restype = i64
+    lib.rs_num_rows.argtypes = [p]
+    lib.rs_dim.restype = i64
+    lib.rs_dim.argtypes = [p]
+    lib.rs_get.argtypes = [p, i64p, i64, f32p]
+    lib.rs_set.argtypes = [p, i64p, i64, f32p]
+    lib.rs_export.argtypes = [p, i64p, f32p]
+    lib.rs_sgd.argtypes = [p, i64p, i64, f32p, f32]
+    lib.rs_momentum.argtypes = [p, p, i64p, i64, f32p, f32, f32, c.c_int]
+    lib.rs_adagrad.argtypes = [p, p, i64p, i64, f32p, f32, f32]
+    lib.rs_adam.argtypes = [p, p, p, p, i64p, i64, f32p, f32, f32, f32,
+                            f32, i64]
+    return lib
+
+
+def get_lib():
+    """The loaded library, or None when unavailable."""
+    global _lib, _load_attempted
+    if _load_attempted:
+        return _lib
+    _load_attempted = True
+    if os.environ.get("ELASTICDL_TPU_NO_NATIVE"):
+        return None
+    stale = (
+        not os.path.exists(_LIB)
+        or os.path.getmtime(_LIB) < os.path.getmtime(_SRC)
+    )
+    if stale and not _build():
+        return None
+    try:
+        _lib = _bind(ctypes.CDLL(_LIB))
+    except OSError as exc:
+        logger.warning("could not load %s: %s", _LIB, exc)
+        _lib = None
+    return _lib
+
+
+def native_available() -> bool:
+    return get_lib() is not None

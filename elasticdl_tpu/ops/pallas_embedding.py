@@ -1,0 +1,307 @@
+"""Pallas TPU kernels for the sparse embedding engine.
+
+TPU-native counterpart of the reference's native embedding hot path (Go
+row map + C++/Eigen kernels, pkg/kernel/capi/kernel_api.cc): for tables
+living in HBM, these kernels stream only the touched rows through VMEM —
+the jnp fallback (``jnp.take``) materializes a (B, L, D) gather that XLA
+stages through HBM, while the kernel overlaps per-row DMA with the
+combine (double-buffered) and never forms the intermediate.
+
+- ``lookup_combine``: fused gather + sum/mean/sqrtn combine over a padded
+  ragged batch (embedding/combiner.py RaggedIds semantics).
+- ``sparse_sgd_update`` / ``sparse_adagrad_update``: in-place
+  (input_output_aliased) row updates on (V, D) tables given deduplicated
+  ids. Pad ids MUST point at row 0 with zero grads — zero-grad updates
+  are no-ops for SGD/Adagrad (Adam's decay is not, so Adam stays on the
+  XLA ``sparse_apply`` path).
+
+Layout notes (Mosaic tiling): ids and weights ride scalar prefetch
+(SMEM) since they are read one element at a time; tables/grads/outputs
+stay in ``pl.ANY`` (HBM) and move row-by-row via explicit DMA, so no
+VMEM block ever violates the (8, 128) tile constraint and the embedding
+dim only needs lane alignment (D % 128 == 0; other dims fall back to the
+jnp path). Every entry point takes ``interpret=`` so CPU tests run the
+same kernels (tests/conftest.py forces the CPU backend).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from elasticdl_tpu.embedding.combiner import COMBINERS, combine
+
+LANE = 128
+
+_COMBINER_ID = {"sum": 0, "mean": 1, "sqrtn": 2}
+
+
+def dim_supported(dim: int) -> bool:
+    return dim % LANE == 0
+
+
+# ---- fused lookup + combine ----------------------------------------------
+
+
+_LOOKUP_PIPELINE = 16  # outstanding row DMAs (latency-bound otherwise)
+_LOOKUP_ROWS = 8       # output rows per grid step (sublane-aligned)
+
+
+def _lookup_kernel(num_ids, combiner_id, ids_ref, w_ref, table_ref,
+                   out_ref, row_buf, acc_buf, denom_buf, sems):
+    """One grid step combines _LOOKUP_ROWS output rows; their
+    ``_LOOKUP_ROWS × num_ids`` row fetches share one flat DMA ring of
+    depth ``_LOOKUP_PIPELINE`` (amortizes grid overhead and keeps many
+    copies in flight — a 2-deep ring is DMA-latency-bound)."""
+    blk = pl.program_id(0)
+    total = _LOOKUP_ROWS * num_ids
+    depth = min(_LOOKUP_PIPELINE, total)
+    base = blk * total
+
+    def row_dma(slot, k):
+        return pltpu.make_async_copy(
+            table_ref.at[pl.ds(ids_ref[base + k], 1), :],
+            row_buf.at[slot],
+            sems.at[slot],
+        )
+
+    for k in range(depth):
+        row_dma(k, k).start()
+
+    acc_buf[...] = jnp.zeros_like(acc_buf)
+    for r in range(_LOOKUP_ROWS):
+        denom_buf[r] = jnp.float32(0.0)
+
+    def body(k, _):
+        slot = k % depth
+        r = k // num_ids
+        row_dma(slot, k).wait()
+        w = w_ref[base + k]
+        acc_buf[r, :] = acc_buf[r, :] + w * row_buf[slot, 0, :]
+        denom_buf[r] = denom_buf[r] + jnp.where(
+            combiner_id == 2, w * w, w
+        )
+
+        # Refill this slot only AFTER its row was consumed — the other
+        # depth-1 slots stay in flight.
+        @pl.when(k + depth < total)
+        def _():
+            row_dma(slot, k + depth).start()
+
+        return 0
+
+    jax.lax.fori_loop(0, total, body, 0)
+    # SMEM scalars -> (rows, 1) vector for the broadcasted normalize.
+    denom = jnp.stack(
+        [denom_buf[r] for r in range(_LOOKUP_ROWS)]
+    ).reshape(_LOOKUP_ROWS, 1)
+    if combiner_id == 0:
+        denom = jnp.ones_like(denom)
+    elif combiner_id == 2:
+        denom = jnp.sqrt(denom)
+    safe = jnp.where(denom > 0, denom, 1.0)
+    acc_buf[...] = jnp.where(denom > 0, acc_buf[...] / safe, 0.0)
+    out = pltpu.make_async_copy(
+        acc_buf,
+        out_ref.at[pl.ds(blk * _LOOKUP_ROWS, _LOOKUP_ROWS), :],
+        sems.at[0],
+    )
+    out.start()
+    out.wait()
+
+
+def lookup_combine_pallas(table, ids, weights, combiner: str,
+                          interpret: bool = False):
+    """(V, D) table, (B, L) int32 ids, (B, L) f32 weights -> (B, D)."""
+    batch, num_ids = ids.shape
+    dim = table.shape[1]
+    # Pad the batch to a whole number of _LOOKUP_ROWS blocks with
+    # weight-0 rows pointing at row 0 (combine to zeros, sliced off).
+    padded = -(-batch // _LOOKUP_ROWS) * _LOOKUP_ROWS
+    if padded != batch:
+        pad = padded - batch
+        ids = jnp.concatenate(
+            [ids, jnp.zeros((pad, num_ids), ids.dtype)], axis=0
+        )
+        weights = jnp.concatenate(
+            [weights, jnp.zeros((pad, num_ids), weights.dtype)], axis=0
+        )
+    kernel = functools.partial(
+        _lookup_kernel, num_ids, _COMBINER_ID[combiner]
+    )
+    depth = min(_LOOKUP_PIPELINE, _LOOKUP_ROWS * num_ids)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,  # flat ids, flat weights
+        grid=(padded // _LOOKUP_ROWS,),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],  # table in HBM
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=[
+            pltpu.VMEM((depth, 1, dim), jnp.float32),
+            pltpu.VMEM((_LOOKUP_ROWS, dim), jnp.float32),  # accumulators
+            pltpu.SMEM((_LOOKUP_ROWS,), jnp.float32),      # denominators
+            pltpu.SemaphoreType.DMA((depth,)),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((padded, dim), jnp.float32),
+        interpret=interpret,
+    )(
+        jnp.ravel(ids).astype(jnp.int32),
+        jnp.ravel(weights).astype(jnp.float32),
+        table.astype(jnp.float32),
+    )
+    return out[:batch]
+
+
+def lookup_combine(table, ids, weights, combiner: str,
+                   interpret: bool = False, force_pallas: bool = False):
+    """Public wrapper. Default is the XLA gather+combine — measured
+    faster on v5e for in-HBM tables (3.99 ms vs 5.22 ms at B=4096, L=10,
+    D=128: XLA's wide vectorized gather beats ~B·L sequential row DMAs).
+    ``force_pallas=True`` opts into the kernel (requires D % 128 == 0);
+    it is the building block for tiers where the gather intermediate
+    cannot be materialized."""
+    if combiner not in COMBINERS:
+        raise ValueError(f"combiner must be one of {COMBINERS}")
+    if force_pallas:
+        if not dim_supported(table.shape[1]):
+            raise ValueError(
+                f"Pallas lookup needs dim % {LANE} == 0, "
+                f"got {table.shape[1]}"
+            )
+        return lookup_combine_pallas(
+            table, ids, weights, combiner, interpret=interpret
+        )
+    rows = jnp.take(table, ids, axis=0)
+    return combine(rows, weights, combiner)
+
+
+# ---- in-place sparse optimizer updates -----------------------------------
+
+
+def _sgd_kernel(lr, ids_ref, grads_ref, _table_in, table_ref,
+                row_buf, grad_buf, sems):
+    i = pl.program_id(0)
+    row = ids_ref[i]
+    load_w = pltpu.make_async_copy(
+        table_ref.at[pl.ds(row, 1), :], row_buf, sems.at[0]
+    )
+    load_g = pltpu.make_async_copy(
+        grads_ref.at[pl.ds(i, 1), :], grad_buf, sems.at[1]
+    )
+    load_w.start()
+    load_g.start()
+    load_w.wait()
+    load_g.wait()
+    row_buf[0, :] = row_buf[0, :] - lr * grad_buf[0, :]
+    store = pltpu.make_async_copy(
+        row_buf, table_ref.at[pl.ds(row, 1), :], sems.at[0]
+    )
+    store.start()
+    store.wait()
+
+
+def sparse_sgd_update(table, unique_ids, row_grads, lr: float,
+                      interpret: bool = False):
+    """In-place ``table[ids] -= lr * grads``. Pad ids with 0 + zero grads
+    (zero-grad SGD is a no-op)."""
+    n, dim = row_grads.shape
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),  # grads in HBM
+            pl.BlockSpec(memory_space=pl.ANY),  # table in HBM (aliased)
+        ],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=[
+            pltpu.VMEM((1, dim), jnp.float32),
+            pltpu.VMEM((1, dim), jnp.float32),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(_sgd_kernel, lr),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(table.shape, jnp.float32),
+        # inputs (after scalar prefetch): 1=grads, 2=table -> out 0
+        input_output_aliases={2: 0},
+        interpret=interpret,
+    )(
+        unique_ids.astype(jnp.int32),
+        row_grads.astype(jnp.float32),
+        table.astype(jnp.float32),
+    )
+
+
+def _adagrad_kernel(lr, eps, ids_ref, grads_ref, _table_in, _accum_in,
+                    table_ref, accum_ref, buf, sems):
+    i = pl.program_id(0)
+    row = ids_ref[i]
+
+    def dma(src, dst, sem):
+        c = pltpu.make_async_copy(src, dst, sem)
+        c.start()
+        return c
+
+    loads = [
+        dma(table_ref.at[pl.ds(row, 1), :], buf.at[0], sems.at[0]),
+        dma(accum_ref.at[pl.ds(row, 1), :], buf.at[1], sems.at[1]),
+        dma(grads_ref.at[pl.ds(i, 1), :], buf.at[2], sems.at[2]),
+    ]
+    for c in loads:
+        c.wait()
+    g = buf[2, 0, :]
+    acc = buf[1, 0, :] + g * g
+    buf[1, 0, :] = acc
+    buf[0, 0, :] = buf[0, 0, :] - lr * g / (jnp.sqrt(acc) + eps)
+    stores = [
+        dma(buf.at[0], table_ref.at[pl.ds(row, 1), :], sems.at[0]),
+        dma(buf.at[1], accum_ref.at[pl.ds(row, 1), :], sems.at[1]),
+    ]
+    for c in stores:
+        c.wait()
+
+
+def sparse_adagrad_update(table, accum, unique_ids, row_grads, lr: float,
+                          epsilon: float = 1e-8,
+                          interpret: bool = False):
+    """In-place Adagrad on (table, accum). Same pad contract as SGD
+    (zero grad leaves both rows unchanged)."""
+    n, dim = row_grads.shape
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),  # grads
+            pl.BlockSpec(memory_space=pl.ANY),  # table (aliased)
+            pl.BlockSpec(memory_space=pl.ANY),  # accum (aliased)
+        ],
+        out_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((3, 1, dim), jnp.float32),
+            pltpu.SemaphoreType.DMA((3,)),
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(_adagrad_kernel, lr, epsilon),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct(table.shape, jnp.float32),
+            jax.ShapeDtypeStruct(accum.shape, jnp.float32),
+        ],
+        input_output_aliases={2: 0, 3: 1},
+        interpret=interpret,
+    )(
+        unique_ids.astype(jnp.int32),
+        row_grads.astype(jnp.float32),
+        table.astype(jnp.float32),
+        accum.astype(jnp.float32),
+    )

@@ -250,8 +250,31 @@ class Client:
                 logger.warning("Pod watch stream error, retrying: %s", exc)
                 time.sleep(1.0)
 
-    def delete_job(self, job_name: str):
-        """Delete every pod and service of a job (`clean` subcommand)."""
-        for pod in self.list_job_pods(job_name):
-            self.delete_pod(pod.metadata.name)
-        self.delete_service(get_master_service_name(job_name))
+    def delete_job(self, job_name: str, force: bool = False):
+        """Delete every pod and service of a job (`clean` subcommand).
+
+        ``force`` keeps going past per-resource API errors so a partially
+        broken job can still be reaped (`clean --force`)."""
+        errors = []
+        try:
+            pods = self.list_job_pods(job_name)
+        except Exception as exc:
+            if not force:
+                raise
+            logger.warning("clean --force: list failed (%s)", exc)
+            pods = []
+        for pod in pods:
+            try:
+                self.delete_pod(pod.metadata.name)
+            except Exception as exc:
+                if not force:
+                    raise
+                errors.append(f"{pod.metadata.name}: {exc}")
+        try:
+            self.delete_service(get_master_service_name(job_name))
+        except Exception as exc:
+            if not force:
+                raise
+            errors.append(f"service: {exc}")
+        for err in errors:
+            logger.warning("clean --force skipped error: %s", err)

@@ -95,17 +95,40 @@ def build_worker(args, master_client=None) -> Worker:
         callbacks=callbacks,
         timing=Timing(args.log_level.upper() == "DEBUG"),
         checkpoint_hook=checkpoint_hook,
-        checkpoint_dir_for_init=getattr(
-            args, "checkpoint_dir_for_init", ""
-        ),
-        # When pointed at the job's own rolling checkpoint dir (the
-        # elastic-relaunch path wired by Master._worker_command), an empty
-        # dir is a legitimate fresh start, not an error.
-        checkpoint_init_required=(
-            getattr(args, "checkpoint_dir_for_init", "")
-            != getattr(args, "checkpoint_dir", "")
-        ),
+        **resolve_init_checkpoint(args),
     )
+
+
+def resolve_init_checkpoint(args) -> dict:
+    """Pick the restore source for a booting worker.
+
+    Priority: the job's rolling --checkpoint_dir when it already holds a
+    valid version (elastic relaunch mid-job resumes the latest state),
+    else the user's --checkpoint_dir_for_init (warm start / transfer —
+    restore REQUIRED: a bad dir must fail loudly, not train from
+    scratch), else fresh init.
+    """
+    rolling = getattr(args, "checkpoint_dir", "")
+    user_init = getattr(args, "checkpoint_dir_for_init", "")
+    if rolling:
+        from elasticdl_tpu.checkpoint.saver import CheckpointSaver
+
+        try:
+            has_version = (
+                CheckpointSaver(rolling).get_valid_latest_version()
+                is not None
+            )
+        except (OSError, ValueError):
+            has_version = False
+        if has_version:
+            return {
+                "checkpoint_dir_for_init": rolling,
+                "checkpoint_init_required": True,
+            }
+    return {
+        "checkpoint_dir_for_init": user_init,
+        "checkpoint_init_required": bool(user_init),
+    }
 
 
 def main(argv=None):

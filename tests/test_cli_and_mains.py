@@ -151,9 +151,48 @@ def test_master_worker_command_wires_relaunch_checkpoint(tmp_path):
     cmd = master._worker_command(7)
     joined = " ".join(cmd)
     assert "--worker_id 7" in joined
-    assert f"--checkpoint_dir_for_init {ckpt}" in joined
-    # The original (empty) checkpoint_dir_for_init must not also appear.
-    assert joined.count("--checkpoint_dir_for_init") == 1
+    assert f"--checkpoint_dir {ckpt}" in joined  # workers know the dir
+
+    # Worker-side restore resolution: empty rolling dir → fresh start;
+    # once the rolling dir holds a valid version, relaunch prefers it.
+    from elasticdl_tpu.worker.main import resolve_init_checkpoint
+
+    worker_args = parse_worker_args([
+        "--worker_id", "3",
+        "--model_zoo", model_zoo_dir(),
+        "--model_def", MODEL_DEF,
+        "--training_data", train,
+        "--minibatch_size", "16",
+        "--checkpoint_dir", ckpt,
+        "--job_name", "relaunch-test",
+    ])
+    resolved = resolve_init_checkpoint(worker_args)
+    assert resolved["checkpoint_dir_for_init"] == ""  # nothing to restore
+
+    from elasticdl_tpu.checkpoint.saver import CheckpointSaver
+
+    CheckpointSaver(ckpt).save(5, {"w": np.ones((2,), np.float32)}, {})
+    resolved = resolve_init_checkpoint(worker_args)
+    assert resolved == {
+        "checkpoint_dir_for_init": ckpt,
+        "checkpoint_init_required": True,
+    }
+
+    # A user warm-start dir passes through when the rolling dir is empty.
+    warm_args = parse_worker_args([
+        "--worker_id", "3",
+        "--model_zoo", model_zoo_dir(),
+        "--model_def", MODEL_DEF,
+        "--training_data", train,
+        "--minibatch_size", "16",
+        "--checkpoint_dir_for_init", "/pretrained",
+        "--job_name", "relaunch-test",
+    ])
+    resolved = resolve_init_checkpoint(warm_args)
+    assert resolved == {
+        "checkpoint_dir_for_init": "/pretrained",
+        "checkpoint_init_required": True,
+    }
     # Train-end callback registered → dispatcher emits it when drained.
     from elasticdl_tpu.common.constants import TaskType
     types = []
@@ -201,9 +240,9 @@ def test_master_cli_max_steps_beats_callback(tmp_path):
     assert total == 48  # 3 steps × 16, not 1 × 16
 
 
-def test_worker_lenient_restore_on_own_checkpoint_dir(tmp_path):
-    """A replacement worker pointed at an empty rolling checkpoint dir
-    starts fresh instead of crashing."""
+def test_worker_fresh_start_on_empty_rolling_dir(tmp_path):
+    """A replacement worker whose rolling checkpoint dir has no valid
+    version yet starts fresh instead of crashing."""
     train = create_mnist_record_file(str(tmp_path / "t.rec"), 32)
     ckpt = str(tmp_path / "empty_ckpt")
     worker_args = parse_worker_args([
@@ -213,7 +252,6 @@ def test_worker_lenient_restore_on_own_checkpoint_dir(tmp_path):
         "--training_data", train,
         "--minibatch_size", "16",
         "--checkpoint_dir", ckpt,
-        "--checkpoint_dir_for_init", ckpt,
         "--job_name", "lenient-test",
     ])
 

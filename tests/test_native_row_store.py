@@ -1,0 +1,149 @@
+"""C++ host row store: build, lazy init, and optimizer parity with the
+pure-Python implementations (the reference tests its C++ kernels against
+hand-computed updates, pkg/kernel/kernel_test.go — here the Python
+RowOptimizer implementations are the oracle)."""
+
+import numpy as np
+import pytest
+
+from elasticdl_tpu.embedding.optimizer import (
+    HostOptimizerWrapper,
+    make_row_optimizer,
+)
+from elasticdl_tpu.embedding.table import EmbeddingTable
+from elasticdl_tpu.native import native_available
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="native library unavailable"
+)
+
+
+def _native_table(name, dim, **kw):
+    from elasticdl_tpu.native.row_store import NativeEmbeddingTable
+
+    return NativeEmbeddingTable(name, dim, **kw)
+
+
+class TestNativeTable:
+    def test_lazy_init_deterministic_and_in_range(self):
+        t1 = _native_table("t", 8)
+        t2 = _native_table("t", 8)
+        rows1 = t1.get([5, 100, 7])
+        rows2 = t2.get([5, 100, 7])
+        np.testing.assert_array_equal(rows1, rows2)
+        assert np.all(np.abs(rows1) <= 0.05)
+        # Distinct ids produce distinct rows; same id is cached.
+        assert not np.array_equal(rows1[0], rows1[1])
+        np.testing.assert_array_equal(t1.get([5])[0], rows1[0])
+        assert t1.num_rows == 3
+
+    def test_different_table_names_differ(self):
+        a = _native_table("a", 4).get([1])
+        b = _native_table("b", 4).get([1])
+        assert not np.array_equal(a, b)
+
+    def test_slot_table_constant_init(self):
+        t = _native_table("s", 4, is_slot=True, slot_init_value=0.1)
+        np.testing.assert_allclose(t.get([9]), 0.1)
+
+    def test_set_get_roundtrip_and_export(self):
+        t = _native_table("r", 4)
+        ids = [30, 10, 20]
+        vals = np.arange(12, dtype=np.float32).reshape(3, 4)
+        t.set(ids, vals)
+        np.testing.assert_array_equal(t.get(ids), vals)
+        out_ids, out_rows = t.to_arrays()
+        np.testing.assert_array_equal(out_ids, [10, 20, 30])
+        np.testing.assert_array_equal(out_rows[0], vals[1])
+
+    def test_from_arrays(self):
+        from elasticdl_tpu.native.row_store import NativeEmbeddingTable
+
+        ids = np.array([3, 1], np.int64)
+        rows = np.array([[1, 2], [3, 4]], np.float32)
+        t = NativeEmbeddingTable.from_arrays("f", ids, rows)
+        np.testing.assert_array_equal(t.get([1]), [[3, 4]])
+
+    def test_many_rows_growth(self):
+        t = _native_table("big", 4)
+        ids = np.arange(5000, dtype=np.int64) * 7 + 1
+        rows = t.get(ids)
+        assert t.num_rows == 5000
+        # Map growth preserved every row.
+        np.testing.assert_array_equal(t.get(ids[:100]), rows[:100])
+
+
+@pytest.mark.parametrize("opt_kwargs", [
+    {"opt_type": "SGD", "lr": 0.1},
+    {"opt_type": "Momentum", "lr": 0.1, "momentum": 0.9},
+    {"opt_type": "Momentum", "lr": 0.1, "momentum": 0.9, "nesterov": True},
+    {"opt_type": "Adagrad", "lr": 0.1},
+    {"opt_type": "Adam", "lr": 0.01},
+    {"opt_type": "Adam", "lr": 0.01, "amsgrad": True},
+])
+def test_native_optimizer_matches_python(opt_kwargs):
+    from elasticdl_tpu.native.row_store import NativeOptimizerWrapper
+
+    dim = 6
+    rng = np.random.RandomState(0)
+    ids = [2, 9, 4]
+    init_rows = rng.randn(3, dim).astype(np.float32)
+
+    py_opt = make_row_optimizer(**dict(opt_kwargs))
+    nat_opt = make_row_optimizer(**dict(opt_kwargs))
+    py_table = EmbeddingTable("t", dim)
+    py_table.set(ids, init_rows)
+    nat_table = _native_table("t", dim)
+    nat_table.set(ids, init_rows)
+    py_wrap = HostOptimizerWrapper(py_opt)
+    nat_wrap = NativeOptimizerWrapper(nat_opt)
+
+    for step in range(4):
+        grads = rng.randn(3, dim).astype(np.float32)
+        py_wrap.apply_gradients(py_table, ids, grads)
+        nat_wrap.apply_gradients(nat_table, ids, grads)
+    np.testing.assert_allclose(
+        nat_table.get(ids), py_table.get(ids), rtol=2e-5, atol=2e-6
+    )
+
+
+def test_make_host_helpers_fall_back(monkeypatch):
+    from elasticdl_tpu.native import row_store as rs_mod
+
+    monkeypatch.setattr(rs_mod, "native_available", lambda: False)
+    t = rs_mod.make_host_table("x", 4)
+    assert isinstance(t, EmbeddingTable)
+    w = rs_mod.make_host_optimizer(make_row_optimizer("SGD"))
+    assert isinstance(w, HostOptimizerWrapper)
+
+
+def test_make_host_helpers_native_path():
+    from elasticdl_tpu.native.row_store import (
+        NativeEmbeddingTable,
+        NativeOptimizerWrapper,
+        make_host_optimizer,
+        make_host_table,
+    )
+
+    assert isinstance(make_host_table("y", 4), NativeEmbeddingTable)
+    assert isinstance(
+        make_host_optimizer(make_row_optimizer("Adam")),
+        NativeOptimizerWrapper,
+    )
+    # float64 request falls back to the Python table.
+    assert isinstance(
+        make_host_table("z", 4, dtype=np.float64), EmbeddingTable
+    )
+
+
+def test_negative_ids_roundtrip():
+    """Signed feature hashes produce negative ids; the id map sentinel
+    must not conflate them with empty slots."""
+    t = _native_table("neg", 4)
+    ids = [-5, -1, 3, -(2**40)]
+    vals = np.arange(16, dtype=np.float32).reshape(4, 4)
+    t.set(ids, vals)
+    np.testing.assert_array_equal(t.get(ids), vals)
+    assert t.num_rows == 4
+    t.get(ids)
+    assert t.num_rows == 4  # no phantom re-inits

@@ -119,6 +119,7 @@ class FakeK8sClient:
 
     def delete_pod(self, name, **kw):
         self.deleted.append(name)
+        return True  # pod existed; None would mean already-gone (404)
 
     def watch_job_pods(self, *a, **kw):
         pass
@@ -211,6 +212,27 @@ class TestInstanceManager:
         info = classify_pod_event(_dead_event("j", 4))
         assert info["replica_index"] == 4
         assert info["replica_type"] == "worker"
+
+    def test_kill_worker_of_vanished_pod_recovers_directly(self):
+        """404 on delete (pod already gone, DELETED event lost in a watch
+        reconnect gap) must recover the tasks instead of hanging."""
+        disp = _dispatcher()
+        mgr, client = self._manager(disp)
+        mgr.start_workers()
+        client.delete_pod = lambda name, **kw: None  # simulate 404
+        t = disp.get(worker_id=0)
+        mgr.kill_worker(0)
+        assert disp.doing_tasks_of(0) == []  # task re-queued
+        assert 2 in mgr.live_workers  # replacement launched
+
+    def test_no_relaunch_after_stop(self):
+        disp = _dispatcher()
+        mgr, client = self._manager(disp)
+        mgr.start_workers()
+        mgr.stop()
+        created_before = len(client.created)
+        mgr._handle_dead_worker(0)
+        assert len(client.created) == created_before  # no leaked pod
 
 
 class TestMaxStepsDispatch:
