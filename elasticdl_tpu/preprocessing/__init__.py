@@ -1,0 +1,32 @@
+"""Preprocessing package — TPU-native twin of ``elasticdl_preprocessing``.
+
+The reference ships Keras preprocessing layers (Discretization, RoundIdentity,
+ToNumber, ``elasticdl_preprocessing/layers/``) plus a feature-column extension
+(``concatenated_categorical_column``). On TPU the same functionality splits
+into two planes:
+
+- **host transforms** (`transforms`): numpy, string-capable, run inside the
+  user's ``dataset_fn`` on the worker host (strings never reach the device);
+- **device layers** (`layers`): pure jnp ops, jit-safe, static shapes, run
+  inside the model under ``pjit``.
+
+``feature_group`` carries the concatenated-categorical-column offset logic
+(reference ``elasticdl_preprocessing/feature_column/feature_column.py``).
+"""
+
+from elasticdl_tpu.preprocessing.feature_group import (  # noqa: F401
+    FeatureGroup,
+    concat_feature_ids,
+)
+from elasticdl_tpu.preprocessing.layers import (  # noqa: F401
+    AddIdOffset,
+    Discretization,
+    Hashing,
+    RoundIdentity,
+)
+from elasticdl_tpu.preprocessing.transforms import (  # noqa: F401
+    CategoryHash,
+    CategoryLookup,
+    NumericBucket,
+    to_number,
+)

@@ -1,0 +1,92 @@
+"""Device-plane preprocessing: pure jnp ops, jit-safe, static shapes.
+
+Twin of the reference's Keras preprocessing layers for *numeric* inputs
+(``elasticdl_preprocessing/layers/discretization.py``, ``round_identity.py``)
+— expressed as stateless callables rather than weight-less Keras layers, so
+they compose inside any flax module under ``pjit`` with no trace surprises.
+All outputs are int32 ids ready for the framework's Embedding layer.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class Discretization:
+    """Bucket numeric data by bin boundaries: id = #boundaries <= x
+    (reference ``Discretization.call``). ``searchsorted`` lowers to a
+    vectorized comparison-sum on TPU — no gather, MXU-friendly shapes."""
+
+    def __init__(self, bin_boundaries):
+        self.bin_boundaries = jnp.asarray(
+            np.sort(np.asarray(bin_boundaries, np.float32))
+        )
+
+    @property
+    def num_buckets(self) -> int:
+        return int(self.bin_boundaries.shape[0]) + 1
+
+    def __call__(self, inputs):
+        x = jnp.asarray(inputs, jnp.float32)
+        return jnp.searchsorted(
+            self.bin_boundaries, x, side="right"
+        ).astype(jnp.int32)
+
+
+class RoundIdentity:
+    """Round a numeric feature to an integer id clipped to [0, num_buckets)
+    (reference ``RoundIdentity.call``: round then min(max_value))."""
+
+    def __init__(self, num_buckets: int):
+        if num_buckets <= 0:
+            raise ValueError("num_buckets must be positive")
+        self.num_buckets = int(num_buckets)
+
+    def __call__(self, inputs):
+        x = jnp.round(jnp.asarray(inputs, jnp.float32))
+        x = jnp.clip(x, 0.0, float(self.num_buckets - 1))
+        return x.astype(jnp.int32)
+
+
+class Hashing:
+    """Integer id → bucket in [0, num_bins) with a splitmix64-style mixer.
+
+    Device twin of the host ``CategoryHash`` for features that are already
+    integers (e.g. user/item ids larger than the table). Pure bit ops —
+    vectorizes on the VPU, no host round-trip."""
+
+    def __init__(self, num_bins: int):
+        if num_bins <= 0:
+            raise ValueError("num_bins must be positive")
+        self.num_bins = int(num_bins)
+
+    def __call__(self, inputs):
+        x = jnp.asarray(inputs).astype(jnp.uint32)
+        # 32-bit murmur3-style finalizer (avalanches all input bits).
+        x = x ^ (x >> 16)
+        x = x * jnp.uint32(0x85EBCA6B)
+        x = x ^ (x >> 13)
+        x = x * jnp.uint32(0xC2B2AE35)
+        x = x ^ (x >> 16)
+        return (x % jnp.uint32(self.num_bins)).astype(jnp.int32)
+
+
+class AddIdOffset:
+    """Concatenate categorical id columns into one id space by adding
+    per-column offsets (census ``AddIdOffset``; the device half of
+    ``concatenated_categorical_column``)."""
+
+    def __init__(self, group_sizes):
+        sizes = [int(s) for s in group_sizes]
+        self.offsets = jnp.asarray(
+            np.concatenate([[0], np.cumsum(sizes)[:-1]]), jnp.int32
+        )
+        self.total_size = int(sum(sizes))
+
+    def __call__(self, id_columns):
+        """id_columns: list of (B,) or (B, 1) int arrays, one per column.
+        Returns (B, num_columns) offset ids."""
+        cols = []
+        for i, col in enumerate(id_columns):
+            col = jnp.asarray(col, jnp.int32).reshape(col.shape[0], -1)
+            cols.append(col + self.offsets[i])
+        return jnp.concatenate(cols, axis=1)

@@ -79,6 +79,35 @@ def create_census_record_file(path, num_records, seed=0):
     return path
 
 
+def create_heart_record_file(path, num_records, seed=0):
+    """Heart-disease-style mixed rows (reference heart_functional_api
+    feature schema: numerics + age + string thal + binary target)."""
+    rng = np.random.RandomState(seed)
+    thal_values = ["fixed", "normal", "reversible"]
+    with RecordFileWriter(path) as writer:
+        for _ in range(num_records):
+            thal = thal_values[rng.randint(len(thal_values))]
+            age = float(rng.randint(29, 77))
+            oldpeak = float(rng.rand() * 4)
+            label = int((age > 55) ^ (thal == "normal"))
+            writer.write(
+                tensor_utils.dumps(
+                    {
+                        "age": age,
+                        "trestbps": float(rng.randint(94, 200)),
+                        "chol": float(rng.randint(126, 400)),
+                        "thalach": float(rng.randint(71, 202)),
+                        "oldpeak": oldpeak,
+                        "slope": float(rng.randint(1, 4)),
+                        "ca": float(rng.randint(0, 4)),
+                        "thal": thal,
+                        "target": label,
+                    }
+                )
+            )
+    return path
+
+
 def create_iris_csv(path, num_records, seed=0):
     rng = np.random.RandomState(seed)
     with open(path, "w", newline="") as f:

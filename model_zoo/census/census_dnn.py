@@ -1,0 +1,76 @@
+"""Census-income plain DNN.
+
+Counterpart of the reference's ``model_zoo/census_dnn_model`` (embedding
+columns + numeric columns → MLP). Shares the census feature pipeline with
+the wide&deep variant but runs a single deep tower — the minimal
+embedding-plus-dense recipe.
+"""
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from elasticdl_tpu.common.constants import Mode
+from elasticdl_tpu.embedding import Embedding
+from elasticdl_tpu.ops import masked_sigmoid_cross_entropy
+
+import os
+
+from elasticdl_tpu.core.model_spec import load_module
+
+# Model-zoo modules are loaded by file path (not as a package), so the
+# shared census pipeline is loaded the same way.
+_wide_deep = load_module(
+    os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                 "census_wide_deep.py")
+)
+FEATURE_GROUP = _wide_deep.FEATURE_GROUP
+_wide_deep_dataset_fn = _wide_deep.dataset_fn
+
+
+class CensusDNN(nn.Module):
+    id_space: int = FEATURE_GROUP.total_buckets
+    embedding_dim: int = 8
+    hidden: tuple = (32, 16)
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, features, training=False):
+        ids = jnp.asarray(features["ids"], jnp.int32)
+        dense = jnp.asarray(features["dense"], jnp.float32)
+        emb = Embedding(self.id_space, self.embedding_dim,
+                        name="embedding")(ids)
+        x = jnp.concatenate(
+            [emb.reshape((emb.shape[0], -1)).astype(self.compute_dtype),
+             dense.astype(self.compute_dtype)],
+            axis=1,
+        )
+        for width in self.hidden:
+            x = nn.relu(nn.Dense(width, dtype=self.compute_dtype)(x))
+        return nn.Dense(1, dtype=self.compute_dtype)(x).astype(
+            jnp.float32
+        )[..., 0]
+
+
+def custom_model():
+    return CensusDNN()
+
+
+def loss(labels, predictions, mask):
+    return masked_sigmoid_cross_entropy(labels, predictions, mask)
+
+
+def optimizer(lr=0.001):
+    return optax.adam(lr)
+
+
+def dataset_fn(records, mode, metadata):
+    return _wide_deep_dataset_fn(records, mode, metadata)
+
+
+def eval_metrics_fn():
+    def accuracy(labels, outputs):
+        return float(np.mean((outputs > 0).astype(np.int32) == labels))
+
+    return {"accuracy": accuracy}

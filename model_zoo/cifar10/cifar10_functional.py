@@ -1,0 +1,81 @@
+"""CIFAR-10 VGG-style CNN.
+
+Counterpart of the reference's
+``model_zoo/cifar10_functional_api/cifar10_functional_api.py:14-80``
+(Conv32×2+BN → pool+dropout → Conv64×2+BN → pool+dropout → Dense512 →
+Dense10), flax + bfloat16 for the MXU. The same LearningRateScheduler
+callback the reference wires (version-based decay) is exposed via
+``callbacks``.
+"""
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from elasticdl_tpu.callbacks import LearningRateScheduler
+from elasticdl_tpu.data.decoders import (
+    argmax_accuracy_metrics,
+    image_classification_dataset_fn,
+)
+from elasticdl_tpu.ops import masked_softmax_cross_entropy
+
+
+class Cifar10Model(nn.Module):
+    num_classes: int = 10
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, features, training=False):
+        x = features.astype(self.compute_dtype)
+        for width, drop in ((32, 0.2), (64, 0.3)):
+            for _ in range(2):
+                x = nn.Conv(width, (3, 3), padding="SAME", use_bias=True,
+                            dtype=self.compute_dtype)(x)
+                x = nn.BatchNorm(
+                    use_running_average=not training, momentum=0.9,
+                    epsilon=1e-6, dtype=self.compute_dtype,
+                )(x)
+                x = nn.relu(x)
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            x = nn.Dropout(drop, deterministic=not training)(x)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(512, dtype=self.compute_dtype)(x))
+        x = nn.Dropout(0.5, deterministic=not training)(x)
+        return nn.Dense(self.num_classes,
+                        dtype=self.compute_dtype)(x).astype(jnp.float32)
+
+
+def custom_model():
+    return Cifar10Model()
+
+
+def loss(labels, predictions, mask):
+    return masked_softmax_cross_entropy(labels, predictions, mask)
+
+
+def optimizer(lr=0.1):
+    return optax.sgd(lr, momentum=0.9)
+
+
+def callbacks():
+    # reference cifar10_functional_api: version-based LR decay (0.1 →
+    # 0.01 → 0.001). The framework schedule is a *multiplier* over the
+    # base optimizer lr (0.1), and is traced under jit, so it is
+    # branch-free jnp, not Python ifs.
+    def _schedule(model_version):
+        return jnp.select(
+            [model_version < 200, model_version < 400],
+            [1.0, 0.1],
+            default=0.01,
+        )
+
+    return [LearningRateScheduler(_schedule)]
+
+
+def dataset_fn(records, mode, metadata):
+    return image_classification_dataset_fn(records, mode, metadata)
+
+
+def eval_metrics_fn():
+    return argmax_accuracy_metrics()

@@ -1,0 +1,78 @@
+"""CIFAR-10 CNN, subclass style (setup + named submodules).
+
+Counterpart of the reference's ``model_zoo/cifar10_subclass/
+cifar10_subclass.py`` (CustomModel(tf.keras.Model), same conv stack as the
+functional variant built in __init__).
+"""
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from elasticdl_tpu.data.decoders import (
+    argmax_accuracy_metrics,
+    image_classification_dataset_fn,
+)
+from elasticdl_tpu.ops import masked_softmax_cross_entropy
+
+
+class ConvBlock(nn.Module):
+    width: int
+    compute_dtype: jnp.dtype
+
+    def setup(self):
+        self.conv_a = nn.Conv(self.width, (3, 3), padding="SAME",
+                              dtype=self.compute_dtype)
+        self.norm_a = nn.BatchNorm(momentum=0.9, epsilon=1e-6,
+                                   dtype=self.compute_dtype)
+        self.conv_b = nn.Conv(self.width, (3, 3), padding="SAME",
+                              dtype=self.compute_dtype)
+        self.norm_b = nn.BatchNorm(momentum=0.9, epsilon=1e-6,
+                                   dtype=self.compute_dtype)
+
+    def __call__(self, x, training):
+        x = nn.relu(self.norm_a(self.conv_a(x),
+                                use_running_average=not training))
+        x = nn.relu(self.norm_b(self.conv_b(x),
+                                use_running_average=not training))
+        return nn.max_pool(x, (2, 2), strides=(2, 2))
+
+
+class Cifar10SubclassModel(nn.Module):
+    num_classes: int = 10
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    def setup(self):
+        self.block1 = ConvBlock(32, self.compute_dtype)
+        self.block2 = ConvBlock(64, self.compute_dtype)
+        self.hidden = nn.Dense(512, dtype=self.compute_dtype)
+        self.head = nn.Dense(self.num_classes, dtype=self.compute_dtype)
+
+    def __call__(self, features, training=False):
+        x = features.astype(self.compute_dtype)
+        x = self.block1(x, training)
+        x = self.block2(x, training)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(self.hidden(x))
+        return self.head(x).astype(jnp.float32)
+
+
+def custom_model():
+    return Cifar10SubclassModel()
+
+
+def loss(labels, predictions, mask):
+    return masked_softmax_cross_entropy(labels, predictions, mask)
+
+
+def optimizer(lr=0.1):
+    return optax.sgd(lr, momentum=0.9)
+
+
+def dataset_fn(records, mode, metadata):
+    return image_classification_dataset_fn(records, mode, metadata)
+
+
+def eval_metrics_fn():
+    return argmax_accuracy_metrics()

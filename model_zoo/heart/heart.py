@@ -1,0 +1,95 @@
+"""Heart-disease classifier over mixed feature columns.
+
+Counterpart of the reference's ``model_zoo/heart_functional_api/
+heart_functional_api.py:6-45``: six numeric columns, a bucketized ``age``
+column, and a hashed ``thal`` category mapped through an 8-dim embedding
+column. The bucketize happens on-device (preprocessing.Discretization); the
+string hash happens host-side in ``dataset_fn`` (strings cannot enter XLA).
+"""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from elasticdl_tpu.common import tensor_utils
+from elasticdl_tpu.common.constants import Mode
+from elasticdl_tpu.embedding import Embedding
+from elasticdl_tpu.ops import masked_sigmoid_cross_entropy
+from elasticdl_tpu.preprocessing import CategoryHash, Discretization
+
+NUMERIC_KEYS = ("trestbps", "chol", "thalach", "oldpeak", "slope", "ca")
+AGE_BOUNDARIES = [18.0, 25.0, 30.0, 35.0, 40.0, 45.0, 50.0, 55.0, 60.0, 65.0]
+THAL_HASH_BUCKETS = 100
+THAL_HASH = CategoryHash(THAL_HASH_BUCKETS)
+
+_AGE_BUCKETIZE = Discretization(AGE_BOUNDARIES)
+
+
+class HeartModel(nn.Module):
+    thal_buckets: int = THAL_HASH_BUCKETS
+    thal_dim: int = 8
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, features, training=False):
+        dense = jnp.asarray(features["numeric"], jnp.float32)
+        age_bucket = _AGE_BUCKETIZE(features["age"])      # (B,) int ids
+        age_onehot = jax.nn.one_hot(
+            age_bucket, _AGE_BUCKETIZE.num_buckets, dtype=jnp.float32
+        )
+        thal = Embedding(self.thal_buckets, self.thal_dim,
+                         name="thal_embedding")(
+            jnp.asarray(features["thal_id"], jnp.int32)
+        )
+        x = jnp.concatenate(
+            [dense, age_onehot, thal.astype(jnp.float32)], axis=1
+        ).astype(self.compute_dtype)
+        x = nn.relu(nn.Dense(16, dtype=self.compute_dtype)(x))
+        x = nn.relu(nn.Dense(16, dtype=self.compute_dtype)(x))
+        return nn.Dense(1, dtype=self.compute_dtype)(x).astype(
+            jnp.float32
+        )[..., 0]
+
+
+def custom_model():
+    return HeartModel()
+
+
+def loss(labels, predictions, mask):
+    return masked_sigmoid_cross_entropy(labels, predictions, mask)
+
+
+def optimizer(lr=0.01):
+    return optax.sgd(lr)
+
+
+def dataset_fn(records, mode, metadata):
+    rows = [tensor_utils.loads(payload) for payload in records]
+    numeric = np.stack(
+        [np.asarray([float(row[k]) for k in NUMERIC_KEYS], np.float32)
+         for row in rows]
+    )
+    # scale numerics to unit-ish range (fixed clinical-scale constants)
+    numeric = numeric / np.asarray(
+        [130.0, 250.0, 150.0, 1.0, 2.0, 1.0], np.float32
+    )
+    features = {
+        "numeric": numeric,
+        "age": np.asarray([float(row["age"]) for row in rows], np.float32),
+        "thal_id": THAL_HASH([row["thal"] for row in rows]).astype(np.int32),
+    }
+    labels = np.asarray(
+        [int(row.get("target", 0)) for row in rows], np.int32
+    )
+    if mode == Mode.PREDICTION:
+        return features, np.zeros_like(labels)
+    return features, labels
+
+
+def eval_metrics_fn():
+    def accuracy(labels, outputs):
+        return float(np.mean((outputs > 0).astype(np.int32) == labels))
+
+    return {"accuracy": accuracy}

@@ -1,0 +1,74 @@
+"""Iris DNN over CSV rows.
+
+Counterpart of the reference's ``model_zoo/odps_iris_dnn_model`` (a small
+dense net whose dataset_fn parses table/CSV rows by column name). Records
+arrive as raw CSV-encoded lines from CSVDataReader (or column tuples from
+the table reader); ``metadata.column_names`` drives the parse, mirroring
+the reference's use of reader metadata.
+"""
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from elasticdl_tpu.common.constants import Mode
+from elasticdl_tpu.ops import masked_softmax_cross_entropy
+
+FEATURE_KEYS = ("sepal_length", "sepal_width", "petal_length", "petal_width")
+LABEL_KEY = "class"
+
+
+class IrisDNN(nn.Module):
+    num_classes: int = 3
+
+    @nn.compact
+    def __call__(self, features, training=False):
+        x = jnp.asarray(features, jnp.float32)
+        x = nn.relu(nn.Dense(16)(x))
+        x = nn.relu(nn.Dense(16)(x))
+        return nn.Dense(self.num_classes)(x)
+
+
+def custom_model():
+    return IrisDNN()
+
+
+def loss(labels, predictions, mask):
+    return masked_softmax_cross_entropy(labels, predictions, mask)
+
+
+def optimizer(lr=0.05):
+    return optax.sgd(lr, momentum=0.9)
+
+
+def dataset_fn(records, mode, metadata):
+    columns = list(getattr(metadata, "column_names", None) or
+                   (*FEATURE_KEYS, LABEL_KEY))
+    sep = getattr(metadata, "extra", {}).get("sep", ",")
+    feat_idx = [columns.index(k) for k in FEATURE_KEYS]
+    label_idx = columns.index(LABEL_KEY) if LABEL_KEY in columns else -1
+    rows, labels = [], []
+    for payload in records:
+        if isinstance(payload, bytes):
+            payload = payload.decode("utf-8")
+        cells = payload.split(sep) if isinstance(payload, str) else list(
+            payload
+        )
+        rows.append([float(cells[i]) for i in feat_idx])
+        labels.append(
+            int(float(cells[label_idx])) if label_idx >= 0 else 0
+        )
+    features = np.asarray(rows, np.float32)
+    labels = np.asarray(labels, np.int32)
+    if mode == Mode.PREDICTION:
+        return features, np.zeros_like(labels)
+    return features, labels
+
+
+def eval_metrics_fn():
+    return {
+        "accuracy": lambda labels, outputs: float(
+            np.mean(np.argmax(outputs, axis=1) == labels)
+        )
+    }

@@ -1,0 +1,60 @@
+"""MNIST CNN, subclass style (explicit setup, no nn.compact).
+
+Counterpart of the reference's ``model_zoo/mnist_subclass/mnist_subclass.py``
+(CustomModel(tf.keras.Model) with layers built in __init__) — the flax
+equivalent of "subclass style" is a module with ``setup`` and named
+submodules instead of inline ``@nn.compact`` definitions.
+"""
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from elasticdl_tpu.data.decoders import (
+    argmax_accuracy_metrics,
+    image_classification_dataset_fn,
+)
+from elasticdl_tpu.ops import masked_softmax_cross_entropy
+
+
+class MnistSubclassModel(nn.Module):
+    num_classes: int = 10
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    def setup(self):
+        self.conv1 = nn.Conv(32, (3, 3), dtype=self.compute_dtype)
+        self.conv2 = nn.Conv(64, (3, 3), dtype=self.compute_dtype)
+        self.norm = nn.BatchNorm(dtype=self.compute_dtype)
+        self.dense = nn.Dense(self.num_classes, dtype=self.compute_dtype)
+
+    def __call__(self, features, training=False):
+        x = features.astype(self.compute_dtype)
+        if x.ndim == 3:
+            x = x[..., None]
+        x = nn.relu(self.conv1(x))
+        x = nn.relu(self.conv2(x))
+        x = self.norm(x, use_running_average=not training)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        return self.dense(x).astype(jnp.float32)
+
+
+def custom_model():
+    return MnistSubclassModel()
+
+
+def loss(labels, predictions, mask):
+    return masked_softmax_cross_entropy(labels, predictions, mask)
+
+
+def optimizer(lr=0.1):
+    return optax.sgd(lr, momentum=0.9)
+
+
+def dataset_fn(records, mode, metadata):
+    return image_classification_dataset_fn(records, mode, metadata)
+
+
+def eval_metrics_fn():
+    return argmax_accuracy_metrics()

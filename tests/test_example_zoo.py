@@ -1,0 +1,74 @@
+"""Model-zoo-as-test-corpus (reference tests/example_test.py).
+
+Every zoo family runs end-to-end through the Local executor on a synthetic
+fixture of its dataset shape. Small record counts / few epochs — the assert
+is "contract holds and training runs", not convergence (convergence is
+asserted for mnist/deepfm in their dedicated tests).
+"""
+
+import pytest
+
+from elasticdl_tpu.api.local_executor import LocalExecutor
+from elasticdl_tpu.testing.data import (
+    create_census_record_file,
+    create_cifar_record_file,
+    create_frappe_record_file,
+    create_heart_record_file,
+    create_iris_csv,
+    create_mnist_record_file,
+    make_local_args,
+    model_zoo_dir,
+)
+
+FIXTURES = {
+    "mnist": create_mnist_record_file,
+    "cifar": create_cifar_record_file,
+    "frappe": create_frappe_record_file,
+    "census": create_census_record_file,
+    "heart": create_heart_record_file,
+    "iris": create_iris_csv,
+}
+
+ZOO = [
+    ("mnist.mnist_subclass.custom_model", "mnist", {}),
+    ("cifar10.cifar10_functional.custom_model", "cifar", {}),
+    ("cifar10.cifar10_subclass.custom_model", "cifar", {}),
+    ("census.census_wide_deep.custom_model", "census", {}),
+    ("census.census_dnn.custom_model", "census", {}),
+    ("heart.heart.custom_model", "heart", {}),
+    ("iris.iris_dnn.custom_model", "iris", {}),
+    # resnet50 on cifar-shaped data: 2 tiny batches, compile-and-train check
+    ("resnet50.resnet50.custom_model", "cifar",
+     {"records": 16, "batch": 8, "epochs": 1}),
+]
+
+
+@pytest.mark.parametrize("model_def,fixture,opts",
+                         ZOO, ids=[z[0] for z in ZOO])
+def test_zoo_model_trains_end_to_end(tmp_path, model_def, fixture, opts):
+    records = opts.get("records", 64)
+    batch = opts.get("batch", 16)
+    epochs = opts.get("epochs", 2)
+    suffix = ".csv" if fixture == "iris" else ".rec"
+    train_path = FIXTURES[fixture](
+        str(tmp_path / f"train{suffix}"), records, seed=1
+    )
+    eval_path = FIXTURES[fixture](
+        str(tmp_path / f"eval{suffix}"), max(records // 4, batch), seed=2
+    )
+    args = make_local_args(
+        model_zoo=model_zoo_dir(),
+        model_def=model_def,
+        training_data=train_path,
+        validation_data=eval_path,
+        tmpdir=tmp_path,
+        minibatch_size=batch,
+        num_epochs=epochs,
+    )
+    result = LocalExecutor(args).run()
+    expected_steps = epochs * ((records + batch - 1) // batch)
+    assert result["steps"] == expected_steps
+    assert result["final_loss"] is not None
+    import math
+    assert math.isfinite(result["final_loss"])
+    assert result["eval_metrics"]  # metrics computed for every family

@@ -1,0 +1,133 @@
+"""Preprocessing package tests.
+
+Mirrors the reference's elasticdl_preprocessing/tests (discretization_test,
+round_identity_test, to_number_test, feature_column_test) across both the
+host (numpy/string) and device (jnp/jit) planes.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from elasticdl_tpu.preprocessing import (
+    AddIdOffset,
+    CategoryHash,
+    CategoryLookup,
+    Discretization,
+    FeatureGroup,
+    Hashing,
+    NumericBucket,
+    RoundIdentity,
+    concat_feature_ids,
+    to_number,
+)
+
+
+# ---- host transforms ----------------------------------------------------
+
+def test_to_number_parses_and_defaults():
+    out = to_number([b"1.5", "2", "", "oops"], default=-1.0)
+    np.testing.assert_allclose(out, [1.5, 2.0, -1.0, -1.0])
+    assert out.dtype == np.float32
+    ints = to_number([["3"], ["x"]], default=0, dtype=np.int64)
+    np.testing.assert_array_equal(ints, [[3], [0]])
+
+
+def test_category_hash_stable_and_in_range():
+    hasher = CategoryHash(num_bins=7)
+    a = hasher(["Private", b"Self-emp", "Private", 42])
+    b = hasher(["Private", b"Self-emp", "Private", 42])
+    np.testing.assert_array_equal(a, b)  # process-stable
+    assert a[0] == a[2]
+    assert ((a >= 0) & (a < 7)).all()
+
+
+def test_category_lookup_vocab_and_oov():
+    lookup = CategoryLookup(["a", "b", "c"], num_oov_buckets=2)
+    assert lookup.num_buckets == 5
+    out = lookup(["b", "a", "zzz", b"c"])
+    assert out[0] == 1 and out[1] == 0 and out[3] == 2
+    assert 3 <= out[2] < 5  # oov lands in the hashed tail
+
+
+def test_numeric_bucket_boundaries():
+    bucket = NumericBucket([10.0, 20.0, 30.0])
+    assert bucket.num_buckets == 4
+    out = bucket(["5", 10, 25.0, 99, ""])
+    np.testing.assert_array_equal(out, [0, 1, 2, 3, 0])
+
+
+# ---- device layers ------------------------------------------------------
+
+def test_discretization_matches_reference_semantics():
+    # reference discretization_test: boundaries [0,1,2] ->
+    # x<0:0, [0,1):1, [1,2):2, >=2:3 with right-closed boundary ids.
+    layer = Discretization([0.0, 1.0, 2.0])
+    out = layer(jnp.asarray([[-1.5, 1.0, 3.4, 0.5], [0.0, 3.0, 1.3, 2.0]]))
+    np.testing.assert_array_equal(
+        np.asarray(out), [[0, 2, 3, 1], [1, 3, 2, 3]]
+    )
+    assert out.dtype == jnp.int32
+    assert layer.num_buckets == 4
+
+
+def test_discretization_is_jittable():
+    layer = Discretization([1.0, 5.0])
+    jitted = jax.jit(lambda x: layer(x))
+    np.testing.assert_array_equal(
+        np.asarray(jitted(jnp.asarray([0.0, 3.0, 9.0]))), [0, 1, 2]
+    )
+
+
+def test_round_identity_rounds_and_clips():
+    # reference round_identity_test: round to nearest int id.
+    layer = RoundIdentity(num_buckets=10)
+    out = layer(jnp.asarray([[1.2, 1.6], [0.2, 3.1]]))
+    np.testing.assert_array_equal(np.asarray(out), [[1, 2], [0, 3]])
+    big = layer(jnp.asarray([123.9, -5.0]))
+    np.testing.assert_array_equal(np.asarray(big), [9, 0])
+
+
+def test_hashing_in_range_and_avalanche():
+    layer = Hashing(num_bins=16)
+    ids = jnp.arange(0, 4096)
+    out = np.asarray(layer(ids))
+    assert ((out >= 0) & (out < 16)).all()
+    # sequential ids spread across bins, not mod-like striping
+    counts = np.bincount(out, minlength=16)
+    assert counts.min() > 100
+
+
+def test_add_id_offset_concatenates_id_spaces():
+    layer = AddIdOffset([10, 20, 5])
+    assert layer.total_size == 35
+    out = layer([
+        jnp.asarray([1, 2]), jnp.asarray([0, 19]), jnp.asarray([4, 0]),
+    ])
+    np.testing.assert_array_equal(
+        np.asarray(out), [[1, 10, 34], [2, 29, 30]]
+    )
+
+
+# ---- feature groups -----------------------------------------------------
+
+def test_feature_group_offsets_and_shapes():
+    group = FeatureGroup([
+        ("workclass", CategoryLookup(["Private", "Gov"], num_oov_buckets=1)),
+        ("age_bucket", NumericBucket([30.0, 50.0])),
+    ])
+    assert group.total_buckets == 3 + 3
+    ids = group({
+        "workclass": np.asarray(["Gov", "Private", "Martian"]),
+        "age_bucket": np.asarray([25.0, 40.0, 60.0]),
+    })
+    assert ids.shape == (3, 2)
+    np.testing.assert_array_equal(ids[:, 0], [1, 0, 2])
+    np.testing.assert_array_equal(ids[:, 1], [3, 4, 5])  # offset by 3
+
+
+def test_concat_feature_ids_multi_group():
+    g0 = np.asarray([[0], [1]])
+    g1 = np.asarray([[2, 0], [1, 1]])
+    out = concat_feature_ids([g0, g1], group_sizes=[2, 3])
+    np.testing.assert_array_equal(out, [[0, 4, 2], [1, 3, 3]])
