@@ -74,9 +74,33 @@ class ModelSpec:
     prediction_outputs_processor: Any = None
     module: Any = None
     extras: Dict[str, Any] = field(default_factory=dict)
+    # Parallel extras (net-new vs the reference contract): declarative
+    # parameter layout + batch layout for multi-axis meshes, consumed by
+    # MeshRunner (parallel/mesh_runner.py). Optional — dp-only models
+    # need neither.
+    param_sharding_rules: Optional[Callable] = None
+    batch_sharding_rule: Optional[Callable] = None
+    model_fn: Optional[Callable] = None
 
     def make_optimizer(self, **kwargs):
         return self.optimizer_fn(**kwargs)
+
+    def make_model(self, mesh=None):
+        """Build the model, passing the mesh when ``custom_model`` accepts
+        a ``mesh`` kwarg (mesh-aware models apply sharding constraints /
+        ring attention; others ignore the mesh entirely)."""
+        import inspect
+
+        if self.model_fn is None:
+            return self.model
+        if mesh is not None:
+            try:
+                params = inspect.signature(self.model_fn).parameters
+            except (TypeError, ValueError):
+                params = {}
+            if "mesh" in params:
+                return self.model_fn(mesh=mesh)
+        return self.model_fn()
 
 
 def get_model_spec(
@@ -112,4 +136,9 @@ def get_model_spec(
             processor_cls() if processor_cls is not None else None
         ),
         module=module,
+        param_sharding_rules=_get_spec_value(
+            module, "param_sharding_rules"
+        ),
+        batch_sharding_rule=_get_spec_value(module, "batch_sharding_rule"),
+        model_fn=model_fn,
     )

@@ -1,0 +1,13 @@
+"""Framework-level model families (reusable flax modules).
+
+The reference keeps all models in ``model_zoo/`` user modules; the TPU
+build additionally ships framework-native families here so parallelism
+features (ring attention, tensor/expert/pipeline parallel layouts) have
+first-class, tested implementations the zoo wraps.
+"""
+
+from elasticdl_tpu.models.transformer import (  # noqa: F401
+    TransformerConfig,
+    TransformerLM,
+    transformer_sharding_rules,
+)

@@ -1,0 +1,241 @@
+"""Decoder-only transformer LM — the multi-axis parallelism flagship.
+
+Net-new capability relative to the reference (SURVEY.md §5: no long-context
+or model parallelism exists in ElasticDL; its models are MLPs/CNNs/recsys),
+built TPU-first to exercise every mesh axis the framework supports:
+
+- ``dp``: batch dim sharded (the reference's only parallelism, worker
+  data-parallel via PS push/pull, here XLA gradient psum over ICI),
+- ``sp``: sequence dim sharded; attention runs as an exact ppermute ring
+  (``ops/ring_attention.py``) so context length scales past one chip's HBM,
+- ``tp``: attention heads and MLP hidden dim sharded Megatron-style —
+  column-parallel in, row-parallel out, one psum per block, expressed as
+  GSPMD sharding constraints instead of hand-written collectives,
+- ``ep``: MoE expert dim sharded; dense one-hot dispatch whose expert
+  einsum partitions over ``ep`` (each device computes only its experts,
+  XLA inserts the combine psum).
+
+Layout is declarative: ``transformer_sharding_rules()`` returns regex
+path → PartitionSpec pairs consumed by ``parallel/rules.py``; the same
+module runs unsharded on one chip (mesh=None) for the single-chip entry.
+"""
+
+from dataclasses import dataclass, field
+from typing import Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from elasticdl_tpu.ops.ring_attention import dense_attention, ring_attention
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 256
+    d_model: int = 128
+    n_heads: int = 8
+    n_layers: int = 2
+    d_ff: int = 512
+    max_len: int = 512
+    dropout_rate: float = 0.0
+    moe_experts: int = 0        # 0 = dense MLP in every block
+    moe_every: int = 2          # MoE replaces the MLP in every k-th block
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        assert self.d_model % self.n_heads == 0
+        return self.d_model // self.n_heads
+
+
+def transformer_sharding_rules() -> Tuple[Tuple[str, P], ...]:
+    """Regex path → PartitionSpec, in priority order; first match wins
+    and ``regex_param_rule`` drops per-dim any axis the mesh lacks, so
+    these run unchanged on dp-only, dp/sp/tp, or dp/ep meshes."""
+    return (
+        # Attention: column-parallel QKV, row-parallel out (heads on tp).
+        (r"(query|key|value)/kernel", P(None, "tp", None)),
+        (r"(query|key|value)/bias", P("tp", None)),
+        (r"attn/out/kernel", P("tp", None, None)),
+        # Dense MLP: Megatron column→row.
+        (r"mlp/wi/kernel", P(None, "tp")),
+        (r"mlp/wi/bias", P("tp")),
+        (r"mlp/wo/kernel", P("tp", None)),
+        # MoE experts: expert dim on ep, hidden dim on tp.
+        (r"moe/wi", P("ep", None, "tp")),
+        (r"moe/wo", P("ep", "tp", None)),
+        # Embeddings / head: vocab over tp.
+        (r"token_embed/embedding", P("tp", None)),
+        (r"lm_head/kernel", P(None, "tp")),
+        (r"lm_head/bias", P("tp")),
+    )
+
+
+class _Constrain:
+    """Activation sharding-constraint helper bound to an optional mesh."""
+
+    def __init__(self, mesh: Optional[Mesh]):
+        self.mesh = mesh
+
+    def __call__(self, x, *axes):
+        if self.mesh is None:
+            return x
+        shape = self.mesh.shape
+        fixed = []
+        for dim, a in enumerate(axes[: x.ndim]):
+            ok = (
+                a is not None
+                and a in shape
+                and x.shape[dim] % shape[a] == 0
+            )
+            fixed.append(a if ok else None)
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(self.mesh, P(*fixed))
+        )
+
+
+class SelfAttention(nn.Module):
+    cfg: TransformerConfig
+    mesh: Optional[Mesh] = None
+
+    @nn.compact
+    def __call__(self, x, training=False):
+        cfg = self.cfg
+        wsc = _Constrain(self.mesh)
+        proj = lambda name: nn.DenseGeneral(
+            (cfg.n_heads, cfg.head_dim), dtype=cfg.compute_dtype, name=name
+        )
+        q = wsc(proj("query")(x), "dp", "sp", "tp", None)
+        k = wsc(proj("key")(x), "dp", "sp", "tp", None)
+        v = wsc(proj("value")(x), "dp", "sp", "tp", None)
+        scale = cfg.head_dim ** -0.5
+        if self.mesh is not None:
+            o = ring_attention(q, k, v, self.mesh, causal=True, scale=scale)
+        else:
+            o = dense_attention(q, k, v, causal=True, scale=scale)
+        o = nn.DenseGeneral(
+            cfg.d_model, axis=(-2, -1), dtype=cfg.compute_dtype, name="out"
+        )(o)
+        return wsc(o, "dp", "sp", None)
+
+
+class Mlp(nn.Module):
+    cfg: TransformerConfig
+    mesh: Optional[Mesh] = None
+
+    @nn.compact
+    def __call__(self, x, training=False):
+        cfg = self.cfg
+        wsc = _Constrain(self.mesh)
+        h = nn.Dense(cfg.d_ff, dtype=cfg.compute_dtype, name="wi")(x)
+        h = wsc(nn.gelu(h), "dp", "sp", "tp")
+        o = nn.Dense(cfg.d_model, dtype=cfg.compute_dtype, name="wo")(h)
+        return wsc(o, "dp", "sp", None)
+
+
+class MoE(nn.Module):
+    """Top-1 routed mixture-of-experts with dense one-hot dispatch.
+
+    The expert einsum carries the expert dim so GSPMD partitions it over
+    ``ep`` — each device computes its local experts for all tokens and the
+    weighted combine psums over ``ep``. (A capacity-based all-to-all
+    dispatch is the follow-on optimization; this layout is exact and
+    collective-correct.)
+    """
+
+    cfg: TransformerConfig
+    mesh: Optional[Mesh] = None
+
+    @nn.compact
+    def __call__(self, x, training=False):
+        cfg = self.cfg
+        e, dm, dff = cfg.moe_experts, cfg.d_model, cfg.d_ff
+        wsc = _Constrain(self.mesh)
+        gates = nn.Dense(e, dtype=jnp.float32, name="router")(
+            x.astype(jnp.float32)
+        )
+        gates = jax.nn.softmax(gates, axis=-1)            # (B,S,E)
+        top1 = jnp.argmax(gates, axis=-1)
+        combine = jax.nn.one_hot(top1, e, dtype=gates.dtype) * gates
+        combine = wsc(combine, "dp", "sp", "ep")
+
+        wi = self.param(
+            "wi", nn.initializers.lecun_normal(), (e, dm, dff), jnp.float32
+        )
+        wo = self.param(
+            "wo", nn.initializers.lecun_normal(), (e, dff, dm), jnp.float32
+        )
+        xc = x.astype(cfg.compute_dtype)
+        h = jnp.einsum(
+            "bsd,edf->besf", xc, wi.astype(cfg.compute_dtype)
+        )
+        h = wsc(nn.gelu(h), "dp", "ep", "sp", "tp")
+        y = jnp.einsum(
+            "besf,efd->besd", h, wo.astype(cfg.compute_dtype)
+        )
+        y = wsc(y, "dp", "ep", "sp", None)
+        out = jnp.einsum("besd,bse->bsd", y, combine.astype(y.dtype))
+        return wsc(out, "dp", "sp", None)
+
+
+class Block(nn.Module):
+    cfg: TransformerConfig
+    mesh: Optional[Mesh] = None
+    use_moe: bool = False
+
+    @nn.compact
+    def __call__(self, x, training=False):
+        cfg = self.cfg
+        h = nn.LayerNorm(dtype=cfg.compute_dtype, name="ln1")(x)
+        h = SelfAttention(cfg, self.mesh, name="attn")(h, training)
+        if cfg.dropout_rate and training:
+            h = nn.Dropout(cfg.dropout_rate, deterministic=False)(h)
+        x = x + h
+        h = nn.LayerNorm(dtype=cfg.compute_dtype, name="ln2")(x)
+        if self.use_moe:
+            h = MoE(cfg, self.mesh, name="moe")(h, training)
+        else:
+            h = Mlp(cfg, self.mesh, name="mlp")(h, training)
+        if cfg.dropout_rate and training:
+            h = nn.Dropout(cfg.dropout_rate, deterministic=False)(h)
+        return x + h
+
+
+class TransformerLM(nn.Module):
+    """``features`` = int32 token ids (B, S); returns f32 logits (B,S,V)."""
+
+    cfg: TransformerConfig
+    mesh: Optional[Mesh] = None
+
+    @nn.compact
+    def __call__(self, features, training=False):
+        cfg = self.cfg
+        wsc = _Constrain(self.mesh)
+        tokens = features.astype(jnp.int32)
+        b, s = tokens.shape
+        x = nn.Embed(
+            cfg.vocab_size, cfg.d_model, dtype=cfg.compute_dtype,
+            name="token_embed",
+        )(tokens)
+        pos = self.param(
+            "pos_embed",
+            nn.initializers.normal(0.02),
+            (cfg.max_len, cfg.d_model),
+            jnp.float32,
+        )
+        x = x + pos[:s].astype(cfg.compute_dtype)[None]
+        x = wsc(x, "dp", "sp", None)
+        for i in range(cfg.n_layers):
+            use_moe = (
+                cfg.moe_experts > 0 and (i + 1) % cfg.moe_every == 0
+            )
+            x = Block(cfg, self.mesh, use_moe=use_moe, name=f"block_{i}")(
+                x, training
+            )
+        x = nn.LayerNorm(dtype=cfg.compute_dtype, name="ln_f")(x)
+        logits = nn.Dense(
+            cfg.vocab_size, dtype=cfg.compute_dtype, name="lm_head"
+        )(x)
+        return wsc(logits.astype(jnp.float32), "dp", "sp", "tp")

@@ -1,0 +1,150 @@
+"""Ring attention: exact attention over a sequence-parallel mesh axis.
+
+Long-context support is net-new relative to the reference (SURVEY.md §5
+"Long-context / sequence parallelism: absent" — ElasticDL scales data and
+sparse state only), designed TPU-first: the sequence dimension is sharded
+over the ``sp`` mesh axis, each device holds one query block, and key/value
+blocks rotate around the ring with ``jax.lax.ppermute`` over ICI while a
+blockwise online softmax (flash-attention style running max / sum / output
+accumulators) keeps the math exact. Compute of block t overlaps the
+transfer of block t+1 — XLA schedules the ppermute DMA asynchronously —
+so the ring rides ICI bandwidth instead of materializing the full
+``S × S`` score matrix on any chip.
+
+The public entry ``ring_attention`` wraps the per-device body in
+``jax.shard_map``; ``dense_attention`` is the mathematically identical
+single-device reference used by small models and by the tests.
+"""
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+_NEG_INF = -1e30
+
+
+def dense_attention(q, k, v, causal: bool = True, scale: Optional[float] = None):
+    """Plain softmax attention. Shapes: q,k,v = (B, S, H, D).
+
+    Reference semantics for ``ring_attention`` (used when the mesh has no
+    sequence axis, and by tests). f32 softmax accumulation regardless of
+    input dtype — bf16 inputs stay bf16 through the matmuls (MXU) but the
+    normalization happens in f32.
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        q_len, k_len = q.shape[1], k.shape[1]
+        qpos = jnp.arange(q_len)[:, None]
+        kpos = jnp.arange(k_len)[None, :]
+        s = jnp.where(qpos >= kpos, s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+
+
+def _block_update(carry, q, k, v, qpos, kpos, causal, scale):
+    """One online-softmax accumulation step against a single K/V block.
+
+    carry: m (B,H,Sq) running max, l (B,H,Sq) running denominator,
+    o (B,Sq,H,D) running numerator — all f32.
+    """
+    m, l, o = carry
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        mask = qpos[:, None] >= kpos[None, :]
+        s = jnp.where(mask, s, _NEG_INF)
+    m_new = jnp.maximum(m, s.max(axis=-1))
+    # exp(-inf - -inf) would give 1 for fully-masked rows; zero the masked
+    # entries explicitly instead of trusting the subtraction.
+    p = jnp.exp(s - m_new[..., None])
+    if causal:
+        p = jnp.where(mask, p, 0.0)
+    alpha = jnp.exp(m - m_new)
+    l = l * alpha + p.sum(axis=-1)
+    o = o * alpha.transpose(0, 2, 1)[..., None] + jnp.einsum(
+        "bhqk,bkhd->bqhd", p.astype(v.dtype), v
+    ).astype(jnp.float32)
+    return m_new, l, o
+
+
+def _ring_attention_local(q, k, v, axis_name: str, causal: bool, scale):
+    """Per-device body under shard_map: q stays, k/v rotate the ring."""
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    b, q_len, h, d = q.shape
+    k_len = k.shape[1]
+    qpos = idx * q_len + jnp.arange(q_len)
+
+    m0 = jnp.full((b, h, q_len), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, q_len), jnp.float32)
+    o0 = jnp.zeros((b, q_len, h, d), jnp.float32)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, t):
+        m, l, o, k, v = carry
+        # After t forward rotations, this device holds block (idx - t) % n.
+        kpos = ((idx - t) % n) * k_len + jnp.arange(k_len)
+        m, l, o = _block_update((m, l, o), q, k, v, qpos, kpos, causal, scale)
+        k = jax.lax.ppermute(k, axis_name, perm)
+        v = jax.lax.ppermute(v, axis_name, perm)
+        return (m, l, o, k, v), None
+
+    (m, l, o, _, _), _ = jax.lax.scan(
+        step, (m0, l0, o0, k, v), jnp.arange(n)
+    )
+    l = jnp.maximum(l, 1e-30)  # fully-masked rows (none in causal LM) stay 0
+    out = o / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention(
+    q,
+    k,
+    v,
+    mesh: Mesh,
+    sp_axis: str = "sp",
+    dp_axis: Optional[str] = "dp",
+    tp_axis: Optional[str] = "tp",
+    causal: bool = True,
+    scale: Optional[float] = None,
+):
+    """Exact attention with the sequence dim sharded over ``sp_axis``.
+
+    q, k, v: (B, S, H, D) global shapes; B may be sharded over ``dp_axis``
+    and H over ``tp_axis`` (both optional — axes absent from the mesh are
+    treated as replicated). The ring communicates only over ``sp_axis``.
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    axes = set(mesh.axis_names)
+    b, s, h, _ = q.shape
+    # The ring needs equal sequence blocks; other axes degrade to
+    # replicated when they don't divide (same policy as rules.fit_spec).
+    if (
+        sp_axis not in axes
+        or mesh.shape[sp_axis] == 1
+        or s % mesh.shape[sp_axis] != 0
+    ):
+        return dense_attention(q, k, v, causal=causal, scale=scale)
+
+    def usable(axis, dim):
+        return (
+            axis if axis and axis in axes and dim % mesh.shape[axis] == 0
+            else None
+        )
+
+    spec = P(usable(dp_axis, b), sp_axis, usable(tp_axis, h), None)
+    body = partial(
+        _ring_attention_local, axis_name=sp_axis, causal=causal, scale=scale
+    )
+    return jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )(q, k, v)

@@ -28,6 +28,7 @@ from elasticdl_tpu.core import step as step_lib
 from elasticdl_tpu.core.train_state import TrainState, init_train_state
 from elasticdl_tpu.embedding import partition as partition_lib
 from elasticdl_tpu.parallel import mesh as mesh_lib
+from elasticdl_tpu.parallel import rules as rules_lib
 
 
 class MeshRunner:
@@ -40,12 +41,17 @@ class MeshRunner:
         accum_steps: int = 1,
         donate_state: bool = True,
         param_rule=None,
+        batch_rule=None,
     ):
         self.mesh = mesh if mesh is not None else mesh_lib.make_mesh()
         self.data_axis = data_axis
         self.accum_steps = accum_steps
         self._donate_state = donate_state
         self._state_shardings = None
+        # Optional (path, leaf) -> PartitionSpec for batch leaves; default
+        # is leading-dim over the data axis. Multi-axis models (sequence
+        # parallel) shard e.g. token ids (B, S) as P("dp", "sp").
+        self.batch_rule = batch_rule
         # Auto-partition pass (reference ModelHandler 2MB rewrite,
         # model_handler.py:85-89): big embedding tables row-shard over the
         # data axis, everything else replicates.
@@ -63,6 +69,17 @@ class MeshRunner:
         return mesh_lib.batch_sharding(self.mesh, self.data_axis)
 
     def _shard_batch_tree(self, batch):
+        if self.batch_rule is not None:
+            mesh = self.mesh
+            return jax.tree_util.tree_map_with_path(
+                lambda path, leaf: NamedSharding(
+                    mesh,
+                    rules_lib.fit_spec(
+                        self.batch_rule(path, leaf), leaf, mesh
+                    ),
+                ),
+                batch,
+            )
         sharding = self._batch_sharding()
         return jax.tree.map(
             lambda _: sharding, batch
@@ -76,7 +93,17 @@ class MeshRunner:
         their table, reference ps/parameters.py:156)."""
         replicated = mesh_lib.replicated(self.mesh)
 
-        def opt_leaf(leaf):
+        def opt_leaf(path, leaf):
+            # Optax state paths embed the param path as a suffix, so the
+            # param rule re-applies here and moments/slots co-shard with
+            # their parameter (reference slot co-location,
+            # ps/parameters.py:156). Unmatched leaves ZeRO-shard over dp.
+            spec = self.param_rule(path, leaf)
+            if (
+                any(a is not None for a in tuple(spec))
+                and rules_lib.spec_fits(spec, leaf, self.mesh)
+            ):
+                return NamedSharding(self.mesh, spec)
             return mesh_lib.shard_leaf_over_axis(
                 self.mesh, leaf, self.data_axis
             )
@@ -88,7 +115,9 @@ class MeshRunner:
             ),
             batch_stats=jax.tree.map(lambda _: replicated,
                                      state.batch_stats),
-            opt_state=jax.tree.map(opt_leaf, state.opt_state),
+            opt_state=jax.tree_util.tree_map_with_path(
+                opt_leaf, state.opt_state
+            ),
             rng=replicated,
         )
 
@@ -111,7 +140,11 @@ class MeshRunner:
         return jax.jit(make_state, out_shardings=shardings)(example_batch)
 
     def place_batch(self, batch):
-        """Shard a host batch over the dp axis (leading dim)."""
+        """Shard a host batch onto the mesh (leading dim over dp by
+        default; per-leaf ``batch_rule`` when set, e.g. tokens over
+        dp×sp for sequence-parallel models)."""
+        if self.batch_rule is not None:
+            return jax.device_put(batch, self._shard_batch_tree(batch))
         return jax.device_put(batch, self._batch_sharding())
 
     def place_state(self, state):
@@ -284,3 +317,37 @@ class MeshRunner:
                 "MeshRunner.init_state must run before building steps"
             )
         return self._state_shardings
+
+
+def make_runner_for_spec(
+    spec,
+    mesh: Optional[Mesh] = None,
+    data_axis: str = "dp",
+    accum_steps: int = 1,
+    **kwargs,
+) -> MeshRunner:
+    """Build a MeshRunner wired to a ModelSpec's parallel extras.
+
+    The production path (worker/main.py, tests alike): the zoo module's
+    ``param_sharding_rules()`` regexes place params on tp/ep/sp axes with
+    the 2MB embedding auto-partition as fallback, and its
+    ``batch_sharding_rule`` lays batches over dp×sp. Modules without the
+    extras get the plain dp behavior.
+    """
+    mesh = mesh if mesh is not None else mesh_lib.make_mesh()
+    param_rule = None
+    if getattr(spec, "param_sharding_rules", None) is not None:
+        fallback = partition_lib.embedding_partition_rule(
+            axis=data_axis, axis_size=mesh.shape[data_axis]
+        )
+        param_rule = rules_lib.regex_param_rule(
+            spec.param_sharding_rules(), mesh=mesh, fallback=fallback
+        )
+    return MeshRunner(
+        mesh=mesh,
+        data_axis=data_axis,
+        accum_steps=accum_steps,
+        param_rule=param_rule,
+        batch_rule=getattr(spec, "batch_sharding_rule", None),
+        **kwargs,
+    )

@@ -1,0 +1,127 @@
+"""Pipeline parallelism: GPipe over a ``pp`` mesh axis.
+
+Net-new relative to the reference (SURVEY.md §2.7: "Absent in the
+reference: ... pipeline parallelism"), built the TPU way: every device
+holds one pipeline stage's parameters (stage-stacked pytree sharded on
+its leading dim over ``pp``), microbatches enter at stage 0 and rotate
+stage-to-stage with ``jax.lax.ppermute`` over ICI inside a ``lax.scan``
+— one compiled SPMD program, no host round-trips, reverse-mode
+differentiable end to end (ppermute's transpose is the reverse ring, so
+backward is automatically the reverse pipeline).
+
+Schedule: plain GPipe fill-drain. ``M`` microbatches through ``n`` stages
+take ``M + n - 1`` ticks; the bubble fraction is ``(n-1)/(M+n-1)`` —
+callers pick ``M >> n`` to amortize. All devices run every tick (SPMD);
+feed/collect selection is by masks, which XLA turns into cheap selects.
+"""
+
+from functools import partial
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def stack_stage_params(init_fn: Callable, rng, n_stages: int):
+    """Initialize ``n_stages`` independent stages as one stacked pytree:
+    leaves get a leading stage dim (to be sharded ``P(pp, ...)``).
+
+    ``init_fn(rng) -> params`` initializes a single stage.
+    """
+    rngs = jax.random.split(rng, n_stages)
+    return jax.vmap(init_fn)(rngs)
+
+
+def _local_stage(params):
+    """Take this device's stage slice (leading dim n/n = 1) off the
+    stacked pytree."""
+    return jax.tree.map(lambda p: p[0], params)
+
+
+def _pipeline_local(params, x, *, stage_fn, axis: str):
+    n = jax.lax.axis_size(axis)
+    idx = jax.lax.axis_index(axis)
+    stage_params = _local_stage(params)
+    m = x.shape[0]
+    ticks = m + n - 1
+
+    def tick(act, t):
+        feed = jax.lax.dynamic_index_in_dim(
+            x, jnp.clip(t, 0, m - 1), axis=0, keepdims=False
+        )
+        act_in = jnp.where(idx == 0, feed, act)
+        out = stage_fn(stage_params, act_in)
+        act_next = jax.lax.ppermute(
+            out, axis, [(i, (i + 1) % n) for i in range(n)]
+        )
+        return act_next, out
+
+    _, ys = jax.lax.scan(tick, jnp.zeros_like(x[0]), jnp.arange(ticks))
+    return ys  # (ticks, mb, ...); valid outputs live on the last stage
+
+
+def pipeline_apply(
+    stage_fn: Callable,
+    stacked_params,
+    x,
+    mesh: Mesh,
+    axis: str = "pp",
+    x_spec: Optional[P] = None,
+):
+    """Run ``x`` through ``n = mesh.shape[axis]`` pipeline stages.
+
+    - ``stage_fn(stage_params, act) -> act`` — one stage (may itself scan
+      over several layers); activation shape is preserved.
+    - ``stacked_params`` — pytree with leading stage dim ``n`` per leaf.
+    - ``x`` — ``(M, mb, ...)`` microbatched input, M microbatches.
+    - ``x_spec`` — PartitionSpec for ``x``'s trailing dims (dim 0, the
+      microbatch index, must be unsharded); lets dp compose with pp,
+      e.g. ``P(None, "dp", None, None)``.
+
+    Returns ``(M, mb, ...)`` outputs (stage ``n-1`` applied last).
+    """
+    n = mesh.shape[axis]
+    m = x.shape[0]
+    if x_spec is None:
+        x_spec = P(*([None] * x.ndim))
+    spec_tail = tuple(x_spec)[1:]
+    if tuple(x_spec)[:1] not in ((None,), ()):
+        raise ValueError("x_spec dim 0 (microbatch index) must be None")
+
+    param_specs = jax.tree.map(
+        lambda p: P(axis, *([None] * (p.ndim - 1))), stacked_params
+    )
+    out_spec = P(axis, *spec_tail)
+
+    body = partial(_pipeline_local, stage_fn=stage_fn, axis=axis)
+    ys = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(param_specs, x_spec),
+        out_specs=out_spec,
+        check_vma=False,
+    )(stacked_params, x)
+    # ys: (n * ticks, mb, ...) — device i's ticks at [i*ticks:(i+1)*ticks].
+    ticks = m + n - 1
+    ys = ys.reshape((n, ticks) + ys.shape[1:])
+    # Microbatch j leaves the last stage at tick (n-1) + j.
+    return jax.lax.slice_in_dim(ys[n - 1], n - 1, n - 1 + m, axis=0)
+
+
+def microbatch(batch, num_microbatches: int):
+    """(B, ...) -> (M, B/M, ...) reshape for pipeline input."""
+    return jax.tree.map(
+        lambda a: a.reshape(
+            (num_microbatches, a.shape[0] // num_microbatches)
+            + a.shape[1:]
+        ),
+        batch,
+    )
+
+
+def unmicrobatch(tree):
+    """(M, mb, ...) -> (M*mb, ...)."""
+    return jax.tree.map(
+        lambda a: a.reshape((a.shape[0] * a.shape[1],) + a.shape[2:]), tree
+    )

@@ -1,0 +1,100 @@
+"""Regex path → PartitionSpec rules for parameter layout.
+
+The reference's only model-partitioning decision is the ModelHandler's
+2MB embedding rewrite (``common/model_handler.py:85-89``); the TPU build
+generalizes that into declarative rules: a model (or model-zoo module)
+ships a list of ``(path_regex, PartitionSpec)`` pairs mapping parameter
+pytree paths to mesh axes (t5x-style logical rules, but over concrete
+axis names). First matching rule wins; no match = replicated.
+
+The same rule is reusable over the *optimizer state* pytree: optax state
+paths embed the parameter path as a suffix (e.g. ``0/trace/decoder/
+attn/query/kernel``), so ``re.search`` places momentum/Adam moments on
+the same axes as their parameter — the mesh-native version of the
+reference PS co-locating slot tables with their table
+(``ps/parameters.py:156``).
+"""
+
+import re
+from typing import Callable, Optional, Sequence, Tuple
+
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def path_str(path) -> str:
+    return "/".join(
+        str(getattr(p, "key", getattr(p, "name", p))) for p in path
+    )
+
+
+def spec_fits(spec: P, leaf, mesh: Mesh) -> bool:
+    """A spec is usable iff every named axis exists in the mesh, the spec
+    rank does not exceed the leaf rank, and each sharded dim divides."""
+    shape = getattr(leaf, "shape", ())
+    if len(spec) > len(shape):
+        return False
+    for dim, axis in enumerate(spec):
+        if axis is None:
+            continue
+        axes = axis if isinstance(axis, tuple) else (axis,)
+        size = 1
+        for a in axes:
+            if a not in mesh.shape:
+                return False
+            size *= mesh.shape[a]
+        if shape[dim] % size != 0:
+            return False
+    return True
+
+
+def fit_spec(spec: P, leaf, mesh: Mesh) -> P:
+    """Clamp a spec to what the mesh/leaf supports, dim by dim: axes
+    missing from the mesh or not dividing the dim become None. Used for
+    batch/activation shardings where partial placement is fine."""
+    shape = getattr(leaf, "shape", ())
+    out = []
+    for dim, axis in enumerate(tuple(spec)[: len(shape)]):
+        if axis is None:
+            out.append(None)
+            continue
+        axes = axis if isinstance(axis, tuple) else (axis,)
+        size = 1
+        ok = True
+        for a in axes:
+            if a not in mesh.shape:
+                ok = False
+                break
+            size *= mesh.shape[a]
+        out.append(axis if ok and shape[dim] % size == 0 else None)
+    return P(*out)
+
+
+def regex_param_rule(
+    rules: Sequence[Tuple[str, P]],
+    mesh: Optional[Mesh] = None,
+    fallback: Optional[Callable] = None,
+) -> Callable:
+    """Build a ``(path, leaf) -> PartitionSpec`` rule from regex pairs.
+
+    When ``mesh`` is given, the first matching spec is *fitted* per-dim
+    (``fit_spec``): axes absent from the mesh or not dividing the dim are
+    dropped to None, so the same model definition runs on any mesh — a
+    tp rule on a dp-only mesh just replicates that dim. ``fallback``
+    handles leaves no rule matched (default: replicate).
+    """
+    compiled = [(re.compile(pat), spec) for pat, spec in rules]
+
+    def rule(path, leaf):
+        name = path_str(path)
+        for rx, spec in compiled:
+            if rx.search(name):
+                return fit_spec(spec, leaf, mesh) if mesh else spec
+        if fallback is not None:
+            return fallback(path, leaf)
+        return P()
+
+    return rule
+
+
+# Pytree-wide spec/sharding mapping lives in embedding/partition.py
+# (tree_partition_specs / tree_shardings); this module only builds rules.

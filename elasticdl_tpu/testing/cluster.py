@@ -45,8 +45,21 @@ class MiniCluster:
         checkpoint_dir: str = "",
         checkpoint_steps: int = 0,
         checkpoint_dir_for_init: str = "",
+        mesh=None,
     ):
         self.spec = get_model_spec(model_zoo, model_def)
+        if mesh is not None:
+            # Same wiring as worker/main.py MESH strategy: mesh-aware
+            # model + spec-driven param/batch layout.
+            from elasticdl_tpu.parallel.mesh_runner import (
+                make_runner_for_spec,
+            )
+
+            self.spec.model = self.spec.make_model(mesh)
+            if step_runner_factory is None:
+                step_runner_factory = lambda: make_runner_for_spec(  # noqa: E731
+                    self.spec, mesh
+                )
         reader_of = lambda origin: create_data_reader(
             data_origin=origin, custom_reader=self.spec.custom_data_reader
         )

@@ -53,6 +53,22 @@ def create_frappe_record_file(path, num_records, seed=0, input_length=10,
     return path
 
 
+def create_lm_record_file(path, num_records, seed=0, seq_len=32,
+                          vocab=256):
+    """Byte-token LM sequences for the transformer zoo model. Each record
+    is a +1-chain (tokens[i+1] = tokens[i]+1 mod vocab) so next-token
+    prediction is fully learnable."""
+    rng = np.random.RandomState(seed)
+    with RecordFileWriter(path) as writer:
+        for _ in range(num_records):
+            start = int(rng.randint(vocab))
+            tokens = (start + np.arange(seq_len + 1)) % vocab
+            writer.write(
+                tensor_utils.dumps({"tokens": tokens.astype(np.int64)})
+            )
+    return path
+
+
 def create_census_record_file(path, num_records, seed=0):
     """Census-style mixed dense+categorical rows (wide&deep workload)."""
     rng = np.random.RandomState(seed)

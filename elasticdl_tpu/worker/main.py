@@ -53,11 +53,17 @@ def build_worker(args, master_client=None) -> Worker:
     step_runner = None
     if args.distribution_strategy == DistributionStrategy.MESH:
         from elasticdl_tpu.parallel.mesh import make_mesh, parse_mesh_args
-        from elasticdl_tpu.parallel.mesh_runner import MeshRunner
+        from elasticdl_tpu.parallel.mesh_runner import make_runner_for_spec
 
         shape, axes = parse_mesh_args(args.mesh_shape, args.mesh_axes)
-        step_runner = MeshRunner(
-            make_mesh(shape, axes),
+        mesh = make_mesh(shape, axes)
+        # Mesh-aware models (e.g. the transformer flagship) rebuild with
+        # the mesh so ring attention / sharding constraints activate; the
+        # zoo module's sharding rules drive param & batch layout.
+        spec.model = spec.make_model(mesh)
+        step_runner = make_runner_for_spec(
+            spec,
+            mesh,
             # grads_to_wait maps onto gradient accumulation before the
             # sync apply (SURVEY.md §7.4).
             accum_steps=getattr(args, "grads_to_wait", 1),

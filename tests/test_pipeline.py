@@ -1,0 +1,87 @@
+"""Pipeline parallelism: pipelined apply == sequential apply, grads flow,
+dp composes with pp."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from elasticdl_tpu.parallel.mesh import make_mesh
+from elasticdl_tpu.parallel.pipeline import (
+    microbatch,
+    pipeline_apply,
+    stack_stage_params,
+    unmicrobatch,
+)
+
+D = 16
+
+
+def _stage_fn(params, x):
+    h = jnp.tanh(x @ params["w1"] + params["b1"])
+    return x + h @ params["w2"]
+
+
+def _init_stage(rng):
+    k1, k2 = jax.random.split(rng)
+    return {
+        "w1": jax.random.normal(k1, (D, 32)) * 0.1,
+        "b1": jnp.zeros((32,)),
+        "w2": jax.random.normal(k2, (32, D)) * 0.1,
+    }
+
+
+def _sequential(stacked, x):
+    n = jax.tree.leaves(stacked)[0].shape[0]
+    for i in range(n):
+        stage = jax.tree.map(lambda p: p[i], stacked)
+        x = _stage_fn(stage, x)
+    return x
+
+
+def test_pipeline_matches_sequential():
+    mesh = make_mesh((4,), ("pp",), devices=jax.devices()[:4])
+    stacked = stack_stage_params(_init_stage, jax.random.PRNGKey(0), 4)
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, 4, D))  # (M, mb, D)
+
+    got = pipeline_apply(_stage_fn, stacked, x, mesh, axis="pp")
+    want = _sequential(stacked, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_pipeline_gradients_match_sequential():
+    mesh = make_mesh((4,), ("pp",), devices=jax.devices()[:4])
+    stacked = stack_stage_params(_init_stage, jax.random.PRNGKey(0), 4)
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, 4, D))
+
+    def loss_pipe(params):
+        return jnp.sum(pipeline_apply(_stage_fn, params, x, mesh) ** 2)
+
+    def loss_seq(params):
+        return jnp.sum(_sequential(params, x) ** 2)
+
+    gp = jax.grad(loss_pipe)(stacked)
+    gs = jax.grad(loss_seq)(stacked)
+    for a, b in zip(jax.tree.leaves(gp), jax.tree.leaves(gs)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_pipeline_composes_with_dp():
+    mesh = make_mesh((2, 4), ("pp", "dp"), devices=jax.devices()[:8])
+    stacked = stack_stage_params(_init_stage, jax.random.PRNGKey(0), 2)
+    batch = jax.random.normal(jax.random.PRNGKey(1), (32, D))
+    x = microbatch(batch, 8)  # (8, 4, D), mb dim shards over dp
+
+    @jax.jit
+    def f(params, x):
+        return pipeline_apply(
+            _stage_fn, params, x, mesh, axis="pp",
+            x_spec=P(None, "dp", None),
+        )
+
+    got = unmicrobatch(f(stacked, x))
+    want = _sequential(stacked, batch)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)

@@ -1,0 +1,193 @@
+"""Transformer LM: single-chip forward, dp/sp/tp mesh training parity,
+expert-parallel MoE, sharding placement."""
+
+import importlib.util
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import PartitionSpec as P
+
+from elasticdl_tpu.core.step import build_train_step
+from elasticdl_tpu.core.train_state import init_train_state
+from elasticdl_tpu.models.transformer import (
+    TransformerConfig,
+    TransformerLM,
+    transformer_sharding_rules,
+)
+from elasticdl_tpu.parallel import rules as rules_lib
+from elasticdl_tpu.parallel.mesh import make_mesh
+from elasticdl_tpu.parallel.mesh_runner import MeshRunner
+
+
+def _zoo_module():
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "model_zoo", "transformer", "transformer_lm.py",
+    )
+    spec = importlib.util.spec_from_file_location("transformer_lm", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+CFG = TransformerConfig(
+    vocab_size=32, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+    max_len=32, compute_dtype=jnp.float32,
+)
+
+
+def _batch(b=8, s=16, vocab=32, seed=0):
+    rng = np.random.RandomState(seed)
+    start = rng.randint(0, vocab, (b, 1))
+    seq = (start + np.arange(s + 1)[None, :]) % vocab  # learnable: +1 chain
+    return {
+        "features": seq[:, :-1].astype(np.int32),
+        "labels": seq[:, 1:].astype(np.int32),
+        "mask": np.ones((b,), np.float32),
+    }
+
+
+def _lm_loss():
+    return _zoo_module().loss
+
+
+def test_single_device_forward():
+    model = TransformerLM(CFG)
+    batch = _batch()
+    variables = model.init(
+        {"params": jax.random.PRNGKey(0)}, batch["features"],
+        training=False,
+    )
+    logits = model.apply(variables, batch["features"], training=False)
+    assert logits.shape == (8, 16, 32)
+    assert logits.dtype == jnp.float32
+
+
+def _runner(mesh, model):
+    zoo = _zoo_module()
+    rule = rules_lib.regex_param_rule(
+        transformer_sharding_rules(), mesh=mesh
+    )
+    return MeshRunner(
+        mesh=mesh, param_rule=rule, batch_rule=zoo.batch_sharding_rule
+    )
+
+
+def test_mesh_training_matches_single_device():
+    """3 optimizer steps on a (2,2,2) dp/sp/tp mesh == unsharded steps."""
+    mesh = make_mesh((2, 2, 2), ("dp", "sp", "tp"),
+                     devices=jax.devices()[:8])
+    loss_fn = _lm_loss()
+
+    # Unsharded reference.
+    model0 = TransformerLM(CFG)
+    state0 = init_train_state(
+        model0, optax.adam(1e-2), _batch(), seed=0
+    )
+    step0 = build_train_step(loss_fn)
+
+    model1 = TransformerLM(CFG, mesh=mesh)
+    runner = _runner(mesh, model1)
+    state1 = runner.init_state(model1, optax.adam(1e-2), _batch(), seed=0)
+    step1 = runner.train_step(loss_fn)
+
+    for i in range(3):
+        batch = _batch(seed=i)
+        state0, m0 = step0(state0, batch)
+        state1, m1 = step1(state1, batch)
+        np.testing.assert_allclose(
+            float(m1["loss"]), float(m0["loss"]), rtol=2e-4, atol=2e-4
+        )
+
+
+def test_mesh_params_actually_sharded():
+    mesh = make_mesh((2, 2, 2), ("dp", "sp", "tp"),
+                     devices=jax.devices()[:8])
+    model = TransformerLM(CFG, mesh=mesh)
+    runner = _runner(mesh, model)
+    state = runner.init_state(model, optax.adam(1e-2), _batch(), seed=0)
+
+    wi = state.params["block_0"]["mlp"]["wi"]["kernel"]
+    assert wi.sharding.spec == P(None, "tp")
+    q = state.params["block_0"]["attn"]["query"]["kernel"]
+    assert q.sharding.spec == P(None, "tp", None)
+    # Adam moments co-shard with their param (slot co-location).
+    mu_wi = state.opt_state[0].mu["block_0"]["mlp"]["wi"]["kernel"]
+    assert mu_wi.sharding.spec == P(None, "tp")
+
+
+def test_moe_expert_parallel():
+    cfg = TransformerConfig(
+        vocab_size=32, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+        max_len=32, moe_experts=4, moe_every=2,
+        compute_dtype=jnp.float32,
+    )
+    mesh = make_mesh((2, 4), ("dp", "ep"), devices=jax.devices()[:8])
+    model = TransformerLM(cfg, mesh=mesh)
+    runner = _runner(mesh, model)
+    state = runner.init_state(model, optax.adam(1e-2), _batch(), seed=0)
+
+    wi = state.params["block_1"]["moe"]["wi"]
+    assert wi.shape == (4, 32, 64)
+    # Mesh has no tp axis, so the hidden dim replicates; experts on ep.
+    assert wi.sharding.spec == P("ep", None, None)
+
+    step = runner.train_step(_lm_loss())
+    losses = []
+    for i in range(8):
+        state, metrics = step(state, _batch(seed=i % 2))
+        losses.append(float(metrics["loss"]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+
+
+def test_mesh_wiring_end_to_end(tmp_path):
+    """Production wiring: record files → MiniCluster (same path as
+    worker/main.py MESH strategy) → spec-driven rules activate — params
+    land tp-sharded without any hand-assembly."""
+    from elasticdl_tpu.testing.cluster import MiniCluster
+    from elasticdl_tpu.testing.data import (
+        create_lm_record_file,
+        model_zoo_dir,
+    )
+
+    path = create_lm_record_file(
+        str(tmp_path / "lm.rec"), 128, seq_len=16
+    )
+    mesh = make_mesh((2, 2, 2), ("dp", "sp", "tp"),
+                     devices=jax.devices()[:8])
+    cluster = MiniCluster(
+        model_zoo_dir(),
+        "transformer.transformer_lm.custom_model",
+        training_data=path,
+        minibatch_size=16,
+        num_epochs=1,
+        mesh=mesh,
+    )
+    results = cluster.run()
+    assert cluster.finished
+    assert np.isfinite(results[0]["final_loss"])
+    worker = cluster.workers[0]
+    assert worker._spec.model.mesh is mesh
+    wi = worker.state.params["block_0"]["mlp"]["wi"]["kernel"]
+    assert wi.sharding.spec == P(None, "tp")
+
+
+def test_training_learns_on_dp_sp_tp():
+    """Loss drops markedly on the deterministic +1-chain task."""
+    mesh = make_mesh((2, 2, 2), ("dp", "sp", "tp"),
+                     devices=jax.devices()[:8])
+    model = TransformerLM(CFG, mesh=mesh)
+    runner = _runner(mesh, model)
+    state = runner.init_state(model, optax.adam(1e-2), _batch(), seed=0)
+    step = runner.train_step(_lm_loss())
+    first = None
+    for i in range(20):
+        state, metrics = step(state, _batch(seed=i % 4))
+        if first is None:
+            first = float(metrics["loss"])
+    last = float(metrics["loss"])
+    assert last < first * 0.7, (first, last)
