@@ -70,6 +70,7 @@ class ReaderType:
     CSV = "CSV"
     RECORD_FILE = "RecordFile"
     TEXT = "Text"
+    TABLE = "Table"  # row-range table service (ODPS-equivalent)
 
 
 class MetricsDictKey:

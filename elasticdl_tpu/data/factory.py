@@ -28,6 +28,14 @@ def create_data_reader(data_origin: str, custom_reader=None, **kwargs):
     if custom_reader is not None:
         return custom_reader(data_origin=data_origin, **kwargs)
     reader_type = kwargs.pop("reader_type", None)
+    # Table origins (sqlite/csv-table/ODPS) route by URL scheme
+    # (reference data_reader_factory.py: ODPS selected by env+path).
+    if reader_type == ReaderType.TABLE or data_origin.startswith(
+        ("table+sqlite://", "table+csv://", "odps://")
+    ):
+        from elasticdl_tpu.data.table_reader import TableDataReader
+
+        return TableDataReader(data_origin=data_origin, **kwargs)
     if reader_type == ReaderType.CSV:
         return CSVDataReader(data_origin=data_origin, **kwargs)
     if reader_type == ReaderType.RECORD_FILE:

@@ -1,0 +1,229 @@
+"""Table data reader — the ODPS/MaxCompute plane, TPU-build edition.
+
+Reference parity targets: ``data/reader/odps_reader.py:12-60`` (shard =
+table row-range), ``data/odps_io.py`` (retrying range reads) and
+``data/parallel_odps_table_reader.py`` (thread-pool prefetch of ranges).
+
+Design: the reader is generic over a ``TableSource`` (count + range read
+of rows); concrete sources:
+
+- ``SqliteTableSource`` — stdlib sqlite3, rowid-range addressable; the
+  in-repo stand-in for a cloud table service, fully testable.
+- ``CsvTableSource`` — header CSV as a table.
+- ``OdpsTableSource`` — real MaxCompute via pyodps, import-gated: this
+  image has no pyodps (and no egress), so constructing it without the
+  package raises with instructions, mirroring how the reference gates
+  ODPS tests behind env vars.
+
+Rows are serialized to msgpack dicts (column name → value) so the user
+``dataset_fn`` sees the same payloads as any other reader.
+"""
+
+import threading
+from typing import Dict, Iterator, List, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+from elasticdl_tpu.common import tensor_utils
+from elasticdl_tpu.data.reader import AbstractDataReader, Metadata
+
+
+class TableSource:
+    """count() + read(start, end) over ordered rows."""
+
+    def count(self) -> int:
+        raise NotImplementedError
+
+    def column_names(self) -> List[str]:
+        raise NotImplementedError
+
+    def read(self, start: int, end: int) -> Iterator[dict]:
+        """Yield rows [start, end) as column dicts."""
+        raise NotImplementedError
+
+    def close(self):
+        pass
+
+
+class SqliteTableSource(TableSource):
+    def __init__(self, path: str, table: str):
+        import sqlite3
+
+        self._path = path
+        self._table = table
+        # One connection per thread (sqlite objects are thread-bound and
+        # the parallel reader fans ranges out over a pool).
+        self._local = threading.local()
+        cols = self._conn().execute(
+            f"PRAGMA table_info({self._quoted})"
+        ).fetchall()
+        if not cols:
+            raise ValueError(f"No such table {table!r} in {path}")
+        self._columns = [c[1] for c in cols]
+
+    @property
+    def _quoted(self) -> str:
+        return '"' + self._table.replace('"', '""') + '"'
+
+    def _conn(self):
+        conn = getattr(self._local, "conn", None)
+        if conn is None:
+            import sqlite3
+
+            conn = sqlite3.connect(self._path)
+            self._local.conn = conn
+        return conn
+
+    def count(self) -> int:
+        row = self._conn().execute(
+            f"SELECT COUNT(*) FROM {self._quoted}"
+        ).fetchone()
+        return int(row[0])
+
+    def column_names(self) -> List[str]:
+        return list(self._columns)
+
+    def read(self, start: int, end: int) -> Iterator[dict]:
+        # Index the range via rowid (the PK btree) instead of
+        # LIMIT/OFFSET, which walks all `start` rows per call — O(n^2)
+        # over a chunked shard scan. Rowids are 1-based and contiguous
+        # for append-only tables (our ingest pattern; a table with
+        # deletions should be compacted/VACUUMed first).
+        cursor = self._conn().execute(
+            f"SELECT * FROM {self._quoted} "
+            f"WHERE rowid > ? AND rowid <= ? ORDER BY rowid",
+            (start, end),
+        )
+        for row in cursor:
+            yield dict(zip(self._columns, row))
+
+
+class CsvTableSource(TableSource):
+    def __init__(self, path: str):
+        import csv
+
+        self._path = path
+        with open(path, newline="") as f:
+            reader = csv.reader(f)
+            self._columns = next(reader)
+            self._num_rows = sum(1 for _ in reader)
+
+    def count(self) -> int:
+        return self._num_rows
+
+    def column_names(self) -> List[str]:
+        return list(self._columns)
+
+    def read(self, start: int, end: int) -> Iterator[dict]:
+        import csv
+
+        with open(self._path, newline="") as f:
+            reader = csv.reader(f)
+            next(reader)  # header
+            for i, row in enumerate(reader):
+                if i >= end:
+                    return
+                if i >= start:
+                    yield dict(zip(self._columns, row))
+
+
+class OdpsTableSource(TableSource):
+    """MaxCompute table via pyodps (import-gated; reference odps_io.py)."""
+
+    def __init__(self, project: str, table: str, access_id: str = "",
+                 access_key: str = "", endpoint: str = ""):
+        try:
+            import odps  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "OdpsTableSource requires the 'pyodps' package, which is "
+                "not available in this environment; use a sqlite:// or "
+                "csv table origin, or install pyodps where egress exists."
+            ) from e
+        from odps import ODPS
+
+        self._odps = ODPS(access_id, access_key, project,
+                          endpoint=endpoint)
+        self._table = self._odps.get_table(table)
+        self._columns = [c.name for c in self._table.schema.columns]
+
+    def count(self) -> int:
+        with self._table.open_reader() as reader:
+            return reader.count
+
+    def column_names(self) -> List[str]:
+        return list(self._columns)
+
+    def read(self, start: int, end: int) -> Iterator[dict]:
+        with self._table.open_reader() as reader:
+            for record in reader.read(start=start, count=end - start):
+                yield dict(zip(self._columns, record.values))
+
+
+def open_table_source(data_origin: str) -> TableSource:
+    """Parse a table origin URL:
+
+    - ``table+sqlite:///path/to.db?table=name``
+    - ``table+csv:///path/to.csv``
+    - ``odps://project/tables/name``
+    """
+    parsed = urlparse(data_origin)
+    scheme = parsed.scheme
+    if scheme == "table+sqlite":
+        q = parse_qs(parsed.query)
+        table = q.get("table", ["data"])[0]
+        return SqliteTableSource(parsed.path, table)
+    if scheme == "table+csv":
+        return CsvTableSource(parsed.path)
+    if scheme == "odps":
+        parts = parsed.path.strip("/").split("/")
+        table = parts[-1] if parts else ""
+        return OdpsTableSource(project=parsed.netloc, table=table)
+    raise ValueError(f"Unrecognized table origin {data_origin!r}")
+
+
+class TableDataReader(AbstractDataReader):
+    """Row-range sharded reader over a TableSource (reference
+    odps_reader.py: one shard table, shards = row ranges; the dispatcher
+    splits the range into tasks)."""
+
+    def __init__(self, data_origin: str, source: Optional[TableSource] =
+                 None, num_prefetch_threads: int = 0,
+                 prefetch_chunk: int = 256, **kwargs):
+        super().__init__(**kwargs)
+        self._data_origin = data_origin
+        self._source = source or open_table_source(data_origin)
+        self._num_prefetch_threads = int(num_prefetch_threads)
+        self._prefetch_chunk = int(prefetch_chunk)
+
+    def create_shards(self) -> Dict[str, Tuple[int, int]]:
+        return {self._data_origin: (0, self._source.count())}
+
+    def read_records(self, task) -> Iterator[bytes]:
+        rows = (
+            self._parallel_rows(task.start, task.end)
+            if self._num_prefetch_threads > 1
+            else self._source.read(task.start, task.end)
+        )
+        for row in rows:
+            yield tensor_utils.dumps(row)
+
+    def _parallel_rows(self, start: int, end: int) -> Iterator[dict]:
+        """Thread-pool range prefetch preserving row order (reference
+        parallel_odps_table_reader.py). ``executor.map`` keeps order and
+        re-raises worker exceptions in the consumer, so a failing range
+        read fails the task instead of hanging it."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        chunk = self._prefetch_chunk
+        ranges = [
+            (s, min(s + chunk, end)) for s in range(start, end, chunk)
+        ]
+        with ThreadPoolExecutor(self._num_prefetch_threads) as pool:
+            for rows in pool.map(
+                lambda r: list(self._source.read(*r)), ranges
+            ):
+                yield from rows
+
+    @property
+    def metadata(self) -> Metadata:
+        return Metadata(column_names=self._source.column_names())

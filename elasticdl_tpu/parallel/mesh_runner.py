@@ -11,10 +11,14 @@ worker dies (recovery = sharded checkpoint + task re-queue, stage 5).
 
 Sync semantics map (SURVEY.md §2.7):
 - sync SGD ``grads_to_wait``  → ``accum_steps`` gradient accumulation,
-- async staleness LR modulation → ``lr_scale`` hook on the accumulated
-  apply (per-host accumulation + delayed sync is the principled mapping
-  of async SGD onto SPMD; documented rather than pretending RPC async),
-- SSP ``get_model_steps``      → planned local-apply window (stage 4+).
+- async staleness LR modulation → ``staleness_modulation=True``:
+  microbatch j in a window of k is weighted 1/(k-j) — the delayed-apply
+  analog of the PS scaling each grad's LR by 1/staleness (per-host
+  accumulation + delayed sync is the principled mapping of async SGD
+  onto SPMD; weighted rather than pretending RPC async),
+- SSP ``get_model_steps``     → ``version_report_steps`` on the Worker:
+  every step applies to the one true SPMD state, the master just
+  observes (and eval-triggers on) every N-th version.
 """
 
 from functools import partial
@@ -42,6 +46,7 @@ class MeshRunner:
         donate_state: bool = True,
         param_rule=None,
         batch_rule=None,
+        staleness_modulation: bool = False,
     ):
         self.mesh = mesh if mesh is not None else mesh_lib.make_mesh()
         self.data_axis = data_axis
@@ -52,6 +57,12 @@ class MeshRunner:
         # is leading-dim over the data axis. Multi-axis models (sequence
         # parallel) shard e.g. token ids (B, S) as P("dp", "sp").
         self.batch_rule = batch_rule
+        # Async-SGD staleness LR modulation (reference
+        # ps/learning_rate_modulator.py + ps/servicer.py:133-140: a grad
+        # applied at staleness s gets lr/s): under delayed SPMD
+        # application, microbatch j in a window of k has staleness k-j at
+        # apply time, so its contribution is weighted 1/(k-j), normalized.
+        self.staleness_modulation = staleness_modulation
         # Auto-partition pass (reference ModelHandler 2MB rewrite,
         # model_handler.py:85-89): big embedding tables row-shard over the
         # data axis, everything else replicates.
@@ -214,6 +225,16 @@ class MeshRunner:
         ``accum_steps`` calls, scaled by 1/accum_steps."""
         shardings = self._require_shardings()
         accum_steps = self.accum_steps
+        if self.staleness_modulation:
+            # Microbatch j (count=j) has staleness k-j at the delayed
+            # apply; weight 1/(k-j), normalize by the harmonic sum so the
+            # effective LR is preserved (reference lr/staleness scaling).
+            weight_of = lambda count: 1.0 / (accum_steps - count)
+            norm = float(sum(1.0 / (accum_steps - j)
+                             for j in range(accum_steps)))
+        else:
+            weight_of = lambda count: 1.0
+            norm = float(accum_steps)
 
         def micro_step(carry, batch):
             state, grad_acc, count = carry
@@ -240,13 +261,16 @@ class MeshRunner:
                     new_bs, state.batch_stats,
                 )
                 state = state.replace(batch_stats=new_bs)
-            grad_acc = jax.tree.map(jnp.add, grad_acc, grads)
+            w = weight_of(count)
+            grad_acc = jax.tree.map(
+                lambda acc, g: acc + w * g, grad_acc, grads
+            )
             count = count + 1
 
             def apply(args):
                 state, grad_acc, count = args
                 mean_grads = jax.tree.map(
-                    lambda g: g / accum_steps, grad_acc
+                    lambda g: g / norm, grad_acc
                 )
                 new_state = state.apply_gradients(grads=mean_grads)
                 zeros = jax.tree.map(jnp.zeros_like, grad_acc)

@@ -65,8 +65,13 @@ def build_worker(args, master_client=None) -> Worker:
             spec,
             mesh,
             # grads_to_wait maps onto gradient accumulation before the
-            # sync apply (SURVEY.md §7.4).
+            # sync apply (SURVEY.md §7.4); async staleness LR modulation
+            # becomes per-microbatch 1/staleness weighting.
             accum_steps=getattr(args, "grads_to_wait", 1),
+            staleness_modulation=(
+                getattr(args, "use_async", False)
+                and getattr(args, "lr_staleness_modulation", False)
+            ),
         )
     if master_client is None:
         master_client = MasterClient(
@@ -97,6 +102,8 @@ def build_worker(args, master_client=None) -> Worker:
         data_reader=reader,
         minibatch_size=args.minibatch_size,
         step_runner=step_runner,
+        # SSP mapping: the master observes every N-th version only.
+        version_report_steps=getattr(args, "get_model_steps", 1),
         prediction_outputs_processor=spec.prediction_outputs_processor,
         callbacks=callbacks,
         timing=Timing(args.log_level.upper() == "DEBUG"),

@@ -12,9 +12,10 @@ reference's protocol:
 - eval tasks: forward pass + raw outputs/labels to the master,
 - predict tasks: forward pass + user outputs processor,
 - TRAIN_END_CALLBACK: run user callbacks,
-- SSP-style local updates: with ``get_model_steps > 1`` the mesh-sync
-  step applies locally and only syncs state every N steps (reference
-  worker.py:297-305 _update_local_model),
+- SSP ``get_model_steps`` (reference worker.py:297-305
+  _update_local_model): under SPMD every step already applies to the
+  one true state, so the knob maps onto ``version_report_steps`` —
+  the master only observes (and eval-triggers on) every N-th version,
 - minibatch retry with a cap (reference worker.py:49 MAX_MINIBATCH_RETRY_NUM).
 
 Under MeshStrategy the same code runs SPMD over the device mesh: batches

@@ -1,0 +1,84 @@
+"""DeepFM with a standard (unsharded) embedding table.
+
+Counterpart of the reference's ``model_zoo/deepfm_functional_api/
+deepfm_functional_api.py`` — the plain-Keras-embedding twin of
+``deepfm_functional.py``: the table lives as an ordinary parameter
+(``nn.Embed``), always replicated, never auto-partitioned. This is the
+small-table path the reference keeps for SavedModel-export simplicity
+(ModelHandler only swaps in the PS-backed layer above 2MB); here it
+doubles as the deliberate "stay replicated" choice when the table fits
+HBM and gather locality beats sharding.
+"""
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from elasticdl_tpu.common import tensor_utils
+from elasticdl_tpu.common.constants import Mode
+from elasticdl_tpu.ops import masked_sigmoid_cross_entropy
+
+INPUT_LENGTH = 10
+MAX_ID = 5500
+EMBEDDING_DIM = 16
+
+
+class DeepFMStandard(nn.Module):
+    input_dim: int = MAX_ID
+    embedding_dim: int = EMBEDDING_DIM
+    hidden: tuple = (64, 32)
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, features, training=False):
+        ids = jnp.asarray(features, jnp.int32)  # (B, fields)
+        emb = nn.Embed(
+            self.input_dim, self.embedding_dim, name="fm_embedding"
+        )(ids).astype(self.compute_dtype)
+        lin = nn.Embed(self.input_dim, 1, name="fm_linear")(ids)
+        lin = lin.astype(self.compute_dtype)
+
+        # FM second-order: 0.5 * ((sum v)^2 - sum v^2) over fields.
+        summed = emb.sum(axis=1)
+        fm = 0.5 * (summed ** 2 - (emb ** 2).sum(axis=1)).sum(
+            axis=-1, keepdims=True
+        )
+        deep = emb.reshape((emb.shape[0], -1))
+        for width in self.hidden:
+            deep = nn.relu(nn.Dense(width, dtype=self.compute_dtype)(deep))
+        deep = nn.Dense(1, dtype=self.compute_dtype)(deep)
+        logit = lin.sum(axis=1) + fm + deep
+        return logit[:, 0].astype(jnp.float32)
+
+
+def custom_model():
+    return DeepFMStandard()
+
+
+def loss(labels, predictions, mask):
+    return masked_sigmoid_cross_entropy(labels, predictions, mask)
+
+
+def optimizer(lr=0.001):
+    return optax.adam(lr)
+
+
+def dataset_fn(records, mode, metadata):
+    ids, labels = [], []
+    for payload in records:
+        rec = tensor_utils.loads(payload)
+        ids.append(np.asarray(rec["feature_ids"], np.int64))
+        labels.append(int(rec.get("label", 0)))
+    features = np.stack(ids).astype(np.int32)
+    labels = np.asarray(labels, np.float32)
+    if mode == Mode.PREDICTION:
+        return features, np.zeros_like(labels)
+    return features, labels
+
+
+def eval_metrics_fn():
+    def accuracy(labels, outputs):
+        return float(np.mean((outputs > 0).astype(np.float32) == labels))
+
+    return {"accuracy": accuracy}

@@ -1,0 +1,97 @@
+"""ResNet-50 v2 (pre-activation) — the second resnet50 zoo family.
+
+Counterpart of the reference's ``model_zoo/resnet50_subclass/`` (a second,
+independently-coded ResNet-50 alongside the functional one; the reference
+keeps both as distinct e2e workloads). This variant is genuinely a
+different network: full pre-activation bottlenecks (BN→ReLU→conv,
+He et al. 2016) with a final BN+ReLU before pooling. Same TPU dtype
+policy as resnet50.py: bfloat16 conv compute, float32 BN and head.
+"""
+
+from functools import partial
+
+import flax.linen as nn
+import jax.numpy as jnp
+import optax
+
+from elasticdl_tpu.data.decoders import (
+    argmax_accuracy_metrics,
+    image_classification_dataset_fn,
+)
+from elasticdl_tpu.ops import masked_softmax_cross_entropy
+
+STAGES = ((64, 3), (128, 4), (256, 6), (512, 3))
+
+
+class PreActBottleneck(nn.Module):
+    filters: int
+    strides: int = 1
+    projection: bool = False
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, training=False):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.compute_dtype)
+        norm = partial(
+            nn.BatchNorm, use_running_average=not training, momentum=0.9,
+            epsilon=1e-5, dtype=jnp.float32,
+        )
+        pre = nn.relu(norm(name="pre_norm")(x))
+        shortcut = x
+        if self.projection:
+            # v2 projects from the pre-activated tensor.
+            shortcut = conv(self.filters * 4, (1, 1),
+                            strides=(self.strides, self.strides),
+                            name="proj")(pre)
+        y = conv(self.filters, (1, 1))(pre)
+        y = nn.relu(norm(name="norm1")(y))
+        y = conv(self.filters, (3, 3),
+                 strides=(self.strides, self.strides))(y)
+        y = nn.relu(norm(name="norm2")(y))
+        y = conv(self.filters * 4, (1, 1))(y)
+        return shortcut + y
+
+
+class ResNet50V2(nn.Module):
+    num_classes: int = 10
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, features, training=False):
+        x = jnp.asarray(features, self.compute_dtype)
+        if x.ndim == 3:
+            x = x[..., None]
+        x = nn.Conv(64, (7, 7), strides=(2, 2), use_bias=False,
+                    dtype=self.compute_dtype, name="stem")(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for stage, (filters, blocks) in enumerate(STAGES):
+            for block in range(blocks):
+                strides = 2 if (stage > 0 and block == 0) else 1
+                x = PreActBottleneck(
+                    filters, strides=strides, projection=(block == 0),
+                    compute_dtype=self.compute_dtype,
+                    name=f"stage{stage}_block{block}",
+                )(x, training)
+        x = nn.relu(nn.BatchNorm(
+            use_running_average=not training, momentum=0.9, epsilon=1e-5,
+            dtype=jnp.float32, name="final_norm",
+        )(x))
+        x = x.mean(axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+        return x
+
+
+def custom_model():
+    return ResNet50V2()
+
+
+def loss(labels, predictions, mask):
+    return masked_softmax_cross_entropy(labels, predictions, mask)
+
+
+def optimizer(lr=0.05):
+    return optax.sgd(lr, momentum=0.9, nesterov=True)
+
+
+dataset_fn = image_classification_dataset_fn
+eval_metrics_fn = argmax_accuracy_metrics

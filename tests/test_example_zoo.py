@@ -15,6 +15,7 @@ from elasticdl_tpu.testing.data import (
     create_frappe_record_file,
     create_heart_record_file,
     create_iris_csv,
+    create_lm_record_file,
     create_mnist_record_file,
     make_local_args,
     model_zoo_dir,
@@ -27,6 +28,7 @@ FIXTURES = {
     "census": create_census_record_file,
     "heart": create_heart_record_file,
     "iris": create_iris_csv,
+    "lm": create_lm_record_file,
 }
 
 ZOO = [
@@ -35,10 +37,16 @@ ZOO = [
     ("cifar10.cifar10_subclass.custom_model", "cifar", {}),
     ("census.census_wide_deep.custom_model", "census", {}),
     ("census.census_dnn.custom_model", "census", {}),
+    ("census.census_sqlflow.custom_model", "census", {}),
     ("heart.heart.custom_model", "heart", {}),
     ("iris.iris_dnn.custom_model", "iris", {}),
-    # resnet50 on cifar-shaped data: 2 tiny batches, compile-and-train check
+    ("deepfm.deepfm_standard.custom_model", "frappe", {}),
+    ("transformer.transformer_lm.custom_model", "lm",
+     {"records": 32, "batch": 8, "epochs": 1}),
+    # resnets on cifar-shaped data: 2 tiny batches, compile-and-train check
     ("resnet50.resnet50.custom_model", "cifar",
+     {"records": 16, "batch": 8, "epochs": 1}),
+    ("resnet50.resnet50_v2.custom_model", "cifar",
      {"records": 16, "batch": 8, "epochs": 1}),
 ]
 

@@ -1,0 +1,205 @@
+"""Table reader (ODPS-equivalent plane), image builder context, and data
+prep tools."""
+
+import csv
+import os
+import sqlite3
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from elasticdl_tpu.common import tensor_utils
+from elasticdl_tpu.common.task import Task
+from elasticdl_tpu.data.factory import create_data_reader
+from elasticdl_tpu.data.record_file import RecordFileScanner
+from elasticdl_tpu.data.table_reader import (
+    CsvTableSource,
+    SqliteTableSource,
+    TableDataReader,
+    open_table_source,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def sqlite_db(tmp_path):
+    path = str(tmp_path / "data.db")
+    conn = sqlite3.connect(path)
+    conn.execute("CREATE TABLE iris (a REAL, b REAL, label INTEGER)")
+    rows = [(float(i), float(i) * 2, i % 3) for i in range(100)]
+    conn.executemany("INSERT INTO iris VALUES (?,?,?)", rows)
+    conn.commit()
+    conn.close()
+    return path
+
+
+class TestTableReader:
+    def test_sqlite_source_shards_and_rows(self, sqlite_db):
+        origin = f"table+sqlite://{sqlite_db}?table=iris"
+        reader = create_data_reader(origin)
+        assert isinstance(reader, TableDataReader)
+        shards = reader.create_shards()
+        assert shards == {origin: (0, 100)}
+        task = Task(shard_name=origin, start=10, end=20)
+        rows = [tensor_utils.loads(p) for p in reader.read_records(task)]
+        assert len(rows) == 10
+        assert rows[0] == {"a": 10.0, "b": 20.0, "label": 1}
+        assert reader.metadata.column_names == ["a", "b", "label"]
+
+    def test_parallel_prefetch_preserves_order(self, sqlite_db):
+        reader = TableDataReader(
+            f"table+sqlite://{sqlite_db}?table=iris",
+            num_prefetch_threads=4,
+        )
+        task = Task(shard_name="x", start=0, end=100)
+        rows = [tensor_utils.loads(p) for p in reader.read_records(task)]
+        assert [r["a"] for r in rows] == [float(i) for i in range(100)]
+
+    def test_prefetch_error_propagates(self, sqlite_db):
+        """A failing range read must fail the task, not hang it."""
+
+        class FlakySource(SqliteTableSource):
+            def read(self, start, end):
+                if start >= 50:
+                    raise RuntimeError("range read failed")
+                return super().read(start, end)
+
+        reader = TableDataReader(
+            "x", source=FlakySource(sqlite_db, "iris"),
+            num_prefetch_threads=4, prefetch_chunk=10,
+        )
+        task = Task(shard_name="x", start=0, end=100)
+        with pytest.raises(RuntimeError, match="range read failed"):
+            list(reader.read_records(task))
+
+    def test_csv_table_source(self, tmp_path):
+        path = tmp_path / "t.csv"
+        with open(path, "w", newline="") as f:
+            w = csv.writer(f)
+            w.writerow(["x", "y"])
+            for i in range(10):
+                w.writerow([i, i * i])
+        src = CsvTableSource(str(path))
+        assert src.count() == 10
+        rows = list(src.read(2, 5))
+        assert rows[0] == {"x": "2", "y": "4"}
+
+    def test_odps_source_gated(self):
+        with pytest.raises((ImportError, ValueError)):
+            open_table_source("odps://proj/tables/foo")
+
+    def test_sqlite_source_threaded_conns(self, sqlite_db):
+        src = SqliteTableSource(sqlite_db, "iris")
+        out = {}
+
+        def read(tid):
+            out[tid] = list(src.read(0, 5))
+
+        import threading
+
+        threads = [
+            threading.Thread(target=read, args=(i,)) for i in range(3)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert all(len(v) == 5 for v in out.values())
+
+
+class TestImageBuilder:
+    def test_context_and_dockerfile(self, tmp_path):
+        from elasticdl_tpu.api.image_builder import (
+            build_and_push_docker_image,
+            prepare_build_context,
+        )
+
+        ctx = prepare_build_context(
+            os.path.join(REPO, "model_zoo"),
+            context_dir=str(tmp_path / "ctx"),
+            base_image="python:3.12-slim",
+            extra_pypi_packages="msgpack",
+        )
+        assert os.path.exists(os.path.join(ctx, "Dockerfile"))
+        assert os.path.exists(
+            os.path.join(ctx, "elasticdl_tpu", "parallel",
+                         "mesh_runner.py")
+        )
+        assert os.path.exists(
+            os.path.join(ctx, "model_zoo", "mnist",
+                         "mnist_functional.py")
+        )
+        content = open(os.path.join(ctx, "Dockerfile")).read()
+        assert "FROM python:3.12-slim" in content
+        assert "msgpack" in content
+
+        # No docker daemon here: returns the image name, context intact.
+        image = build_and_push_docker_image(
+            os.path.join(REPO, "model_zoo"),
+            docker_image_repository="registry.example.com/jobs",
+        )
+        assert image.startswith("registry.example.com/jobs/elasticdl_tpu:")
+
+
+class TestRecordGenTools:
+    def test_csv_to_records_roundtrip(self, tmp_path):
+        sys.path.insert(0, os.path.join(REPO, "tools", "record_gen"))
+        try:
+            import csv_to_records
+        finally:
+            sys.path.pop(0)
+        src = tmp_path / "in.csv"
+        with open(src, "w", newline="") as f:
+            w = csv.writer(f)
+            w.writerow(["a", "label"])
+            for i in range(20):
+                w.writerow([i * 1.5, i % 2])
+        out = str(tmp_path / "out.rec")
+        files = csv_to_records.convert(str(src), out)
+        assert files == [out]
+        with RecordFileScanner(out, 0, 20) as scanner:
+            rows = [tensor_utils.loads(p) for p in scanner]
+        assert rows[2] == {"a": 3.0, "label": 0}
+
+    def test_numpy_to_records(self, tmp_path):
+        sys.path.insert(0, os.path.join(REPO, "tools", "record_gen"))
+        try:
+            import numpy_to_records
+        finally:
+            sys.path.pop(0)
+        features = np.arange(12, dtype=np.float32).reshape(4, 3)
+        labels = np.array([0, 1, 0, 1])
+        out = str(tmp_path / "imgs.rec")
+        n = numpy_to_records.convert(features, labels, out, key="image")
+        assert n == 4
+        with RecordFileScanner(out, 0, 4) as scanner:
+            rows = [tensor_utils.loads(p) for p in scanner]
+        np.testing.assert_array_equal(
+            np.asarray(rows[1]["image"]), features[1]
+        )
+        assert rows[1]["label"] == 1
+
+    def test_flatten_kv_cli(self, tmp_path):
+        src = tmp_path / "kv.csv"
+        with open(src, "w", newline="") as f:
+            w = csv.writer(f)
+            w.writerow(["id", "features"])
+            w.writerow([1, "f1:2.0,f2:4.0"])
+            w.writerow([2, "f1:6.0"])
+        out = tmp_path / "flat.csv"
+        result = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "tools", "table_tools", "flatten_kv.py"),
+             str(src), str(out), "--kv_column", "features",
+             "--normalize"],
+            capture_output=True, text=True,
+        )
+        assert result.returncode == 0, result.stderr
+        with open(out, newline="") as f:
+            rows = list(csv.DictReader(f))
+        assert rows[0]["f1"] == "0.0" and rows[1]["f1"] == "1.0"
+        # f2 absent in row 2 -> default 0, normalized range [0, 4].
+        assert float(rows[0]["f2"]) == 1.0 and float(rows[1]["f2"]) == 0.0

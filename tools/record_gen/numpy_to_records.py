@@ -1,0 +1,64 @@
+"""Convert .npy/.npz arrays into RecordFiles of image/label records.
+
+Counterpart of the reference's image dataset converters
+(``elasticdl/python/data/recordio_gen/image_label.py`` and the
+mnist/cifar generation scripts): given a features array (N, ...) and a
+labels array (N,), emit records ``{"image": ..., "label": int}`` in the
+shape the bundled mnist/cifar zoo models consume.
+
+Usage:
+  python tools/record_gen/numpy_to_records.py features.npy labels.npy \
+      out.rec [--key image]
+  python tools/record_gen/numpy_to_records.py data.npz out.rec \
+      --features_key x_train --labels_key y_train
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), "..", ".."))
+
+from elasticdl_tpu.common import tensor_utils  # noqa: E402
+from elasticdl_tpu.data.record_file import RecordFileWriter  # noqa: E402
+
+
+def convert(features: np.ndarray, labels: np.ndarray, out_path: str,
+            key: str = "image") -> int:
+    assert len(features) == len(labels), (
+        f"{len(features)} features vs {len(labels)} labels"
+    )
+    with RecordFileWriter(out_path) as writer:
+        for x, y in zip(features, labels):
+            writer.write(tensor_utils.dumps(
+                {key: np.asarray(x), "label": int(y)}
+            ))
+    return len(features)
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("inputs", nargs="+",
+                        help="features.npy labels.npy OR one .npz")
+    parser.add_argument("out_path")
+    parser.add_argument("--key", default="image")
+    parser.add_argument("--features_key", default="x_train")
+    parser.add_argument("--labels_key", default="y_train")
+    args = parser.parse_args()
+    if len(args.inputs) == 1 and args.inputs[0].endswith(".npz"):
+        data = np.load(args.inputs[0])
+        features, labels = data[args.features_key], data[args.labels_key]
+    elif len(args.inputs) == 2:
+        features = np.load(args.inputs[0])
+        labels = np.load(args.inputs[1])
+    else:
+        parser.error("pass features.npy labels.npy, or one .npz")
+    n = convert(features, labels, args.out_path, key=args.key)
+    print(f"wrote {n} records to {args.out_path}")
+
+
+if __name__ == "__main__":
+    main()

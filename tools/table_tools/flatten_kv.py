@@ -1,0 +1,114 @@
+"""Key-value column flatten / normalize utilities.
+
+Counterpart of the reference's ``tools/odps_table_tools`` (k-v ODPS table
+flatten + normalize UDFs): rows whose column packs sparse features as
+"k1:v1,k2:v2" strings are expanded into dense columns, optionally
+min-max normalized, over CSV or any TableSource.
+
+Usage: python tools/table_tools/flatten_kv.py in.csv out.csv \
+           --kv_column features [--normalize]
+"""
+
+import argparse
+import csv
+from typing import Dict, Iterable, List, Optional, Tuple
+
+
+def parse_kv(cell: str, kv_sep: str = ":",
+             item_sep: str = ",") -> Dict[str, float]:
+    out: Dict[str, float] = {}
+    cell = (cell or "").strip()
+    if not cell:
+        return out
+    for item in cell.split(item_sep):
+        item = item.strip()
+        if not item:
+            continue
+        key, _, value = item.partition(kv_sep)
+        try:
+            out[key.strip()] = float(value)
+        except ValueError:
+            continue
+    return out
+
+
+def collect_keys(rows: Iterable[Dict[str, str]], kv_column: str,
+                 **kv_kwargs) -> List[str]:
+    keys = set()
+    for row in rows:
+        keys.update(parse_kv(row.get(kv_column, ""), **kv_kwargs))
+    return sorted(keys)
+
+
+def flatten_rows(
+    rows: Iterable[Dict[str, str]],
+    kv_column: str,
+    keys: List[str],
+    default: float = 0.0,
+    bounds: Optional[Dict[str, Tuple[float, float]]] = None,
+    **kv_kwargs,
+):
+    """Expand the kv column into one dense column per key; optionally
+    min-max normalize with precomputed per-key (lo, hi) bounds."""
+    for row in rows:
+        kv = parse_kv(row.get(kv_column, ""), **kv_kwargs)
+        out = {k: v for k, v in row.items() if k != kv_column}
+        for key in keys:
+            value = kv.get(key, default)
+            if bounds and key in bounds:
+                lo, hi = bounds[key]
+                value = (value - lo) / (hi - lo) if hi > lo else 0.0
+            out[key] = value
+        yield out
+
+
+def compute_bounds(rows: Iterable[Dict[str, str]], kv_column: str,
+                   keys: List[str],
+                   **kv_kwargs) -> Dict[str, Tuple[float, float]]:
+    bounds = {k: (float("inf"), float("-inf")) for k in keys}
+    for row in rows:
+        kv = parse_kv(row.get(kv_column, ""), **kv_kwargs)
+        for key in keys:
+            value = kv.get(key, 0.0)
+            lo, hi = bounds[key]
+            bounds[key] = (min(lo, value), max(hi, value))
+    return bounds
+
+
+def flatten_csv(in_path: str, out_path: str, kv_column: str,
+                normalize: bool = False, **kv_kwargs) -> int:
+    with open(in_path, newline="") as f:
+        rows = list(csv.DictReader(f))
+    keys = collect_keys(rows, kv_column, **kv_kwargs)
+    bounds = (
+        compute_bounds(rows, kv_column, keys, **kv_kwargs)
+        if normalize else None
+    )
+    flat = list(flatten_rows(rows, kv_column, keys, bounds=bounds,
+                             **kv_kwargs))
+    if not flat:
+        return 0
+    with open(out_path, "w", newline="") as f:
+        writer = csv.DictWriter(f, fieldnames=list(flat[0].keys()))
+        writer.writeheader()
+        writer.writerows(flat)
+    return len(flat)
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("in_csv")
+    parser.add_argument("out_csv")
+    parser.add_argument("--kv_column", required=True)
+    parser.add_argument("--normalize", action="store_true")
+    parser.add_argument("--kv_sep", default=":")
+    parser.add_argument("--item_sep", default=",")
+    args = parser.parse_args()
+    n = flatten_csv(args.in_csv, args.out_csv, args.kv_column,
+                    normalize=args.normalize, kv_sep=args.kv_sep,
+                    item_sep=args.item_sep)
+    print(f"wrote {n} rows to {args.out_csv}")
+
+
+if __name__ == "__main__":
+    main()
