@@ -28,6 +28,10 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from elasticdl_tpu.ops.flash_attention import (
+    flash_attention,
+    supports as flash_supports,
+)
 from elasticdl_tpu.ops.ring_attention import dense_attention, ring_attention
 
 
@@ -113,6 +117,10 @@ class SelfAttention(nn.Module):
         scale = cfg.head_dim ** -0.5
         if self.mesh is not None:
             o = ring_attention(q, k, v, self.mesh, causal=True, scale=scale)
+        elif jax.default_backend() == "tpu" and flash_supports(q.shape):
+            # Single-chip TPU hot path: fused Pallas kernel (O(S) HBM,
+            # causal block skipping) instead of the O(S^2) dense scores.
+            o = flash_attention(q, k, v, causal=True, scale=scale)
         else:
             o = dense_attention(q, k, v, causal=True, scale=scale)
         o = nn.DenseGeneral(

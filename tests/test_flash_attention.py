@@ -1,0 +1,109 @@
+"""Flash attention (Pallas interpret mode) vs dense reference: values,
+gradients, causal block skipping, bf16."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from elasticdl_tpu.ops.flash_attention import flash_attention, supports
+from elasticdl_tpu.ops.ring_attention import dense_attention
+
+B, S, H, D = 2, 64, 2, 16
+
+
+def _qkv(seed=0, dtype=jnp.float32, s=S):
+    rng = np.random.RandomState(seed)
+    mk = lambda: jnp.asarray(rng.randn(B, s, H, D), dtype) * 0.3
+    return mk(), mk(), mk()
+
+
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("blocks", [(16, 16), (32, 16), (64, 64)])
+def test_flash_matches_dense(causal, blocks):
+    bq, bk = blocks
+    q, k, v = _qkv()
+    got = flash_attention(q, k, v, causal=causal, block_q=bq,
+                          block_k=bk, interpret=True)
+    want = dense_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_flash_gradients_match_dense():
+    q, k, v = _qkv(seed=1)
+
+    def loss_flash(q, k, v):
+        out = flash_attention(q, k, v, causal=True, block_q=16,
+                              block_k=16, interpret=True)
+        return jnp.sum(out ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(dense_attention(q, k, v, causal=True) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_flash_noncausal_gradients():
+    q, k, v = _qkv(seed=2)
+
+    def loss_flash(q, k, v):
+        out = flash_attention(q, k, v, causal=False, block_q=32,
+                              block_k=16, interpret=True)
+        return jnp.sum(out * jnp.cos(out))
+
+    def loss_dense(q, k, v):
+        out = dense_attention(q, k, v, causal=False)
+        return jnp.sum(out * jnp.cos(out))
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_flash_bf16_inputs():
+    q, k, v = _qkv(seed=3, dtype=jnp.bfloat16)
+    got = flash_attention(q, k, v, causal=True, block_q=32,
+                          block_k=32, interpret=True)
+    want = dense_attention(
+        q.astype(jnp.float32), k.astype(jnp.float32),
+        v.astype(jnp.float32), causal=True,
+    )
+    assert got.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want),
+        rtol=2e-2, atol=2e-2,
+    )
+
+
+def test_supports_gate():
+    assert supports((2, 256, 4, 16))
+    assert supports((2, 32, 4, 16))      # small aligned S: blocks clamp
+    assert not supports((2, 100, 4, 16))  # not sublane-aligned
+    assert not supports((2, 200, 4, 16))  # doesn't tile by 128
+
+
+def test_unaligned_seq_raises():
+    q, k, v = _qkv(seed=5, s=48)
+    with pytest.raises(ValueError, match="must tile"):
+        flash_attention(q, k, v, block_q=32, block_k=32, interpret=True)
+
+
+def test_jit_and_under_vmapless_batch():
+    q, k, v = _qkv(seed=4)
+
+    @jax.jit
+    def f(q, k, v):
+        return flash_attention(q, k, v, causal=True, block_q=16,
+                               block_k=16, interpret=True)
+
+    got = f(q, k, v)
+    want = dense_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
