@@ -165,6 +165,12 @@ def add_train_params(parser):
                         help=">1 enables SSP-style local updates between syncs")
     parser.add_argument("--random_seed", type=non_neg_int, default=0)
     parser.add_argument("--max_steps", type=non_neg_int, default=0)
+    parser.add_argument("--profile_dir", default="",
+                        help="Write a jax.profiler trace (TensorBoard/"
+                             "Perfetto) for a step window")
+    parser.add_argument("--profile_start_step", type=non_neg_int,
+                        default=5)
+    parser.add_argument("--profile_steps", type=pos_int, default=5)
     parser.add_argument("--task_timeout_secs", type=pos_float, default=300.0)
 
 

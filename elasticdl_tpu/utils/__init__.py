@@ -1,0 +1,1 @@
+from elasticdl_tpu.utils.profiler import Profiler  # noqa: F401

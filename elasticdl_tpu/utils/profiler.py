@@ -1,0 +1,88 @@
+"""Native profiler integration (beyond-parity for SURVEY.md §5 tracing).
+
+The reference's only tracing is wall-clock phase accumulators
+(common/timing_utils.py, mirrored by common/timing.py here). On TPU the
+interesting time is *inside* the XLA program, which host timers cannot
+see — so this wraps ``jax.profiler``: a step-window trace capturing
+device timelines (HBM transfers, fusions, collective overlap) viewable
+in TensorBoard/Perfetto, plus named trace annotations that show host
+phases on the same timeline.
+
+Wired via ``--profile_dir`` (+ ``--profile_start_step/--profile_steps``):
+the worker starts the trace when the step window opens and stops it when
+it closes, so steady-state steps are captured rather than compile time.
+"""
+
+import contextlib
+from typing import Optional
+
+from elasticdl_tpu.common.log_utils import get_logger
+
+logger = get_logger("profiler")
+
+
+class Profiler:
+    """Step-windowed jax.profiler trace.
+
+    ``observe_step(step)`` is called once per training step; the trace
+    runs for steps [start_step, start_step + num_steps).
+    """
+
+    def __init__(self, profile_dir: str = "", start_step: int = 5,
+                 num_steps: int = 5):
+        self.profile_dir = profile_dir
+        self.start_step = int(start_step)
+        self.num_steps = int(num_steps)
+        self._active = False
+        self._done = False
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.profile_dir)
+
+    def observe_step(self, step: int):
+        if not self.enabled or self._done:
+            return
+        if not self._active and step >= self.start_step:
+            import jax
+
+            jax.profiler.start_trace(self.profile_dir)
+            self._active = True
+            self._window_end = step + self.num_steps
+            logger.info(
+                "profiler: tracing steps %d..%d to %s",
+                step, self._window_end - 1, self.profile_dir,
+            )
+        elif self._active and step >= self._window_end:
+            self.stop()
+
+    def stop(self):
+        if self._active:
+            import jax
+
+            jax.profiler.stop_trace()
+            self._active = False
+            self._done = True
+            logger.info("profiler: trace written to %s", self.profile_dir)
+
+    @contextlib.contextmanager
+    def annotation(self, name: str):
+        """Host-phase annotation visible on the device timeline."""
+        if not self.enabled:
+            yield
+            return
+        import jax
+
+        with jax.profiler.TraceAnnotation(name):
+            yield
+
+
+def from_args(args) -> Optional[Profiler]:
+    profile_dir = getattr(args, "profile_dir", "")
+    if not profile_dir:
+        return None
+    return Profiler(
+        profile_dir,
+        start_step=getattr(args, "profile_start_step", 5),
+        num_steps=getattr(args, "profile_steps", 5),
+    )

@@ -15,6 +15,7 @@ from elasticdl_tpu.common.constants import DistributionStrategy
 from elasticdl_tpu.common.log_utils import get_logger
 from elasticdl_tpu.common.timing import Timing
 from elasticdl_tpu.core.model_spec import get_model_spec
+from elasticdl_tpu.utils.profiler import from_args as profiler_from_args
 from elasticdl_tpu.data.factory import (
     create_data_reader,
     parse_data_reader_params,
@@ -108,6 +109,7 @@ def build_worker(args, master_client=None) -> Worker:
         callbacks=callbacks,
         timing=Timing(args.log_level.upper() == "DEBUG"),
         checkpoint_hook=checkpoint_hook,
+        profiler=profiler_from_args(args),
         **resolve_init_checkpoint(args),
     )
 

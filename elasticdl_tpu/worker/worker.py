@@ -62,6 +62,7 @@ class Worker:
         checkpoint_hook=None,
         checkpoint_dir_for_init: str = "",
         checkpoint_init_required: bool = True,
+        profiler=None,
     ):
         self._id = worker_id
         self._master = master_client
@@ -93,6 +94,8 @@ class Worker:
 
         self._checkpoint = checkpoint_hook or CheckpointHook()
         self._checkpoint_dir_for_init = checkpoint_dir_for_init
+        # jax.profiler step-window trace (utils/profiler.py); None = off.
+        self._profiler = profiler
         self._checkpoint_init_required = checkpoint_init_required
 
     # ---- state init ----------------------------------------------------
@@ -157,8 +160,16 @@ class Worker:
         for batch in batches:
             self._maybe_init(batch)
             self.last_batch = batch
+            if self._profiler is not None:
+                # Pre-step so the window [start, start+num) captures the
+                # steps it names.
+                self._profiler.observe_step(int(self.state.step))
             with self._timing.record("batch_process"):
-                self._process_train_batch(batch)
+                if self._profiler is not None:
+                    with self._profiler.annotation("train_step"):
+                        self._process_train_batch(batch)
+                else:
+                    self._process_train_batch(batch)
             count += 1
             version = int(self.state.step)
             if version % self._version_report_steps == 0:
@@ -202,6 +213,15 @@ class Worker:
 
     def run(self) -> dict:
         """The task pull loop (reference Worker.run → _train_and_evaluate)."""
+        try:
+            return self._run()
+        finally:
+            if self._profiler is not None:
+                # Close a still-open trace even on preemption, or a later
+                # start_trace in this process raises "already started".
+                self._profiler.stop()
+
+    def _run(self) -> dict:
         trained_batches = 0
         for task, batches in self._task_data.task_stream():
             if task.type == TaskType.TRAIN_END_CALLBACK:

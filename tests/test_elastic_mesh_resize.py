@@ -1,0 +1,143 @@
+"""Elastic mesh-topology change: train on one mesh, die, resume on a
+DIFFERENT mesh from the sharded checkpoint.
+
+The TPU analogue of the reference's cross-N checkpoint repartitioning
+(save_utils.py:206-259, pkg/ps/checkpoint.go:47-119: restore a model
+saved by N parameter servers onto M): on TPU a membership change means a
+new Mesh (JAX fixes ICI topology at init), so elastic recovery = restore
+host-side checkpoint leaves + re-place them under the NEW mesh's
+shardings (SURVEY.md §7 stage 5 — the hard part #1 design).
+"""
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from elasticdl_tpu.checkpoint import CheckpointSaver
+from elasticdl_tpu.core.model_spec import get_model_spec
+from elasticdl_tpu.parallel.mesh import make_mesh
+from elasticdl_tpu.parallel.mesh_runner import make_runner_for_spec
+from elasticdl_tpu.testing.cluster import MiniCluster
+from elasticdl_tpu.testing.data import (
+    create_lm_record_file,
+    model_zoo_dir,
+)
+from elasticdl_tpu.testing.in_process_master import InProcessMaster
+from elasticdl_tpu.worker.worker import Worker
+
+MODEL_DEF = "transformer.transformer_lm.custom_model"
+
+
+class WorkerKilled(RuntimeError):
+    pass
+
+
+def test_mesh_resize_resume(tmp_path):
+    train = create_lm_record_file(str(tmp_path / "t.rec"), 192,
+                                  seq_len=16, seed=1)
+    ckpt_dir = str(tmp_path / "ckpt")
+
+    # Phase 1: dp2 x sp2 x tp2 over 8 devices; dies after 3 tasks.
+    mesh8 = make_mesh((2, 2, 2), ("dp", "sp", "tp"),
+                      devices=jax.devices()[:8])
+    calls = {"n": 0}
+
+    def die_after_three(request):
+        calls["n"] += 1
+        if calls["n"] > 3:
+            raise WorkerKilled("simulated TPU-VM preemption")
+
+    cluster = MiniCluster(
+        model_zoo=model_zoo_dir(),
+        model_def=MODEL_DEF,
+        training_data=train,
+        minibatch_size=16,
+        num_minibatches_per_task=2,
+        checkpoint_dir=ckpt_dir,
+        checkpoint_steps=2,
+        mesh=mesh8,
+        worker_callbacks={"get_task": die_after_three},
+    )
+    with pytest.raises(WorkerKilled):
+        cluster.workers[0].run()
+    assert not cluster.finished
+    cluster.dispatcher.recover_tasks(0)
+
+    version = CheckpointSaver(ckpt_dir).get_valid_latest_version()
+    assert version is not None and version >= 2
+
+    # Phase 2: the "cluster shrank" — resume on a dp-only 4-device mesh.
+    # Fresh spec (a relaunched worker re-imports the module) + new mesh.
+    mesh4 = make_mesh((4,), ("dp",), devices=jax.devices()[:4])
+    spec4 = get_model_spec(model_zoo_dir(), MODEL_DEF)
+    spec4.model = spec4.make_model(mesh4)
+    runner4 = make_runner_for_spec(spec4, mesh4)
+    replacement = Worker(
+        worker_id=1,
+        master_client=InProcessMaster(cluster.servicer, worker_id=1),
+        model_spec=spec4,
+        data_reader=cluster.train_reader,
+        minibatch_size=16,
+        step_runner=runner4,
+        checkpoint_dir_for_init=ckpt_dir,
+    )
+    result = replacement.run()
+    assert cluster.finished
+    assert int(replacement.state.step) > version
+    assert np.isfinite(result["final_loss"])
+    # Params live under the NEW mesh: tp axis gone -> kernel replicated.
+    wi = replacement.state.params["block_0"]["mlp"]["wi"]["kernel"]
+    assert wi.sharding.mesh.shape == {"dp": 4}
+    assert wi.sharding.spec in (P(), P(None, None))
+
+
+def test_mesh_regrow_reshards_tp(tmp_path):
+    """Resume the other direction: dp-only checkpoint -> dp/tp mesh; the
+    restored kernels land tp-sharded under the new rules."""
+    train = create_lm_record_file(str(tmp_path / "t.rec"), 64,
+                                  seq_len=16, seed=2)
+    ckpt_dir = str(tmp_path / "ckpt")
+
+    mesh2 = make_mesh((2,), ("dp",), devices=jax.devices()[:2])
+    cluster = MiniCluster(
+        model_zoo=model_zoo_dir(),
+        model_def=MODEL_DEF,
+        training_data=train,
+        minibatch_size=16,
+        num_minibatches_per_task=2,
+        checkpoint_dir=ckpt_dir,
+        checkpoint_steps=2,
+        mesh=mesh2,
+    )
+    results = cluster.run()
+    assert cluster.finished
+    assert np.isfinite(results[0]["final_loss"])
+    version = CheckpointSaver(ckpt_dir).get_valid_latest_version()
+    assert version is not None
+
+    mesh8 = make_mesh((2, 2, 2), ("dp", "sp", "tp"),
+                      devices=jax.devices()[:8])
+    spec8 = get_model_spec(model_zoo_dir(), MODEL_DEF)
+    spec8.model = spec8.make_model(mesh8)
+    runner8 = make_runner_for_spec(spec8, mesh8)
+    state = runner8.init_state(
+        spec8.model, spec8.make_optimizer(),
+        cluster.workers[0].last_batch, seed=0,
+    )
+    from elasticdl_tpu.checkpoint import restore_from_dir
+
+    restored = restore_from_dir(state, ckpt_dir, required=True)
+    restored = runner8.place_state(restored)
+    assert int(restored.step) == version
+    wi = restored.params["block_0"]["mlp"]["wi"]["kernel"]
+    assert wi.sharding.spec == P(None, "tp")
+    # Values survived the round trip.
+    np.testing.assert_allclose(
+        np.asarray(wi),
+        np.asarray(
+            cluster.workers[0].state.params["block_0"]["mlp"]["wi"]
+            ["kernel"]
+        ),
+        rtol=1e-6, atol=1e-6,
+    )

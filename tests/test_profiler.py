@@ -1,0 +1,66 @@
+"""Profiler: step-window jax.profiler trace through the worker path."""
+
+import glob
+import os
+
+from elasticdl_tpu.testing.cluster import MiniCluster
+from elasticdl_tpu.testing.data import (
+    create_mnist_record_file,
+    model_zoo_dir,
+)
+from elasticdl_tpu.utils.profiler import Profiler, from_args
+
+
+def test_window_opens_and_closes(tmp_path):
+    prof = Profiler(str(tmp_path / "trace"), start_step=2, num_steps=2)
+    assert prof.enabled
+    prof.observe_step(1)
+    assert not prof._active
+    prof.observe_step(2)
+    assert prof._active
+    prof.observe_step(3)
+    assert prof._active
+    prof.observe_step(4)  # window [2, 4) closed
+    assert not prof._active and prof._done
+    # Idempotent / no restart after done.
+    prof.observe_step(5)
+    assert not prof._active
+    plugins = glob.glob(
+        str(tmp_path / "trace" / "plugins" / "profile" / "*")
+    )
+    assert plugins, "no profile trace written"
+
+
+def test_from_args_gate():
+    class Args:
+        profile_dir = ""
+
+    assert from_args(Args()) is None
+
+    class Args2:
+        profile_dir = "/tmp/x"
+        profile_start_step = 1
+        profile_steps = 3
+
+    prof = from_args(Args2())
+    assert prof.start_step == 1 and prof.num_steps == 3
+
+
+def test_worker_writes_trace(tmp_path):
+    train = create_mnist_record_file(str(tmp_path / "t.rec"), 96, seed=1)
+    trace_dir = str(tmp_path / "trace")
+    cluster = MiniCluster(
+        model_zoo=model_zoo_dir(),
+        model_def="mnist.mnist_functional.custom_model",
+        training_data=train,
+        minibatch_size=16,
+        num_epochs=1,
+    )
+    worker = cluster.workers[0]
+    worker._profiler = Profiler(trace_dir, start_step=2, num_steps=2)
+    worker.run()
+    assert cluster.finished
+    assert worker._profiler._done
+    assert glob.glob(
+        os.path.join(trace_dir, "plugins", "profile", "*")
+    ), "worker did not write a profile trace"
