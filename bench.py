@@ -1,9 +1,16 @@
 """Benchmark: examples/sec/chip on the MNIST CNN training step.
 
-Prints ONE JSON line {"metric","value","unit","vs_baseline"}. The reference
-publishes no numbers (BASELINE.md), so the regression floor is this repo's
-own first TPU run, recorded in BENCH_FLOOR.json; until that file exists
-vs_baseline is 1.0 and the floor is written on a TPU run.
+Measures the task-granular execution mode (core/step.build_multi_step):
+the framework's unit of work is a task of N minibatches (reference
+task_dispatcher records_per_task), and fusing those N optimizer steps
+into one XLA program via lax.scan removes N-1 host dispatches per task —
+the dominant cost for small models. Distinct batches are stacked on
+device; per-step losses remain observable.
+
+Prints ONE JSON line {"metric","value","unit","vs_baseline"}. The
+reference publishes no numbers (BASELINE.md), so the regression floor is
+this repo's own first TPU run, recorded in BENCH_FLOOR.json; until that
+file exists vs_baseline is 1.0 and the floor is written on a TPU run.
 """
 
 import json
@@ -13,8 +20,9 @@ import time
 import numpy as np
 
 BATCH = 512
-WARMUP_STEPS = 5
-MEASURE_STEPS = 30
+STEPS_PER_TASK = 16   # reference num_minibatches_per_task granularity
+WARMUP_TASKS = 2
+MEASURE_TASKS = 4
 FLOOR_FILE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                           "BENCH_FLOOR.json")
 
@@ -23,7 +31,7 @@ def main():
     import jax
 
     from elasticdl_tpu.core.model_spec import get_model_spec
-    from elasticdl_tpu.core.step import build_train_step
+    from elasticdl_tpu.core.step import build_multi_step, stack_batches
     from elasticdl_tpu.core.train_state import init_train_state
     from elasticdl_tpu.testing.data import model_zoo_dir
 
@@ -32,27 +40,50 @@ def main():
         model_zoo_dir(), "mnist.mnist_functional.custom_model"
     )
     rng = np.random.RandomState(0)
-    batch = {
-        "features": rng.rand(BATCH, 28, 28).astype(np.float32) * 255.0,
-        "labels": rng.randint(0, 10, BATCH).astype(np.int32),
-        "mask": np.ones((BATCH,), np.float32),
-    }
-    state = init_train_state(
-        spec.model, spec.make_optimizer(), batch, seed=0
-    )
-    step = build_train_step(spec.loss)
 
-    for _ in range(WARMUP_STEPS):
-        state, metrics = step(state, batch)
-    jax.block_until_ready(state.params)
+    def make_batch():
+        # Learnable label-correlated pixels (same scheme as
+        # testing.data.create_mnist_record_file) so the measured steps
+        # are healthy training, not divergence to inf/nan.
+        labels = rng.randint(0, 10, BATCH).astype(np.int32)
+        images = rng.rand(BATCH, 28 * 28).astype(np.float32) * 0.125
+        block = (28 * 28) // 10
+        for i, label in enumerate(labels):
+            images[i, label * block:(label + 1) * block] += 0.75
+        return {
+            "features": images.reshape(BATCH, 28, 28),
+            "labels": labels,
+            "mask": np.ones((BATCH,), np.float32),
+        }
+
+    task = jax.device_put(
+        stack_batches([make_batch() for _ in range(STEPS_PER_TASK)])
+    )
+    state = init_train_state(
+        spec.model, spec.make_optimizer(),
+        jax.tree.map(lambda x: x[0], task), seed=0,
+    )
+    multi_step = build_multi_step(spec.loss)
+
+    def sync(metrics):
+        # Host transfer of the last step's loss: a hard sync even where
+        # block_until_ready returns early (tunnel'd device backends).
+        return float(np.asarray(metrics["loss"][-1]))
+
+    for _ in range(WARMUP_TASKS):
+        state, metrics = multi_step(state, task)
+    sync(metrics)
 
     start = time.perf_counter()
-    for _ in range(MEASURE_STEPS):
-        state, metrics = step(state, batch)
-    jax.block_until_ready(state.params)
+    for _ in range(MEASURE_TASKS):
+        state, metrics = multi_step(state, task)
+    final_loss = sync(metrics)
     elapsed = time.perf_counter() - start
+    assert np.isfinite(final_loss), f"bench diverged: loss={final_loss}"
 
-    examples_per_sec = BATCH * MEASURE_STEPS / elapsed
+    examples_per_sec = (
+        BATCH * STEPS_PER_TASK * MEASURE_TASKS / elapsed
+    )
     vs_baseline = 1.0
     floor = None
     if os.path.exists(FLOOR_FILE):
