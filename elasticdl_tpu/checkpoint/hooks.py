@@ -68,6 +68,13 @@ class CheckpointHook:
     def enabled(self) -> bool:
         return self.saver is not None
 
+    def note_version(self, version: int):
+        """Seed the save baseline after a checkpoint restore, so the
+        interval-crossing rule doesn't count pre-restore steps and write
+        a spurious (non-multiple) checkpoint on the first step."""
+        if self._last_saved is None:
+            self._last_saved = int(version)
+
     def maybe_save(self, state) -> bool:
         if (
             self.saver is None
@@ -76,7 +83,16 @@ class CheckpointHook:
         ):
             return False
         version = int(state.step)
-        if version == 0 or version % self.checkpoint_steps != 0:
+        if version == 0 or version == self._last_saved:
+            return False
+        # Save on exact multiples (per-step callers) or whenever the
+        # interval was crossed since the last save — fused task execution
+        # advances the version several steps per call and may never land
+        # exactly on a multiple.
+        crossed = (
+            version - (self._last_saved or 0) >= self.checkpoint_steps
+        )
+        if version % self.checkpoint_steps != 0 and not crossed:
             return False
         self._save(version, state)
         return True

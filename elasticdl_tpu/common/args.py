@@ -165,6 +165,9 @@ def add_train_params(parser):
                         help=">1 enables SSP-style local updates between syncs")
     parser.add_argument("--random_seed", type=non_neg_int, default=0)
     parser.add_argument("--max_steps", type=non_neg_int, default=0)
+    add_bool_param(parser, "--fuse_task_steps", False,
+                   "Scan a whole task's minibatches in one XLA program "
+                   "(removes per-step host dispatch)")
     parser.add_argument("--profile_dir", default="",
                         help="Write a jax.profiler trace (TensorBoard/"
                              "Perfetto) for a step window")

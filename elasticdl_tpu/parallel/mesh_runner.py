@@ -318,6 +318,57 @@ class MeshRunner:
 
         return wrapped
 
+    def train_multi_step(self, loss_fn: Callable) -> Callable:
+        """Fused task-granular step: scan a whole task's minibatches
+        (stacked with a leading T dim) through one compiled SPMD
+        program (core/step.build_multi_step, mesh edition). Only the
+        plain (accum_steps == 1) path fuses — accumulation already
+        carries cross-call state."""
+        shardings = self._require_shardings()
+        runner = self
+
+        def multi_step(state, batches):
+            def body(state, batch):
+                return step_lib._train_step_body(loss_fn, state, batch)
+
+            return jax.lax.scan(body, state, batches)
+
+        jitted = jax.jit(
+            multi_step,
+            in_shardings=(shardings, None),
+            out_shardings=(shardings, None),
+            donate_argnums=(0,) if self._donate_state else (),
+        )
+
+        def wrapped(state, batches):
+            return jitted(state, runner.place_task(batches))
+
+        return wrapped
+
+    def place_task(self, batches):
+        """Place a stacked task ({k: (T, B, ...)}) on the mesh: per-leaf
+        batch specs shift right one dim for the leading T."""
+        mesh = self.mesh
+
+        def sharding(path, leaf):
+            if self.batch_rule is not None:
+                # The rule sees the per-batch view (leading T stripped)
+                # so its ndim/shape dispatch matches the unstacked case.
+                spec = self.batch_rule(path, leaf[0])
+                spec = rules_lib.fit_spec(
+                    P(None, *tuple(spec)), leaf, mesh
+                )
+            else:
+                spec = rules_lib.fit_spec(
+                    P(None, self.data_axis), leaf, mesh
+                )
+            return NamedSharding(mesh, spec)
+
+        return jax.device_put(
+            batches,
+            jax.tree_util.tree_map_with_path(sharding, batches),
+        )
+
     def eval_step(self) -> Callable:
         shardings = self._require_shardings()
         runner = self

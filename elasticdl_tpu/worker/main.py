@@ -110,6 +110,7 @@ def build_worker(args, master_client=None) -> Worker:
         timing=Timing(args.log_level.upper() == "DEBUG"),
         checkpoint_hook=checkpoint_hook,
         profiler=profiler_from_args(args),
+        fuse_task_steps=getattr(args, "fuse_task_steps", False),
         **resolve_init_checkpoint(args),
     )
 

@@ -63,6 +63,7 @@ class Worker:
         checkpoint_dir_for_init: str = "",
         checkpoint_init_required: bool = True,
         profiler=None,
+        fuse_task_steps: bool = False,
     ):
         self._id = worker_id
         self._master = master_client
@@ -96,6 +97,12 @@ class Worker:
         self._checkpoint_dir_for_init = checkpoint_dir_for_init
         # jax.profiler step-window trace (utils/profiler.py); None = off.
         self._profiler = profiler
+        # Fused task execution: scan all of a task's minibatches in one
+        # XLA program (core/step.build_multi_step) — removes the per-step
+        # host dispatch, the dominant cost for small models. Version
+        # reporting/checkpointing then happen at task granularity.
+        self._fuse_task_steps = fuse_task_steps
+        self._multi_step = None
         self._checkpoint_init_required = checkpoint_init_required
 
     # ---- state init ----------------------------------------------------
@@ -114,9 +121,19 @@ class Worker:
             )
             self._train_step = self._step_runner.train_step(self._spec.loss)
             self._eval_step = self._step_runner.eval_step()
+            if self._fuse_task_steps and getattr(
+                self._step_runner, "accum_steps", 1
+            ) == 1:
+                self._multi_step = self._step_runner.train_multi_step(
+                    self._spec.loss
+                )
         else:
             self.state = init_train_state(self._spec.model, tx, batch)
             self._train_step = build_train_step(self._spec.loss)
+            if self._fuse_task_steps:
+                from elasticdl_tpu.core.step import build_multi_step
+
+                self._multi_step = build_multi_step(self._spec.loss)
         if self._checkpoint_dir_for_init:
             from elasticdl_tpu.checkpoint import restore_from_dir
 
@@ -130,6 +147,10 @@ class Worker:
                 self._step_runner, "place_state"
             ):
                 self.state = self._step_runner.place_state(self.state)
+            # The restored version is the save baseline — without this,
+            # interval-crossing counts pre-restore steps and writes a
+            # spurious checkpoint on the first post-restore step.
+            self._checkpoint.note_version(int(self.state.step))
 
     def set_state(self, state):
         """Install restored state (checkpoint resume / elastic re-init)."""
@@ -156,6 +177,14 @@ class Worker:
         )
 
     def _process_train_task(self, task, batches) -> int:
+        if self._fuse_task_steps:
+            batch_list = list(batches)
+            if not batch_list:
+                return 0
+            self._maybe_init(batch_list[0])
+            if self._multi_step is not None and len(batch_list) > 1:
+                return self._process_train_task_fused(batch_list)
+            batches = iter(batch_list)
         count = 0
         for batch in batches:
             self._maybe_init(batch)
@@ -178,6 +207,47 @@ class Worker:
             with self._timing.record("checkpoint"):
                 self._checkpoint.maybe_save(self.state)
         return count
+
+    def _process_train_task_fused(self, batch_list) -> int:
+        """One compiled scan over the task's minibatches; version
+        reporting and checkpointing at task granularity."""
+        from elasticdl_tpu.core.step import stack_batches
+
+        self.last_batch = batch_list[-1]
+        if self._profiler is not None:
+            self._profiler.observe_step(int(self.state.step))
+        stacked = stack_batches(batch_list)
+        with self._timing.record("batch_process"):
+            for attempt in range(MAX_MINIBATCH_RETRY_NUM):
+                try:
+                    self.state, metrics = self._multi_step(
+                        self.state, stacked
+                    )
+                    break
+                except jax.errors.JaxRuntimeError:
+                    logger.warning(
+                        "fused task step failed (attempt %d):\n%s",
+                        attempt + 1, traceback.format_exc(),
+                    )
+            else:
+                raise RuntimeError(
+                    f"Fused task failed after "
+                    f"{MAX_MINIBATCH_RETRY_NUM} retries"
+                )
+        self.last_metrics = {"loss": metrics["loss"][-1]}
+        version = int(self.state.step)
+        # Same SSP gating as the per-step path, at task granularity:
+        # report iff a version_report_steps boundary was crossed.
+        prev = version - len(batch_list)
+        if (
+            version // self._version_report_steps
+            > prev // self._version_report_steps
+        ):
+            with self._timing.record("report_version"):
+                self._master.report_version(version)
+        with self._timing.record("checkpoint"):
+            self._checkpoint.maybe_save(self.state)
+        return len(batch_list)
 
     def _process_eval_task(self, task, batches):
         outputs_acc, labels_acc = [], []
