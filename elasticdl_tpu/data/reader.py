@@ -69,7 +69,35 @@ class RecordFileDataReader(AbstractDataReader):
         super().__init__(**kwargs)
         self._data_origin = data_origin
 
+    # Below this mean record size the native mmap reader wins (~5x: the
+    # per-record Python interpreter overhead dominates); above it, the
+    # buffered sequential scanner is already memcpy-bound and mmap page
+    # faults make the native path slightly slower. Measured on this
+    # image at 60B (4.8x faster) vs 3.3KB (0.88x).
+    NATIVE_READ_MAX_MEAN_RECORD_BYTES = 1024
+
     def read_records(self, task) -> Iterator[bytes]:
+        # Hot loop: the C extension reads the whole task range through
+        # one mmap pass, building list[bytes] in C
+        # (native/record_codec.py), when record granularity favors it.
+        from elasticdl_tpu.native.record_codec import (
+            native_record_reader_available,
+            read_range,
+        )
+
+        if native_record_reader_available():
+            total = num_records_in_file(task.shard_name)
+            mean = os.path.getsize(task.shard_name) / max(total, 1)
+            if mean <= self.NATIVE_READ_MAX_MEAN_RECORD_BYTES:
+                # Clamp like RecordFileScanner does (a shard table built
+                # before a file was rewritten shorter must not fail the
+                # task on one path and succeed on the other).
+                start = min(max(task.start, 0), total)
+                end = min(task.end, total)
+                yield from read_range(
+                    task.shard_name, start, max(end - start, 0)
+                )
+                return
         with RecordFileScanner(
             task.shard_name, task.start, task.end - task.start
         ) as scanner:

@@ -1,0 +1,134 @@
+"""C++ RecordFile scanner == Python scanner, and the reader hot path."""
+
+import os
+
+import numpy as np
+import pytest
+
+from elasticdl_tpu.common import tensor_utils
+from elasticdl_tpu.common.task import Task
+from elasticdl_tpu.data.reader import RecordFileDataReader
+from elasticdl_tpu.data.record_file import (
+    RecordFileScanner,
+    RecordFileWriter,
+)
+from elasticdl_tpu.native.record_codec import (
+    native_record_reader_available,
+    num_records,
+    read_range,
+)
+
+needs_native = pytest.mark.skipif(
+    not native_record_reader_available(),
+    reason="native record codec unavailable (no g++?)",
+)
+
+
+@pytest.fixture()
+def record_file(tmp_path):
+    path = str(tmp_path / "data.rec")
+    rng = np.random.RandomState(0)
+    payloads = [
+        tensor_utils.dumps({"x": rng.randn(rng.randint(1, 8)).tolist(),
+                            "i": i})
+        for i in range(50)
+    ]
+    with RecordFileWriter(path) as writer:
+        for p in payloads:
+            writer.write(p)
+    return path, payloads
+
+
+@needs_native
+def test_matches_python_scanner(record_file):
+    path, payloads = record_file
+    assert num_records(path) == 50
+    got = read_range(path, 7, 20)
+    with RecordFileScanner(path, 7, 20) as scanner:
+        want = list(scanner)
+    assert got == want == payloads[7:27]
+
+
+@needs_native
+def test_full_and_empty_ranges(record_file):
+    path, payloads = record_file
+    assert read_range(path, 0, 50) == payloads
+    assert read_range(path, 10, 0) == []
+
+
+@needs_native
+def test_out_of_bounds_raises(record_file):
+    path, _ = record_file
+    with pytest.raises(ValueError, match="out of bounds"):
+        read_range(path, 40, 20)
+
+
+@needs_native
+def test_invalid_file_raises(tmp_path):
+    bad = str(tmp_path / "bad.rec")
+    with open(bad, "wb") as f:
+        f.write(b"not a record file, definitely" * 3)
+    with pytest.raises(ValueError, match="not a valid RecordFile"):
+        read_range(bad, 0, 1)
+
+
+@needs_native
+def test_reader_uses_native_path(record_file):
+    path, payloads = record_file
+    reader = RecordFileDataReader(path)
+    task = Task(shard_name=path, start=5, end=15)
+    assert list(reader.read_records(task)) == payloads[5:15]
+
+
+def test_reader_python_fallback(record_file, monkeypatch):
+    """With the extension cache forced empty the reader really goes
+    through RecordFileScanner."""
+    path, payloads = record_file
+    import elasticdl_tpu.native as native_mod
+
+    monkeypatch.setattr(native_mod, "_ext", None)
+    monkeypatch.setattr(native_mod, "_ext_load_attempted", True)
+    from elasticdl_tpu.native.record_codec import (
+        native_record_reader_available,
+    )
+
+    assert not native_record_reader_available()
+    reader = RecordFileDataReader(path)
+    task = Task(shard_name=path, start=5, end=15)
+    assert list(reader.read_records(task)) == payloads[5:15]
+
+
+@needs_native
+def test_reader_native_clamps_like_scanner(record_file):
+    """Over-long task ranges clamp on the native path too."""
+    path, payloads = record_file
+    reader = RecordFileDataReader(path)
+    task = Task(shard_name=path, start=40, end=70)
+    assert list(reader.read_records(task)) == payloads[40:50]
+
+
+@needs_native
+def test_remat_transformer_with_dropout():
+    """remat + dropout: training must be static under nn.remat."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from elasticdl_tpu.models.transformer import (
+        TransformerConfig,
+        TransformerLM,
+    )
+
+    cfg = TransformerConfig(
+        vocab_size=16, d_model=16, n_heads=2, n_layers=1, d_ff=32,
+        max_len=16, dropout_rate=0.1, remat=True,
+        compute_dtype=jnp.float32,
+    )
+    model = TransformerLM(cfg)
+    tokens = np.zeros((2, 8), np.int32)
+    rng = jax.random.PRNGKey(0)
+    variables = model.init({"params": rng, "dropout": rng}, tokens,
+                           training=True)
+    out = model.apply(variables, tokens, training=True,
+                      rngs={"dropout": rng})
+    assert out.shape == (2, 8, 16)

@@ -176,6 +176,26 @@ def test_mesh_wiring_end_to_end(tmp_path):
     assert wi.sharding.spec == P(None, "tp")
 
 
+def test_remat_matches_plain():
+    """remat=True changes memory, not math: same loss trajectory."""
+    import dataclasses
+
+    batch = _batch()
+    losses = {}
+    for remat in (False, True):
+        cfg = dataclasses.replace(CFG, remat=remat)
+        model = TransformerLM(cfg)
+        state = init_train_state(model, optax.adam(1e-2), batch, seed=0)
+        step = build_train_step(_lm_loss())
+        run = []
+        for i in range(3):
+            state, m = step(state, _batch(seed=i))
+            run.append(float(m["loss"]))
+        losses[remat] = run
+    np.testing.assert_allclose(losses[True], losses[False],
+                               rtol=1e-5, atol=1e-6)
+
+
 def test_training_learns_on_dp_sp_tp():
     """Loss drops markedly on the deterministic +1-chain task."""
     mesh = make_mesh((2, 2, 2), ("dp", "sp", "tp"),
