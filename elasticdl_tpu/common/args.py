@@ -165,6 +165,16 @@ def add_train_params(parser):
                         help=">1 enables SSP-style local updates between syncs")
     parser.add_argument("--random_seed", type=non_neg_int, default=0)
     parser.add_argument("--max_steps", type=non_neg_int, default=0)
+    parser.add_argument("--num_jax_processes", type=pos_int, default=1,
+                        help=">1 wires jax.distributed across worker "
+                             "processes (multi-host mesh over DCN)")
+    parser.add_argument("--coordinator_addr", default="",
+                        help="jax.distributed coordinator host:port "
+                             "(required when num_jax_processes > 1)")
+    parser.add_argument("--jax_process_id", type=int, default=-1,
+                        help="Stable process id for jax.distributed; "
+                             "-1 = use worker_id. Elastic relaunches "
+                             "must reuse the dead worker's id")
     add_bool_param(parser, "--fuse_task_steps", False,
                    "Scan a whole task's minibatches in one XLA program "
                    "(removes per-step host dispatch)")

@@ -153,10 +153,14 @@ class MeshRunner:
     def place_batch(self, batch):
         """Shard a host batch onto the mesh (leading dim over dp by
         default; per-leaf ``batch_rule`` when set, e.g. tokens over
-        dp×sp for sequence-parallel models)."""
-        if self.batch_rule is not None:
-            return jax.device_put(batch, self._shard_batch_tree(batch))
-        return jax.device_put(batch, self._batch_sharding())
+        dp×sp for sequence-parallel models). Multi-host: this process's
+        batch becomes its process-local shard of the global batch
+        (parallel/multihost.py)."""
+        from elasticdl_tpu.parallel import multihost
+
+        return multihost.make_global_batch(
+            batch, self.mesh, self._shard_batch_tree(batch)
+        )
 
     def place_state(self, state):
         """Re-place a (host-restored) state onto the mesh shardings.

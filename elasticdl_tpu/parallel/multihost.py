@@ -1,0 +1,173 @@
+"""Multi-host execution: ICI + DCN meshes across TPU-VM worker processes.
+
+The reference scales across pods with gRPC parameter servers and an
+FTLib/NCCL collective backend (SURVEY.md §2.7). The TPU-native shape of
+that capability:
+
+- ``jax.distributed.initialize(coordinator, num_processes, process_id)``
+  wires worker processes over DCN; afterwards ``jax.devices()`` spans
+  every host and one ``Mesh`` lays out the whole pod slice. XLA routes
+  collectives over ICI within a slice and DCN across slices.
+- The master already assigns stable worker ids and fixed k8s service
+  names (reference ``k8s_client.py:19-22``); worker 0's service is the
+  coordinator, the worker id is the process id.
+- **Data plane:** each worker keeps pulling its own tasks from the
+  master (dynamic sharding untouched). Under SPMD every process must
+  execute the same program on one global batch — so each worker's
+  padded task batch becomes its *process-local shard* of the global
+  batch (``jax.make_array_from_process_local_data``), the dp axis
+  spanning processes. Dynamic sharding and mesh data-parallelism
+  compose instead of conflicting.
+
+Single-process (the common case, and every CI/test environment) is a
+strict no-op: helpers detect ``process_count() == 1`` and fall through
+to plain device_put. Real multi-host runs require TPU pod hardware this
+environment does not have; the logic here is exercised single-process
+and the wiring is driven entirely by flags the master already passes.
+"""
+
+from typing import Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from elasticdl_tpu.common.log_utils import get_logger
+
+logger = get_logger("multihost")
+
+_initialized = False
+
+
+def initialize_multihost(
+    coordinator_address: str,
+    num_processes: int,
+    process_id: int,
+    local_device_ids=None,
+) -> bool:
+    """Wire this process into the jax.distributed mesh. No-op (returns
+    False) for single-process jobs. Idempotent."""
+    global _initialized
+    if num_processes <= 1:
+        return False
+    if _initialized:
+        return True
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=local_device_ids,
+    )
+    _initialized = True
+    logger.info(
+        "jax.distributed initialized: process %d/%d via %s; %d global "
+        "devices", process_id, num_processes, coordinator_address,
+        len(jax.devices()),
+    )
+    return True
+
+
+def coordinator_from_args(args) -> str:
+    """The coordinator address. Multi-host requires an explicit
+    ``--coordinator_addr`` (a resolvable host:port for process 0 — e.g.
+    a headless k8s Service the operator provisions); guessing a pod DNS
+    name that may not exist would hang ``jax.distributed.initialize``
+    on every worker."""
+    explicit = getattr(args, "coordinator_addr", "")
+    if explicit:
+        return explicit
+    if getattr(args, "num_jax_processes", 1) > 1:
+        raise ValueError(
+            "--coordinator_addr is required when --num_jax_processes > 1"
+        )
+    return ""
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def make_global_batch(batch, mesh: Mesh, shardings):
+    """Assemble per-process local batches into global arrays.
+
+    ``shardings`` is the pytree of NamedShardings the batch should carry
+    (from MeshRunner's batch rules). With one process this is exactly
+    ``device_put``; with N processes each local leaf becomes this
+    process's shard along the process-spanning axis and the global shape
+    is inferred (local batch × N along dp).
+    """
+    if jax.process_count() <= 1:
+        return jax.device_put(batch, shardings)
+    return jax.tree.map(
+        lambda leaf, sharding: jax.make_array_from_process_local_data(
+            sharding, leaf
+        ),
+        batch,
+        shardings,
+    )
+
+
+def global_batch_size(local_batch_size: int) -> int:
+    return local_batch_size * jax.process_count()
+
+
+def host_local_slice(global_array) -> Optional["jax.Array"]:
+    """This process's rows of a **leading-dim sharded** array (e.g.
+    per-example prediction outputs): addressable shards deduped by
+    index, ordered by their leading-dim start. Replicated arrays return
+    one copy; arrays sharded over non-leading dims are unsupported."""
+    import numpy as np
+
+    seen = {}
+    for s in global_array.addressable_shards:
+        idx = s.index
+        for dim_slice in idx[1:]:
+            if dim_slice != slice(None):
+                raise ValueError(
+                    "host_local_slice supports leading-dim sharding "
+                    f"only; got shard index {idx}"
+                )
+        key = (idx[0].start if idx and idx[0].start is not None else 0)
+        if key not in seen:
+            seen[key] = np.asarray(s.data)
+    if not seen:
+        return None
+    return np.concatenate(
+        [seen[k] for k in sorted(seen)], axis=0
+    )
+
+
+def exchange_continue(mesh: Mesh, data_axis: str, local_flag: bool) -> bool:
+    """Global any() over per-process flags — the step-count barrier for
+    dynamic sharding under SPMD. Every process must call this the same
+    number of times; True means at least one process still has a real
+    batch this step (others feed zero-mask dummies). Single-process:
+    returns the flag untouched, no device work."""
+    if jax.process_count() <= 1:
+        return bool(local_flag)
+    import numpy as np
+
+    spec = P(mesh.axis_names)  # all axes over the flat flag vector
+    sharding = NamedSharding(mesh, spec)
+    local = np.full(
+        (len(mesh.local_devices),), 1.0 if local_flag else 0.0,
+        np.float32,
+    )
+    arr = jax.make_array_from_process_local_data(sharding, local)
+    import jax.numpy as jnp
+
+    return bool(jnp.max(arr) > 0.0)
+
+
+def zero_mask_like(batch):
+    """A dummy batch participating in collectives with zero loss weight:
+    zeros everywhere, mask strictly 0."""
+    import numpy as np
+
+    return {
+        key: (np.zeros_like(np.asarray(value)))
+        for key, value in batch.items()
+    }

@@ -28,6 +28,28 @@ logger = get_logger("worker_main")
 
 def build_worker(args, master_client=None) -> Worker:
     """Assemble a Worker from parsed args (shared with tests)."""
+    # Multi-host: wire jax.distributed BEFORE anything can touch the JAX
+    # backend — including the user's model-zoo module imported below,
+    # which may build arrays at import time. The process id must be
+    # stable across elastic relaunches (--jax_process_id; membership
+    # changes restart the whole multi-host job from checkpoint).
+    num_procs = getattr(args, "num_jax_processes", 1)
+    if num_procs > 1:
+        from elasticdl_tpu.parallel import multihost
+
+        process_id = getattr(args, "jax_process_id", -1)
+        if process_id < 0:
+            process_id = args.worker_id
+        if process_id >= num_procs:
+            raise ValueError(
+                f"jax process id {process_id} out of range for "
+                f"{num_procs} processes — elastic relaunches of a "
+                "multi-host job must reuse the dead worker's process "
+                "id (pass --jax_process_id)"
+            )
+        multihost.initialize_multihost(
+            multihost.coordinator_from_args(args), num_procs, process_id
+        )
     spec = get_model_spec(
         model_zoo=args.model_zoo,
         model_def=args.model_def,

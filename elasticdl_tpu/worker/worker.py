@@ -103,6 +103,16 @@ class Worker:
         # reporting/checkpointing then happen at task granularity.
         self._fuse_task_steps = fuse_task_steps
         self._multi_step = None
+        # Multi-host SPMD + dynamic sharding need a step-count barrier:
+        # every process runs the SAME number of compiled steps (the
+        # gradient reduction spans processes), but each pulls its own
+        # tasks from the master. Protocol: one exchange_continue() per
+        # step; a process without a real batch feeds a zero-mask dummy
+        # until ALL processes report drained. Training-only jobs for
+        # now (mid-training eval tasks would need the same treatment);
+        # retries and fusion are disabled under sync (a failed collective
+        # step means restart-from-checkpoint, not local retry).
+        self._multihost_sync = False
         self._checkpoint_init_required = checkpoint_init_required
 
     # ---- state init ----------------------------------------------------
@@ -116,6 +126,18 @@ class Worker:
             self._spec.make_optimizer(), self._callbacks
         )
         if self._step_runner is not None:
+            import jax as _jax
+
+            self._multihost_sync = (
+                _jax.process_count() > 1
+                and hasattr(self._step_runner, "mesh")
+            )
+            if self._multihost_sync and self._fuse_task_steps:
+                logger.warning(
+                    "fuse_task_steps disabled under multi-host sync "
+                    "(unequal task sizes would desync step counts)"
+                )
+                self._fuse_task_steps = False
             self.state = self._step_runner.init_state(
                 self._spec.model, tx, batch
             )
@@ -159,6 +181,19 @@ class Worker:
     # ---- task processing ----------------------------------------------
 
     def _process_train_batch(self, batch):
+        if self._multihost_sync:
+            # One barrier exchange per step; we have a real batch, and a
+            # failed collective step is fatal (restart-from-checkpoint),
+            # so no local retry loop either.
+            from elasticdl_tpu.parallel import multihost
+
+            multihost.exchange_continue(
+                self._step_runner.mesh, self._step_runner.data_axis,
+                True,
+            )
+            self.state, metrics = self._train_step(self.state, batch)
+            self.last_metrics = metrics
+            return
         for attempt in range(MAX_MINIBATCH_RETRY_NUM):
             try:
                 self.state, metrics = self._train_step(self.state, batch)
@@ -249,6 +284,22 @@ class Worker:
             self._checkpoint.maybe_save(self.state)
         return len(batch_list)
 
+    def _drain_multihost(self):
+        """Drain barrier: keep feeding zero-mask dummy steps until every
+        process reports no more real batches, so no process is left
+        blocking in a cross-host gradient reduction."""
+        if not self._multihost_sync or self.state is None:
+            return
+        if self.last_batch is None:
+            return
+        from elasticdl_tpu.parallel import multihost
+
+        dummy = multihost.zero_mask_like(self.last_batch)
+        while multihost.exchange_continue(
+            self._step_runner.mesh, self._step_runner.data_axis, False
+        ):
+            self.state, _ = self._train_step(self.state, dummy)
+
     def _process_eval_task(self, task, batches):
         outputs_acc, labels_acc = [], []
         for batch in batches:
@@ -326,6 +377,7 @@ class Worker:
                     task.task_id,
                     err_reason=f"{type(exc).__name__}: {exc}",
                 )
+        self._drain_multihost()
         if self.state is not None and trained_batches:
             self._checkpoint.save_final(self.state)
         self._timing.report_timing()

@@ -1,0 +1,111 @@
+"""Multi-host helpers: single-process no-op semantics, batch assembly,
+coordinator derivation. (Real multi-host needs pod hardware; these pin
+the single-process contract every environment exercises.)"""
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from elasticdl_tpu.parallel import multihost
+from elasticdl_tpu.parallel.mesh import make_mesh
+from elasticdl_tpu.parallel.mesh_runner import MeshRunner
+
+
+def test_initialize_noop_single_process():
+    assert not multihost.initialize_multihost("ignored:1234", 1, 0)
+    assert multihost.process_count() == 1
+    assert multihost.process_index() == 0
+
+
+def test_coordinator_from_args():
+    import pytest
+
+    class Single:
+        coordinator_addr = ""
+        num_jax_processes = 1
+
+    assert multihost.coordinator_from_args(Single()) == ""
+
+    class Explicit:
+        coordinator_addr = "10.0.0.5:4444"
+
+    assert multihost.coordinator_from_args(Explicit()) == "10.0.0.5:4444"
+
+    class MultiNoAddr:
+        coordinator_addr = ""
+        num_jax_processes = 4
+
+    with pytest.raises(ValueError, match="coordinator_addr"):
+        multihost.coordinator_from_args(MultiNoAddr())
+
+
+def test_exchange_continue_single_process():
+    mesh = make_mesh((4,), ("dp",), devices=jax.devices()[:4])
+    assert multihost.exchange_continue(mesh, "dp", True) is True
+    assert multihost.exchange_continue(mesh, "dp", False) is False
+
+
+def test_zero_mask_like():
+    batch = {
+        "features": np.ones((4, 3), np.float32),
+        "labels": np.ones((4,), np.int32),
+        "mask": np.ones((4,), np.float32),
+    }
+    dummy = multihost.zero_mask_like(batch)
+    assert dummy["mask"].sum() == 0
+    assert dummy["features"].shape == (4, 3)
+    assert dummy["labels"].dtype == np.int32
+
+
+def test_host_local_slice_dedups_replicated():
+    mesh = make_mesh((4,), ("dp",), devices=jax.devices()[:4])
+    arr = jax.device_put(
+        np.arange(6, dtype=np.float32).reshape(3, 2),
+        NamedSharding(mesh, P()),  # replicated: 4 identical shards
+    )
+    local = multihost.host_local_slice(arr)
+    np.testing.assert_array_equal(local, np.asarray(arr))
+
+
+def test_make_global_batch_single_process():
+    mesh = make_mesh((4,), ("dp",), devices=jax.devices()[:4])
+    batch = {
+        "features": np.arange(32, dtype=np.float32).reshape(8, 4),
+        "mask": np.ones((8,), np.float32),
+    }
+    shardings = jax.tree.map(
+        lambda _: NamedSharding(mesh, P("dp")), batch
+    )
+    placed = multihost.make_global_batch(batch, mesh, shardings)
+    assert placed["features"].sharding.spec == P("dp")
+    np.testing.assert_array_equal(
+        np.asarray(placed["features"]), batch["features"]
+    )
+    assert multihost.global_batch_size(8) == 8
+
+
+def test_host_local_slice_roundtrip():
+    mesh = make_mesh((4,), ("dp",), devices=jax.devices()[:4])
+    arr = jax.device_put(
+        np.arange(16, dtype=np.float32).reshape(8, 2),
+        NamedSharding(mesh, P("dp")),
+    )
+    local = multihost.host_local_slice(arr)
+    np.testing.assert_array_equal(local, np.asarray(arr))
+
+
+def test_mesh_runner_place_batch_goes_through_multihost():
+    """place_batch routes through make_global_batch on both rule and
+    default paths (single-process: values + shardings unchanged)."""
+    mesh = make_mesh((8,), ("dp",), devices=jax.devices()[:8])
+    runner = MeshRunner(mesh=mesh)
+    batch = {
+        "features": np.random.rand(16, 4).astype(np.float32),
+        "labels": np.zeros((16,), np.int32),
+        "mask": np.ones((16,), np.float32),
+    }
+    placed = runner.place_batch(batch)
+    assert placed["features"].sharding.spec == P("dp")
+    np.testing.assert_array_equal(
+        np.asarray(placed["features"]), batch["features"]
+    )

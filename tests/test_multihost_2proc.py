@@ -1,0 +1,113 @@
+"""Real two-process multi-host run on CPU: jax.distributed + cross-
+process gradient reductions + the drain barrier for uneven task counts.
+
+Each subprocess gets 2 virtual CPU devices; the mesh spans both
+processes (4 global devices). Process 0 runs 3 real steps, process 1
+only 1 — without the barrier, process 0's later collectives would hang
+forever; with it, process 1 contributes zero-mask dummy steps and both
+finish at version 3.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_SCRIPT = textwrap.dedent("""
+    import os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    pid = int(sys.argv[1]); port = sys.argv[2]
+    jax.distributed.initialize(f"localhost:{port}", 2, pid)
+    sys.path.insert(0, "@REPO@")
+    import numpy as np, optax, flax.linen as nn
+    from elasticdl_tpu.parallel import multihost
+    from elasticdl_tpu.parallel.mesh import make_mesh
+    from elasticdl_tpu.parallel.mesh_runner import MeshRunner
+
+    assert jax.process_count() == 2
+    mesh = make_mesh((len(jax.devices()),), ("dp",))
+    runner = MeshRunner(mesh=mesh, donate_state=False)
+
+    class Lin(nn.Module):
+        @nn.compact
+        def __call__(self, x, training=False):
+            return nn.Dense(2)(x)
+
+    def loss(labels, preds, mask):
+        import jax.numpy as jnp
+        err = ((preds - labels) ** 2).sum(-1)
+        return (err * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
+    rng = np.random.RandomState(pid)
+    def local_batch():
+        return {"features": rng.rand(4, 3).astype(np.float32),
+                 "labels": rng.rand(4, 2).astype(np.float32),
+                 "mask": np.ones((4,), np.float32)}
+
+    state = runner.init_state(Lin(), optax.sgd(0.1), local_batch(),
+                              seed=0)
+    step = runner.train_step(loss)
+    n_real = 3 if pid == 0 else 1
+    batch = None
+    for _ in range(n_real):
+        batch = local_batch()
+        multihost.exchange_continue(mesh, "dp", True)
+        state, m = step(state, batch)
+    drains = 0
+    dummy = multihost.zero_mask_like(batch)
+    while multihost.exchange_continue(mesh, "dp", False):
+        state, _ = step(state, dummy)
+        drains += 1
+    print(f"RESULT pid={pid} steps={int(state.step)} "
+          f"drains={drains}", flush=True)
+""").replace("@REPO@", REPO)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.slow
+def test_two_process_drain_barrier(tmp_path):
+    script = tmp_path / "proc.py"
+    script.write_text(_SCRIPT)
+    port = str(_free_port())
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(pid), port],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, env=env, cwd=str(tmp_path),
+        )
+        for pid in (0, 1)
+    ]
+    outputs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("multi-host subprocess hung (barrier broken?)")
+        outputs.append(out)
+    for pid, out in enumerate(outputs):
+        assert procs[pid].returncode == 0, out
+    results = sorted(
+        line for out in outputs for line in out.splitlines()
+        if line.startswith("RESULT")
+    )
+    assert results == [
+        "RESULT pid=0 steps=3 drains=0",
+        "RESULT pid=1 steps=3 drains=2",
+    ], results
