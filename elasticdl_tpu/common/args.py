@@ -175,6 +175,9 @@ def add_train_params(parser):
                         help="Stable process id for jax.distributed; "
                              "-1 = use worker_id. Elastic relaunches "
                              "must reuse the dead worker's id")
+    parser.add_argument("--prefetch_depth", type=non_neg_int, default=2,
+                        help="Background batch-decode queue depth "
+                             "(0 disables prefetching)")
     add_bool_param(parser, "--fuse_task_steps", False,
                    "Scan a whole task's minibatches in one XLA program "
                    "(removes per-step host dispatch)")

@@ -133,6 +133,7 @@ def build_worker(args, master_client=None) -> Worker:
         checkpoint_hook=checkpoint_hook,
         profiler=profiler_from_args(args),
         fuse_task_steps=getattr(args, "fuse_task_steps", False),
+        prefetch_depth=getattr(args, "prefetch_depth", 2),
         **resolve_init_checkpoint(args),
     )
 

@@ -13,12 +13,14 @@ gain is that a preempted worker never half-consumes a task (simpler
 elastic re-queue semantics, no pending-task bookkeeping).
 """
 
+import contextlib
 import time
 from typing import Iterator, Optional, Tuple
 
 from elasticdl_tpu.common.constants import Mode, TaskType
 from elasticdl_tpu.common.log_utils import get_logger
 from elasticdl_tpu.data.batcher import batch_records
+from elasticdl_tpu.data.prefetch import prefetch
 
 logger = get_logger("task_data_service")
 
@@ -31,12 +33,16 @@ _TASK_TYPE_TO_MODE = {
 
 class TaskDataService:
     def __init__(self, master_client, data_reader, dataset_fn,
-                 minibatch_size: int, wait_sleep_secs: float = 2.0):
+                 minibatch_size: int, wait_sleep_secs: float = 2.0,
+                 prefetch_depth: int = 2):
         self._master = master_client
         self._reader = data_reader
         self._dataset_fn = dataset_fn
         self._minibatch_size = minibatch_size
         self._wait_sleep_secs = wait_sleep_secs
+        # Background decode of batch N+1 while the device runs step N
+        # (reference tf.data .prefetch(1), worker.py:1022-1027); 0 = off.
+        self._prefetch_depth = prefetch_depth
 
     def task_stream(self) -> Iterator[Tuple[object, Optional[Iterator]]]:
         """Yield ``(task, batch_iter)`` pairs until the job is finished.
@@ -72,4 +78,10 @@ class TaskDataService:
                 mode,
                 self._reader.metadata,
             )
-            yield task, batches
+            ctx = (
+                prefetch(batches, self._prefetch_depth)
+                if self._prefetch_depth > 0
+                else contextlib.nullcontext(batches)
+            )
+            with ctx as batches:
+                yield task, batches

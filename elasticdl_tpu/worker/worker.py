@@ -64,6 +64,7 @@ class Worker:
         checkpoint_init_required: bool = True,
         profiler=None,
         fuse_task_steps: bool = False,
+        prefetch_depth: int = 2,
     ):
         self._id = worker_id
         self._master = master_client
@@ -83,7 +84,7 @@ class Worker:
         self._eval_step = build_eval_step()
         self._task_data = TaskDataService(
             master_client, data_reader, model_spec.dataset_fn,
-            minibatch_size,
+            minibatch_size, prefetch_depth=prefetch_depth,
         )
         self.last_metrics = None
         # Periodic sharded checkpoint (reference PS saves inside

@@ -1,0 +1,101 @@
+"""Background batch prefetch: equivalence, error propagation, cleanup."""
+
+import threading
+import time
+
+import pytest
+
+from elasticdl_tpu.data.prefetch import PrefetchIterator, prefetch
+
+
+def test_yields_everything_in_order():
+    assert list(prefetch(iter(range(100)), depth=2)) == list(range(100))
+
+
+def test_producer_exception_reraises_in_consumer():
+    def source():
+        yield 1
+        yield 2
+        raise ValueError("bad record")
+
+    it = prefetch(source(), depth=2)
+    assert next(it) == 1
+    assert next(it) == 2
+    with pytest.raises(ValueError, match="bad record"):
+        next(it)
+
+
+def test_close_unblocks_producer():
+    produced = []
+
+    def source():
+        for i in range(1000):
+            produced.append(i)
+            yield i
+
+    it = PrefetchIterator(source(), depth=1)
+    assert next(it) == 0
+    it.close()
+    # Producer must exit promptly instead of blocking on the full queue.
+    deadline = time.time() + 5.0
+    while it._thread.is_alive() and time.time() < deadline:
+        time.sleep(0.01)
+    assert not it._thread.is_alive()
+    assert len(produced) < 1000  # it really stopped early
+    with pytest.raises(StopIteration):
+        next(it)
+
+
+def test_overlap_actually_happens():
+    """Producer runs ahead of the consumer up to the queue depth."""
+    started = threading.Event()
+
+    def slow_consumer_source():
+        for i in range(5):
+            yield i
+        started.set()
+
+    it = prefetch(slow_consumer_source(), depth=8)
+    assert started.wait(timeout=5.0)  # drained before we consumed any
+    assert list(it) == list(range(5))
+
+
+def test_exhausted_iterator_stays_exhausted():
+    it = prefetch(iter([1, 2]), depth=2)
+    assert list(it) == [1, 2]
+    with pytest.raises(StopIteration):
+        next(it)
+    with pytest.raises(StopIteration):
+        next(it)  # must not block on the empty queue
+
+
+def test_error_repeats_after_first_raise():
+    def source():
+        yield 1
+        raise ValueError("bad record")
+
+    it = prefetch(source(), depth=2)
+    assert next(it) == 1
+    for _ in range(2):
+        with pytest.raises(ValueError, match="bad record"):
+            next(it)
+
+
+def test_task_data_service_prefetches(tmp_path):
+    from elasticdl_tpu.testing.cluster import MiniCluster
+    from elasticdl_tpu.testing.data import (
+        create_mnist_record_file,
+        model_zoo_dir,
+    )
+
+    train = create_mnist_record_file(str(tmp_path / "t.rec"), 96, seed=1)
+    cluster = MiniCluster(
+        model_zoo=model_zoo_dir(),
+        model_def="mnist.mnist_functional.custom_model",
+        training_data=train,
+        minibatch_size=16,
+        num_epochs=1,
+    )
+    results = cluster.run()
+    assert cluster.finished
+    assert results[0]["trained_batches"] == 6
