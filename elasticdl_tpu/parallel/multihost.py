@@ -140,26 +140,47 @@ def host_local_slice(global_array) -> Optional["jax.Array"]:
     )
 
 
-def exchange_continue(mesh: Mesh, data_axis: str, local_flag: bool) -> bool:
-    """Global any() over per-process flags — the step-count barrier for
-    dynamic sharding under SPMD. Every process must call this the same
-    number of times; True means at least one process still has a real
-    batch this step (others feed zero-mask dummies). Single-process:
-    returns the flag untouched, no device work."""
+# Step-type codes for the barrier: per tick every process announces what
+# it wants to run; the global max wins, lower-priority processes feed a
+# zero-mask dummy through the winning program and retry next tick.
+# DONE vs IDLE matters for termination: IDLE means "no batch this tick
+# but the job may still hand me one" (WAIT from the master, or a
+# requeued task later); DONE means "the master told me the job is over".
+# Ticking stops only on an all-DONE tick — exiting on an all-idle tick
+# would strand a peer whose next tick carries a requeued task.
+STEP_DONE = 0
+STEP_IDLE = 1
+STEP_TRAIN = 2
+STEP_FORWARD = 3  # eval/predict (the forward-only compiled program)
+
+
+def exchange_code(mesh: Mesh, code: int) -> int:
+    """Global max() over per-process step codes — the step-alignment
+    barrier for dynamic sharding under SPMD. Every process calls this
+    exactly once per tick; the returned code is the program ALL
+    processes run this tick (0 = everyone done for good). Single-
+    process: returns the code untouched, no device work."""
     if jax.process_count() <= 1:
-        return bool(local_flag)
+        return int(code)
     import numpy as np
 
-    spec = P(mesh.axis_names)  # all axes over the flat flag vector
+    spec = P(mesh.axis_names)  # all axes over the flat code vector
     sharding = NamedSharding(mesh, spec)
     local = np.full(
-        (len(mesh.local_devices),), 1.0 if local_flag else 0.0,
-        np.float32,
+        (len(mesh.local_devices),), float(code), np.float32,
     )
     arr = jax.make_array_from_process_local_data(sharding, local)
     import jax.numpy as jnp
 
-    return bool(jnp.max(arr) > 0.0)
+    return int(jnp.max(arr))
+
+
+def exchange_continue(mesh: Mesh, data_axis: str, local_flag: bool) -> bool:
+    """Boolean barrier (no-more-batches-ever semantics): any process
+    still stepping?"""
+    return exchange_code(
+        mesh, STEP_TRAIN if local_flag else STEP_DONE
+    ) != STEP_DONE
 
 
 def zero_mask_like(batch):

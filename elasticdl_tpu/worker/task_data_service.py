@@ -34,7 +34,7 @@ _TASK_TYPE_TO_MODE = {
 class TaskDataService:
     def __init__(self, master_client, data_reader, dataset_fn,
                  minibatch_size: int, wait_sleep_secs: float = 2.0,
-                 prefetch_depth: int = 2):
+                 prefetch_depth: int = 2, on_wait=None):
         self._master = master_client
         self._reader = data_reader
         self._dataset_fn = dataset_fn
@@ -43,6 +43,17 @@ class TaskDataService:
         # Background decode of batch N+1 while the device runs step N
         # (reference tf.data .prefetch(1), worker.py:1022-1027); 0 = off.
         self._prefetch_depth = prefetch_depth
+        # Called (with the configured wait interval) instead of sleeping
+        # while WAITing for tasks; multi-host workers use it to keep
+        # participating in barrier ticks (a sleeping process would
+        # strand its peers in a collective).
+        self._on_wait = on_wait
+
+    def _wait(self):
+        if self._on_wait is not None:
+            self._on_wait(self._wait_sleep_secs)
+        else:
+            time.sleep(self._wait_sleep_secs)
 
     def task_stream(self) -> Iterator[Tuple[object, Optional[Iterator]]]:
         """Yield ``(task, batch_iter)`` pairs until the job is finished.
@@ -51,15 +62,41 @@ class TaskDataService:
         TRAIN_END_CALLBACK yielded for the worker to run callbacks). The
         caller must consume ``batch_iter`` fully, then report the task.
         """
+        from elasticdl_tpu.comm.rpc import RpcError
+
+        # ~60s of master unavailability before giving up: long enough to
+        # ride out a master reschedule/GC pause, finite so a torn-down
+        # job lets workers exit. (A relaunched master gets fresh workers
+        # with its address anyway.)
+        max_failures = max(1, int(60.0 / max(self._wait_sleep_secs, 0.1)))
+        rpc_failures = 0
         while True:
-            task, finished = self._master.get_task()
+            try:
+                task, finished = self._master.get_task()
+            except RpcError as exc:
+                rpc_failures += 1
+                logger.warning(
+                    "get_task RPC failed (%d/%d): %s",
+                    rpc_failures, max_failures, exc,
+                )
+                if rpc_failures >= max_failures:
+                    logger.warning(
+                        "master unreachable; treating job as finished"
+                    )
+                    return
+                # _wait (not sleep): multi-host workers must keep
+                # ticking the barrier during the backoff or they strand
+                # peers mid-collective.
+                self._wait()
+                continue
+            rpc_failures = 0
             if task is None:
                 if finished:
                     return
-                time.sleep(self._wait_sleep_secs)
+                self._wait()
                 continue
             if task.type == TaskType.WAIT:
-                time.sleep(self._wait_sleep_secs)
+                self._wait()
                 continue
             if task.type == TaskType.TRAIN_END_CALLBACK:
                 yield task, None

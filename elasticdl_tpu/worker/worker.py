@@ -85,6 +85,7 @@ class Worker:
         self._task_data = TaskDataService(
             master_client, data_reader, model_spec.dataset_fn,
             minibatch_size, prefetch_depth=prefetch_depth,
+            on_wait=self._wait_tick,
         )
         self.last_metrics = None
         # Periodic sharded checkpoint (reference PS saves inside
@@ -104,15 +105,16 @@ class Worker:
         # reporting/checkpointing then happen at task granularity.
         self._fuse_task_steps = fuse_task_steps
         self._multi_step = None
-        # Multi-host SPMD + dynamic sharding need a step-count barrier:
-        # every process runs the SAME number of compiled steps (the
-        # gradient reduction spans processes), but each pulls its own
-        # tasks from the master. Protocol: one exchange_continue() per
-        # step; a process without a real batch feeds a zero-mask dummy
-        # until ALL processes report drained. Training-only jobs for
-        # now (mid-training eval tasks would need the same treatment);
-        # retries and fusion are disabled under sync (a failed collective
-        # step means restart-from-checkpoint, not local retry).
+        # Multi-host SPMD + dynamic sharding need a step-alignment
+        # barrier: every process runs the SAME compiled program the same
+        # number of times (collectives span processes), but each pulls
+        # its own tasks from the master. Protocol (_await_turn): per
+        # tick every process announces a step code (train / forward /
+        # drained); the max wins, lower-priority processes participate
+        # with a zero-mask dummy and retry. Covers train, eval, and
+        # predict tasks. Retries and task fusion are disabled under
+        # sync (a failed collective step means restart-from-checkpoint,
+        # and unequal fused lengths would desync the tick count).
         self._multihost_sync = False
         self._checkpoint_init_required = checkpoint_init_required
 
@@ -181,17 +183,66 @@ class Worker:
 
     # ---- task processing ----------------------------------------------
 
-    def _process_train_batch(self, batch):
-        if self._multihost_sync:
-            # One barrier exchange per step; we have a real batch, and a
-            # failed collective step is fatal (restart-from-checkpoint),
-            # so no local retry loop either.
+    def _wait_tick(self, wait_secs: float = 2.0):
+        """While WAITing for tasks (queue empty, job unfinished): keep
+        participating in barrier ticks as IDLE — a process that just
+        sleeps would strand its peers mid-collective. The blocking
+        exchange paces us to the peers' tick rate; we keep ticking for
+        a polling interval before returning to get_task, so an idle
+        worker doesn't hammer the master once per peer step."""
+        import time as _time
+
+        if (
+            self._multihost_sync
+            and self.state is not None
+            and self.last_batch is not None
+        ):
             from elasticdl_tpu.parallel import multihost
 
-            multihost.exchange_continue(
-                self._step_runner.mesh, self._step_runner.data_axis,
-                True,
-            )
+            deadline = _time.monotonic() + min(wait_secs, 0.5)
+            while True:
+                won = multihost.exchange_code(
+                    self._step_runner.mesh, multihost.STEP_IDLE
+                )
+                if won > multihost.STEP_IDLE:
+                    self._feed_dummy(won)
+                    if _time.monotonic() < deadline:
+                        continue  # keep ticking before re-polling
+                    return
+                _time.sleep(0.05)
+                return
+        _time.sleep(wait_secs)
+
+    def _await_turn(self, code):
+        """Barrier protocol: announce the program we want; while a
+        higher-priority program wins the tick, participate in it with a
+        zero-mask dummy, then retry. Returns when it's our turn."""
+        from elasticdl_tpu.parallel import multihost
+
+        mesh = self._step_runner.mesh
+        while True:
+            won = multihost.exchange_code(mesh, code)
+            if won == code:
+                return
+            self._feed_dummy(won)
+
+    def _feed_dummy(self, code):
+        """Participate in another process's step with zero loss weight."""
+        from elasticdl_tpu.parallel import multihost
+
+        dummy = multihost.zero_mask_like(self.last_batch)
+        if code == multihost.STEP_TRAIN:
+            self.state, _ = self._train_step(self.state, dummy)
+        elif code == multihost.STEP_FORWARD:
+            self._eval_step(self.state, dummy)
+
+    def _process_train_batch(self, batch):
+        if self._multihost_sync:
+            # One barrier exchange per step; a failed collective step is
+            # fatal (restart-from-checkpoint), so no local retry loop.
+            from elasticdl_tpu.parallel import multihost
+
+            self._await_turn(multihost.STEP_TRAIN)
             self.state, metrics = self._train_step(self.state, batch)
             self.last_metrics = metrics
             return
@@ -286,28 +337,51 @@ class Worker:
         return len(batch_list)
 
     def _drain_multihost(self):
-        """Drain barrier: keep feeding zero-mask dummy steps until every
-        process reports no more real batches, so no process is left
-        blocking in a cross-host gradient reduction."""
+        """Drain barrier: keep participating in other processes' steps
+        (train or forward) until every process reports drained, so no
+        one is left blocking in a cross-host collective."""
         if not self._multihost_sync or self.state is None:
             return
         if self.last_batch is None:
             return
+        import time as _time
+
         from elasticdl_tpu.parallel import multihost
 
-        dummy = multihost.zero_mask_like(self.last_batch)
-        while multihost.exchange_continue(
-            self._step_runner.mesh, self._step_runner.data_axis, False
-        ):
-            self.state, _ = self._train_step(self.state, dummy)
+        while True:
+            won = multihost.exchange_code(
+                self._step_runner.mesh, multihost.STEP_DONE
+            )
+            if won == multihost.STEP_DONE:
+                return
+            if won == multihost.STEP_IDLE:
+                # A peer is idle but its master link still lives — keep
+                # ticking (it may yet pick up a requeued task).
+                _time.sleep(0.05)
+                continue
+            self._feed_dummy(won)
+
+    def _local_rows(self, preds):
+        """This process's rows of the (possibly multi-host global)
+        prediction array."""
+        if self._multihost_sync:
+            from elasticdl_tpu.parallel import multihost
+
+            return multihost.host_local_slice(preds)
+        return np.asarray(preds)
 
     def _process_eval_task(self, task, batches):
         outputs_acc, labels_acc = [], []
         for batch in batches:
             self._maybe_init(batch)
+            self.last_batch = batch
+            if self._multihost_sync:
+                from elasticdl_tpu.parallel import multihost
+
+                self._await_turn(multihost.STEP_FORWARD)
             preds = self._eval_step(self.state, batch)
             real = int(np.sum(batch["mask"]))
-            outputs_acc.append(np.asarray(preds)[:real])
+            outputs_acc.append(self._local_rows(preds)[:real])
             labels_acc.append(np.asarray(batch["labels"])[:real])
         if outputs_acc:
             self._master.report_evaluation_metrics(
@@ -318,11 +392,16 @@ class Worker:
     def _process_predict_task(self, task, batches):
         for batch in batches:
             self._maybe_init(batch)
+            self.last_batch = batch
+            if self._multihost_sync:
+                from elasticdl_tpu.parallel import multihost
+
+                self._await_turn(multihost.STEP_FORWARD)
             preds = self._eval_step(self.state, batch)
             real = int(np.sum(batch["mask"]))
             if self._processor is not None:
                 self._processor.process(
-                    np.asarray(preds)[:real], self._id
+                    self._local_rows(preds)[:real], self._id
                 )
 
     def _run_train_end_callbacks(self):
@@ -368,6 +447,17 @@ class Worker:
                         self._process_predict_task(task, batches)
                 self._master.report_task_result(task.task_id)
             except Exception as exc:
+                if self._multihost_sync:
+                    # A failed step after winning a barrier tick leaves
+                    # peers inside a collective we never joined —
+                    # report-and-continue would desync the tick count
+                    # and hang the job. Die; recovery is a full restart
+                    # from checkpoint (docs/designs/multihost.md).
+                    logger.error(
+                        "Fatal under multi-host sync — task %d: %s",
+                        task.task_id, exc,
+                    )
+                    raise
                 logger.error(
                     "Task %d failed: %s\n%s",
                     task.task_id, exc, traceback.format_exc(),

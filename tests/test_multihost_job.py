@@ -1,0 +1,167 @@
+"""Full two-process distributed job: RPC master + two mesh workers under
+jax.distributed, with mid-training eval tasks.
+
+The real thing end to end: process 0 hosts the master (task dispatcher +
+eval service over localhost gRPC) AND runs worker 0; process 1 runs
+worker 1. Both workers pull tasks dynamically from one queue while their
+device meshes form a single 4-device global mesh. The typed-tick barrier
+must reconcile: uneven task pulls, mid-training eval tasks (one worker
+runs the forward program while the other feeds a dummy), and the final
+drain. Assertions: both processes finish, same final version, eval
+metrics reported, loss finite.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_SCRIPT = textwrap.dedent("""
+    import os, sys, time
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    pid = int(sys.argv[1]); jax_port = sys.argv[2]
+    master_port = sys.argv[3]; data_dir = sys.argv[4]
+    jax.distributed.initialize(f"localhost:{jax_port}", 2, pid)
+    sys.path.insert(0, "@REPO@")
+
+    from elasticdl_tpu.core.model_spec import get_model_spec
+    from elasticdl_tpu.data.factory import create_data_reader
+    from elasticdl_tpu.parallel.mesh import make_mesh
+    from elasticdl_tpu.parallel.mesh_runner import make_runner_for_spec
+    from elasticdl_tpu.testing.data import model_zoo_dir
+    from elasticdl_tpu.worker.master_client import MasterClient
+    from elasticdl_tpu.worker.worker import Worker
+
+    spec = get_model_spec(model_zoo_dir(),
+                          "mnist.mnist_functional.custom_model")
+    mesh = make_mesh((len(jax.devices()),), ("dp",))
+    spec.model = spec.make_model(mesh)
+    runner = make_runner_for_spec(spec, mesh)
+    train_path = os.path.join(data_dir, "train.rec")
+    reader = create_data_reader(train_path)
+
+    server = None
+    if pid == 0:
+        from elasticdl_tpu.master.evaluation_service import (
+            EvaluationService,
+        )
+        from elasticdl_tpu.master.servicer import (
+            SERVICE_NAME, MasterServicer,
+        )
+        from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+        from elasticdl_tpu.comm.rpc import RpcServer
+
+        eval_reader = create_data_reader(
+            os.path.join(data_dir, "eval.rec")
+        )
+        dispatcher = TaskDispatcher(
+            training_shards=reader.create_shards(),
+            evaluation_shards=eval_reader.create_shards(),
+            records_per_task=32,
+            num_epochs=1,
+        )
+        eval_service = EvaluationService(
+            dispatcher, spec.eval_metrics_fn(), eval_steps=3,
+        )
+        servicer = MasterServicer(dispatcher, eval_service)
+        server = RpcServer(
+            f"localhost:{master_port}",
+            {SERVICE_NAME: servicer.handlers()},
+        ).start()
+
+    master = MasterClient(
+        f"localhost:{master_port}", worker_id=pid,
+        connect_timeout=60, retries=5,
+    )
+    worker = Worker(
+        worker_id=pid,
+        master_client=master,
+        model_spec=spec,
+        data_reader=reader,
+        minibatch_size=16,
+        step_runner=runner,
+    )
+    result = worker.run()
+    print(f"RESULT pid={pid} version={result['final_version']} "
+          f"batches={result['trained_batches']} "
+          f"loss_finite={result['final_loss'] == result['final_loss']}",
+          flush=True)
+    if pid == 0:
+        deadline = time.time() + 60
+        while not dispatcher.finished() and time.time() < deadline:
+            time.sleep(0.2)
+        print(f"MASTER finished={dispatcher.finished()} "
+              f"evals={len(eval_service.completed_results)}", flush=True)
+        server.stop(0)
+""").replace("@REPO@", REPO)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.slow
+def test_two_process_job_with_eval(tmp_path):
+    sys.path.insert(0, REPO)
+    try:
+        from elasticdl_tpu.testing.data import create_mnist_record_file
+
+        create_mnist_record_file(str(tmp_path / "train.rec"), 192, seed=1)
+        create_mnist_record_file(str(tmp_path / "eval.rec"), 32, seed=2)
+    finally:
+        sys.path.pop(0)
+    script = tmp_path / "proc.py"
+    script.write_text(_SCRIPT)
+    jax_port, master_port = str(_free_port()), str(_free_port())
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(pid), jax_port,
+             master_port, str(tmp_path)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, env=env, cwd=str(tmp_path),
+        )
+        for pid in (0, 1)
+    ]
+    outputs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=420)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("distributed job hung (barrier broken?)")
+        outputs.append(out)
+    for pid, out in enumerate(outputs):
+        assert procs[pid].returncode == 0, f"pid {pid}:\n{out}"
+    results = {}
+    for out in outputs:
+        for line in out.splitlines():
+            if line.startswith("RESULT"):
+                fields = dict(
+                    kv.split("=") for kv in line.split()[1:]
+                )
+                results[int(fields["pid"])] = fields
+            if line.startswith("MASTER"):
+                assert "finished=True" in line, line
+                evals = int(line.split("evals=")[1])
+                assert evals >= 1, line
+    assert set(results) == {0, 1}
+    # One true global state: both processes end at the same version.
+    assert results[0]["version"] == results[1]["version"]
+    assert int(results[0]["version"]) >= 1
+    assert results[0]["loss_finite"] == "True"
+    # Both workers really pulled tasks (12 batches split between them).
+    total = int(results[0]["batches"]) + int(results[1]["batches"])
+    assert total == 12, results
