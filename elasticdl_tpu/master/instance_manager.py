@@ -84,6 +84,7 @@ class InstanceManager:
         max_relaunches: int = 0,  # 0 = unlimited (reference relaunches
         # for the life of the job; task retries are capped instead)
         on_worker_relaunch: Optional[Callable[[int, int], None]] = None,
+        multihost: bool = False,
     ):
         self._task_d = task_dispatcher
         self._client = k8s_client
@@ -107,11 +108,22 @@ class InstanceManager:
         self._next_worker_id = itertools.count(num_workers)
         self._relaunch_count = 0
         self._stopped = False
+        # Multi-host jobs (jax.distributed) restart as a GANG: one death
+        # invalidates every process's mesh, so all workers are deleted
+        # and relaunched with their ORIGINAL ids (stable process ids;
+        # docs/designs/multihost.md). Each gang generation gets a pod-
+        # name suffix: k8s deletion is async, so recreating the same
+        # name would 409, and the suffix also lets stale events for old
+        # pods be recognized (name mismatch) instead of cascading.
+        self._multihost = multihost
+        self._generation = 0
 
     # ---- pod creation ---------------------------------------------------
 
     def _start_worker(self, worker_id: int):
         name = get_worker_pod_name(self._job_name, worker_id)
+        if self._multihost and self._generation:
+            name = f"{name}-g{self._generation}"
         manifest = build_pod_manifest(
             name=name,
             job_name=self._job_name,
@@ -156,10 +168,18 @@ class InstanceManager:
         with self._lock:
             if self._stopped or worker_id not in self._worker_pods:
                 return
+            if self._worker_pods[worker_id] != info["name"]:
+                # Stale event for a previous generation's pod (e.g. the
+                # deletions a gang restart itself caused) — the tracked
+                # pod is a newer one with a different name.
+                return
             del self._worker_pods[worker_id]
         self._handle_dead_worker(worker_id)
 
     def _handle_dead_worker(self, worker_id: int):
+        if self._multihost:
+            self._handle_dead_worker_multihost(worker_id)
+            return
         requeued = self._task_d.recover_tasks(worker_id)
         logger.info(
             "Worker %d died; re-queued %s task(s)", worker_id, requeued
@@ -183,6 +203,48 @@ class InstanceManager:
         self._start_worker(new_id)
         if self._on_worker_relaunch is not None:
             self._on_worker_relaunch(worker_id, new_id)
+
+    def _handle_dead_worker_multihost(self, worker_id: int):
+        """Gang restart: one dead process invalidates every process's
+        jax.distributed mesh, so delete ALL workers and relaunch the
+        full set with their original ids (process ids must be stable)
+        under a new pod-name generation. Workers resume from the rolling
+        checkpoint (worker/main.py resolve_init_checkpoint)."""
+        # The dead worker's tasks always re-queue, even when the budget
+        # is spent — stuck `doing` tasks would hang the job forever.
+        self._task_d.recover_tasks(worker_id)
+        with self._lock:
+            if self._stopped:
+                return
+            if self._max_relaunches and (
+                self._relaunch_count >= self._max_relaunches
+            ):
+                logger.warning(
+                    "Relaunch budget (%d) exhausted; not gang-"
+                    "restarting after worker %d died",
+                    self._max_relaunches, worker_id,
+                )
+                return
+            self._relaunch_count += 1
+            self._generation += 1
+            live = dict(self._worker_pods)
+            live.pop(worker_id, None)
+            self._worker_pods.clear()
+        logger.info(
+            "Multi-host gang restart (generation %d): worker %d died; "
+            "deleting %d peer(s), relaunching all %d with original ids",
+            self._generation, worker_id, len(live), self._num_workers,
+        )
+        for wid, pod_name in live.items():
+            self._task_d.recover_tasks(wid)
+            try:
+                self._client.delete_pod(pod_name)
+            except Exception as exc:
+                logger.warning("deleting %s failed: %s", pod_name, exc)
+        for wid in range(self._num_workers):
+            self._start_worker(wid)
+        if self._on_worker_relaunch is not None:
+            self._on_worker_relaunch(worker_id, worker_id)
 
     # ---- straggler handling ---------------------------------------------
 

@@ -147,16 +147,24 @@ class Master:
         (reference master.py:365-485 + build_arguments_from_parsed_result)."""
         passthrough = build_arguments_from_parsed_result(
             self._args,
-            filter_args=["worker_id", "force", "master_addr"],
+            # jax_process_id filtered: the master's own value (-1) must
+            # not override the per-worker flag set below.
+            filter_args=["worker_id", "force", "master_addr",
+                         "jax_process_id"],
         )
         # The user's --checkpoint_dir_for_init (warm start) passes through
         # untouched; elastic relaunch resume comes from the worker itself
         # preferring the rolling --checkpoint_dir when it holds a valid
         # version (worker/main.py resolve_init_checkpoint).
+        cmd = [sys.executable, "-m", "elasticdl_tpu.worker.main",
+               "--worker_id", str(worker_id),
+               "--master_addr", self._master_addr_for_workers()]
+        if getattr(self._args, "num_jax_processes", 1) > 1:
+            # Stable jax.distributed process id across gang restarts
+            # (multi-host workers always relaunch with original ids).
+            cmd += ["--jax_process_id", str(worker_id)]
         return (
-            [sys.executable, "-m", "elasticdl_tpu.worker.main",
-             "--worker_id", str(worker_id),
-             "--master_addr", self._master_addr_for_workers()]
+            cmd
             + passthrough
         )
 
@@ -221,6 +229,9 @@ class Master:
                 envs=parse_envs(self._args.envs),
                 restart_policy=self._args.restart_policy,
                 owner=owner,
+                multihost=(
+                    getattr(self._args, "num_jax_processes", 1) > 1
+                ),
             )
             self.instance_manager.start_watch()
             self.instance_manager.start_workers()

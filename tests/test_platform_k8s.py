@@ -133,12 +133,13 @@ def _dispatcher(n_records=64, records_per_task=16):
     )
 
 
-def _dead_event(job, worker_id, etype="DELETED", phase="", exit_code=None):
+def _dead_event(job, worker_id, etype="DELETED", phase="", exit_code=None,
+                name=None):
     return {
         "type": etype,
         "object": {
             "metadata": {
-                "name": get_worker_pod_name(job, worker_id),
+                "name": name or get_worker_pod_name(job, worker_id),
                 "labels": {
                     "elasticdl-tpu-replica-type": "worker",
                     "elasticdl-tpu-replica-index": str(worker_id),
@@ -192,6 +193,56 @@ class TestInstanceManager:
                         exit_code=1)
         )
         assert 1 in mgr.live_workers  # user crash: NOT replaced
+
+    def test_multihost_gang_restart(self):
+        """A death in a multi-host job deletes ALL workers and relaunches
+        the full set with their ORIGINAL ids (stable process ids); the
+        self-inflicted deaths don't cascade into more restarts."""
+        disp = _dispatcher()
+        mgr, client = self._manager(disp, n=3, multihost=True)
+        mgr.start_workers()
+        t0 = disp.get(worker_id=0)
+        t2 = disp.get(worker_id=2)
+        assert t0 is not None and t2 is not None
+
+        mgr._event_cb(_dead_event("j", 1))
+        # Peers 0 and 2 were deleted; everyone's tasks re-queued.
+        assert sorted(client.deleted) == [
+            "elasticdl-tpu-j-worker-0", "elasticdl-tpu-j-worker-2",
+        ]
+        assert disp.doing_tasks_of(0) == []
+        assert disp.doing_tasks_of(2) == []
+        # Full set relaunched under ORIGINAL ids, new pod-name
+        # generation (k8s deletion is async — same names would 409).
+        assert len(client.created) == 6
+        assert set(mgr.live_workers) == {0, 1, 2}
+        gen1 = {m["metadata"]["name"] for m in client.created[3:]}
+        assert gen1 == {
+            "elasticdl-tpu-j-worker-0-g1",
+            "elasticdl-tpu-j-worker-1-g1",
+            "elasticdl-tpu-j-worker-2-g1",
+        }
+
+        # Stale events for the OLD generation's pods — no cascade, and
+        # the relaunched workers stay tracked.
+        created_before = len(client.created)
+        mgr._event_cb(_dead_event("j", 0))
+        mgr._event_cb(_dead_event("j", 2))
+        assert len(client.created) == created_before
+        assert set(mgr.live_workers) == {0, 1, 2}
+
+        # A FRESH death of a relaunched (gen-1) pod triggers another
+        # gang restart.
+        mgr._event_cb(_dead_event(
+            "j", 1, name="elasticdl-tpu-j-worker-1-g1"
+        ))
+        assert len(client.created) == created_before + 3
+        gen2 = {m["metadata"]["name"] for m in client.created[6:]}
+        assert gen2 == {
+            "elasticdl-tpu-j-worker-0-g2",
+            "elasticdl-tpu-j-worker-1-g2",
+            "elasticdl-tpu-j-worker-2-g2",
+        }
 
     def test_relaunch_budget(self):
         disp = _dispatcher()
