@@ -54,6 +54,25 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, l_ref, m_acc, l_acc, o_acc,
         l_acc[:] = jnp.zeros_like(l_acc)
         o_acc[:] = jnp.zeros_like(o_acc)
 
+    _scratch_tile_update(
+        q_ref, k_ref, v_ref, m_acc, l_acc, o_acc, q_start, k_start,
+        block_k=block_k, causal=causal, scale=scale,
+    )
+
+    @pl.when(kb == num_kb - 1)
+    def _finalize():
+        l_safe = jnp.maximum(l_acc[:], 1e-30)
+        o_ref[0] = (o_acc[:] / l_safe).astype(o_ref.dtype)
+        l_ref[0] = m_acc[:] + jnp.log(l_safe)  # logsumexp residual
+
+
+def _scratch_tile_update(q_ref, k_ref, v_ref, m_acc, l_acc, o_acc,
+                         q_start, k_start, *, block_k, causal, scale):
+    """The online-softmax recurrence for one K/V tile against the VMEM
+    scratch accumulators — shared by the standalone forward and the
+    ring-chunk kernel so the numerically delicate update exists once."""
+    block_q = q_ref.shape[1]
+
     def _compute():
         q = q_ref[0]
         k_blk = k_ref[0]
@@ -85,18 +104,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, l_ref, m_acc, l_acc, o_acc,
         )
 
     if causal:
-        # Blocks strictly above the diagonal contribute nothing — the
+        # Tiles strictly above the diagonal contribute nothing — the
         # body is predicated out and their FLOPs skipped (the grid still
         # visits the step, so the scratch state machine stays uniform).
         pl.when(q_start + block_q - 1 >= k_start)(_compute)
     else:
         _compute()
-
-    @pl.when(kb == num_kb - 1)
-    def _finalize():
-        l_safe = jnp.maximum(l_acc[:], 1e-30)
-        o_ref[0] = (o_acc[:] / l_safe).astype(o_ref.dtype)
-        l_ref[0] = m_acc[:] + jnp.log(l_safe)  # logsumexp residual
 
 
 def _flash_forward(q, k, v, causal: bool, scale: float, block_q: int,
@@ -206,6 +219,109 @@ def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def _chunk_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref, m_ref, l_ref,
+                  acc_ref, m_out, l_out, acc_out, m_scr, l_scr, acc_scr,
+                  *, block_k: int, causal: bool, scale: float):
+    """Carry-in/carry-out online-softmax update of q blocks against one
+    K/V chunk — the fused inner step of ring attention (the ring rotates
+    chunks between devices; position offsets arrive as prefetched
+    scalars). Same streaming structure as _fwd_kernel: the k dimension
+    is an innermost sequential grid axis and K/V tiles flow through VMEM
+    (O(block) residency), with scratch seeded from the carry at the
+    first tile and flushed to the carry outputs at the last."""
+    qi = pl.program_id(1)
+    kt = pl.program_id(2)
+    num_kt = pl.num_programs(2)
+    block_q = q_ref.shape[1]
+    q_start = qoff_ref[0] + qi * block_q
+    k_start = koff_ref[0] + kt * block_k
+
+    @pl.when(kt == 0)
+    def _init():
+        m_scr[:] = m_ref[0]
+        l_scr[:] = l_ref[0]
+        acc_scr[:] = acc_ref[0]
+
+    _scratch_tile_update(
+        q_ref, k_ref, v_ref, m_scr, l_scr, acc_scr, q_start, k_start,
+        block_k=block_k, causal=causal, scale=scale,
+    )
+
+    @pl.when(kt == num_kt - 1)
+    def _flush():
+        m_out[0] = m_scr[:]
+        l_out[0] = l_scr[:]
+        acc_out[0] = acc_scr[:]
+
+
+def flash_chunk_update(
+    q, k_chunk, v_chunk, m, l, acc, q_offset, k_offset,
+    causal: bool = True, scale: Optional[float] = None,
+    block_q: int = DEFAULT_BLOCK_Q, block_k: int = DEFAULT_BLOCK_K,
+    interpret: bool = False,
+):
+    """Fold one K/V chunk into running flash accumulators.
+
+    q: (BH, Sq, D); k_chunk/v_chunk: (BH, Sk, D); m, l: (BH, Sq, 1) f32;
+    acc: (BH, Sq, D) f32; q_offset/k_offset: scalar global positions of
+    q[.,0] and k_chunk[.,0] (traced values fine — scalar-prefetched).
+    Returns updated (m, l, acc); callers finalize with acc/max(l,eps)
+    after the last chunk.
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    bh, sq, d = q.shape
+    sk = k_chunk.shape[1]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    if sq % block_q or sk % block_k:
+        raise ValueError(
+            f"flash_chunk_update: shapes (Sq={sq}, Sk={sk}) must tile "
+            f"by blocks ({block_q}, {block_k})"
+        )
+    kernel = functools.partial(
+        _chunk_kernel, block_k=block_k, causal=causal,
+        scale=float(scale),
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(bh, sq // block_q, sk // block_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j, *_: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j, *_: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j, *_: (b, j, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j, *_: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j, *_: (b, i, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j, *_: (b, i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j, *_: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j, *_: (b, i, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j, *_: (b, i, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+    )
+    qoff = jnp.asarray(q_offset, jnp.int32).reshape((1,))
+    koff = jnp.asarray(k_offset, jnp.int32).reshape((1,))
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq, 1), jnp.float32),
+            jax.ShapeDtypeStruct((bh, sq, 1), jnp.float32),
+            jax.ShapeDtypeStruct((bh, sq, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(qoff, koff, q, k_chunk, v_chunk, m, l, acc)
 
 
 def supports(q_shape, block_q: int = DEFAULT_BLOCK_Q,

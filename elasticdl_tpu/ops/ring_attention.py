@@ -101,6 +101,76 @@ def _ring_attention_local(q, k, v, axis_name: str, causal: bool, scale):
     return out.astype(q.dtype)
 
 
+def _ring_local_pallas_fwd(q, k, v, axis_name: str, causal: bool,
+                           scale, interpret: bool):
+    """Pallas-fused ring forward: each arriving K/V chunk folds into the
+    running flash accumulators via one fused kernel call
+    (ops/flash_attention.flash_chunk_update) instead of XLA einsums —
+    scores exist only as on-chip tiles while chunks rotate over ICI."""
+    from elasticdl_tpu.ops.flash_attention import flash_chunk_update
+
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    b, s_loc, h, d = q.shape
+
+    def to_bh(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, s_loc, d)
+
+    qb = to_bh(q)
+    m0 = jnp.full((b * h, s_loc, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b * h, s_loc, 1), jnp.float32)
+    acc0 = jnp.zeros((b * h, s_loc, d), jnp.float32)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    q_off = idx * s_loc
+
+    def step(carry, t):
+        m, l, acc, kc, vc = carry
+        k_off = ((idx - t) % n) * s_loc
+        m, l, acc = flash_chunk_update(
+            qb, to_bh(kc), to_bh(vc), m, l, acc, q_off, k_off,
+            causal=causal, scale=scale, interpret=interpret,
+        )
+        kc = jax.lax.ppermute(kc, axis_name, perm)
+        vc = jax.lax.ppermute(vc, axis_name, perm)
+        return (m, l, acc, kc, vc), None
+
+    (m, l, acc, _, _), _ = jax.lax.scan(
+        step, (m0, l0, acc0, k, v), jnp.arange(n)
+    )
+    out = acc / jnp.maximum(l, 1e-30)
+    out = out.reshape(b, h, s_loc, d).transpose(0, 2, 1, 3)
+    return out.astype(q.dtype)
+
+
+def _make_ring_local_pallas(axis_name: str, causal: bool, scale,
+                            interpret: bool):
+    """Pallas forward + recompute backward: the VJP re-runs the pure-jnp
+    ring (same math, ppermutes and all) and differentiates that —
+    correct by construction, while the forward gets the fused kernel."""
+
+    @jax.custom_vjp
+    def ring(q, k, v):
+        return _ring_local_pallas_fwd(
+            q, k, v, axis_name, causal, scale, interpret
+        )
+
+    def fwd(q, k, v):
+        return ring(q, k, v), (q, k, v)
+
+    def bwd(res, g):
+        q, k, v = res
+        _, vjp = jax.vjp(
+            lambda q, k, v: _ring_attention_local(
+                q, k, v, axis_name=axis_name, causal=causal, scale=scale
+            ),
+            q, k, v,
+        )
+        return vjp(g)
+
+    ring.defvjp(fwd, bwd)
+    return ring
+
+
 def ring_attention(
     q,
     k,
@@ -111,12 +181,18 @@ def ring_attention(
     tp_axis: Optional[str] = "tp",
     causal: bool = True,
     scale: Optional[float] = None,
+    use_pallas: Optional[bool] = None,
+    interpret: bool = False,
 ):
     """Exact attention with the sequence dim sharded over ``sp_axis``.
 
     q, k, v: (B, S, H, D) global shapes; B may be sharded over ``dp_axis``
     and H over ``tp_axis`` (both optional — axes absent from the mesh are
     treated as replicated). The ring communicates only over ``sp_axis``.
+
+    ``use_pallas`` (default: auto — on for the TPU backend when the
+    local block shape is sublane-aligned) fuses each chunk update into
+    one Pallas kernel call; backward recomputes through the jnp ring.
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
@@ -137,10 +213,28 @@ def ring_attention(
             else None
         )
 
+    s_loc = s // mesh.shape[sp_axis]
+    if use_pallas is None:
+        from elasticdl_tpu.ops.flash_attention import (
+            supports as flash_supports,
+        )
+
+        # Same tiling gate as single-chip flash: the local block must
+        # tile by the clamped kernel blocks, or fall back to jnp.
+        use_pallas = (
+            jax.default_backend() == "tpu"
+            and flash_supports((b, s_loc, h, q.shape[-1]))
+        )
+    if use_pallas:
+        body = _make_ring_local_pallas(
+            sp_axis, causal, float(scale), interpret
+        )
+    else:
+        body = partial(
+            _ring_attention_local, axis_name=sp_axis, causal=causal,
+            scale=scale,
+        )
     spec = P(usable(dp_axis, b), sp_axis, usable(tp_axis, h), None)
-    body = partial(
-        _ring_attention_local, axis_name=sp_axis, causal=causal, scale=scale
-    )
     return jax.shard_map(
         body,
         mesh=mesh,
