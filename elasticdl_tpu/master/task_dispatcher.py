@@ -243,7 +243,15 @@ class TaskDispatcher:
                 self.counters.add_completed(task.type, task.num_records)
             else:
                 key = f"{task.shard_name}:{task.start}:{task.end}"
-                retries = self._task_retry_count.get(key, 0) + 1
+                # Graceful preemption hand-backs (SIGTERM before the
+                # pod dies) are not task failures: no records were
+                # consumed and no real error occurred, so they must not
+                # burn the shard's retry budget — repeatedly-preempted
+                # shards would otherwise be dropped from training.
+                preempted = err_reason.startswith("preempted")
+                retries = self._task_retry_count.get(key, 0) + (
+                    0 if preempted else 1
+                )
                 self._task_retry_count[key] = retries
                 if retries <= MAX_TASK_RETRIES:
                     logger.info(

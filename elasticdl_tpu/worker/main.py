@@ -173,6 +173,13 @@ def resolve_init_checkpoint(args) -> dict:
 def main(argv=None):
     args = parse_worker_args(argv)
     worker = build_worker(args)
+    # k8s sends SIGTERM ahead of the KILL: stop at the next batch
+    # boundary, checkpoint the freshest state, hand the task back.
+    import signal
+
+    signal.signal(
+        signal.SIGTERM, lambda signum, frame: worker.request_stop()
+    )
     result = worker.run()
     logger.info("Worker %d done: %s", args.worker_id, result)
     return 0

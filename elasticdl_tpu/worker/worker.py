@@ -46,6 +46,10 @@ from elasticdl_tpu.worker.task_data_service import TaskDataService
 logger = get_logger("worker")
 
 
+class WorkerStopped(Exception):
+    """Raised internally when a graceful stop (SIGTERM) was requested."""
+
+
 class Worker:
     def __init__(
         self,
@@ -116,6 +120,11 @@ class Worker:
         # sync (a failed collective step means restart-from-checkpoint,
         # and unequal fused lengths would desync the tick count).
         self._multihost_sync = False
+        # Graceful preemption (k8s SIGTERM before the KILL): a stop
+        # request checkpoints the freshest state and hands the current
+        # task back before the pod dies (worker/main.py installs the
+        # signal handler).
+        self._stop_requested = False
         self._checkpoint_init_required = checkpoint_init_required
 
     # ---- state init ----------------------------------------------------
@@ -192,6 +201,10 @@ class Worker:
         worker doesn't hammer the master once per peer step."""
         import time as _time
 
+        if self._stop_requested:
+            # Idle worker: nothing to hand back; exit the task loop
+            # (the post-loop path checkpoints whatever was trained).
+            raise WorkerStopped()
         if (
             self._multihost_sync
             and self.state is not None
@@ -262,6 +275,15 @@ class Worker:
         raise RuntimeError(
             f"Minibatch failed after {MAX_MINIBATCH_RETRY_NUM} retries"
         )
+
+    def request_stop(self):
+        """Ask the worker to stop at the next TASK boundary, saving a
+        checkpoint first (SIGTERM grace-period path). Task granularity
+        keeps the exactly-once invariant: a handed-back task has
+        consumed none of its records, so nothing trains twice — the
+        checkpoint reflects completed tasks only. (A task outlasting
+        the grace period falls back to the ordinary pod-death path.)"""
+        self._stop_requested = True
 
     def _process_train_task(self, task, batches) -> int:
         if self._fuse_task_steps:
@@ -424,6 +446,27 @@ class Worker:
 
     def _run(self) -> dict:
         trained_batches = 0
+        try:
+            trained_batches = self._task_loop()
+        except WorkerStopped:
+            logger.info("stop requested while idle; exiting task loop")
+        if self.state is not None and trained_batches:
+            self._checkpoint.save_final(self.state)
+        self._timing.report_timing()
+        return {
+            "worker_id": self._id,
+            "trained_batches": trained_batches,
+            "final_version": (
+                int(self.state.step) if self.state is not None else 0
+            ),
+            "final_loss": (
+                float(self.last_metrics["loss"])
+                if self.last_metrics is not None else None
+            ),
+        }
+
+    def _task_loop(self) -> int:
+        trained_batches = 0
         for task, batches in self._task_data.task_stream():
             if task.type == TaskType.TRAIN_END_CALLBACK:
                 try:
@@ -435,6 +478,24 @@ class Worker:
                         err_reason=f"callback: {type(exc).__name__}: {exc}",
                     )
                 continue
+            if self._stop_requested:
+                # Graceful preemption, checked at the task boundary (the
+                # pulled task has consumed nothing): checkpoint the
+                # freshest state, hand the task back untouched (it
+                # re-queues immediately, without burning its retry
+                # budget), and exit.
+                logger.info(
+                    "stop requested: checkpointing at version %s and "
+                    "returning task %d",
+                    int(self.state.step) if self.state is not None
+                    else "-", task.task_id,
+                )
+                if self.state is not None:
+                    self._checkpoint.save_final(self.state)
+                self._master.report_task_result(
+                    task.task_id, err_reason="preempted (SIGTERM)"
+                )
+                break
             try:
                 with self._timing.record("task_process"):
                     if task.type == TaskType.TRAINING:
@@ -468,18 +529,9 @@ class Worker:
                     task.task_id,
                     err_reason=f"{type(exc).__name__}: {exc}",
                 )
-        self._drain_multihost()
-        if self.state is not None and trained_batches:
-            self._checkpoint.save_final(self.state)
-        self._timing.report_timing()
-        return {
-            "worker_id": self._id,
-            "trained_batches": trained_batches,
-            "final_version": (
-                int(self.state.step) if self.state is not None else 0
-            ),
-            "final_loss": (
-                float(self.last_metrics["loss"])
-                if self.last_metrics is not None else None
-            ),
-        }
+        if not self._stop_requested:
+            # A stopping worker must not drain: the barrier drains only
+            # when ALL processes are done, and peers aren't — its death
+            # triggers the gang restart instead.
+            self._drain_multihost()
+        return trained_batches

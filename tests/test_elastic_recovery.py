@@ -73,6 +73,53 @@ def test_worker_death_checkpoint_resume(tmp_path):
     assert result is not None
 
 
+def test_graceful_sigterm_checkpoints_and_returns_task(tmp_path):
+    """SIGTERM grace path: the worker checkpoints the freshest state and
+    reports its task failed (immediate re-queue) instead of dying with
+    the task stuck in doing until the watch event."""
+    train = create_mnist_record_file(str(tmp_path / "t.rec"), 192, seed=1)
+    ckpt_dir = str(tmp_path / "ckpt")
+    cluster = MiniCluster(
+        model_zoo=model_zoo_dir(),
+        model_def="mnist.mnist_functional.custom_model",
+        training_data=train,
+        minibatch_size=16,
+        num_minibatches_per_task=2,
+        checkpoint_dir=ckpt_dir,
+        checkpoint_steps=100,  # interval never fires on its own
+    )
+    worker = cluster.workers[0]
+
+    calls = {"n": 0}
+
+    def stop_after_three(request):
+        calls["n"] += 1
+        if calls["n"] == 3:
+            worker.request_stop()  # what the SIGTERM handler does
+
+    worker._master._callbacks = {"get_task": stop_after_three}
+    result = worker.run()
+    assert not cluster.finished
+    # The freshest state was checkpointed despite the interval.
+    saver = CheckpointSaver(ckpt_dir)
+    version = saver.get_valid_latest_version()
+    assert version == result["final_version"] > 0
+    # The in-flight task went back to todo (reported failed).
+    assert cluster.dispatcher.doing_tasks_of(0) == []
+    # A replacement worker finishes the job from that checkpoint.
+    replacement = Worker(
+        worker_id=1,
+        master_client=InProcessMaster(cluster.servicer, worker_id=1),
+        model_spec=cluster.spec,
+        data_reader=cluster.train_reader,
+        minibatch_size=16,
+        checkpoint_dir_for_init=ckpt_dir,
+    )
+    replacement.run()
+    assert cluster.finished
+    assert int(replacement.state.step) > version
+
+
 def test_task_requeue_preserves_all_records(tmp_path):
     """No records are lost across a kill+recover cycle: completed counts
     cover every record exactly once per epoch."""
