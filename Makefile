@@ -1,0 +1,36 @@
+# Developer entry points (reference elasticdl/Makefile builds protos +
+# C++ kernels; here the native pieces build lazily on import, so make
+# mostly drives tests/bench).
+
+PY ?= python
+
+.PHONY: test test-fast native bench dryrun clean lint
+
+test:
+	$(PY) -m pytest tests/ -q
+
+test-fast:
+	$(PY) -m pytest tests/ -q \
+	  --ignore=tests/test_example_zoo.py \
+	  --ignore=tests/test_multihost_job.py \
+	  --ignore=tests/test_multihost_2proc.py
+
+# Force-rebuild the native components (row store + record reader).
+native:
+	rm -f elasticdl_tpu/native/_librowstore.so \
+	      elasticdl_tpu/native/_record_ext.so
+	$(PY) -c "from elasticdl_tpu.native import native_available, \
+	get_record_ext; assert native_available(); assert get_record_ext()"
+
+bench:
+	$(PY) bench.py
+
+# Multi-chip sharding dry run on a virtual 8-device CPU mesh.
+dryrun:
+	XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	$(PY) __graft_entry__.py 8
+
+clean:
+	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null; \
+	rm -f elasticdl_tpu/native/_librowstore.so \
+	      elasticdl_tpu/native/_record_ext.so

@@ -209,6 +209,44 @@ def callbacks():
 '''
 
 
+def test_transformer_export_standalone_predict(tmp_path):
+    """The flagship exports to a standalone StableHLO predictor too
+    (no model-zoo code needed at load time)."""
+    import jax
+    import optax
+
+    from elasticdl_tpu.core.model_spec import get_model_spec
+    from elasticdl_tpu.core.train_state import init_train_state
+    from elasticdl_tpu.serving.export import (
+        export_serving_bundle,
+        load_predictor,
+    )
+    from elasticdl_tpu.testing.data import model_zoo_dir
+
+    spec = get_model_spec(
+        model_zoo_dir(), "transformer.transformer_lm.custom_model"
+    )
+    tokens = np.zeros((2, 16), np.int32)
+    batch = {"features": tokens,
+             "labels": tokens,
+             "mask": np.ones((2,), np.float32)}
+    state = init_train_state(spec.model, optax.adam(1e-3), batch, seed=0)
+    out_dir = str(tmp_path / "bundle")
+    export_serving_bundle(
+        out_dir, spec.model, state, batch_example=batch,
+        model_def="transformer.transformer_lm.custom_model",
+    )
+    predictor = load_predictor(out_dir)
+    preds = predictor(tokens)
+    want = spec.model.apply(
+        {"params": state.params}, tokens, training=False
+    )
+    # bf16 compute, two independently compiled programs.
+    np.testing.assert_allclose(
+        np.asarray(preds), np.asarray(want), rtol=3e-2, atol=3e-2
+    )
+
+
 def test_local_executor_runs_callbacks_end_to_end(tmp_path):
     from elasticdl_tpu.api.local_executor import LocalExecutor
     from elasticdl_tpu.testing.data import (
