@@ -55,6 +55,7 @@ class CheckpointHook:
         num_shards: int = 1,
         keep_max: int = 3,
         saver: Optional[CheckpointSaver] = None,
+        async_save: bool = True,
     ):
         if saver is None and checkpoint_dir:
             saver = CheckpointSaver(
@@ -63,6 +64,41 @@ class CheckpointHook:
         self.saver = saver
         self.checkpoint_steps = int(checkpoint_steps)
         self._last_saved = None
+        # Async: the device->host copy stays on the caller's thread (it
+        # must observe a consistent state), but serialization + disk IO
+        # move to a single background writer — the training step doesn't
+        # wait on storage. At most ONE write is in flight: a new save
+        # joins the previous one first, so slow storage backpressures
+        # instead of piling up full host model copies. A crash mid-write
+        # leaves a torn version dir the saver's validity check skips.
+        self._async = async_save
+        self._writer = None
+        self._inflight = None
+        self._pending_error = None
+
+    def _writer_submit(self, fn):
+        from concurrent.futures import ThreadPoolExecutor
+
+        if self._writer is None:
+            self._writer = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="ckpt-writer"
+            )
+        if self._inflight is not None:
+            # Backpressure + strict ordering: one write in flight.
+            self._inflight.exception()
+        self._inflight = self._writer.submit(fn)
+        return self._inflight
+
+    def flush(self):
+        """Wait for in-flight async writes; raise a deferred failure
+        (unless a newer write has since succeeded and superseded it)."""
+        if self._writer is not None:
+            self._writer.shutdown(wait=True)
+            self._writer = None
+            self._inflight = None
+        if self._pending_error is not None:
+            exc, self._pending_error = self._pending_error, None
+            raise exc
 
     @property
     def enabled(self) -> bool:
@@ -99,13 +135,43 @@ class CheckpointHook:
 
     def save_final(self, state) -> bool:
         if self.saver is None or state is None:
+            # Even with nothing new to write, surface deferred failures.
+            self.flush()
             return False
         version = int(state.step)
         if self._last_saved == version:
+            self.flush()
             return False
         self._save(version, state)
+        self.flush()
         return True
 
     def _save(self, version: int, state):
-        self.saver.save(version, named_leaves_from_state(state))
-        self._last_saved = version
+        # Device->host copy here (consistent snapshot before the step
+        # mutates/donates buffers); serialization+IO async when enabled.
+        # _last_saved advances only on a SUCCESSFUL write, so a failed
+        # one is retried by the next maybe_save/save_final.
+        import jax
+
+        leaves = jax.device_get(named_leaves_from_state(state))
+        if not self._async:
+            self.saver.save(version, leaves)
+            self._last_saved = version
+            return
+
+        def write():
+            try:
+                self.saver.save(version, leaves)
+            except BaseException as exc:
+                self._pending_error = exc
+                logger.error(
+                    "async checkpoint write (version %d) failed: %s",
+                    version, exc,
+                )
+                raise
+            self._last_saved = version
+            # A newer successful write supersedes an older failure —
+            # the freshest checkpoint is what restores.
+            self._pending_error = None
+
+        self._writer_submit(write)

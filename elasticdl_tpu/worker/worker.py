@@ -443,6 +443,13 @@ class Worker:
                 # Close a still-open trace even on preemption, or a later
                 # start_trace in this process raises "already started".
                 self._profiler.stop()
+            try:
+                # Land any in-flight async checkpoint write — a dying
+                # worker's freshest checkpoint must hit disk before the
+                # replacement looks for it.
+                self._checkpoint.flush()
+            except Exception as exc:
+                logger.error("checkpoint flush on exit failed: %s", exc)
 
     def _run(self) -> dict:
         trained_batches = 0
@@ -490,8 +497,16 @@ class Worker:
                     int(self.state.step) if self.state is not None
                     else "-", task.task_id,
                 )
-                if self.state is not None:
-                    self._checkpoint.save_final(self.state)
+                try:
+                    if self.state is not None:
+                        self._checkpoint.save_final(self.state)
+                except Exception as exc:
+                    # A deferred write failure must not skip the task
+                    # hand-back below (the master would wait on the
+                    # pod-death timeout otherwise).
+                    logger.error(
+                        "final checkpoint on preemption failed: %s", exc
+                    )
                 self._master.report_task_result(
                     task.task_id, err_reason="preempted (SIGTERM)"
                 )
