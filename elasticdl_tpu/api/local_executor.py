@@ -113,6 +113,19 @@ class LocalExecutor:
             self._tb_service = TensorboardService(args.tensorboard_log_dir)
 
     def _task_batches(self, reader, mode):
+        gen = self._task_batches_raw(reader, mode)
+        # Background decode of batch N+1 while the device runs step N
+        # (same role as the worker path's data/prefetch.py wiring).
+        depth = getattr(self._args, "prefetch_depth", 2)
+        if depth > 0:
+            from elasticdl_tpu.data.prefetch import prefetch
+
+            with prefetch(gen, depth) as batches:
+                yield from batches
+        else:
+            yield from gen
+
+    def _task_batches_raw(self, reader, mode):
         shards = reader.create_shards()
         task_id = 0
         for shard_name, (start, count) in shards.items():
