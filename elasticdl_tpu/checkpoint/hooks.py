@@ -17,14 +17,71 @@ from elasticdl_tpu.common.log_utils import get_logger
 logger = get_logger(__name__)
 
 
+def _has_orbax_versions(checkpoint_dir: str) -> bool:
+    import os
+    import re
+
+    # Finalized versions only — orbax's in-progress
+    # *.orbax-checkpoint-tmp-* dirs must not route restore here.
+    pattern = re.compile(r"^orbax-\d+$")
+    try:
+        return any(
+            pattern.match(name) for name in os.listdir(checkpoint_dir)
+        )
+    except OSError:
+        return False
+
+
+def has_valid_checkpoint(checkpoint_dir: str) -> bool:
+    """Either backend has a restorable version here (used by the
+    elastic-relaunch resume decision, worker/main.py)."""
+    if not checkpoint_dir:
+        return False
+    if _has_orbax_versions(checkpoint_dir):
+        return True
+    try:
+        return (
+            CheckpointSaver(checkpoint_dir).get_valid_latest_version()
+            is not None
+        )
+    except OSError:
+        return False
+
+
 def restore_from_dir(state, checkpoint_dir: str, required: bool = True):
     """Restore a TrainState's leaves from the latest valid version.
+
+    Backend is detected from the directory contents: orbax version dirs
+    (multi-host jobs write those — global arrays aren't addressable from
+    one process) restore onto the state's current shardings; otherwise
+    the native shard files restore via host numpy.
 
     ``required=False`` is the elastic-relaunch path: a replacement worker
     is pointed at the job's checkpoint dir, which legitimately has no
     valid version yet if the job died before the first checkpoint — start
     fresh instead of crash-looping the replacement pod.
     """
+    if _has_orbax_versions(checkpoint_dir):
+        from elasticdl_tpu.checkpoint.orbax_backend import (
+            OrbaxSaver,
+            restore_state,
+        )
+
+        try:
+            state = restore_state(OrbaxSaver(checkpoint_dir), state)
+        except FileNotFoundError:
+            if required:
+                raise
+            logger.warning(
+                "No valid orbax checkpoint under %s; starting fresh",
+                checkpoint_dir,
+            )
+            return state
+        logger.info(
+            "Restored state at version %d from %s (orbax)",
+            int(state.step), checkpoint_dir,
+        )
+        return state
     try:
         _, dense, _ = CheckpointSaver(checkpoint_dir).restore()
     except FileNotFoundError:
@@ -56,7 +113,18 @@ class CheckpointHook:
         keep_max: int = 3,
         saver: Optional[CheckpointSaver] = None,
         async_save: bool = True,
+        backend: str = "native",
     ):
+        # "orbax": required for multi-host jobs (one process cannot
+        # device_get a global array); writes coordinately and restores
+        # onto any target sharding. Orbax manages its own async IO, so
+        # the hook's async wrapper is bypassed there.
+        self._orbax = None
+        if backend == "orbax" and checkpoint_dir:
+            from elasticdl_tpu.checkpoint.orbax_backend import OrbaxSaver
+
+            self._orbax = OrbaxSaver(checkpoint_dir, keep_max=keep_max)
+            saver = saver or self._orbax  # enables the save paths below
         if saver is None and checkpoint_dir:
             saver = CheckpointSaver(
                 checkpoint_dir, num_shards=num_shards, keep_max=keep_max
@@ -92,6 +160,8 @@ class CheckpointHook:
     def flush(self):
         """Wait for in-flight async writes; raise a deferred failure
         (unless a newer write has since succeeded and superseded it)."""
+        if self._orbax is not None:
+            self._orbax.wait()
         if self._writer is not None:
             self._writer.shutdown(wait=True)
             self._writer = None
@@ -152,6 +222,13 @@ class CheckpointHook:
         # _last_saved advances only on a SUCCESSFUL write, so a failed
         # one is retried by the next maybe_save/save_final.
         import jax
+
+        if self._orbax is not None:
+            from elasticdl_tpu.checkpoint.orbax_backend import save_state
+
+            save_state(self._orbax, state)
+            self._last_saved = version
+            return
 
         leaves = jax.device_get(named_leaves_from_state(state))
         if not self._async:

@@ -100,8 +100,21 @@ def build_worker(args, master_client=None) -> Worker:
         master_client = MasterClient(
             args.master_addr, worker_id=args.worker_id
         )
+    import jax as _jax
+
     checkpoint_hook = None
-    if getattr(args, "checkpoint_dir", "") and args.worker_id == 0:
+    # Single-host: one writer (worker 0) suffices — state is shared.
+    # Multi-host: EVERY process must hold a hook; orbax saves are
+    # coordinated writes all processes participate in (the worker calls
+    # maybe_save on the same globally-consistent versions everywhere).
+    mesh_multihost = (
+        args.distribution_strategy == DistributionStrategy.MESH
+        and _jax.process_count() > 1
+    )
+    needs_hook = getattr(args, "checkpoint_dir", "") and (
+        args.worker_id == 0 or mesh_multihost
+    )
+    if needs_hook:
         from elasticdl_tpu.checkpoint import CheckpointHook
 
         checkpoint_hook = CheckpointHook(
@@ -109,6 +122,11 @@ def build_worker(args, master_client=None) -> Worker:
             checkpoint_steps=getattr(args, "checkpoint_steps", 0),
             num_shards=getattr(args, "checkpoint_shards", 1) or 1,
             keep_max=getattr(args, "keep_checkpoint_max", 3),
+            # Mesh multi-host only: global arrays aren't addressable
+            # from one process; orbax writes shards coordinately, and
+            # the barrier aligns save versions. Non-mesh strategies keep
+            # the native per-process saver.
+            backend="orbax" if mesh_multihost else "native",
         )
     callbacks = spec.callbacks_fn() if spec.callbacks_fn else []
     from elasticdl_tpu.callbacks import set_callback_parameters
@@ -150,16 +168,11 @@ def resolve_init_checkpoint(args) -> dict:
     rolling = getattr(args, "checkpoint_dir", "")
     user_init = getattr(args, "checkpoint_dir_for_init", "")
     if rolling:
-        from elasticdl_tpu.checkpoint.saver import CheckpointSaver
+        # Backend-agnostic probe: a multi-host gang restart must find
+        # the orbax versions its previous generation wrote.
+        from elasticdl_tpu.checkpoint.hooks import has_valid_checkpoint
 
-        try:
-            has_version = (
-                CheckpointSaver(rolling).get_valid_latest_version()
-                is not None
-            )
-        except (OSError, ValueError):
-            has_version = False
-        if has_version:
+        if has_valid_checkpoint(rolling):
             return {
                 "checkpoint_dir_for_init": rolling,
                 "checkpoint_init_required": True,

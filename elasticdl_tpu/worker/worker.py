@@ -246,6 +246,11 @@ class Worker:
         dummy = multihost.zero_mask_like(self.last_batch)
         if code == multihost.STEP_TRAIN:
             self.state, _ = self._train_step(self.state, dummy)
+            # Checkpoint participation: orbax multi-host saves are
+            # coordinated writes — every process must call save at the
+            # same (globally consistent) versions, including ticks where
+            # this process only fed a dummy.
+            self._checkpoint.maybe_save(self.state)
         elif code == multihost.STEP_FORWARD:
             self._eval_step(self.state, dummy)
 
@@ -457,7 +462,17 @@ class Worker:
             trained_batches = self._task_loop()
         except WorkerStopped:
             logger.info("stop requested while idle; exiting task loop")
-        if self.state is not None and trained_batches:
+        # Multi-host: save_final is a coordinated write — EVERY process
+        # must join whenever peers do (even one that trained 0 batches:
+        # it stepped the shared state via dummy ticks). Only a stopping
+        # worker skips (peers skip their drain-era saves symmetrically:
+        # it's about to die and the gang restart resumes from the last
+        # coordinated checkpoint).
+        if (
+            self.state is not None
+            and (trained_batches or self._multihost_sync)
+            and not (self._multihost_sync and self._stop_requested)
+        ):
             self._checkpoint.save_final(self.state)
         self._timing.report_timing()
         return {
@@ -498,7 +513,13 @@ class Worker:
                     else "-", task.task_id,
                 )
                 try:
-                    if self.state is not None:
+                    # Multi-host: a final save would block waiting for
+                    # peers who aren't saving; the gang restart resumes
+                    # from the last coordinated checkpoint instead.
+                    if (
+                        self.state is not None
+                        and not self._multihost_sync
+                    ):
                         self._checkpoint.save_final(self.state)
                 except Exception as exc:
                     # A deferred write failure must not skip the task

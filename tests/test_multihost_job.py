@@ -81,6 +81,14 @@ _SCRIPT = textwrap.dedent("""
         f"localhost:{master_port}", worker_id=pid,
         connect_timeout=60, retries=5,
     )
+    # Coordinated multi-host checkpointing: EVERY process holds a hook
+    # (orbax saves are collective writes).
+    from elasticdl_tpu.checkpoint import CheckpointHook
+
+    hook = CheckpointHook(
+        checkpoint_dir=os.path.join(data_dir, "ckpt"),
+        checkpoint_steps=3, backend="orbax",
+    )
     worker = Worker(
         worker_id=pid,
         master_client=master,
@@ -88,10 +96,17 @@ _SCRIPT = textwrap.dedent("""
         data_reader=reader,
         minibatch_size=16,
         step_runner=runner,
+        checkpoint_hook=hook,
     )
     result = worker.run()
+    from elasticdl_tpu.checkpoint.orbax_backend import OrbaxSaver
+
+    ckpt_version = OrbaxSaver(
+        os.path.join(data_dir, "ckpt")
+    ).get_valid_latest_version()
     print(f"RESULT pid={pid} version={result['final_version']} "
           f"batches={result['trained_batches']} "
+          f"ckpt={ckpt_version} "
           f"loss_finite={result['final_loss'] == result['final_loss']}",
           flush=True)
     if pid == 0:
@@ -162,6 +177,8 @@ def test_two_process_job_with_eval(tmp_path):
     assert results[0]["version"] == results[1]["version"]
     assert int(results[0]["version"]) >= 1
     assert results[0]["loss_finite"] == "True"
+    # Coordinated orbax checkpoint landed (final save = final version).
+    assert results[0]["ckpt"] == results[0]["version"]
     # Both workers really pulled tasks (12 batches split between them).
     total = int(results[0]["batches"]) + int(results[1]["batches"])
     assert total == 12, results
