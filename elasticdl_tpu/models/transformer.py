@@ -107,6 +107,7 @@ class _Constrain:
 class SelfAttention(nn.Module):
     cfg: TransformerConfig
     mesh: Optional[Mesh] = None
+    decode: bool = False
 
     @nn.compact
     def __call__(self, x, training=False):
@@ -119,6 +120,8 @@ class SelfAttention(nn.Module):
         k = wsc(proj("key")(x), "dp", "sp", "tp", None)
         v = wsc(proj("value")(x), "dp", "sp", "tp", None)
         scale = cfg.head_dim ** -0.5
+        if self.decode:
+            return self._decode_step(q, k, v, scale)
         if self.mesh is not None:
             o = ring_attention(q, k, v, self.mesh, causal=True, scale=scale)
         elif jax.default_backend() == "tpu" and flash_supports(q.shape):
@@ -131,6 +134,45 @@ class SelfAttention(nn.Module):
             cfg.d_model, axis=(-2, -1), dtype=cfg.compute_dtype, name="out"
         )(o)
         return wsc(o, "dp", "sp", None)
+
+    def _decode_step(self, q, k, v, scale):
+        """KV-cache incremental decoding: one new token per call. The
+        cache holds (B, max_len, H, D) K/V buffers (static shapes — the
+        position index is the only dynamic piece, XLA-friendly), new
+        entries land via dynamic_update_slice, and attention masks out
+        positions beyond the cache fill."""
+        cfg = self.cfg
+        b, t, h, d = q.shape
+        cache_k = self.variable(
+            "cache", "k",
+            lambda: jnp.zeros((b, cfg.max_len, h, d), cfg.compute_dtype),
+        )
+        cache_v = self.variable(
+            "cache", "v",
+            lambda: jnp.zeros((b, cfg.max_len, h, d), cfg.compute_dtype),
+        )
+        cache_index = self.variable(
+            "cache", "index", lambda: jnp.zeros((), jnp.int32)
+        )
+        idx = cache_index.value
+        cache_k.value = jax.lax.dynamic_update_slice(
+            cache_k.value, k.astype(cache_k.value.dtype), (0, idx, 0, 0)
+        )
+        cache_v.value = jax.lax.dynamic_update_slice(
+            cache_v.value, v.astype(cache_v.value.dtype), (0, idx, 0, 0)
+        )
+        cache_index.value = idx + t
+        # Shared attention math with the query-position offset: causality
+        # with qpos = idx+i also masks every still-empty cache slot
+        # (those sit beyond the newest query's position).
+        o = dense_attention(
+            q, cache_k.value, cache_v.value, causal=True, scale=scale,
+            q_offset=idx,
+        )
+        return nn.DenseGeneral(
+            cfg.d_model, axis=(-2, -1), dtype=cfg.compute_dtype,
+            name="out",
+        )(o)
 
 
 class Mlp(nn.Module):
@@ -196,12 +238,15 @@ class Block(nn.Module):
     cfg: TransformerConfig
     mesh: Optional[Mesh] = None
     use_moe: bool = False
+    decode: bool = False
 
     @nn.compact
     def __call__(self, x, training=False):
         cfg = self.cfg
         h = nn.LayerNorm(dtype=cfg.compute_dtype, name="ln1")(x)
-        h = SelfAttention(cfg, self.mesh, name="attn")(h, training)
+        h = SelfAttention(
+            cfg, self.mesh, decode=self.decode, name="attn"
+        )(h, training)
         if cfg.dropout_rate and training:
             h = nn.Dropout(cfg.dropout_rate, deterministic=False)(h)
         x = x + h
@@ -220,6 +265,7 @@ class TransformerLM(nn.Module):
 
     cfg: TransformerConfig
     mesh: Optional[Mesh] = None
+    decode: bool = False
 
     @nn.compact
     def __call__(self, features, training=False):
@@ -237,22 +283,118 @@ class TransformerLM(nn.Module):
             (cfg.max_len, cfg.d_model),
             jnp.float32,
         )
-        x = x + pos[:s].astype(cfg.compute_dtype)[None]
+        if self.decode:
+            # Incremental positions continue from the cache fill.
+            pos_index = self.variable(
+                "cache", "pos_index", lambda: jnp.zeros((), jnp.int32)
+            )
+            start = pos_index.value
+            pos_slice = jax.lax.dynamic_slice(
+                pos, (start, 0), (s, cfg.d_model)
+            )
+            pos_index.value = start + s
+        else:
+            pos_slice = pos[:s]
+        x = x + pos_slice.astype(cfg.compute_dtype)[None]
         x = wsc(x, "dp", "sp", None)
         # static_argnums counts self: (2,) marks ``training`` static so
-        # dropout's Python bool branch still works under remat.
+        # dropout's Python bool branch still works under remat. Decode
+        # (inference) never remats.
         block_cls = (
-            nn.remat(Block, static_argnums=(2,)) if cfg.remat else Block
+            nn.remat(Block, static_argnums=(2,))
+            if cfg.remat and not self.decode else Block
         )
         for i in range(cfg.n_layers):
             use_moe = (
                 cfg.moe_experts > 0 and (i + 1) % cfg.moe_every == 0
             )
             x = block_cls(
-                cfg, self.mesh, use_moe=use_moe, name=f"block_{i}"
+                cfg, self.mesh, use_moe=use_moe, decode=self.decode,
+                name=f"block_{i}",
             )(x, training)
         x = nn.LayerNorm(dtype=cfg.compute_dtype, name="ln_f")(x)
         logits = nn.Dense(
             cfg.vocab_size, dtype=cfg.compute_dtype, name="lm_head"
         )(x)
         return wsc(logits.astype(jnp.float32), "dp", "sp", "tp")
+
+
+import functools as _functools
+
+
+@_functools.lru_cache(maxsize=32)
+def _generate_fn(cfg: TransformerConfig, max_new_tokens: int,
+                 temperature: float):
+    """Compiled generation driver, cached per (cfg, length, temperature)
+    so repeated generate() calls don't retrace."""
+    model = TransformerLM(cfg, mesh=None, decode=True)
+
+    def sample(logits, key):
+        if temperature == 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(
+            key, logits / temperature, axis=-1
+        ).astype(jnp.int32)
+
+    @jax.jit
+    def run(params, prompt, rng):
+        logits, aux = model.apply(
+            {"params": params}, prompt, training=False,
+            mutable=["cache"],
+        )
+        rng, key = jax.random.split(rng)
+        tok0 = sample(logits[:, -1], key)
+
+        def step(carry, _):
+            cache, tok, rng = carry
+            logits, aux = model.apply(
+                {"params": params, "cache": cache}, tok[:, None],
+                training=False, mutable=["cache"],
+            )
+            rng, key = jax.random.split(rng)
+            next_tok = sample(logits[:, -1], key)
+            return (aux["cache"], next_tok, rng), next_tok
+
+        _, toks = jax.lax.scan(
+            step, (aux["cache"], tok0, rng), None,
+            length=max_new_tokens - 1,
+        )
+        return jnp.concatenate(
+            [tok0[:, None], jnp.swapaxes(toks, 0, 1)], axis=1
+        )
+
+    return run
+
+
+def generate(
+    cfg: TransformerConfig,
+    params,
+    prompt,
+    max_new_tokens: int,
+    temperature: float = 0.0,
+    rng=None,
+):
+    """Autoregressive sampling with the KV cache: prompt prefills in one
+    pass, then one token per ``lax.scan`` step — static shapes
+    throughout (the cache is (B, max_len, H, D); the fill index is the
+    only dynamic piece). temperature 0 = greedy.
+
+    Returns (B, max_new_tokens) int32 tokens.
+    """
+    prompt = jnp.asarray(prompt, jnp.int32)
+    if max_new_tokens < 1:
+        raise ValueError("max_new_tokens must be >= 1")
+    total = prompt.shape[1] + max_new_tokens
+    if total > cfg.max_len:
+        # XLA clamps out-of-range dynamic slices silently — overflowing
+        # the cache would return corrupted tokens, not an error.
+        raise ValueError(
+            f"prompt ({prompt.shape[1]}) + max_new_tokens "
+            f"({max_new_tokens}) = {total} exceeds max_len "
+            f"{cfg.max_len}"
+        )
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    return _generate_fn(cfg, max_new_tokens, float(temperature))(
+        params, prompt, rng
+    )

@@ -26,8 +26,12 @@ from jax.sharding import Mesh, PartitionSpec as P
 _NEG_INF = -1e30
 
 
-def dense_attention(q, k, v, causal: bool = True, scale: Optional[float] = None):
-    """Plain softmax attention. Shapes: q,k,v = (B, S, H, D).
+def dense_attention(q, k, v, causal: bool = True,
+                    scale: Optional[float] = None, q_offset=0):
+    """Plain softmax attention. Shapes: q = (B, Sq, H, D), k/v =
+    (B, Sk, H, D) with Sk >= Sq allowed (KV-cache decoding: ``q_offset``
+    is q[:,0]'s global position, so causality masks the right keys —
+    including still-empty cache slots beyond the fill).
 
     Reference semantics for ``ring_attention`` (used when the mesh has no
     sequence axis, and by tests). f32 softmax accumulation regardless of
@@ -39,7 +43,7 @@ def dense_attention(q, k, v, causal: bool = True, scale: Optional[float] = None)
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
     if causal:
         q_len, k_len = q.shape[1], k.shape[1]
-        qpos = jnp.arange(q_len)[:, None]
+        qpos = q_offset + jnp.arange(q_len)[:, None]
         kpos = jnp.arange(k_len)[None, :]
         s = jnp.where(qpos >= kpos, s, _NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
