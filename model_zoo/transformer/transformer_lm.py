@@ -41,6 +41,16 @@ def custom_model(mesh=None, config: TransformerConfig = CONFIG):
     return TransformerLM(config, mesh=mesh)
 
 
+def generate_text(params, prompt_tokens, max_new_tokens,
+                  temperature=0.0, rng=None,
+                  config: TransformerConfig = CONFIG):
+    """KV-cache sampling with the trained params (greedy by default)."""
+    from elasticdl_tpu.models.transformer import generate
+
+    return generate(config, params, prompt_tokens, max_new_tokens,
+                    temperature=temperature, rng=rng)
+
+
 def param_sharding_rules():
     return transformer_sharding_rules()
 
