@@ -17,3 +17,37 @@ elasticity requires membership tracking outside the mesh.
 __version__ = "0.1.0"
 
 from elasticdl_tpu.common import constants  # noqa: F401
+
+
+def __getattr__(name):
+    """Lazy top-level API (PEP 562): the package imports fast (no jax at
+    import time) while ``elasticdl_tpu.Embedding`` etc. still resolve.
+
+    Exposed: Embedding, RaggedIds, get_model_spec, ModelSpec,
+    TrainState, MeshRunner, make_mesh, TransformerLM, TransformerConfig,
+    generate, LocalExecutor.
+    """
+    lazy = {
+        "Embedding": ("elasticdl_tpu.embedding", "Embedding"),
+        "RaggedIds": ("elasticdl_tpu.embedding.combiner", "RaggedIds"),
+        "get_model_spec": ("elasticdl_tpu.core.model_spec",
+                           "get_model_spec"),
+        "ModelSpec": ("elasticdl_tpu.core.model_spec", "ModelSpec"),
+        "TrainState": ("elasticdl_tpu.core.train_state", "TrainState"),
+        "MeshRunner": ("elasticdl_tpu.parallel.mesh_runner",
+                       "MeshRunner"),
+        "make_mesh": ("elasticdl_tpu.parallel.mesh", "make_mesh"),
+        "TransformerLM": ("elasticdl_tpu.models.transformer",
+                          "TransformerLM"),
+        "TransformerConfig": ("elasticdl_tpu.models.transformer",
+                              "TransformerConfig"),
+        "generate": ("elasticdl_tpu.models.transformer", "generate"),
+        "LocalExecutor": ("elasticdl_tpu.api.local_executor",
+                          "LocalExecutor"),
+    }
+    if name in lazy:
+        import importlib
+
+        module, attr = lazy[name]
+        return getattr(importlib.import_module(module), attr)
+    raise AttributeError(f"module 'elasticdl_tpu' has no attribute {name!r}")
