@@ -324,6 +324,213 @@ def flash_chunk_update(
     )(qoff, koff, q, k_chunk, v_chunk, m, l, acc)
 
 
+def _bwd_tile_math(q, k_blk, v_blk, do, lse, delta, q_start, k_start,
+                   block_q, block_k, causal, scale):
+    """Shared backward tile: P = exp(S−lse); dS = P∘(dO·Vᵀ−Δ).
+    Returns (ds, p) as f32 (block_q, block_k)."""
+    s = jax.lax.dot_general(
+        q, k_blk, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale
+    p = jnp.exp(s - lse)
+    if causal:
+        qpos = q_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        kpos = k_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        p = jnp.where(qpos >= kpos, p, 0.0)
+    dp = jax.lax.dot_general(
+        do, v_blk, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    ds = p * (dp - delta)
+    return ds, p
+
+
+def _dq_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+               delta_ref, dq_ref, dq_acc, *, block_k: int, causal: bool,
+               scale: float):
+    """Grid (bh, q-block, k-tile), k sequential: dq accumulates in
+    scratch while K/V tiles stream; flushed at the last tile."""
+    qi = pl.program_id(1)
+    kt = pl.program_id(2)
+    num_kt = pl.num_programs(2)
+    block_q = q_ref.shape[1]
+    q_start = qoff_ref[0] + qi * block_q
+    k_start = koff_ref[0] + kt * block_k
+
+    @pl.when(kt == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    def _compute():
+        ds, _ = _bwd_tile_math(
+            q_ref[0], k_ref[0], v_ref[0], do_ref[0], lse_ref[0],
+            delta_ref[0], q_start, k_start, block_q, block_k, causal,
+            scale,
+        )
+        dq_acc[:] += jax.lax.dot_general(
+            ds.astype(k_ref.dtype), k_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+
+    if causal:
+        pl.when(q_start + block_q - 1 >= k_start)(_compute)
+    else:
+        _compute()
+
+    @pl.when(kt == num_kt - 1)
+    def _flush():
+        dq_ref[0] = dq_acc[:]
+
+
+def _dkv_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref, do_ref,
+                lse_ref, delta_ref, dk_ref, dv_ref, dk_acc, dv_acc, *,
+                block_q: int, causal: bool, scale: float):
+    """Grid (bh, k-block, q-tile), q sequential: dK/dV accumulate in
+    scratch while Q/dO/lse/Δ tiles stream; flushed at the last tile."""
+    ki = pl.program_id(1)
+    qt = pl.program_id(2)
+    num_qt = pl.num_programs(2)
+    block_k = k_ref.shape[1]
+    q_start = qoff_ref[0] + qt * block_q
+    k_start = koff_ref[0] + ki * block_k
+
+    @pl.when(qt == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    def _compute():
+        ds, p = _bwd_tile_math(
+            q_ref[0], k_ref[0], v_ref[0], do_ref[0], lse_ref[0],
+            delta_ref[0], q_start, k_start, block_q, block_k, causal,
+            scale,
+        )
+        dk_acc[:] += jax.lax.dot_general(
+            ds.astype(q_ref.dtype), q_ref[0], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        dv_acc[:] += jax.lax.dot_general(
+            p.astype(do_ref.dtype), do_ref[0], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    if causal:
+        pl.when(q_start + block_q - 1 >= k_start)(_compute)
+    else:
+        _compute()
+
+    @pl.when(qt == num_qt - 1)
+    def _flush():
+        dk_ref[0] = dk_acc[:]
+        dv_ref[0] = dv_acc[:]
+
+
+def flash_chunk_grads(
+    q, k_chunk, v_chunk, do, lse, delta, q_offset, k_offset,
+    causal: bool = True, scale: Optional[float] = None,
+    block_q: int = DEFAULT_BLOCK_Q, block_k: int = DEFAULT_BLOCK_K,
+    interpret: bool = False,
+):
+    """Backward of one attention chunk pairing, fully tiled.
+
+    q/do: (BH, Sq, D); k_chunk/v_chunk: (BH, Sk, D); lse/delta:
+    (BH, Sq, 1) f32. Returns (dq_partial, dk_chunk, dv_chunk) — f32,
+    the ring accumulates dq over chunks and rotates dk/dv home. Two
+    kernels (dq: k-sequential; dk/dv: q-sequential) so each output has
+    exactly one sequential accumulation dim; score tiles never leave
+    VMEM.
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    bh, sq, d = q.shape
+    sk = k_chunk.shape[1]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    if sq % block_q or sk % block_k:
+        raise ValueError(
+            f"flash_chunk_grads: shapes (Sq={sq}, Sk={sk}) must tile by "
+            f"blocks ({block_q}, {block_k})"
+        )
+    qoff = jnp.asarray(q_offset, jnp.int32).reshape((1,))
+    koff = jnp.asarray(k_offset, jnp.int32).reshape((1,))
+    common = dict(causal=causal, scale=float(scale))
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, block_k=block_k, **common),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(bh, sq // block_q, sk // block_k),
+            in_specs=[
+                pl.BlockSpec((1, block_q, d),
+                             lambda b, i, j, *_: (b, i, 0)),
+                pl.BlockSpec((1, block_k, d),
+                             lambda b, i, j, *_: (b, j, 0)),
+                pl.BlockSpec((1, block_k, d),
+                             lambda b, i, j, *_: (b, j, 0)),
+                pl.BlockSpec((1, block_q, d),
+                             lambda b, i, j, *_: (b, i, 0)),
+                pl.BlockSpec((1, block_q, 1),
+                             lambda b, i, j, *_: (b, i, 0)),
+                pl.BlockSpec((1, block_q, 1),
+                             lambda b, i, j, *_: (b, i, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, block_q, d),
+                                   lambda b, i, j, *_: (b, i, 0)),
+            scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        ),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(qoff, koff, q, k_chunk, v_chunk, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, block_q=block_q, **common),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(bh, sk // block_k, sq // block_q),
+            in_specs=[
+                pl.BlockSpec((1, block_q, d),
+                             lambda b, i, j, *_: (b, j, 0)),
+                pl.BlockSpec((1, block_k, d),
+                             lambda b, i, j, *_: (b, i, 0)),
+                pl.BlockSpec((1, block_k, d),
+                             lambda b, i, j, *_: (b, i, 0)),
+                pl.BlockSpec((1, block_q, d),
+                             lambda b, i, j, *_: (b, j, 0)),
+                pl.BlockSpec((1, block_q, 1),
+                             lambda b, i, j, *_: (b, j, 0)),
+                pl.BlockSpec((1, block_q, 1),
+                             lambda b, i, j, *_: (b, j, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, block_k, d),
+                             lambda b, i, j, *_: (b, i, 0)),
+                pl.BlockSpec((1, block_k, d),
+                             lambda b, i, j, *_: (b, i, 0)),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((block_k, d), jnp.float32),
+                pltpu.VMEM((block_k, d), jnp.float32),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sk, d), jnp.float32),
+            jax.ShapeDtypeStruct((bh, sk, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(qoff, koff, q, k_chunk, v_chunk, do, lse, delta)
+    return dq, dk, dv
+
+
 def supports(q_shape, block_q: int = DEFAULT_BLOCK_Q,
              block_k: int = DEFAULT_BLOCK_K) -> bool:
     """Static shape gate: S must tile evenly by the (clamped) blocks and

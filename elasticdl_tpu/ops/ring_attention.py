@@ -26,6 +26,18 @@ from jax.sharding import Mesh, PartitionSpec as P
 _NEG_INF = -1e30
 
 
+def _to_bh(x):
+    """(B, S, H, D) -> (B*H, S, D) — the layout the Pallas kernels use."""
+    b, s, h, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+
+
+def _from_bh(x, b, h):
+    """(B*H, S, D) -> (B, S, H, D)."""
+    bh, s, d = x.shape
+    return x.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+
+
 def dense_attention(q, k, v, causal: bool = True,
                     scale: Optional[float] = None, q_offset=0):
     """Plain softmax attention. Shapes: q = (B, Sq, H, D), k/v =
@@ -75,7 +87,8 @@ def _block_update(carry, q, k, v, qpos, kpos, causal, scale):
     return m_new, l, o
 
 
-def _ring_attention_local(q, k, v, axis_name: str, causal: bool, scale):
+def _ring_attention_local(q, k, v, axis_name: str, causal: bool, scale,
+                          return_lse: bool = False):
     """Per-device body under shard_map: q stays, k/v rotate the ring."""
     n = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
@@ -102,7 +115,38 @@ def _ring_attention_local(q, k, v, axis_name: str, causal: bool, scale):
     )
     l = jnp.maximum(l, 1e-30)  # fully-masked rows (none in causal LM) stay 0
     out = o / l.transpose(0, 2, 1)[..., None]
+    if return_lse:
+        lse = (m + jnp.log(l))[..., None]        # (b, h, s, 1)
+        return out.astype(q.dtype), lse
     return out.astype(q.dtype)
+
+
+def _make_ring_local_jnp(axis_name: str, causal: bool, scale):
+    """jnp ring forward + the fused ring backward (shared with the
+    Pallas path's math, jnp flavor): one reverse ring from the saved
+    logsumexp instead of AD re-walking the forward scan."""
+
+    @jax.custom_vjp
+    def ring(q, k, v):
+        return _ring_attention_local(
+            q, k, v, axis_name=axis_name, causal=causal, scale=scale
+        )
+
+    def fwd(q, k, v):
+        out, lse = _ring_attention_local(
+            q, k, v, axis_name=axis_name, causal=causal, scale=scale,
+            return_lse=True,
+        )
+        return out, (q, k, v, out, lse)
+
+    def bwd(res, g):
+        q, k, v, out, lse = res
+        return _ring_local_bwd(
+            q, k, v, out, lse, g, axis_name, causal, scale
+        )
+
+    ring.defvjp(fwd, bwd)
+    return ring
 
 
 def _ring_local_pallas_fwd(q, k, v, axis_name: str, causal: bool,
@@ -119,10 +163,7 @@ def _ring_local_pallas_fwd(q, k, v, axis_name: str, causal: bool,
     n = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     b, s_loc, h, d = q.shape
-
-    def to_bh(x):
-        return x.transpose(0, 2, 1, 3).reshape(b * h, s_loc, d)
-
+    to_bh = _to_bh
     qb = to_bh(q)
     m0 = jnp.full((b * h, s_loc, 1), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((b * h, s_loc, 1), jnp.float32)
@@ -149,6 +190,59 @@ def _ring_local_pallas_fwd(q, k, v, axis_name: str, causal: bool,
     out = out.reshape(b, h, s_loc, d).transpose(0, 2, 1, 3)
     lse = (m + jnp.log(l_safe)).reshape(b, h, s_loc, 1)
     return out.astype(q.dtype), lse
+
+
+def _ring_local_bwd_pallas(q, k, v, o, lse, do, axis_name: str,
+                           causal: bool, scale, interpret: bool):
+    """Fused ring backward with the per-chunk Pallas kernels
+    (flash_chunk_grads): score tiles never leave VMEM. Same rotation
+    schedule as the jnp version."""
+    from elasticdl_tpu.ops.flash_attention import flash_chunk_grads
+
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    b, s_loc, h, d = q.shape
+    to_bh = _to_bh
+    from_bh = lambda x: _from_bh(x, b, h)
+    qb, dob = to_bh(q), to_bh(do)
+    ob = to_bh(o)
+    lse_b = lse.reshape(b * h, s_loc, 1)
+    delta = (
+        dob.astype(jnp.float32) * ob.astype(jnp.float32)
+    ).sum(axis=-1, keepdims=True)
+    q_off = idx * s_loc
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, t):
+        dq, kc, vc, dkc, dvc = carry
+        kc, vc = jax.lax.cond(
+            t > 0,
+            lambda kv: (
+                jax.lax.ppermute(kv[0], axis_name, perm),
+                jax.lax.ppermute(kv[1], axis_name, perm),
+            ),
+            lambda kv: kv,
+            (kc, vc),
+        )
+        k_off = ((idx - t) % n) * s_loc
+        dq_p, dk_c, dv_c = flash_chunk_grads(
+            qb, to_bh(kc), to_bh(vc), dob, lse_b, delta, q_off, k_off,
+            causal=causal, scale=scale, interpret=interpret,
+        )
+        dq = dq + dq_p
+        dkc = jax.lax.ppermute(dkc + dk_c, axis_name, perm)
+        dvc = jax.lax.ppermute(dvc + dv_c, axis_name, perm)
+        return (dq, kc, vc, dkc, dvc), None
+
+    zeros = jnp.zeros((b * h, s_loc, d), jnp.float32)
+    (dq, _, _, dk, dv), _ = jax.lax.scan(
+        step, (zeros, k, v, zeros, zeros), jnp.arange(n)
+    )
+    return (
+        from_bh(dq).astype(q.dtype),
+        from_bh(dk).astype(k.dtype),
+        from_bh(dv).astype(v.dtype),
+    )
 
 
 def _ring_local_bwd(q, k, v, o, lse, do, axis_name: str, causal: bool,
@@ -234,8 +328,8 @@ def _make_ring_local_pallas(axis_name: str, causal: bool, scale,
 
     def bwd(res, g):
         q, k, v, out, lse = res
-        return _ring_local_bwd(
-            q, k, v, out, lse, g, axis_name, causal, scale
+        return _ring_local_bwd_pallas(
+            q, k, v, out, lse, g, axis_name, causal, scale, interpret
         )
 
     ring.defvjp(fwd, bwd)
@@ -302,10 +396,7 @@ def ring_attention(
             sp_axis, causal, float(scale), interpret
         )
     else:
-        body = partial(
-            _ring_attention_local, axis_name=sp_axis, causal=causal,
-            scale=scale,
-        )
+        body = _make_ring_local_jnp(sp_axis, causal, float(scale))
     spec = P(usable(dp_axis, b), sp_axis, usable(tp_axis, h), None)
     return jax.shard_map(
         body,
