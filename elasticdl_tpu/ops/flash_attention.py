@@ -28,8 +28,12 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 _NEG_INF = -1e30
-DEFAULT_BLOCK_Q = 128
-DEFAULT_BLOCK_K = 128
+# Measured on v5e (bf16, D=64, S=512..4096): 512-blocks are 10-27x
+# faster than 128-blocks (per-grid-step overhead dominates small tiles
+# on this backend) and beat the dense path at every size; VMEM per step
+# stays ~1MB at D=128. Blocks clamp to S for short sequences.
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 512
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, l_ref, m_acc, l_acc, o_acc,

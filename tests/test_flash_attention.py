@@ -85,8 +85,9 @@ def test_flash_bf16_inputs():
 def test_supports_gate():
     assert supports((2, 256, 4, 16))
     assert supports((2, 32, 4, 16))      # small aligned S: blocks clamp
+    assert supports((2, 200, 4, 16))     # <= one clamped block
     assert not supports((2, 100, 4, 16))  # not sublane-aligned
-    assert not supports((2, 200, 4, 16))  # doesn't tile by 128
+    assert not supports((2, 520, 4, 16))  # doesn't tile by the block
 
 
 def test_unaligned_seq_raises():
