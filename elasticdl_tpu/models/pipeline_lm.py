@@ -1,0 +1,189 @@
+"""Pipeline-parallel transformer LM.
+
+Integrates GPipe pipelining (parallel/pipeline.py) into a real model:
+embedding / final norm / head live replicated, while the block stack is
+STAGE-STACKED — one leading dim of size ``pp`` sharded over the pipeline
+axis, each stage holding ``layers_per_stage`` inner blocks it scans over
+locally. Microbatches rotate stage-to-stage with ``ppermute`` inside the
+compiled step; dp composes on the microbatch dim.
+
+Not a flax Module at the top: the pipeline needs stage-stacked params
+(leading dim = pp) which flax's per-layer naming would scatter, so this
+is a small init/apply pair over an explicit param pytree, built from
+flax submodules (the same ``Block`` the flagship uses).
+"""
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from elasticdl_tpu.models.transformer import Block, TransformerConfig
+from elasticdl_tpu.parallel.pipeline import (
+    microbatch,
+    pipeline_apply,
+    unmicrobatch,
+)
+
+
+class _EmbedHead(nn.Module):
+    """The replicated ends of the network (token+pos embed, final norm,
+    lm head) as one flax module so their params init/apply normally."""
+
+    cfg: TransformerConfig
+
+    def setup(self):
+        cfg = self.cfg
+        self.token_embed = nn.Embed(
+            cfg.vocab_size, cfg.d_model, dtype=cfg.compute_dtype
+        )
+        self.pos_embed = self.param(
+            "pos_embed", nn.initializers.normal(0.02),
+            (cfg.max_len, cfg.d_model), jnp.float32,
+        )
+        self.ln_f = nn.LayerNorm(dtype=cfg.compute_dtype)
+        self.lm_head = nn.Dense(cfg.vocab_size, dtype=cfg.compute_dtype)
+
+    def embed(self, tokens):
+        x = self.token_embed(tokens.astype(jnp.int32))
+        s = tokens.shape[1]
+        return x + self.pos_embed[:s].astype(self.cfg.compute_dtype)[None]
+
+    def head(self, x):
+        return self.lm_head(self.ln_f(x)).astype(jnp.float32)
+
+    def __call__(self, tokens):  # init-only path
+        return self.head(self.embed(tokens))
+
+
+class PipelineLM:
+    """``init(rng, tokens) -> params`` / ``apply(params, tokens)`` with
+    the block stack pipelined over ``pp_axis``.
+
+    n_layers = pp_size * layers_per_stage; batch must be divisible by
+    num_microbatches (and the microbatch by the dp axis).
+    """
+
+    def __init__(
+        self,
+        cfg: TransformerConfig,
+        mesh: Mesh,
+        num_microbatches: int = 4,
+        layers_per_stage: int = 1,
+        pp_axis: str = "pp",
+        dp_axis: Optional[str] = "dp",
+    ):
+        self.cfg = cfg
+        self.mesh = mesh
+        self.num_microbatches = num_microbatches
+        self.layers_per_stage = layers_per_stage
+        self.pp_axis = pp_axis
+        self.dp_axis = dp_axis if (dp_axis in mesh.axis_names) else None
+        self.pp_size = mesh.shape[pp_axis]
+        if cfg.n_layers != self.pp_size * layers_per_stage:
+            raise ValueError(
+                f"cfg.n_layers ({cfg.n_layers}) must equal pp_size "
+                f"({self.pp_size}) * layers_per_stage "
+                f"({layers_per_stage}) — the stage stack IS the depth"
+            )
+        if cfg.dropout_rate:
+            raise NotImplementedError(
+                "dropout under pipelining needs per-stage rng "
+                "threading; set dropout_rate=0 for PipelineLM"
+            )
+        self.ends = _EmbedHead(cfg)
+        self.block = Block(cfg, mesh=None)
+
+    # ---- params --------------------------------------------------------
+
+    def init(self, rng, tokens):
+        ends_rng, blocks_rng = jax.random.split(rng)
+        ends = self.ends.init(ends_rng, tokens)["params"]
+        x0 = self.ends.apply(
+            {"params": ends}, tokens, method=self.ends.embed
+        )
+        mb = x0[: max(tokens.shape[0] // self.num_microbatches, 1)]
+
+        def init_block(r):
+            return self.block.init(r, mb, training=False)["params"]
+
+        def init_stage(r):
+            return jax.vmap(init_block)(
+                jax.random.split(r, self.layers_per_stage)
+            )
+
+        blocks = jax.vmap(init_stage)(
+            jax.random.split(blocks_rng, self.pp_size)
+        )
+        return {"ends": ends, "blocks": blocks}
+
+    def param_shardings(self, params):
+        """Blocks shard their stage dim over pp; ends replicate."""
+        rep = NamedSharding(self.mesh, P())
+        pp = self.pp_axis
+
+        def block_leaf(leaf):
+            return NamedSharding(
+                self.mesh, P(pp, *([None] * (leaf.ndim - 1)))
+            )
+
+        return {
+            "ends": jax.tree.map(lambda _: rep, params["ends"]),
+            "blocks": jax.tree.map(block_leaf, params["blocks"]),
+        }
+
+    # ---- forward -------------------------------------------------------
+
+    def apply(self, params, tokens, training=False):
+        x = self.ends.apply(
+            {"params": params["ends"]}, tokens, method=self.ends.embed
+        )
+        x_micro = microbatch(x, self.num_microbatches)
+
+        def stage_fn(stage_params, act):
+            # pipeline_apply already stripped the stage dim: leaves are
+            # (layers_per_stage, ...) — scan the inner layers.
+            def body(a, layer_params):
+                return self.block.apply(
+                    {"params": layer_params}, a, training=training
+                ), None
+
+            act, _ = jax.lax.scan(body, act, stage_params)
+            return act
+
+        # pipeline_apply slices the leading stage dim itself, so hand it
+        # params with that dim intact (leaves (pp, L, ...)).
+        y = pipeline_apply(
+            stage_fn,
+            params["blocks"],
+            x_micro,
+            self.mesh,
+            axis=self.pp_axis,
+            x_spec=P(None, self.dp_axis, None, None),
+        )
+        x = unmicrobatch(y)
+        return self.ends.apply(
+            {"params": params["ends"]}, x, method=self.ends.head
+        )
+
+    # ---- training ------------------------------------------------------
+
+    def make_train_step(self, loss_fn, tx: optax.GradientTransformation):
+        """(params, opt_state, batch) -> (params, opt_state, loss),
+        jitted with the pipeline placement pinned."""
+
+        def train_step(params, opt_state, batch):
+            def compute(params):
+                logits = self.apply(
+                    params, batch["features"], training=True
+                )
+                return loss_fn(batch["labels"], logits, batch["mask"])
+
+            loss, grads = jax.value_and_grad(compute)(params)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        return jax.jit(train_step, donate_argnums=(0, 1))

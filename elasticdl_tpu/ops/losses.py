@@ -42,3 +42,19 @@ def masked_sigmoid_cross_entropy(labels, logits, mask):
         jnp.maximum(x, 0.0) - x * z + jnp.log1p(jnp.exp(-jnp.abs(x)))
     )
     return masked_mean(per_example, mask)
+
+
+def masked_next_token_cross_entropy(labels, logits, mask):
+    """Per-token LM cross entropy: labels (B, S) int, logits (B, S, V),
+    ``mask`` the (B,) padded-row mask broadcast over tokens. Same
+    log-softmax formulation as masked_softmax_cross_entropy (stable
+    under the TPU fast-math rewrite)."""
+    import jax
+    import jax.numpy as jnp
+
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    ll = jnp.take_along_axis(
+        logp, labels[..., None].astype(jnp.int32), axis=-1
+    )[..., 0]
+    weights = jnp.broadcast_to(mask[:, None], ll.shape)
+    return -jnp.sum(ll * weights) / jnp.maximum(jnp.sum(weights), 1.0)

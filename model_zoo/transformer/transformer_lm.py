@@ -66,12 +66,9 @@ def batch_sharding_rule(path, leaf):
 def loss(labels, predictions, mask):
     """Per-token next-token cross entropy; ``mask`` is the (B,) padded-row
     mask from the batcher, broadcast over the token dim."""
-    logp = jax.nn.log_softmax(predictions.astype(jnp.float32), axis=-1)
-    ll = jnp.take_along_axis(
-        logp, labels[..., None].astype(jnp.int32), axis=-1
-    )[..., 0]
-    weights = jnp.broadcast_to(mask[:, None], ll.shape)
-    return -jnp.sum(ll * weights) / jnp.maximum(jnp.sum(weights), 1.0)
+    from elasticdl_tpu.ops import masked_next_token_cross_entropy
+
+    return masked_next_token_cross_entropy(labels, predictions, mask)
 
 
 def optimizer(lr=1e-3):

@@ -1,0 +1,85 @@
+"""Pipeline-parallel transformer LM: matches the sequential flagship and
+trains on a (pp, dp) mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import PartitionSpec as P
+
+from elasticdl_tpu.models.pipeline_lm import PipelineLM
+from elasticdl_tpu.models.transformer import TransformerConfig
+from elasticdl_tpu.parallel.mesh import make_mesh
+
+CFG = TransformerConfig(
+    vocab_size=32, d_model=32, n_heads=4, n_layers=4, d_ff=64,
+    max_len=32, compute_dtype=jnp.float32,
+)
+
+
+def _batch(seed=0, b=16, s=16):
+    r = np.random.RandomState(seed)
+    start = r.randint(0, 32, (b, 1))
+    seq = (start + np.arange(s + 1)[None, :]) % 32
+    return {
+        "features": seq[:, :-1].astype(np.int32),
+        "labels": seq[:, 1:].astype(np.int32),
+        "mask": np.ones((b,), np.float32),
+    }
+
+
+from elasticdl_tpu.ops import masked_next_token_cross_entropy as _loss
+
+
+def test_pipelined_forward_matches_sequential():
+    """2 stages x 2 layers == the same 4 blocks applied sequentially."""
+    mesh = make_mesh((2, 2), ("pp", "dp"), devices=jax.devices()[:4])
+    lm = PipelineLM(CFG, mesh, num_microbatches=4, layers_per_stage=2)
+    batch = _batch()
+    params = lm.init(jax.random.PRNGKey(0), batch["features"])
+    params = jax.device_put(params, lm.param_shardings(params))
+    got = lm.apply(params, batch["features"])
+
+    # Sequential reference: same params, plain loop.
+    x = lm.ends.apply(
+        {"params": params["ends"]}, batch["features"],
+        method=lm.ends.embed,
+    )
+    blocks_host = jax.device_get(params["blocks"])
+    for stage in range(2):
+        for layer in range(2):
+            layer_params = jax.tree.map(
+                lambda p: p[stage][layer], blocks_host
+            )
+            x = lm.block.apply({"params": layer_params}, x,
+                               training=False)
+    want = lm.ends.apply(
+        {"params": params["ends"]}, x, method=lm.ends.head
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_pipelined_training_learns():
+    mesh = make_mesh((2, 4), ("pp", "dp"), devices=jax.devices()[:8])
+    lm = PipelineLM(CFG, mesh, num_microbatches=4, layers_per_stage=2)
+    batch = _batch()
+    params = lm.init(jax.random.PRNGKey(0), batch["features"])
+    shardings = lm.param_shardings(params)
+    params = jax.device_put(params, shardings)
+    # Stage params really live sharded over pp.
+    leaf = jax.tree.leaves(params["blocks"])[0]
+    assert leaf.sharding.spec[0] == "pp"
+
+    tx = optax.adam(1e-2)
+    opt_state = tx.init(params)
+    step = lm.make_train_step(_loss, tx)
+    first = last = None
+    for i in range(25):
+        params, opt_state, loss = step(params, opt_state,
+                                       _batch(seed=i % 4))
+        if first is None:
+            first = float(loss)
+    last = float(loss)
+    assert np.isfinite(last)
+    assert last < first * 0.5, (first, last)
