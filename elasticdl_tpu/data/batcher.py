@@ -7,7 +7,7 @@ float ``mask`` (1.0 = real row, 0.0 = padding) that the loss and metrics
 weight by. Padding replicates row 0 so dtypes/shapes are trivially right.
 """
 
-from typing import Any, Callable, Dict, Iterator, List, Optional
+from typing import Any, Callable, Dict, Iterator, List
 
 import numpy as np
 

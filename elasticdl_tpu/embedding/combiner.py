@@ -8,7 +8,7 @@ with per-slot weights; weight 0 marks padding. Empty rows combine to the
 zero vector (the reference's ``safe_`` default-row behavior).
 """
 
-from typing import List, Optional, Sequence
+from typing import Optional, Sequence
 
 import jax.numpy as jnp
 import numpy as np

@@ -16,7 +16,7 @@ Here the update math is pure array code, so the same functions serve
   (apply → write back, mirroring OptimizerWrapper.apply_gradients).
 """
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Dict, Tuple
 
 import jax.numpy as jnp

@@ -14,7 +14,7 @@ shard produces identical values — the reference instead relied on the PS
 pod surviving; we cannot (SURVEY.md §7 stage 5).
 """
 
-from typing import Dict, Iterable, Optional
+from typing import Dict, Iterable
 
 import numpy as np
 

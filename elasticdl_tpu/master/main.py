@@ -20,7 +20,6 @@ from elasticdl_tpu.common.args import (
     parse_envs,
     parse_master_args,
 )
-from elasticdl_tpu.common.constants import TaskType
 from elasticdl_tpu.common.log_utils import get_logger
 from elasticdl_tpu.comm.rpc import RpcServer
 from elasticdl_tpu.core.model_spec import get_model_spec

@@ -11,7 +11,7 @@ testing/ calls them directly, the RpcServer serves them over gRPC.
 
 import threading
 import time
-from typing import Dict, Optional
+from typing import Dict
 
 from elasticdl_tpu.common.constants import TaskType
 from elasticdl_tpu.common.log_utils import get_logger

@@ -20,7 +20,7 @@ path → PartitionSpec pairs consumed by ``parallel/rules.py``; the same
 module runs unsharded on one chip (mesh=None) for the single-chip entry.
 """
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Optional, Tuple
 
 import flax.linen as nn

@@ -16,7 +16,6 @@ The public entry ``ring_attention`` wraps the per-device body in
 single-device reference used by small models and by the tests.
 """
 
-from functools import partial
 from typing import Optional
 
 import jax

@@ -21,7 +21,6 @@ Sync semantics map (SURVEY.md §2.7):
   observes (and eval-triggers on) every N-th version.
 """
 
-from functools import partial
 from typing import Callable, Optional
 
 import jax
