@@ -45,6 +45,7 @@ class TransformerConfig:
     max_len: int = 512
     dropout_rate: float = 0.0
     moe_experts: int = 0        # 0 = dense MLP in every block
+    moe_top_k: int = 1          # experts combined per token (renormed)
     moe_every: int = 2          # MoE replaces the MLP in every k-th block
     # Rematerialize each block on backward (jax.checkpoint): trades
     # ~1/3 more FLOPs for O(n_layers) less activation HBM — the lever
@@ -211,8 +212,20 @@ class MoE(nn.Module):
             x.astype(jnp.float32)
         )
         gates = jax.nn.softmax(gates, axis=-1)            # (B,S,E)
-        top1 = jnp.argmax(gates, axis=-1)
-        combine = jax.nn.one_hot(top1, e, dtype=gates.dtype) * gates
+        # Top-k routing. k=1 is the classic switch: the RAW gate value
+        # weights the expert (renormalizing to 1 would kill the router's
+        # gradient). k>1 renormalizes the kept gates to sum to 1
+        # (gradients flow through the relative weights).
+        k = min(cfg.moe_top_k, e)
+        top_vals, top_idx = jax.lax.top_k(gates, k)
+        if k > 1:
+            top_vals = top_vals / jnp.maximum(
+                top_vals.sum(axis=-1, keepdims=True), 1e-9
+            )
+        combine = (
+            jax.nn.one_hot(top_idx, e, dtype=gates.dtype)
+            * top_vals[..., None]
+        ).sum(axis=-2)                                     # (B,S,E)
         combine = wsc(combine, "dp", "sp", "ep")
 
         wi = self.param(
