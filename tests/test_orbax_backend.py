@@ -124,3 +124,34 @@ def test_restore_missing_raises(tmp_path):
     saver = OrbaxSaver(str(tmp_path))
     with pytest.raises(FileNotFoundError):
         saver.restore_tree({})
+
+
+def test_restore_from_dir_detects_orbax_backend(tmp_path):
+    """The generic restore entry routes to orbax when the dir holds
+    orbax versions (the path a gang-restarted worker takes), and honors
+    required=False over a dir with only torn tmp writes."""
+    from elasticdl_tpu.checkpoint import restore_from_dir
+
+    mesh = make_mesh((2, 2, 2), ("dp", "sp", "tp"),
+                     devices=jax.devices()[:8])
+    _, state = _mesh_state(mesh)
+    state = state.replace(step=state.step + 5)
+    save_state(OrbaxSaver(str(tmp_path)), state)
+    OrbaxSaver(str(tmp_path)).wait()
+
+    _, fresh = _mesh_state(mesh)
+    restored = restore_from_dir(fresh, str(tmp_path))
+    assert int(restored.step) == 5
+    np.testing.assert_array_equal(
+        np.asarray(restored.params["block_0"]["mlp"]["wi"]["kernel"]),
+        np.asarray(state.params["block_0"]["mlp"]["wi"]["kernel"]),
+    )
+
+    # Torn first write only: orbax tmp dir name must not be mistaken
+    # for a finalized version; required=False starts fresh.
+    torn = tmp_path / "torn"
+    torn.mkdir()
+    (torn / "orbax-3.orbax-checkpoint-tmp-123").mkdir()
+    _, fresh2 = _mesh_state(mesh)
+    out = restore_from_dir(fresh2, str(torn), required=False)
+    assert int(out.step) == 0  # started fresh, no crash
