@@ -11,17 +11,20 @@ Prints ONE JSON line {"metric","value","unit","vs_baseline"}. The
 reference publishes no numbers (BASELINE.md), so the regression floor is
 this repo's own first TPU run, recorded in BENCH_FLOOR.json; until that
 file exists vs_baseline is 1.0 and the floor is written on a TPU run.
+
+The measurement harness lives in benchlib.py (shared with the breadth
+suite bench_suite.py).
 """
 
 import json
 import os
-import time
 
 import numpy as np
 
+from benchlib import load_json, make_mnist_batch, measure_multi_step
+
 BATCH = 512
 STEPS_PER_TASK = 16   # reference num_minibatches_per_task granularity
-WARMUP_TASKS = 2
 MEASURE_TASKS = 4
 MEASURE_ROUNDS = 5    # median over rounds (tunnel throughput varies)
 FLOOR_FILE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -32,8 +35,7 @@ def main():
     import jax
 
     from elasticdl_tpu.core.model_spec import get_model_spec
-    from elasticdl_tpu.core.step import build_multi_step, stack_batches
-    from elasticdl_tpu.core.train_state import init_train_state
+    from elasticdl_tpu.core.step import stack_batches
     from elasticdl_tpu.testing.data import model_zoo_dir
 
     platform = jax.devices()[0].platform
@@ -41,67 +43,19 @@ def main():
         model_zoo_dir(), "mnist.mnist_functional.custom_model"
     )
     rng = np.random.RandomState(0)
-
-    def make_batch():
-        # Learnable label-correlated pixels (same scheme as
-        # testing.data.create_mnist_record_file) so the measured steps
-        # are healthy training, not divergence to inf/nan.
-        labels = rng.randint(0, 10, BATCH).astype(np.int32)
-        images = rng.rand(BATCH, 28 * 28).astype(np.float32) * 0.125
-        block = (28 * 28) // 10
-        for i, label in enumerate(labels):
-            images[i, label * block:(label + 1) * block] += 0.75
-        return {
-            "features": images.reshape(BATCH, 28, 28),
-            "labels": labels,
-            "mask": np.ones((BATCH,), np.float32),
-        }
-
     task = jax.device_put(
-        stack_batches([make_batch() for _ in range(STEPS_PER_TASK)])
+        stack_batches(
+            [make_mnist_batch(BATCH, rng) for _ in range(STEPS_PER_TASK)]
+        )
     )
-    state = init_train_state(
-        spec.model, spec.make_optimizer(),
-        jax.tree.map(lambda x: x[0], task), seed=0,
+    examples_per_sec = measure_multi_step(
+        spec, task, BATCH, STEPS_PER_TASK, MEASURE_TASKS,
+        measure_rounds=MEASURE_ROUNDS,
     )
-    multi_step = build_multi_step(spec.loss)
 
-    def sync(metrics):
-        # Host transfer of the last step's loss: a hard sync even where
-        # block_until_ready returns early (tunnel'd device backends).
-        return float(np.asarray(metrics["loss"][-1]))
-
-    for _ in range(WARMUP_TASKS):
-        state, metrics = multi_step(state, task)
-    sync(metrics)
-
-    # Median of repeated rounds: the device tunnel's throughput varies
-    # run to run, and a single window makes vs_baseline noise.
-    rounds = []
-    final_loss = 0.0
-    for _ in range(MEASURE_ROUNDS):
-        start = time.perf_counter()
-        for _ in range(MEASURE_TASKS):
-            state, metrics = multi_step(state, task)
-        final_loss = sync(metrics)
-        rounds.append(time.perf_counter() - start)
-    elapsed = float(np.median(rounds))
-    assert np.isfinite(final_loss), f"bench diverged: loss={final_loss}"
-
-    examples_per_sec = (
-        BATCH * STEPS_PER_TASK * MEASURE_TASKS / elapsed
-    )
-    vs_baseline = 1.0
-    floor = None
-    if os.path.exists(FLOOR_FILE):
-        try:
-            with open(FLOOR_FILE) as f:
-                floor = json.load(f).get("examples_per_sec")
-        except Exception:
-            floor = None
-    if floor:
-        vs_baseline = examples_per_sec / floor
-    elif platform != "cpu":
+    floor = load_json(FLOOR_FILE, {}).get("examples_per_sec")
+    vs_baseline = examples_per_sec / floor if floor else 1.0
+    if not floor and platform != "cpu":
         with open(FLOOR_FILE, "w") as f:
             json.dump(
                 {"examples_per_sec": examples_per_sec,

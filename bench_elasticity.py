@@ -1,0 +1,191 @@
+"""Job throughput under worker preemption (BASELINE.md target #6).
+
+The reference's headline capability is elasticity: a killed worker pod must
+not sink the job, only its in-flight tasks (re-queued by the master,
+``k8s_instance_manager.py:278`` -> ``task_dispatcher.py:352-364``). Here the
+same contract is mesh-native: recovery = sharded checkpoint + task re-queue
+(SURVEY.md §7 stage 5) because there is no PS process to survive.
+
+Measures, in-process (the reference benches this path on minikube pods;
+the framework logic is identical either way):
+
+  A. baseline: one worker drains an mnist job of R records      -> rec/sec
+  B. preempt:  same job, worker killed mid-task at ~50% (its
+     in-flight task is left in `doing` and re-queued by the
+     master); a replacement worker restores from the sharded
+     checkpoint, retrains the re-queued task, drains the rest    -> rec/sec
+  recovery_seconds: replacement construction + checkpoint restore +
+     first completed task (the downtime added by the kill, measured
+     to the replacement's first report_task_result).
+
+Prints one JSON line per metric; throughput_retention = B/A (1.0 means the
+kill cost nothing beyond the re-run of re-queued minibatches).
+"""
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+TOTAL_RECORDS = 8192
+MINIBATCH = 64
+MINIBATCHES_PER_TASK = 8
+CHECKPOINT_STEPS = 16
+REPS = 2
+
+
+class _Preempted(RuntimeError):
+    pass
+
+
+def _make_cluster(train, ckpt_dir, kill_after_tasks=None):
+    from elasticdl_tpu.testing.cluster import MiniCluster
+    from elasticdl_tpu.testing.data import model_zoo_dir
+
+    callbacks = None
+    if kill_after_tasks is not None:
+        calls = {"n": 0}
+
+        # Raise on the report of task K+1: that task is fully trained but
+        # unreported, so it sits in the dispatcher's `doing` queue at the
+        # kill — recover_tasks() genuinely re-queues in-flight work (the
+        # k8s watch-event path), not just undispatched tasks.
+        def die(request):
+            calls["n"] += 1
+            if calls["n"] > kill_after_tasks:
+                raise _Preempted("simulated pod preemption (exit 137)")
+
+        callbacks = {"report_task_result": die}
+    return MiniCluster(
+        model_zoo=model_zoo_dir(),
+        model_def="mnist.mnist_functional.custom_model",
+        training_data=train,
+        minibatch_size=MINIBATCH,
+        num_minibatches_per_task=MINIBATCHES_PER_TASK,
+        checkpoint_dir=ckpt_dir,
+        checkpoint_steps=CHECKPOINT_STEPS,
+        worker_callbacks=callbacks,
+        fuse_task_steps=True,
+    )
+
+
+def main():
+    import argparse
+
+    import jax
+
+    from elasticdl_tpu.testing.data import create_mnist_record_file
+    from elasticdl_tpu.testing.in_process_master import InProcessMaster
+    from elasticdl_tpu.worker.main import _enable_compilation_cache
+    from elasticdl_tpu.worker.worker import Worker
+
+    platform = jax.devices()[0].platform
+    tmp = tempfile.mkdtemp(prefix="bench_elastic_")
+    # The elastic-relaunch story includes the persistent XLA compilation
+    # cache (--compilation_cache_dir): a replacement worker restores
+    # compiled executables from disk, so recovery is checkpoint-read
+    # bound, not compile bound. Same wiring as worker/main.py.
+    _enable_compilation_cache(argparse.Namespace(
+        compilation_cache_dir=os.path.join(tmp, "xla_cache")
+    ))
+    train = create_mnist_record_file(
+        os.path.join(tmp, "train.rec"), TOTAL_RECORDS, seed=7
+    )
+
+    # Warmup job on a small slice: pays jit compilation once so both
+    # measured phases see the same (cached) compile cost, as a long-lived
+    # worker would.
+    warm = create_mnist_record_file(
+        os.path.join(tmp, "w.rec"), MINIBATCH * MINIBATCHES_PER_TASK, seed=8
+    )
+    _make_cluster(warm, os.path.join(tmp, "ckpt_w")).run()
+
+    def run_clean(tag):
+        cluster = _make_cluster(train, os.path.join(tmp, f"ckpt_a{tag}"))
+        start = time.perf_counter()
+        cluster.run()
+        elapsed = time.perf_counter() - start
+        assert cluster.finished
+        return elapsed
+
+    def run_preempted(tag):
+        """Kill at ~50% of tasks, requeue, replacement restores + drains."""
+        total_tasks = TOTAL_RECORDS // (MINIBATCH * MINIBATCHES_PER_TASK)
+        ckpt_b = os.path.join(tmp, f"ckpt_b{tag}")
+        cluster = _make_cluster(
+            train, ckpt_b, kill_after_tasks=total_tasks // 2
+        )
+        start = time.perf_counter()
+        try:
+            cluster.workers[0].run()
+        except _Preempted:
+            pass
+        assert not cluster.finished
+        # The in-flight task must be sitting in doing for the requeue
+        # path to be exercised.
+        assert cluster.dispatcher.doing_tasks_of(0)
+        cluster.dispatcher.recover_tasks(0)  # master watch-event path
+
+        recover_start = time.perf_counter()
+        first_report = {}
+
+        def record_first_report(request):
+            first_report.setdefault("t", time.perf_counter())
+
+        from elasticdl_tpu.checkpoint import CheckpointHook
+
+        replacement = Worker(
+            worker_id=1,
+            master_client=InProcessMaster(
+                cluster.servicer, worker_id=1,
+                callbacks={"report_task_result": record_first_report},
+            ),
+            model_spec=cluster.spec,
+            data_reader=cluster.train_reader,
+            minibatch_size=MINIBATCH,
+            # Same checkpoint duty as the worker it replaces — otherwise
+            # phase B throughput wins by skipping checkpoint saves.
+            checkpoint_hook=CheckpointHook(
+                checkpoint_dir=ckpt_b, checkpoint_steps=CHECKPOINT_STEPS,
+            ),
+            checkpoint_dir_for_init=ckpt_b,
+            fuse_task_steps=True,
+        )
+        replacement.run()
+        elapsed = time.perf_counter() - start
+        assert cluster.finished
+        return elapsed, first_report["t"] - recover_start
+
+    # Interleave A/B repetitions: the device-tunnel RTT drifts over
+    # minutes and per-batch host->device round trips dominate this
+    # job-level bench, so alternating phases + medians keeps the
+    # retention ratio from measuring tunnel weather.
+    t_bases, t_kills, recoveries = [], [], []
+    for rep in range(REPS):
+        t_bases.append(run_clean(rep))
+        t_kill, recovery = run_preempted(rep)
+        t_kills.append(t_kill)
+        recoveries.append(recovery)
+
+    import numpy as np
+
+    base_rps = TOTAL_RECORDS / float(np.median(t_bases))
+    kill_rps = TOTAL_RECORDS / float(np.median(t_kills))
+    recovery_seconds = float(np.median(recoveries))
+    for metric, value, unit, vs in (
+        ("elastic_baseline_records_per_sec", base_rps, "records/sec", 1.0),
+        ("elastic_preempted_records_per_sec", kill_rps, "records/sec",
+         kill_rps / base_rps),
+        ("elastic_recovery_seconds", recovery_seconds, "seconds", 0.0),
+    ):
+        print(json.dumps({
+            "metric": f"{metric}[{platform}]",
+            "value": round(value, 2),
+            "unit": unit,
+            "vs_baseline": round(vs, 4),
+        }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,141 @@
+"""Multi-config benchmark suite over the BASELINE.md target configs.
+
+BASELINE.md defines five self-measured configs (the reference publishes no
+numbers): mnist CNN, cifar10 CNN, resnet50 (224x224), DeepFM sparse ids, and
+census wide&deep mixed dense+sparse. ``bench.py`` stays the driver's
+single-line metric (mnist); this suite is the breadth harness: it measures
+examples/sec/chip for every config through the same task-granular execution
+path (core/step.build_multi_step — N fused optimizer steps per XLA program,
+harness shared via benchlib.py) and records per-config regression floors.
+
+Usage:
+    python bench_suite.py               # all configs
+    python bench_suite.py mnist deepfm  # a subset
+
+Prints one JSON line per config and merges results into BENCH_SUITE.json;
+the first TPU run of each config also records a floor in
+BENCH_SUITE_FLOOR.json (both gitignored — machine-local measurements, not
+source). Job-level elasticity (throughput under preemption) is measured
+separately by bench_elasticity.py.
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+
+from benchlib import (
+    load_json,
+    make_mnist_batch,
+    measure_multi_step,
+    merge_json,
+)
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+FLOOR_FILE = os.path.join(HERE, "BENCH_SUITE_FLOOR.json")
+OUT_FILE = os.path.join(HERE, "BENCH_SUITE.json")
+
+# name -> (zoo model_def, batch, steps_per_task, measure_tasks)
+# resnet50 runs ImageNet-shaped inputs, so smaller batch / fewer steps.
+CONFIGS = {
+    "mnist": ("mnist.mnist_functional.custom_model", 512, 16, 4),
+    "cifar10": ("cifar10.cifar10_functional.custom_model", 256, 16, 4),
+    "resnet50": ("resnet50.resnet50.custom_model", 64, 4, 2),
+    "deepfm": ("deepfm.deepfm_functional.custom_model", 512, 16, 4),
+    "census": ("census.census_wide_deep.custom_model", 512, 16, 4),
+}
+
+
+def _make_batch(name, batch, rng):
+    if name == "mnist":
+        return make_mnist_batch(batch, rng)
+    if name == "cifar10":
+        labels = rng.randint(0, 10, batch).astype(np.int32)
+        features = rng.rand(batch, 32, 32, 3).astype(np.float32)
+    elif name == "resnet50":
+        labels = rng.randint(0, 10, batch).astype(np.int32)
+        features = rng.rand(batch, 224, 224, 3).astype(np.float32)
+    elif name == "deepfm":
+        from model_zoo.deepfm import deepfm_functional as m
+
+        labels = rng.randint(0, 2, batch).astype(np.int32)
+        features = rng.randint(
+            0, m.MAX_ID, (batch, m.INPUT_LENGTH)
+        ).astype(np.int32)
+    elif name == "census":
+        from model_zoo.census import census_wide_deep as m
+
+        labels = rng.randint(0, 2, batch).astype(np.int32)
+        num_cols = len(m.FEATURE_GROUP.columns)
+        features = {
+            "ids": rng.randint(
+                0, m.FEATURE_GROUP.total_buckets, (batch, num_cols)
+            ).astype(np.int32),
+            "dense": rng.rand(batch, len(m.NUMERIC_KEYS)).astype(np.float32),
+        }
+    else:
+        raise ValueError(name)
+    return {
+        "features": features,
+        "labels": labels,
+        "mask": np.ones((batch,), np.float32),
+    }
+
+
+def run_config(name):
+    import jax
+
+    from elasticdl_tpu.core.model_spec import get_model_spec
+    from elasticdl_tpu.core.step import stack_batches
+    from elasticdl_tpu.testing.data import model_zoo_dir
+
+    model_def, batch, steps, measure_tasks = CONFIGS[name]
+    spec = get_model_spec(model_zoo_dir(), model_def)
+    rng = np.random.RandomState(0)
+    task = jax.device_put(
+        stack_batches([_make_batch(name, batch, rng) for _ in range(steps)])
+    )
+    return measure_multi_step(spec, task, batch, steps, measure_tasks)
+
+
+def main():
+    import jax
+
+    names = sys.argv[1:] or list(CONFIGS)
+    unknown = [n for n in names if n not in CONFIGS]
+    if unknown:
+        raise SystemExit(f"unknown configs {unknown}; pick from {list(CONFIGS)}")
+
+    platform = jax.devices()[0].platform
+    floors = load_json(FLOOR_FILE, {})
+
+    results = {}
+    for name in names:
+        eps = run_config(name)
+        floor = (floors.get(name) or {}).get("examples_per_sec")
+        vs = eps / floor if floor else 1.0
+        if not floor and platform != "cpu":
+            floors[name] = {
+                "examples_per_sec": eps, "platform": platform,
+                "batch": CONFIGS[name][1],
+            }
+        results[name] = {
+            "examples_per_sec": round(eps, 2), "vs_floor": round(vs, 4),
+            "platform": platform,
+        }
+        print(json.dumps({
+            "metric": f"{name}_train_examples_per_sec_per_chip[{platform}]",
+            "value": round(eps, 2),
+            "unit": "examples/sec/chip",
+            "vs_baseline": round(vs, 4),
+        }))
+
+    if platform != "cpu":
+        with open(FLOOR_FILE, "w") as f:
+            json.dump(floors, f, indent=1)
+    merge_json(OUT_FILE, results)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,86 @@
+"""Shared measurement harness for bench.py / bench_suite.py.
+
+One implementation of batch synthesis, the warmup/median measurement loop,
+and floor-file bookkeeping so the driver bench (bench.py) and the breadth
+suite (bench_suite.py) can't drift apart.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def make_mnist_batch(batch, rng, flat=False):
+    """Label-correlated pixels (same scheme as
+    testing.data.create_mnist_record_file) so measured steps are healthy
+    training, not divergence to inf/nan."""
+    labels = rng.randint(0, 10, batch).astype(np.int32)
+    images = rng.rand(batch, 28 * 28).astype(np.float32) * 0.125
+    block = (28 * 28) // 10
+    for i, label in enumerate(labels):
+        images[i, label * block:(label + 1) * block] += 0.75
+    features = images if flat else images.reshape(batch, 28, 28)
+    return {
+        "features": features,
+        "labels": labels,
+        "mask": np.ones((batch,), np.float32),
+    }
+
+
+def measure_multi_step(spec, task, batch, steps_per_task, measure_tasks,
+                       warmup_tasks=2, measure_rounds=3):
+    """Time the fused task-granular step (core/step.build_multi_step) on a
+    device-resident task; returns examples/sec (median over rounds — the
+    device tunnel's throughput varies run to run)."""
+    import jax
+
+    from elasticdl_tpu.core.step import build_multi_step
+    from elasticdl_tpu.core.train_state import init_train_state
+
+    state = init_train_state(
+        spec.model, spec.make_optimizer(),
+        jax.tree.map(lambda x: x[0], task), seed=0,
+    )
+    multi_step = build_multi_step(spec.loss)
+
+    def sync(metrics):
+        # Host transfer of the last step's loss: a hard sync even where
+        # block_until_ready returns early (tunnel'd device backends).
+        return float(np.asarray(metrics["loss"][-1]))
+
+    for _ in range(warmup_tasks):
+        state, metrics = multi_step(state, task)
+    sync(metrics)
+
+    rounds = []
+    final_loss = 0.0
+    for _ in range(measure_rounds):
+        start = time.perf_counter()
+        for _ in range(measure_tasks):
+            state, metrics = multi_step(state, task)
+        final_loss = sync(metrics)
+        rounds.append(time.perf_counter() - start)
+    elapsed = float(np.median(rounds))
+    assert np.isfinite(final_loss), f"bench diverged: loss={final_loss}"
+    return batch * steps_per_task * measure_tasks / elapsed
+
+
+def load_json(path, default):
+    if os.path.exists(path):
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except Exception:
+            pass
+    return default
+
+
+def merge_json(path, updates):
+    """Read-modify-write so subset runs don't drop other entries."""
+    data = load_json(path, {})
+    data.update(updates)
+    with open(path, "w") as f:
+        json.dump(data, f, indent=1)
+    return data

@@ -46,6 +46,7 @@ class MiniCluster:
         checkpoint_steps: int = 0,
         checkpoint_dir_for_init: str = "",
         mesh=None,
+        fuse_task_steps: bool = False,
     ):
         self.spec = get_model_spec(model_zoo, model_def)
         if mesh is not None:
@@ -149,6 +150,7 @@ class MiniCluster:
                     # One writer: worker 0 (state is shared/replicated).
                     checkpoint_hook=hook if wid == 0 else None,
                     checkpoint_dir_for_init=checkpoint_dir_for_init,
+                    fuse_task_steps=fuse_task_steps,
                 )
             )
 
