@@ -88,7 +88,7 @@ def build_train_step(loss_fn: Callable) -> Callable:
     return jax.jit(train_step, donate_argnums=(0,))
 
 
-def build_multi_step(loss_fn: Callable) -> Callable:
+def build_multi_step(loss_fn: Callable, unroll: int = 4) -> Callable:
     """Build ``(state, batches) -> (state, metrics)`` where ``batches``
     leaves carry a leading task dim T: T optimizer steps fused into ONE
     XLA program via ``lax.scan``.
@@ -99,13 +99,20 @@ def build_multi_step(loss_fn: Callable) -> Callable:
     removes T-1 host dispatches per task — the dominant cost for small
     models behind a device tunnel. ``metrics`` leaves come back stacked
     (T,) so per-step losses stay observable.
+
+    ``unroll`` partially unrolls the scan body (measured ~5% on the mnist
+    CNN at unroll=4 on v5e; full unroll inflates the program for no
+    further gain and can exceed remote-compile payload limits).
     """
 
     def multi_step(state, batches):
         def body(state, batch):
             return _train_step_body(loss_fn, state, batch)
 
-        return jax.lax.scan(body, state, batches)
+        num_steps = jax.tree.leaves(batches)[0].shape[0]
+        return jax.lax.scan(
+            body, state, batches, unroll=max(1, min(unroll, num_steps))
+        )
 
     return jax.jit(multi_step, donate_argnums=(0,))
 

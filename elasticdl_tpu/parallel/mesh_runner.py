@@ -321,12 +321,14 @@ class MeshRunner:
 
         return wrapped
 
-    def train_multi_step(self, loss_fn: Callable) -> Callable:
+    def train_multi_step(
+        self, loss_fn: Callable, unroll: int = 4
+    ) -> Callable:
         """Fused task-granular step: scan a whole task's minibatches
         (stacked with a leading T dim) through one compiled SPMD
-        program (core/step.build_multi_step, mesh edition). Only the
-        plain (accum_steps == 1) path fuses — accumulation already
-        carries cross-call state."""
+        program (core/step.build_multi_step, mesh edition — same
+        default partial unroll). Only the plain (accum_steps == 1)
+        path fuses — accumulation already carries cross-call state."""
         shardings = self._require_shardings()
         runner = self
 
@@ -334,7 +336,11 @@ class MeshRunner:
             def body(state, batch):
                 return step_lib._train_step_body(loss_fn, state, batch)
 
-            return jax.lax.scan(body, state, batches)
+            num_steps = jax.tree.leaves(batches)[0].shape[0]
+            return jax.lax.scan(
+                body, state, batches,
+                unroll=max(1, min(unroll, num_steps)),
+            )
 
         jitted = jax.jit(
             multi_step,
