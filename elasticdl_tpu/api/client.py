@@ -57,7 +57,19 @@ def _master_manifests(args, mode: str):
     service = build_master_service_manifest(
         args.job_name, namespace=args.namespace, port=MASTER_PORT
     )
-    return [pod, service]
+    manifests = [pod, service]
+    if getattr(args, "tensorboard_log_dir", ""):
+        # External TB endpoint over the master's tensorboard subprocess
+        # (reference api.py wires k8s_tensorboard_client when
+        # --tensorboard_log_dir is set).
+        from elasticdl_tpu.platform.k8s_client import (
+            build_tensorboard_service_manifest,
+        )
+
+        manifests.append(build_tensorboard_service_manifest(
+            args.job_name, namespace=args.namespace
+        ))
+    return manifests
 
 
 def _submit_job(args, mode: str) -> int:
@@ -77,7 +89,8 @@ def _submit_job(args, mode: str) -> int:
         )
         return 0
     client.create_pod(manifests[0])
-    client.create_service(manifests[1])
+    for service in manifests[1:]:
+        client.create_service(service)
     logger.info(
         "Submitted job %s (master pod %s)",
         args.job_name, manifests[0]["metadata"]["name"],

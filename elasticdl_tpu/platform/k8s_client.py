@@ -134,6 +134,36 @@ def build_master_service_manifest(
     }
 
 
+TENSORBOARD_PORT = 6006
+
+
+def get_tensorboard_service_name(job_name: str) -> str:
+    return f"tensorboard-{job_name}"
+
+
+def build_tensorboard_service_manifest(
+    job_name: str, namespace: str = "default", port: int = TENSORBOARD_PORT,
+    service_type: str = "LoadBalancer",
+) -> dict:
+    """External TensorBoard endpoint selecting the master pod (the TB
+    subprocess runs there) — reference k8s_tensorboard_client.py +
+    k8s_client.py:386-405."""
+    return {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {
+            "name": get_tensorboard_service_name(job_name),
+            "namespace": namespace,
+            "labels": _labels(job_name, "tensorboard"),
+        },
+        "spec": {
+            "selector": _labels(job_name, "master"),
+            "ports": [{"port": port, "targetPort": port}],
+            "type": service_type,
+        },
+    }
+
+
 def render_job_manifests(manifests: List[dict]) -> str:
     """YAML multi-doc dump for `kubectl apply -f -` (yaml-dump mode)."""
     import yaml
@@ -276,5 +306,13 @@ class Client:
             if not force:
                 raise
             errors.append(f"service: {exc}")
+        try:
+            # Optional resource (exists only when --tensorboard_log_dir
+            # was set at submit); delete_service no-ops on 404.
+            self.delete_service(get_tensorboard_service_name(job_name))
+        except Exception as exc:
+            if not force:
+                raise
+            errors.append(f"tensorboard service: {exc}")
         for err in errors:
             logger.warning("clean --force skipped error: %s", err)

@@ -1,0 +1,69 @@
+"""bench_suite configs stay runnable (CPU smoke, tiny shapes).
+
+The suite itself measures on TPU; this guards against drift between the
+batch synthesizers and the zoo model contracts (wrong feature shapes/dtypes
+would otherwise only surface on a hardware run).
+"""
+
+import sys
+import os
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench_suite  # noqa: E402
+import benchlib  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def tiny_configs(monkeypatch):
+    tiny = {
+        "mnist": ("mnist.mnist_functional.custom_model", 8, 2, 1),
+        "cifar10": ("cifar10.cifar10_functional.custom_model", 8, 2, 1),
+        "deepfm": ("deepfm.deepfm_functional.custom_model", 8, 2, 1),
+        "census": ("census.census_wide_deep.custom_model", 8, 2, 1),
+        "transformer": ("transformer.transformer_lm.custom_model", 2, 2, 1),
+    }
+    monkeypatch.setattr(bench_suite, "CONFIGS", tiny)
+    monkeypatch.setattr(bench_suite, "TRANSFORMER_SEQ", 16)
+
+    def tiny_transformer(spec):
+        from elasticdl_tpu.models.transformer import TransformerConfig
+
+        cfg = TransformerConfig(
+            vocab_size=64, d_model=16, n_heads=2, n_layers=1,
+            d_ff=32, max_len=16,
+        )
+        spec.model = spec.module.custom_model(config=cfg)
+        return spec
+
+    monkeypatch.setattr(bench_suite, "_transformer_spec", tiny_transformer)
+    # Transformer batch synthesis draws ids from the full 32768 vocab;
+    # clamp into the tiny model's range.
+    orig = bench_suite._make_batch
+
+    def clamped(name, batch, rng):
+        b = orig(name, batch, rng)
+        if name == "transformer":
+            b["features"] = (b["features"] % 64).astype(np.int32)
+            b["labels"] = (b["labels"] % 64).astype(np.int32)
+        return b
+
+    monkeypatch.setattr(bench_suite, "_make_batch", clamped)
+
+
+@pytest.mark.parametrize(
+    "name", ["mnist", "cifar10", "deepfm", "census", "transformer"]
+)
+def test_config_runs(name):
+    eps = bench_suite.run_config(name)
+    assert np.isfinite(eps) and eps > 0
+
+
+def test_merge_json_preserves_other_entries(tmp_path):
+    path = str(tmp_path / "out.json")
+    benchlib.merge_json(path, {"a": 1})
+    data = benchlib.merge_json(path, {"b": 2})
+    assert data == {"a": 1, "b": 2}

@@ -106,6 +106,39 @@ class TestManifests:
         docs = list(yaml.safe_load_all(text))
         assert len(docs) == 2 and docs[1]["kind"] == "Service"
 
+    def test_tensorboard_service_manifest(self):
+        from elasticdl_tpu.platform.k8s_client import (
+            build_tensorboard_service_manifest,
+        )
+
+        svc = build_tensorboard_service_manifest("job1")
+        assert svc["metadata"]["name"] == "tensorboard-job1"
+        assert svc["spec"]["type"] == "LoadBalancer"
+        # Selects the master pod: the TB subprocess runs there.
+        assert svc["spec"]["selector"][
+            "elasticdl-tpu-replica-type"] == "master"
+        assert svc["spec"]["ports"][0]["port"] == 6006
+
+    def test_submit_manifests_include_tensorboard_service(self):
+        import argparse
+
+        from elasticdl_tpu.api.client import _master_manifests
+
+        base = dict(
+            job_name="job1", image_name="img", namespace="default",
+            master_resource_request="", master_resource_limit="",
+            volume="", envs="", restart_policy="Never",
+            tensorboard_log_dir="",
+        )
+        args = argparse.Namespace(**base)
+        assert len(_master_manifests(args, "train")) == 2
+        args = argparse.Namespace(**{
+            **base, "tensorboard_log_dir": "/tmp/tb",
+        })
+        manifests = _master_manifests(args, "train")
+        assert len(manifests) == 3
+        assert manifests[2]["metadata"]["name"] == "tensorboard-job1"
+
 
 class FakeK8sClient:
     """Record-only client; tests feed events to the manager directly."""
