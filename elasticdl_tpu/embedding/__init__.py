@@ -15,7 +15,10 @@ Two tiers:
   ModelHandler's 2MB rewrite) row-shards big tables over the mesh.
 - **Host tier** (`table.EmbeddingTable`): a lazy, dict-backed row store
   mirroring the reference PS table semantics, used for >HBM tables and for
-  checkpoint repartitioning.
+  checkpoint repartitioning. `host_engine.HostEmbeddingEngine` trains it
+  end to end: per-batch dedup + bucket-padded row blocks on device,
+  gradients w.r.t. the block scattered back through the row optimizers,
+  double-buffered row prefetch.
 """
 
 from elasticdl_tpu.embedding.combiner import RaggedIds, combine
@@ -33,9 +36,19 @@ from elasticdl_tpu.embedding.optimizer import (
     sparse_apply,
     unique_pad,
 )
+from elasticdl_tpu.embedding.host_engine import (
+    HostEmbedding,
+    HostEmbeddingEngine,
+    build_host_train_step,
+    host_rows_template,
+)
 from elasticdl_tpu.embedding.table import EmbeddingTable, get_slot_table_name
 
 __all__ = [
+    "HostEmbedding",
+    "HostEmbeddingEngine",
+    "build_host_train_step",
+    "host_rows_template",
     "HostOptimizerWrapper",
     "RowOptimizer",
     "init_slot_tables",

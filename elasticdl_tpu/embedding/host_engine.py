@@ -1,0 +1,260 @@
+"""Host-tier embedding training engine (>HBM tables, end to end).
+
+SURVEY.md §7 stage 6 "hard part #2": dynamic-shape id batches vs XLA
+static shapes. The reference trains huge tables by keeping rows on
+parameter-server pods and shipping row batches over gRPC
+(``worker/worker.py:362-391`` pull, ``:570-580`` scatter,
+``ps/optimizer_wrapper.py:143`` lookup-apply-writeback). Here the same
+capability is mesh-native:
+
+- the table lives in host RAM (`EmbeddingTable` or the C++
+  `NativeEmbeddingTable` via `make_host_table`),
+- per batch, ids are deduplicated host-side and their rows pulled into a
+  device array whose leading dim is **bucket-padded** (next power of two)
+  so the jit step compiles once per bucket, not once per batch,
+- the model reads those rows through the ``host_rows`` flax collection
+  (`HostEmbedding` layer) and indexes them with the batch's inverse map,
+- the step function differentiates w.r.t. the row block; the engine
+  scatters the row gradients back through a row optimizer
+  (`HostOptimizerWrapper` / native), slots co-stored with the table,
+- `prepared_batches` double-buffers: rows for batch N+1 are pulled on a
+  background thread while batch N trains on device.
+"""
+
+from typing import Callable, Dict, Iterable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from elasticdl_tpu.embedding.combiner import RaggedIds, combine
+
+MIN_BUCKET = 8
+
+# Collection name through which the engine hands the per-batch row block
+# to the model.
+HOST_ROWS_COLLECTION = "host_rows"
+
+
+def bucket_size(n: int, min_bucket: int = MIN_BUCKET) -> int:
+    """Next power of two >= n (>= min_bucket): bounds the number of
+    distinct compiled shapes to O(log vocab) per table."""
+    b = min_bucket
+    while b < n:
+        b *= 2
+    return b
+
+
+class HostEmbedding(nn.Module):
+    """Embedding lookup over the engine-provided per-batch row block.
+
+    Input is the batch's **inverse map** (positions -> slots in the row
+    block), produced by ``HostEmbeddingEngine.prepare_batch`` — not raw
+    ids. Supports the same dense / RaggedIds+combiner forms as the
+    in-HBM `Embedding` layer.
+    """
+
+    table_name: str
+    output_dim: int
+    combiner: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, inverse):
+        rows = self.variable(
+            HOST_ROWS_COLLECTION,
+            self.table_name,
+            lambda: jnp.zeros((MIN_BUCKET, self.output_dim), jnp.float32),
+        ).value
+        if isinstance(inverse, RaggedIds):
+            if self.combiner is None:
+                raise ValueError("RaggedIds input requires a combiner")
+            emb = jnp.take(rows, inverse.ids, axis=0)
+            return combine(emb, inverse.weights, self.combiner)
+        return jnp.take(rows, jnp.asarray(inverse), axis=0)
+
+
+def host_rows_template(model, example_batch, seed: int = 0):
+    """The model's ``host_rows`` collection structure (nested by module
+    path, as flax scopes it). The engine speaks flat {table: rows}; the
+    step nests/flattens against this template. Table names must be
+    unique across the model."""
+    variables = model.init(
+        {"params": jax.random.PRNGKey(seed)},
+        example_batch["features"], training=False,
+    )
+    template = variables.get(HOST_ROWS_COLLECTION, {})
+    names = [k for k, _ in _iter_leaves(template)]
+    dupes = {n for n in names if names.count(n) > 1}
+    if dupes:
+        raise ValueError(
+            f"host table names must be unique across the model: {dupes}"
+        )
+    return template
+
+
+def _iter_leaves(node, out=None):
+    out = [] if out is None else out
+    for key, value in node.items():
+        if isinstance(value, dict):
+            _iter_leaves(value, out)
+        else:
+            out.append((key, value))
+    return out
+
+
+def _nest_rows(template, flat):
+    """Flat {table: rows} -> the template's nested module-path shape."""
+    return {
+        key: (_nest_rows(value, flat) if isinstance(value, dict)
+              else flat[key])
+        for key, value in template.items()
+    }
+
+
+def build_host_train_step(loss_fn: Callable, rows_template) -> Callable:
+    """Build ``(state, batch, host_rows) -> (state, row_grads, metrics)``.
+
+    Same contract as core/step.build_train_step plus the host row block:
+    ``host_rows`` (flat {table: (bucket, dim)}) enters as a
+    differentiated argument; its gradients come back (flat) for the
+    engine to scatter into the host store. ``rows_template`` comes from
+    ``host_rows_template``. BatchNorm models are supported the same way
+    as the core step (running stats frozen on padded batches).
+    """
+    from elasticdl_tpu.core.step import _call_loss
+
+    def train_step(state, batch, host_rows):
+        state, rng = state.next_rng()
+
+        def compute_loss(params, host_rows):
+            variables = {
+                "params": params,
+                HOST_ROWS_COLLECTION: _nest_rows(rows_template, host_rows),
+            }
+            has_batch_stats = bool(state.batch_stats)
+            if has_batch_stats:
+                variables["batch_stats"] = state.batch_stats
+            mutable = ["batch_stats"] if has_batch_stats else False
+            out = state.apply_fn(
+                variables,
+                batch["features"],
+                training=True,
+                rngs={"dropout": rng} if rng is not None else None,
+                mutable=mutable,
+            )
+            if mutable:
+                preds, updates = out
+                new_stats = updates.get("batch_stats", state.batch_stats)
+            else:
+                preds, new_stats = out, state.batch_stats
+            loss = _call_loss(loss_fn, batch["labels"], preds, batch["mask"])
+            return loss, new_stats
+
+        grad_fn = jax.value_and_grad(compute_loss, argnums=(0, 1),
+                                     has_aux=True)
+        (loss, new_stats), (param_grads, row_grads) = grad_fn(
+            state.params, host_rows
+        )
+        if state.batch_stats:
+            is_full = jnp.all(batch["mask"] > 0)
+            new_stats = jax.tree.map(
+                lambda new, old: jnp.where(is_full, new, old),
+                new_stats, state.batch_stats,
+            )
+        state = state.apply_gradients(
+            grads=param_grads, batch_stats=new_stats
+        )
+        return state, row_grads, {"loss": loss}
+
+    return jax.jit(train_step, donate_argnums=(0,))
+
+
+class HostEmbeddingEngine:
+    """Pull/dedup/pad rows per batch; scatter row grads back after.
+
+    ``tables``:   {name: EmbeddingTable-like} (host or native),
+    ``optimizer``: a HostOptimizerWrapper-compatible object
+                  (``apply_gradients(table, ids, grads)``),
+    ``id_keys``:  {table_name: feature_key} — which feature carries the
+                  raw ids for each table; prepare_batch replaces it with
+                  the inverse map.
+    """
+
+    def __init__(self, tables: Dict, optimizer, id_keys: Dict[str, str]):
+        unknown = set(id_keys) - set(tables)
+        if unknown:
+            raise ValueError(f"id_keys reference unknown tables {unknown}")
+        keys = list(id_keys.values())
+        dupes = {k for k in keys if keys.count(k) > 1}
+        if dupes:
+            # Two tables sharing one feature would see the first table's
+            # inverse map as the second's raw ids — silent corruption.
+            raise ValueError(
+                f"feature keys must be unique across tables: {dupes}"
+            )
+        self.tables = tables
+        self.optimizer = optimizer
+        self.id_keys = id_keys
+
+    def prepare_batch(self, batch: dict) -> Tuple[dict, dict, dict]:
+        """Host-side half of the step (runs off-thread under
+        ``prepared_batches``): dedup ids, pull rows, bucket-pad.
+
+        Returns (batch', host_rows, uniques):
+        - batch' — ``batch`` with each id feature replaced by its int32
+          inverse map into the row block,
+        - host_rows — {table: (bucket, dim) float32}; rows[u:] are zero
+          padding whose grads are dropped,
+        - uniques — {table: (unique_ids, u)} for apply_row_grads.
+        """
+        features = dict(batch["features"]) if isinstance(
+            batch["features"], dict
+        ) else {"__only__": batch["features"]}
+        host_rows, uniques = {}, {}
+        for table_name, key in self.id_keys.items():
+            ids = features[key]
+            ragged = isinstance(ids, RaggedIds)
+            raw = np.asarray(ids.ids if ragged else ids)
+            uniq, inverse = np.unique(raw, return_inverse=True)
+            u = len(uniq)
+            bucket = bucket_size(u)
+            table = self.tables[table_name]
+            rows = np.zeros((bucket, table.dim), np.float32)
+            rows[:u] = table.get([int(i) for i in uniq])
+            inv = inverse.reshape(raw.shape).astype(np.int32)
+            features[key] = (
+                RaggedIds(ids=inv, weights=ids.weights) if ragged else inv
+            )
+            host_rows[table_name] = rows
+            uniques[table_name] = (uniq, u)
+        out = dict(batch)
+        out["features"] = (
+            features["__only__"] if "__only__" in features
+            else features
+        )
+        return out, host_rows, uniques
+
+    def apply_row_grads(self, row_grads: dict, uniques: dict) -> None:
+        """Scatter the step's row gradients into the host tables
+        (lookup-apply-writeback, reference optimizer_wrapper.py:143)."""
+        for table_name, (uniq, u) in uniques.items():
+            grads = np.asarray(row_grads[table_name])[:u]
+            self.optimizer.apply_gradients(
+                self.tables[table_name], [int(i) for i in uniq], grads
+            )
+
+    def prepared_batches(self, batches: Iterable[dict], depth: int = 2):
+        """Double-buffered iterator: rows for upcoming batches are
+        pulled while the current batch trains (data/prefetch.py plays
+        the same role for record decode). NOTE: a prefetched batch can
+        read rows up to ``depth + 1`` apply_row_grads behind on ids it
+        shares with in-flight batches — the reference async PS pull's
+        relaxed-consistency window (async_sgd.md), widened by the
+        prefetch depth. Returns a PrefetchIterator; ``close()`` it (or
+        use as a context manager) when abandoning mid-stream."""
+        from elasticdl_tpu.data.prefetch import prefetch
+
+        return prefetch(
+            (self.prepare_batch(b) for b in batches), depth=depth
+        )

@@ -1,0 +1,221 @@
+"""Host-tier embedding training, end to end.
+
+The capability the reference delivers with PS pods + gRPC row push/pull
+(worker.py:362-391/:570-580, optimizer_wrapper.py:143): train a model
+whose embedding table lives OFF-device, rows pulled per batch and row
+gradients scattered back through a row optimizer. Here: host RAM table +
+bucket-padded device row blocks + jit step differentiating w.r.t. the
+row block (embedding/host_engine.py).
+"""
+
+import flax.linen as nn
+import numpy as np
+import optax
+import pytest
+
+from elasticdl_tpu.core.train_state import init_train_state
+from elasticdl_tpu.embedding.combiner import RaggedIds
+from elasticdl_tpu.embedding.host_engine import (
+    HostEmbedding,
+    HostEmbeddingEngine,
+    bucket_size,
+    build_host_train_step,
+    host_rows_template,
+)
+from elasticdl_tpu.embedding.optimizer import SGD, HostOptimizerWrapper
+from elasticdl_tpu.embedding.table import EmbeddingTable
+
+VOCAB = 1000
+DIM = 8
+FIELDS = 4
+
+
+class TinyHostModel(nn.Module):
+    @nn.compact
+    def __call__(self, features, training=False):
+        emb = HostEmbedding("items", DIM)(features["item_ids"])  # (B,F,D)
+        x = emb.reshape((emb.shape[0], -1))
+        x = nn.relu(nn.Dense(16)(x))
+        return nn.Dense(1)(x)[..., 0]
+
+
+def loss_fn(labels, preds, mask):
+    import jax.numpy as jnp
+
+    per = optax.sigmoid_binary_cross_entropy(preds, labels.astype(np.float32))
+    return (per * mask).sum() / jnp.maximum(mask.sum(), 1)
+
+
+def make_batch(rng, batch=16):
+    ids = rng.randint(0, VOCAB, (batch, FIELDS)).astype(np.int64)
+    # Learnable signal: label = parity of the first id.
+    labels = (ids[:, 0] % 2).astype(np.int32)
+    return {
+        "features": {"item_ids": ids},
+        "labels": labels,
+        "mask": np.ones((batch,), np.float32),
+    }
+
+
+@pytest.fixture
+def engine():
+    tables = {"items": EmbeddingTable("items", DIM)}
+    return HostEmbeddingEngine(
+        tables, HostOptimizerWrapper(SGD(lr=0.5)),
+        id_keys={"items": "item_ids"},
+    )
+
+
+def test_bucket_size():
+    assert bucket_size(1) == 8
+    assert bucket_size(8) == 8
+    assert bucket_size(9) == 16
+    assert bucket_size(100) == 128
+
+
+def test_prepare_batch_shapes_and_padding(engine):
+    rng = np.random.RandomState(0)
+    batch = make_batch(rng)
+    prepared, host_rows, uniques = engine.prepare_batch(batch)
+    uniq, u = uniques["items"]
+    rows = host_rows["items"]
+    assert rows.shape == (bucket_size(u), DIM)
+    assert np.all(rows[u:] == 0.0)  # padding slots
+    inv = prepared["features"]["item_ids"]
+    assert inv.dtype == np.int32 and inv.shape == (16, FIELDS)
+    # Inverse maps back to the original ids.
+    assert np.array_equal(uniq[inv], batch["features"]["item_ids"])
+
+
+def test_end_to_end_training_learns(engine):
+    # Small id space so every embedding row gets enough visits to learn
+    # the per-id signal (each id must be SEEN to be trained — the whole
+    # point of the sparse path).
+    rng = np.random.RandomState(1)
+
+    def small_batch():
+        b = make_batch(rng, batch=32)
+        b["features"]["item_ids"] = b["features"]["item_ids"] % 50
+        b["labels"] = (b["features"]["item_ids"][:, 0] % 2).astype(np.int32)
+        return b
+
+    init_prepared, _, _ = engine.prepare_batch(small_batch())
+    model = TinyHostModel()
+    state = init_train_state(model, optax.adam(3e-2), init_prepared, seed=0)
+    step = build_host_train_step(
+        loss_fn, host_rows_template(model, init_prepared)
+    )
+
+    losses = []
+    for _ in range(80):
+        prepared, host_rows, uniques = engine.prepare_batch(small_batch())
+        state, row_grads, metrics = step(state, prepared, host_rows)
+        engine.apply_row_grads(
+            {k: np.asarray(v) for k, v in row_grads.items()}, uniques
+        )
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0] * 0.7, losses[::16]
+    # Rows were actually trained into the host table.
+    assert engine.tables["items"].num_rows > 0
+
+
+def test_untouched_rows_keep_lazy_init(engine):
+    rng = np.random.RandomState(2)
+    batch = make_batch(rng, batch=4)
+    prepared, host_rows, uniques = engine.prepare_batch(batch)
+    model = TinyHostModel()
+    state = init_train_state(model, optax.sgd(0.1), prepared, seed=0)
+    step = build_host_train_step(
+        loss_fn, host_rows_template(model, prepared)
+    )
+    state, row_grads, _ = step(state, prepared, host_rows)
+    engine.apply_row_grads(
+        {k: np.asarray(v) for k, v in row_grads.items()}, uniques
+    )
+    touched = set(int(i) for i in uniques["items"][0])
+    # An untouched id still materializes from the deterministic lazy
+    # initializer (reference EmbeddingTable.get:51-62 semantics).
+    fresh = next(i for i in range(VOCAB) if i not in touched)
+    ref = EmbeddingTable("items", DIM)
+    np.testing.assert_array_equal(
+        engine.tables["items"].get([fresh]), ref.get([fresh])
+    )
+
+
+def test_ragged_ids_path(engine):
+    ragged = RaggedIds.from_lists([[1, 2, 3], [4], []], max_ids=4)
+
+    class RaggedModel(nn.Module):
+        @nn.compact
+        def __call__(self, features, training=False):
+            emb = HostEmbedding("items", DIM, combiner="mean")(
+                features["item_ids"]
+            )
+            return nn.Dense(1)(emb)[..., 0]
+
+    batch = {
+        "features": {"item_ids": ragged},
+        "labels": np.array([1, 0, 1], np.int32),
+        "mask": np.ones((3,), np.float32),
+    }
+    prepared, host_rows, uniques = engine.prepare_batch(batch)
+    inv = prepared["features"]["item_ids"]
+    assert isinstance(inv, RaggedIds)
+    model = RaggedModel()
+    state = init_train_state(model, optax.sgd(0.1), prepared, seed=0)
+    step = build_host_train_step(
+        loss_fn, host_rows_template(model, prepared)
+    )
+    state, row_grads, metrics = step(state, prepared, host_rows)
+    engine.apply_row_grads(
+        {k: np.asarray(v) for k, v in row_grads.items()}, uniques
+    )
+    assert np.isfinite(float(metrics["loss"]))
+
+
+def test_prepared_batches_double_buffering(engine):
+    rng = np.random.RandomState(3)
+    batches = [make_batch(rng) for _ in range(5)]
+    with engine.prepared_batches(iter(batches)) as it:
+        seen = list(it)
+    assert len(seen) == 5
+    for prepared, host_rows, uniques in seen:
+        assert "items" in host_rows and "items" in uniques
+
+
+def test_prepared_batches_close_stops_producer(engine):
+    rng = np.random.RandomState(5)
+    batches = (make_batch(rng) for _ in range(100))
+    it = engine.prepared_batches(batches)
+    next(iter(it))
+    it.close()  # abandoning mid-stream must not leak a blocked thread
+
+
+def test_duplicate_feature_keys_rejected():
+    with pytest.raises(ValueError, match="unique across tables"):
+        HostEmbeddingEngine(
+            {"a": EmbeddingTable("a", DIM), "b": EmbeddingTable("b", DIM)},
+            HostOptimizerWrapper(SGD(lr=0.1)),
+            id_keys={"a": "ids", "b": "ids"},
+        )
+
+
+def test_prepared_batches_propagates_errors(engine):
+    def gen():
+        yield make_batch(np.random.RandomState(4))
+        raise RuntimeError("reader died")
+
+    it = engine.prepared_batches(gen())
+    next(it)
+    with pytest.raises(RuntimeError, match="reader died"):
+        for _ in it:
+            pass
+
+
+def test_unknown_table_key_rejected():
+    with pytest.raises(ValueError, match="unknown tables"):
+        HostEmbeddingEngine(
+            {"items": EmbeddingTable("items", DIM)},
+            HostOptimizerWrapper(SGD(lr=0.1)),
+            id_keys={"typo": "item_ids"},
+        )
