@@ -48,7 +48,8 @@ def has_valid_checkpoint(checkpoint_dir: str) -> bool:
         return False
 
 
-def restore_from_dir(state, checkpoint_dir: str, required: bool = True):
+def restore_from_dir(state, checkpoint_dir: str, required: bool = True,
+                     host_tables=None):
     """Restore a TrainState's leaves from the latest valid version.
 
     Backend is detected from the directory contents: orbax version dirs
@@ -60,8 +61,19 @@ def restore_from_dir(state, checkpoint_dir: str, required: bool = True):
     is pointed at the job's checkpoint dir, which legitimately has no
     valid version yet if the job died before the first checkpoint — start
     fresh instead of crash-looping the replacement pod.
+
+    ``host_tables`` ({name: EmbeddingTable-like}): host-tier tables to
+    refill from the checkpoint's embedding rows (native backend only).
     """
     if _has_orbax_versions(checkpoint_dir):
+        if host_tables:
+            # Symmetric with CheckpointHook: orbax checkpoints don't
+            # carry host rows — silently continuing would lazy-reinit
+            # every trained row.
+            raise ValueError(
+                "host_tables restore requires a native-backend "
+                f"checkpoint; {checkpoint_dir} is orbax-backed"
+            )
         from elasticdl_tpu.checkpoint.orbax_backend import (
             OrbaxSaver,
             restore_state,
@@ -83,7 +95,7 @@ def restore_from_dir(state, checkpoint_dir: str, required: bool = True):
         )
         return state
     try:
-        _, dense, _ = CheckpointSaver(checkpoint_dir).restore()
+        _, dense, embeddings = CheckpointSaver(checkpoint_dir).restore()
     except FileNotFoundError:
         if required:
             raise
@@ -92,6 +104,13 @@ def restore_from_dir(state, checkpoint_dir: str, required: bool = True):
         )
         return state
     state = restore_state_from_named_leaves(state, dense)
+    for name, table in (host_tables or {}).items():
+        saved = embeddings.get(name)
+        if saved is None:
+            continue
+        ids, rows = saved.to_arrays()
+        if ids.size:
+            table.set([int(i) for i in ids], rows)
     logger.info(
         "Restored state at version %d from %s",
         int(state.step), checkpoint_dir,
@@ -114,7 +133,16 @@ class CheckpointHook:
         saver: Optional[CheckpointSaver] = None,
         async_save: bool = True,
         backend: str = "native",
+        host_tables=None,
     ):
+        # host_tables ({name: EmbeddingTable-like}): host-tier rows are
+        # saved alongside the state (native backend; the saver shards
+        # rows by id % N like the reference Go checkpoint).
+        if host_tables and backend == "orbax":
+            raise ValueError(
+                "host_tables checkpointing requires the native backend"
+            )
+        self._host_tables = host_tables or {}
         # "orbax": required for multi-host jobs (one process cannot
         # device_get a global array); writes coordinately and restores
         # onto any target sharding. Orbax manages its own async IO, so
@@ -231,14 +259,29 @@ class CheckpointHook:
             return
 
         leaves = jax.device_get(named_leaves_from_state(state))
+        # Host-table snapshot on the caller's thread: the async writer
+        # must not race ongoing apply_row_grads over live tables.
+        embeddings = None
+        if self._host_tables:
+            from elasticdl_tpu.embedding.table import EmbeddingTable
+
+            embeddings = {}
+            for name, table in self._host_tables.items():
+                ids, rows = table.to_arrays()
+                embeddings[name] = EmbeddingTable.from_arrays(
+                    name, ids, rows
+                )
+        # Only pass the kwarg when host tables exist — custom savers
+        # (tests, adapters) need not grow the parameter otherwise.
+        kwargs = {"embeddings": embeddings} if embeddings else {}
         if not self._async:
-            self.saver.save(version, leaves)
+            self.saver.save(version, leaves, **kwargs)
             self._last_saved = version
             return
 
         def write():
             try:
-                self.saver.save(version, leaves)
+                self.saver.save(version, leaves, **kwargs)
             except BaseException as exc:
                 self._pending_error = exc
                 logger.error(

@@ -39,6 +39,8 @@ from elasticdl_tpu.embedding.optimizer import (
 from elasticdl_tpu.embedding.host_engine import (
     HostEmbedding,
     HostEmbeddingEngine,
+    HostStepRunner,
+    build_host_eval_step,
     build_host_train_step,
     host_rows_template,
 )
@@ -47,6 +49,8 @@ from elasticdl_tpu.embedding.table import EmbeddingTable, get_slot_table_name
 __all__ = [
     "HostEmbedding",
     "HostEmbeddingEngine",
+    "HostStepRunner",
+    "build_host_eval_step",
     "build_host_train_step",
     "host_rows_template",
     "HostOptimizerWrapper",

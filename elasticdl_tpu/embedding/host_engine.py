@@ -16,9 +16,12 @@ capability is mesh-native:
   (`HostEmbedding` layer) and indexes them with the batch's inverse map,
 - the step function differentiates w.r.t. the row block; the engine
   scatters the row gradients back through a row optimizer
-  (`HostOptimizerWrapper` / native), slots co-stored with the table,
-- `prepared_batches` double-buffers: rows for batch N+1 are pulled on a
-  background thread while batch N trains on device.
+  (`HostOptimizerWrapper` / native); slot tables and step counters ride
+  the checkpoint via `HostStepRunner.host_tables`,
+- `prepared_batches` double-buffers for engine-driven loops: rows for
+  batch N+1 are pulled on a background thread while batch N trains.
+  (`HostStepRunner` — the Worker adapter — prepares synchronously
+  inside each step, since the worker hands it one batch at a time.)
 """
 
 from typing import Callable, Dict, Iterable, Optional, Tuple
@@ -170,6 +173,24 @@ def build_host_train_step(loss_fn: Callable, rows_template) -> Callable:
     return jax.jit(train_step, donate_argnums=(0,))
 
 
+def build_host_eval_step(rows_template) -> Callable:
+    """Build ``(state, batch, host_rows) -> predictions`` (host-tier
+    counterpart of core/step.build_eval_step)."""
+
+    def eval_step(state, batch, host_rows):
+        variables = {
+            "params": state.params,
+            HOST_ROWS_COLLECTION: _nest_rows(rows_template, host_rows),
+        }
+        if state.batch_stats:
+            variables["batch_stats"] = state.batch_stats
+        return state.apply_fn(
+            variables, batch["features"], training=False, mutable=False
+        )
+
+    return jax.jit(eval_step)
+
+
 class HostEmbeddingEngine:
     """Pull/dedup/pad rows per batch; scatter row grads back after.
 
@@ -208,9 +229,13 @@ class HostEmbeddingEngine:
           padding whose grads are dropped,
         - uniques — {table: (unique_ids, u)} for apply_row_grads.
         """
-        features = dict(batch["features"]) if isinstance(
-            batch["features"], dict
-        ) else {"__only__": batch["features"]}
+        if not isinstance(batch["features"], dict):
+            raise TypeError(
+                "host-tier batches need dict features (id_keys names the "
+                "feature carrying each table's ids); got "
+                f"{type(batch['features']).__name__}"
+            )
+        features = dict(batch["features"])
         host_rows, uniques = {}, {}
         for table_name, key in self.id_keys.items():
             ids = features[key]
@@ -221,7 +246,7 @@ class HostEmbeddingEngine:
             bucket = bucket_size(u)
             table = self.tables[table_name]
             rows = np.zeros((bucket, table.dim), np.float32)
-            rows[:u] = table.get([int(i) for i in uniq])
+            rows[:u] = table.get(uniq)
             inv = inverse.reshape(raw.shape).astype(np.int32)
             features[key] = (
                 RaggedIds(ids=inv, weights=ids.weights) if ragged else inv
@@ -229,10 +254,7 @@ class HostEmbeddingEngine:
             host_rows[table_name] = rows
             uniques[table_name] = (uniq, u)
         out = dict(batch)
-        out["features"] = (
-            features["__only__"] if "__only__" in features
-            else features
-        )
+        out["features"] = features
         return out, host_rows, uniques
 
     def apply_row_grads(self, row_grads: dict, uniques: dict) -> None:
@@ -241,7 +263,7 @@ class HostEmbeddingEngine:
         for table_name, (uniq, u) in uniques.items():
             grads = np.asarray(row_grads[table_name])[:u]
             self.optimizer.apply_gradients(
-                self.tables[table_name], [int(i) for i in uniq], grads
+                self.tables[table_name], uniq, grads
             )
 
     def prepared_batches(self, batches: Iterable[dict], depth: int = 2):
@@ -258,3 +280,64 @@ class HostEmbeddingEngine:
         return prefetch(
             (self.prepare_batch(b) for b in batches), depth=depth
         )
+
+
+class HostStepRunner:
+    """Step-runner adapter: drive host-tier models through the standard
+    Worker/MiniCluster loop (worker.py accepts any runner exposing
+    init_state/train_step/eval_step). prepare/apply happen inside the
+    wrapped step so the worker's (state, batch) contract is unchanged —
+    the role the reference worker's PS stubs played inline
+    (worker.py:869-908), collapsed into the runner.
+    """
+
+    def __init__(self, engine: HostEmbeddingEngine):
+        self.engine = engine
+        self._template = None
+        self._model = None
+
+    @property
+    def host_tables(self) -> Dict:
+        """Everything the checkpoint must carry: main tables PLUS the
+        row optimizer's slot tables and per-table step counters (Adam
+        bias correction must not restart at 1 after a relaunch). Pass
+        to CheckpointHook(host_tables=...) / restore_from_dir."""
+        out = dict(self.engine.tables)
+        state_tables = getattr(self.engine.optimizer, "state_tables", None)
+        if state_tables is not None:
+            out.update(state_tables(self.engine.tables))
+        return out
+
+    def init_state(self, model, tx, batch):
+        from elasticdl_tpu.core.train_state import init_train_state
+
+        prepared, _, _ = self.engine.prepare_batch(batch)
+        self._template = host_rows_template(model, prepared)
+        self._model = model
+        return init_train_state(model, tx, prepared)
+
+    def train_step(self, loss_fn: Callable) -> Callable:
+        host_step = build_host_train_step(loss_fn, self._template)
+        engine = self.engine
+
+        def step(state, batch):
+            prepared, host_rows, uniques = engine.prepare_batch(batch)
+            state, row_grads, metrics = host_step(
+                state, prepared, host_rows
+            )
+            engine.apply_row_grads(
+                {k: np.asarray(v) for k, v in row_grads.items()}, uniques
+            )
+            return state, metrics
+
+        return step
+
+    def eval_step(self) -> Callable:
+        host_eval = build_host_eval_step(self._template)
+        engine = self.engine
+
+        def step(state, batch):
+            prepared, host_rows, _ = engine.prepare_batch(batch)
+            return host_eval(state, prepared, host_rows)
+
+        return step

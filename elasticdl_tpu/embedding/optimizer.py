@@ -253,3 +253,66 @@ class HostOptimizerWrapper:
                 ids, np.asarray(new_slots[name])
             )
         return table
+
+    def state_tables(self, main_tables: Dict) -> Dict:
+        """Slot tables + step counters for checkpointing (see
+        wrapper_state_tables)."""
+        return wrapper_state_tables(self, main_tables)
+
+
+# ---- checkpoint integration ----------------------------------------------
+
+STEPS_TABLE_NAME = "__row_optimizer_steps__"
+
+
+class _StepCountersTable:
+    """Checkpoint adapter persisting a wrapper's per-table apply counts
+    as a dim-1 table (row id = crc32 of the main-table name). Exposes
+    exactly the to_arrays/set surface the checkpoint hook and
+    restore_from_dir use, so step counts ride the normal embeddings
+    payload (Adam bias correction must not restart at 1 after a
+    relaunch)."""
+
+    dim = 1
+
+    def __init__(self, wrapper, table_names):
+        import zlib
+
+        self._wrapper = wrapper
+        self._name_of = {
+            zlib.crc32(name.encode("utf-8")): name for name in table_names
+        }
+        if len(self._name_of) < len(list(table_names)):
+            raise ValueError(
+                f"table-name hash collision among {list(table_names)}"
+            )
+
+    def to_arrays(self):
+        items = sorted(
+            (tid, self._wrapper._steps[name])
+            for tid, name in self._name_of.items()
+            if self._wrapper._steps.get(name)
+        )
+        ids = np.array([t for t, _ in items], np.int64)
+        rows = np.array([[s] for _, s in items], np.float64).reshape(-1, 1)
+        return ids, rows
+
+    def set(self, ids, values):
+        values = np.asarray(values).reshape(len(list(ids)), -1)
+        for tid, row in zip(ids, values):
+            name = self._name_of.get(int(tid))
+            if name is not None:
+                self._wrapper._steps[name] = int(round(float(row[0])))
+
+
+def wrapper_state_tables(wrapper, main_tables: Dict) -> Dict:
+    """Slot tables + step counters of a host/native optimizer wrapper,
+    keyed for the checkpoint embeddings payload. Pre-creates every slot
+    table for ``main_tables`` so a FRESH wrapper (relaunch path) has
+    live objects for restore to refill."""
+    for table in main_tables.values():
+        for slot in wrapper.opt.slot_names:
+            wrapper._slot_table(table, slot)
+    out = dict(wrapper._slot_tables)
+    out[STEPS_TABLE_NAME] = _StepCountersTable(wrapper, list(main_tables))
+    return out

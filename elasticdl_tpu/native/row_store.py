@@ -203,6 +203,13 @@ class NativeOptimizerWrapper:
             raise ValueError(f"No native kernel for {opt.name}")
         return table
 
+    def state_tables(self, main_tables: Dict) -> Dict:
+        """Slot tables + step counters for checkpointing (shared adapter
+        with the Python wrapper)."""
+        from elasticdl_tpu.embedding.optimizer import wrapper_state_tables
+
+        return wrapper_state_tables(self, main_tables)
+
 
 def make_host_table(name: str, dim: int, dtype=np.float32, **kwargs):
     """Native table when available + float32 + a supported initializer

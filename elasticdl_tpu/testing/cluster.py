@@ -109,15 +109,8 @@ class MiniCluster:
         task_reader = (
             self.train_reader or self.eval_reader or self.predict_reader
         )
-        hook = None
-        if checkpoint_dir:
-            from elasticdl_tpu.checkpoint import CheckpointHook
-
-            hook = CheckpointHook(
-                checkpoint_dir=checkpoint_dir,
-                checkpoint_steps=checkpoint_steps,
-            )
         self.workers: List[Worker] = []
+        hook = None
         for wid in range(num_workers):
             if use_rpc:
                 client = MasterClient(
@@ -132,6 +125,16 @@ class MiniCluster:
             runner = (
                 step_runner_factory() if step_runner_factory else None
             )
+            if wid == 0 and checkpoint_dir:
+                from elasticdl_tpu.checkpoint import CheckpointHook
+
+                # Built once worker 0's runner exists so host-tier
+                # tables (HostStepRunner) checkpoint alongside the state.
+                hook = CheckpointHook(
+                    checkpoint_dir=checkpoint_dir,
+                    checkpoint_steps=checkpoint_steps,
+                    host_tables=getattr(runner, "host_tables", None),
+                )
             self.workers.append(
                 Worker(
                     worker_id=wid,

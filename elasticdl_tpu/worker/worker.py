@@ -158,9 +158,18 @@ class Worker:
             if self._fuse_task_steps and getattr(
                 self._step_runner, "accum_steps", 1
             ) == 1:
-                self._multi_step = self._step_runner.train_multi_step(
-                    self._spec.loss
-                )
+                if hasattr(self._step_runner, "train_multi_step"):
+                    self._multi_step = self._step_runner.train_multi_step(
+                        self._spec.loss
+                    )
+                else:
+                    # e.g. HostStepRunner: host-side work per batch can't
+                    # fuse into one XLA program; fall back to per-step.
+                    logger.warning(
+                        "fuse_task_steps ignored: %s has no "
+                        "train_multi_step",
+                        type(self._step_runner).__name__,
+                    )
         else:
             self.state = init_train_state(self._spec.model, tx, batch)
             self._train_step = build_train_step(self._spec.loss)
@@ -174,6 +183,9 @@ class Worker:
             self.state = restore_from_dir(
                 self.state, self._checkpoint_dir_for_init,
                 required=self._checkpoint_init_required,
+                host_tables=getattr(
+                    self._step_runner, "host_tables", None
+                ),
             )
             # Restored leaves are host arrays; re-place them with the
             # runner's shardings or a mesh-sized table lands on one device.
