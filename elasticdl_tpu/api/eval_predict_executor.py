@@ -64,7 +64,15 @@ class EvalPredictExecutor:
         self._batch_size = args.minibatch_size
         self._ckpt_dir = args.checkpoint_dir_for_init
         self.state = None
-        self._eval_step = build_eval_step()
+        # Host-tier models: rows come back from the checkpoint into the
+        # runner's tables; its eval step reads them per batch.
+        self._step_runner = (
+            self._spec.make_host_runner()
+            if self._spec.make_host_runner else None
+        )
+        self._eval_step = (
+            None if self._step_runner is not None else build_eval_step()
+        )
 
     def _batches(self):
         data_mode = (
@@ -89,10 +97,19 @@ class EvalPredictExecutor:
             )
 
     def _restore(self, batch):
-        self.state = init_train_state(
-            self._spec.model, self._spec.make_optimizer(), batch
+        if self._step_runner is not None:
+            self.state = self._step_runner.init_state(
+                self._spec.model, self._spec.make_optimizer(), batch
+            )
+            self._eval_step = self._step_runner.eval_step()
+        else:
+            self.state = init_train_state(
+                self._spec.model, self._spec.make_optimizer(), batch
+            )
+        self.state = restore_from_dir(
+            self.state, self._ckpt_dir,
+            host_tables=getattr(self._step_runner, "host_tables", None),
         )
-        self.state = restore_from_dir(self.state, self._ckpt_dir)
         logger.info(
             "Restored model version %d from %s",
             int(self.state.step), self._ckpt_dir,

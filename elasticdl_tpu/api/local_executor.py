@@ -67,8 +67,19 @@ class LocalExecutor:
         self._timing = Timing(args.log_level.upper() == "DEBUG", self._logger)
         self.state = None
         self.last_batch = None
-        self._train_step = build_train_step(self._spec.loss)
-        self._eval_step = build_eval_step()
+        # Host-tier models (make_host_runner in the zoo module) run
+        # through their runner; its steps are built at state init (the
+        # row-block template needs an example batch).
+        self._step_runner = (
+            self._spec.make_host_runner()
+            if self._spec.make_host_runner else None
+        )
+        if self._step_runner is None:
+            self._train_step = build_train_step(self._spec.loss)
+            self._eval_step = build_eval_step()
+        else:
+            self._train_step = None
+            self._eval_step = None
         self.last_train_metrics = None
         # Checkpointing (reference save inside push_gradients every
         # checkpoint_steps versions, ps/servicer.py:242-257; restore-at-init
@@ -80,6 +91,7 @@ class LocalExecutor:
             # 0 is a legal explicit value meaning "keep everything"
             # (CheckpointSaver.gc); only an absent flag falls back to 3.
             keep_max=getattr(args, "keep_checkpoint_max", 3),
+            host_tables=getattr(self._step_runner, "host_tables", None),
         )
         self._init_checkpoint_dir = getattr(
             args, "checkpoint_dir_for_init", ""
@@ -149,13 +161,25 @@ class LocalExecutor:
             tx = apply_callbacks_to_optimizer(
                 self._spec.make_optimizer(), self._callbacks
             )
-            self.state = init_train_state(
-                self._spec.model, tx, batch,
-                seed=getattr(self._args, "random_seed", 0),
-            )
+            if self._step_runner is not None:
+                self.state = self._step_runner.init_state(
+                    self._spec.model, tx, batch
+                )
+                self._train_step = self._step_runner.train_step(
+                    self._spec.loss
+                )
+                self._eval_step = self._step_runner.eval_step()
+            else:
+                self.state = init_train_state(
+                    self._spec.model, tx, batch,
+                    seed=getattr(self._args, "random_seed", 0),
+                )
             if self._init_checkpoint_dir:
                 self.state = restore_from_dir(
-                    self.state, self._init_checkpoint_dir
+                    self.state, self._init_checkpoint_dir,
+                    host_tables=getattr(
+                        self._step_runner, "host_tables", None
+                    ),
                 )
 
     def _maybe_checkpoint(self):

@@ -7,6 +7,8 @@ this across ps/parameter_server.py:49-66 and ps/servicer.py:242-257).
 
 from typing import Optional
 
+import numpy as np
+
 from elasticdl_tpu.checkpoint.saver import CheckpointSaver
 from elasticdl_tpu.checkpoint.state_io import (
     named_leaves_from_state,
@@ -104,13 +106,20 @@ def restore_from_dir(state, checkpoint_dir: str, required: bool = True,
         )
         return state
     state = restore_state_from_named_leaves(state, dense)
+    missing = [n for n in (host_tables or {}) if n not in embeddings]
+    if missing:
+        # Loud, like the orbax guard above: continuing would silently
+        # lazy-reinit every trained row / optimizer slot.
+        raise ValueError(
+            f"checkpoint at {checkpoint_dir} (version {int(state.step)}) "
+            f"carries no host-table payload for {sorted(missing)}; "
+            "was it written without host_tables, or with a different "
+            "row optimizer?"
+        )
     for name, table in (host_tables or {}).items():
-        saved = embeddings.get(name)
-        if saved is None:
-            continue
-        ids, rows = saved.to_arrays()
+        ids, rows = embeddings[name].to_arrays()
         if ids.size:
-            table.set([int(i) for i in ids], rows)
+            table.set(ids, rows)
     logger.info(
         "Restored state at version %d from %s",
         int(state.step), checkpoint_dir,
@@ -268,8 +277,12 @@ class CheckpointHook:
             embeddings = {}
             for name, table in self._host_tables.items():
                 ids, rows = table.to_arrays()
+                # Preserve the source dtype: step counters serialize as
+                # float64 rows (exact ints past 2^24), and a float32
+                # default here would silently round them.
                 embeddings[name] = EmbeddingTable.from_arrays(
-                    name, ids, rows
+                    name, ids, rows,
+                    dtype=rows.dtype if rows.size else np.float32,
                 )
         # Only pass the kwarg when host tables exist — custom savers
         # (tests, adapters) need not grow the parameter otherwise.

@@ -81,6 +81,10 @@ class ModelSpec:
     param_sharding_rules: Optional[Callable] = None
     batch_sharding_rule: Optional[Callable] = None
     model_fn: Optional[Callable] = None
+    # Host-tier models (embedding/host_engine.py): zero-arg factory
+    # returning a HostStepRunner. When present, the worker and local
+    # executor drive the model through it automatically.
+    make_host_runner: Optional[Callable] = None
 
     def make_optimizer(self, **kwargs):
         return self.optimizer_fn(**kwargs)
@@ -141,4 +145,5 @@ def get_model_spec(
         ),
         batch_sharding_rule=_get_spec_value(module, "batch_sharding_rule"),
         model_fn=model_fn,
+        make_host_runner=_get_spec_value(module, "make_host_runner"),
     )

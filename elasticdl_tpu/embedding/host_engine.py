@@ -22,8 +22,15 @@ capability is mesh-native:
   batch N+1 are pulled on a background thread while batch N trains.
   (`HostStepRunner` — the Worker adapter — prepares synchronously
   inside each step, since the worker hands it one batch at a time.)
+
+Scope: the host tier lives in ONE training process (tables in that
+process's RAM). Multi-worker jobs sharing one table would reintroduce a
+row service over RPC — the one PS role deliberately not rebuilt this
+round (PARITY.md "Known gaps"); in-process multi-worker tests share a
+single runner instead.
 """
 
+import threading
 from typing import Callable, Dict, Iterable, Optional, Tuple
 
 import jax
@@ -203,6 +210,12 @@ class HostEmbeddingEngine:
     """
 
     def __init__(self, tables: Dict, optimizer, id_keys: Dict[str, str]):
+        # Serializes host-side table access: in-process multi-worker
+        # jobs share ONE engine (threads), and neither the dict table
+        # nor the C++ open-addressing row map (which rehashes on
+        # growth) is safe under concurrent mutation. The device step
+        # itself still runs outside the lock.
+        self.lock = threading.RLock()
         unknown = set(id_keys) - set(tables)
         if unknown:
             raise ValueError(f"id_keys reference unknown tables {unknown}")
@@ -229,6 +242,10 @@ class HostEmbeddingEngine:
           padding whose grads are dropped,
         - uniques — {table: (unique_ids, u)} for apply_row_grads.
         """
+        with self.lock:
+            return self._prepare_batch_locked(batch)
+
+    def _prepare_batch_locked(self, batch):
         if not isinstance(batch["features"], dict):
             raise TypeError(
                 "host-tier batches need dict features (id_keys names the "
@@ -260,11 +277,12 @@ class HostEmbeddingEngine:
     def apply_row_grads(self, row_grads: dict, uniques: dict) -> None:
         """Scatter the step's row gradients into the host tables
         (lookup-apply-writeback, reference optimizer_wrapper.py:143)."""
-        for table_name, (uniq, u) in uniques.items():
-            grads = np.asarray(row_grads[table_name])[:u]
-            self.optimizer.apply_gradients(
-                self.tables[table_name], uniq, grads
-            )
+        with self.lock:
+            for table_name, (uniq, u) in uniques.items():
+                grads = np.asarray(row_grads[table_name])[:u]
+                self.optimizer.apply_gradients(
+                    self.tables[table_name], uniq, grads
+                )
 
     def prepared_batches(self, batches: Iterable[dict], depth: int = 2):
         """Double-buffered iterator: rows for upcoming batches are
@@ -301,12 +319,17 @@ class HostStepRunner:
         """Everything the checkpoint must carry: main tables PLUS the
         row optimizer's slot tables and per-table step counters (Adam
         bias correction must not restart at 1 after a relaunch). Pass
-        to CheckpointHook(host_tables=...) / restore_from_dir."""
+        to CheckpointHook(host_tables=...) / restore_from_dir. Views
+        are lock-guarded so checkpoint snapshots don't race training
+        threads sharing the engine."""
         out = dict(self.engine.tables)
         state_tables = getattr(self.engine.optimizer, "state_tables", None)
         if state_tables is not None:
             out.update(state_tables(self.engine.tables))
-        return out
+        return {
+            name: _LockedTable(table, self.engine.lock)
+            for name, table in out.items()
+        }
 
     def init_state(self, model, tx, batch):
         from elasticdl_tpu.core.train_state import init_train_state
@@ -341,3 +364,33 @@ class HostStepRunner:
             return host_eval(state, prepared, host_rows)
 
         return step
+
+
+class _LockedTable:
+    """Lock-guarded view over a host table (or checkpoint adapter): the
+    checkpoint hook snapshots and restore refills under the engine's
+    lock, never racing training threads."""
+
+    def __init__(self, table, lock):
+        self._table = table
+        self._lock = lock
+
+    def to_arrays(self):
+        with self._lock:
+            return self._table.to_arrays()
+
+    def set(self, ids, values):
+        with self._lock:
+            return self._table.set(ids, values)
+
+    def get(self, ids):
+        with self._lock:
+            return self._table.get(ids)
+
+    @property
+    def num_rows(self):
+        with self._lock:
+            return self._table.num_rows
+
+    def __getattr__(self, name):
+        return getattr(self._table, name)

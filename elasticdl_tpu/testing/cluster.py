@@ -106,6 +106,12 @@ class MiniCluster:
                 "localhost:0", {SERVICE_NAME: self.servicer.handlers()}
             ).start()
 
+        if step_runner_factory is None and self.spec.make_host_runner:
+            # Host-tier default: ONE runner shared by every worker so all
+            # threads train the same row stores (the PS-sharing shape);
+            # a per-worker factory would silently fork the tables.
+            shared_runner = self.spec.make_host_runner()
+            step_runner_factory = lambda: shared_runner  # noqa: E731
         task_reader = (
             self.train_reader or self.eval_reader or self.predict_reader
         )

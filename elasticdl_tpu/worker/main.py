@@ -115,6 +115,15 @@ def build_worker(args, master_client=None) -> Worker:
                 and getattr(args, "lr_staleness_modulation", False)
             ),
         )
+    if spec.make_host_runner is not None:
+        # Host-tier model (>HBM tables, embedding/host_engine.py): the
+        # zoo module supplies the runner holding its row stores.
+        if step_runner is not None:
+            raise ValueError(
+                "host-tier models (make_host_runner) do not combine "
+                "with MeshStrategy; use the default strategy"
+            )
+        step_runner = spec.make_host_runner()
     if master_client is None:
         master_client = MasterClient(
             args.master_addr, worker_id=args.worker_id
@@ -146,6 +155,7 @@ def build_worker(args, master_client=None) -> Worker:
             # the barrier aligns save versions. Non-mesh strategies keep
             # the native per-process saver.
             backend="orbax" if mesh_multihost else "native",
+            host_tables=getattr(step_runner, "host_tables", None),
         )
     callbacks = spec.callbacks_fn() if spec.callbacks_fn else []
     from elasticdl_tpu.callbacks import set_callback_parameters

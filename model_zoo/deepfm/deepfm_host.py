@@ -5,9 +5,10 @@ pods (``model_zoo/deepfm_edl_embedding/deepfm_edl_embedding.py:27-61``);
 this variant is the TPU-native equivalent of that deployment shape: the
 table lives in host RAM (C++ row store when available), rows are pulled
 per batch as bucket-padded blocks and row grads scattered back
-(`embedding/host_engine.py`). Run it by passing
-``step_runner_factory=make_host_runner`` (MiniCluster) or constructing a
-`HostStepRunner` for the Worker.
+(`embedding/host_engine.py`). No extra wiring needed: the spec loader
+resolves ``make_host_runner`` and the executors/worker/MiniCluster pick
+it up automatically (MiniCluster shares ONE runner across its worker
+threads — per-worker runners would fork the tables).
 
 Same frappe-record dataset contract as deepfm_functional.
 """

@@ -20,13 +20,14 @@ from elasticdl_tpu.testing.data import (
 
 
 def _cluster(train, ckpt_dir="", **kwargs):
+    # No step_runner_factory: MiniCluster resolves spec.make_host_runner
+    # itself and shares one runner across workers (the auto-share path).
     return MiniCluster(
         model_zoo=model_zoo_dir(),
         model_def="deepfm.deepfm_host.custom_model",
         training_data=train,
         minibatch_size=16,
         num_minibatches_per_task=2,
-        step_runner_factory=deepfm_host.make_host_runner,
         checkpoint_dir=ckpt_dir,
         checkpoint_steps=2 if ckpt_dir else 0,
         **kwargs,
@@ -168,3 +169,54 @@ def test_adam_slot_state_survives_relaunch(tmp_path):
     assert new_m.keys() == old_m.keys()
     for rid in old_m:
         np.testing.assert_allclose(new_m[rid], old_m[rid], rtol=1e-6)
+
+
+def test_host_deepfm_cli_local_train_then_evaluate(tmp_path):
+    """The full user workflow with zero extra wiring: `train
+    --distribution_strategy=Local` then `evaluate` from the checkpoint,
+    host tables restored automatically via spec.make_host_runner."""
+    import sys
+
+    from elasticdl_tpu.api.client import main as cli_main
+
+    train = create_frappe_record_file(str(tmp_path / "t.rec"), 64, seed=5)
+    val = create_frappe_record_file(str(tmp_path / "v.rec"), 32, seed=6)
+    ckpt = str(tmp_path / "ckpt")
+    base = [
+        "--model_zoo", model_zoo_dir(),
+        "--model_def", "deepfm.deepfm_host.custom_model",
+        "--minibatch_size", "16",
+        "--distribution_strategy", "Local",
+        "--job_name", "hostjob",
+    ]
+    argv_train = ["prog", "train", *base,
+                  "--training_data", train,
+                  "--num_epochs", "1",
+                  "--checkpoint_dir", ckpt, "--checkpoint_steps", "2"]
+    argv_eval = ["prog", "evaluate", *base,
+                 "--validation_data", val,
+                 "--checkpoint_dir_for_init", ckpt]
+    old = sys.argv
+    try:
+        sys.argv = argv_train
+        assert cli_main() == 0
+        sys.argv = argv_eval
+        assert cli_main() == 0
+    finally:
+        sys.argv = old
+    saver = CheckpointSaver(ckpt)
+    _, _, embeddings = saver.restore()
+    assert embeddings[deepfm_host.TABLE_NAME].num_rows > 0
+
+
+def test_two_workers_share_one_host_table(tmp_path):
+    """Auto-share: both worker threads train the SAME row stores (the
+    PS-sharing shape); engine lock serializes host-side access."""
+    train = create_frappe_record_file(str(tmp_path / "t.rec"), 128, seed=7)
+    cluster = _cluster(train, num_workers=2)
+    cluster.run()
+    assert cluster.finished
+    r0 = cluster.workers[0]._step_runner
+    r1 = cluster.workers[1]._step_runner
+    assert r0 is r1  # one shared runner, not forked tables
+    assert r0.host_tables[deepfm_host.TABLE_NAME].num_rows > 0
