@@ -163,7 +163,8 @@ class LocalExecutor:
             )
             if self._step_runner is not None:
                 self.state = self._step_runner.init_state(
-                    self._spec.model, tx, batch
+                    self._spec.model, tx, batch,
+                    seed=getattr(self._args, "random_seed", 0),
                 )
                 self._train_step = self._step_runner.train_step(
                     self._spec.loss

@@ -175,11 +175,25 @@ class CheckpointSaver:
                     if slices.values.ndim == 2 else 0
                 )
                 table = embeddings.get(tname)
+                if table is not None and (
+                    table.num_rows == 0 and slices.ids.size
+                    and slices.values.dtype != table.dtype
+                ):
+                    # A row-less placeholder from an earlier empty shard
+                    # must not pin the dtype either.
+                    table = None
                 if table is None or (table.dim == 0 and dim):
-                    table = EmbeddingTable(tname, dim)
+                    # Preserve the saved dtype: step counters serialize
+                    # as float64 rows (exact ints past 2^24) and must
+                    # not round through a float32 default on restore.
+                    dtype = (
+                        slices.values.dtype
+                        if slices.ids.size else np.float32
+                    )
+                    table = EmbeddingTable(tname, dim, dtype=dtype)
                     embeddings[tname] = table
                 if slices.ids.size:
-                    table.set([int(i) for i in slices.ids], slices.values)
+                    table.set(slices.ids, slices.values)
         return int(version), dense, embeddings
 
     # ---- GC ------------------------------------------------------------

@@ -178,6 +178,10 @@ def add_train_params(parser):
     parser.add_argument("--prefetch_depth", type=non_neg_int, default=2,
                         help="Background batch-decode queue depth "
                              "(0 disables prefetching)")
+    parser.add_argument("--row_service_addr", default="",
+                        help="Address of a shared host-tier row service "
+                             "(embedding/row_service.py) — required for "
+                             "host-tier models with num_workers > 1")
     add_bool_param(parser, "--fuse_task_steps", False,
                    "Scan a whole task's minibatches in one XLA program "
                    "(removes per-step host dispatch)")

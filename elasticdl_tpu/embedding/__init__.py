@@ -44,11 +44,17 @@ from elasticdl_tpu.embedding.host_engine import (
     build_host_train_step,
     host_rows_template,
 )
+from elasticdl_tpu.embedding.row_service import (
+    HostRowService,
+    make_remote_engine,
+)
 from elasticdl_tpu.embedding.table import EmbeddingTable, get_slot_table_name
 
 __all__ = [
     "HostEmbedding",
     "HostEmbeddingEngine",
+    "HostRowService",
+    "make_remote_engine",
     "HostStepRunner",
     "build_host_eval_step",
     "build_host_train_step",

@@ -23,11 +23,10 @@ capability is mesh-native:
   (`HostStepRunner` — the Worker adapter — prepares synchronously
   inside each step, since the worker hands it one batch at a time.)
 
-Scope: the host tier lives in ONE training process (tables in that
-process's RAM). Multi-worker jobs sharing one table would reintroduce a
-row service over RPC — the one PS role deliberately not rebuilt this
-round (PARITY.md "Known gaps"); in-process multi-worker tests share a
-single runner instead.
+Scope: one engine = one process's tables. In-process multi-worker jobs
+share a single runner (engine lock serializes host access); multi-
+PROCESS jobs share rows through `embedding/row_service.py` — the
+Pserver sparse role over RPC (`--row_service_addr`).
 """
 
 import threading
@@ -321,23 +320,22 @@ class HostStepRunner:
         bias correction must not restart at 1 after a relaunch). Pass
         to CheckpointHook(host_tables=...) / restore_from_dir. Views
         are lock-guarded so checkpoint snapshots don't race training
-        threads sharing the engine."""
-        out = dict(self.engine.tables)
-        state_tables = getattr(self.engine.optimizer, "state_tables", None)
-        if state_tables is not None:
-            out.update(state_tables(self.engine.tables))
-        return {
-            name: _LockedTable(table, self.engine.lock)
-            for name, table in out.items()
-        }
+        threads sharing the engine. None for remote engines
+        (embedding/row_service.py): the row SERVICE owns its rows'
+        checkpointing, like the reference PS did."""
+        if getattr(self.engine, "remote", False):
+            return None
+        return locked_checkpoint_tables(
+            self.engine.tables, self.engine.optimizer, self.engine.lock
+        )
 
-    def init_state(self, model, tx, batch):
+    def init_state(self, model, tx, batch, seed: int = 0):
         from elasticdl_tpu.core.train_state import init_train_state
 
         prepared, _, _ = self.engine.prepare_batch(batch)
-        self._template = host_rows_template(model, prepared)
+        self._template = host_rows_template(model, prepared, seed=seed)
         self._model = model
-        return init_train_state(model, tx, prepared)
+        return init_train_state(model, tx, prepared, seed=seed)
 
     def train_step(self, loss_fn: Callable) -> Callable:
         host_step = build_host_train_step(loss_fn, self._template)
@@ -364,6 +362,20 @@ class HostStepRunner:
             return host_eval(state, prepared, host_rows)
 
         return step
+
+
+def locked_checkpoint_tables(tables: Dict, optimizer, lock) -> Dict:
+    """Everything a host-tier checkpoint must carry — main tables plus
+    the optimizer's slot tables and step counters — each behind a
+    lock-guarded view. Shared by HostStepRunner and HostRowService so
+    the local and served checkpoint payloads cannot drift."""
+    out = dict(tables)
+    state_tables = getattr(optimizer, "state_tables", None)
+    if state_tables is not None:
+        out.update(state_tables(tables))
+    return {
+        name: _LockedTable(table, lock) for name, table in out.items()
+    }
 
 
 class _LockedTable:

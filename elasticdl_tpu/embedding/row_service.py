@@ -1,0 +1,162 @@
+"""Shared row service: the host tier served over RPC.
+
+The one parameter-server role the mesh cannot absorb: several *worker
+processes* training one >HBM embedding table need a shared row plane.
+The reference serves it with the Pserver gRPC service
+(``pull_embedding_vectors`` / ``push_gradients``,
+``elasticdl/proto/elasticdl.proto:137-145``; Go impl
+``pkg/ps/server.go:149,162``). Here the same contract rides the
+framework's msgpack RPC (comm/rpc.py):
+
+- **Server** (`HostRowService`): owns the tables (Python or C++ row
+  store) and the row optimizer; applies pushed gradients under a lock
+  (async-PS semantics — concurrent workers interleave, reference
+  async_sgd.md); exposes `host_tables` so the server-side process
+  checkpoints rows + optimizer slots exactly like a local engine.
+- **Client** (`make_remote_engine`): a `HostEmbeddingEngine` whose
+  tables pull rows over RPC and whose "optimizer" pushes gradients
+  back. `HostStepRunner` works unchanged on top; its `host_tables` is
+  None (the server owns checkpointing).
+
+Worker-side dedup/bucketing still applies: each pull moves only the
+batch's unique rows, mirroring the reference worker's dedup before
+push (worker.py:487-599).
+"""
+
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.comm.rpc import RpcServer, RpcStub
+from elasticdl_tpu.embedding.host_engine import HostEmbeddingEngine
+
+logger = get_logger("row_service")
+
+SERVICE_NAME = "RowService"
+
+
+class HostRowService:
+    """Server side of the shared host tier."""
+
+    def __init__(self, tables: Dict, optimizer):
+        self._tables = tables
+        self._optimizer = optimizer
+        self._lock = threading.RLock()
+        self._server: Optional[RpcServer] = None
+
+    # ---- RPC handlers --------------------------------------------------
+
+    def handlers(self):
+        return {
+            "table_info": self._table_info,
+            "pull_rows": self._pull_rows,
+            "push_row_grads": self._push_row_grads,
+        }
+
+    def _table_info(self, request: dict) -> dict:
+        return {
+            "tables": {
+                name: {"dim": int(table.dim)}
+                for name, table in self._tables.items()
+            }
+        }
+
+    def _pull_rows(self, request: dict) -> dict:
+        table = self._tables[request["table"]]
+        with self._lock:
+            rows = table.get(np.asarray(request["ids"], np.int64))
+        return {"rows": np.asarray(rows, np.float32)}
+
+    def _push_row_grads(self, request: dict) -> dict:
+        table = self._tables[request["table"]]
+        with self._lock:
+            self._optimizer.apply_gradients(
+                table,
+                np.asarray(request["ids"], np.int64),
+                np.asarray(request["grads"], np.float32),
+            )
+        return {}
+
+    # ---- lifecycle / checkpoint ---------------------------------------
+
+    def start(self, addr: str = "localhost:0") -> "HostRowService":
+        self._server = RpcServer(
+            addr, {SERVICE_NAME: self.handlers()}
+        ).start()
+        logger.info("Row service on port %d", self._server.port)
+        return self
+
+    @property
+    def port(self) -> int:
+        return self._server.port
+
+    def stop(self, grace: Optional[float] = None):
+        if self._server is not None:
+            self._server.stop(grace)
+
+    @property
+    def host_tables(self) -> Dict:
+        """Rows + optimizer slots + step counters, lock-guarded — pass
+        to CheckpointHook/restore_from_dir in the SERVER process (the
+        reference checkpoints on the PS for the same reason,
+        ps/servicer.py:242-257)."""
+        from elasticdl_tpu.embedding.host_engine import (
+            locked_checkpoint_tables,
+        )
+
+        return locked_checkpoint_tables(
+            self._tables, self._optimizer, self._lock
+        )
+
+
+class _RemoteTable:
+    """Table-like view pulling rows over RPC (get-only: writes happen
+    server-side via the optimizer push)."""
+
+    def __init__(self, stub: RpcStub, name: str, dim: int):
+        self._stub = stub
+        self.name = name
+        self.dim = dim
+
+    def get(self, ids) -> np.ndarray:
+        resp = self._stub.call(
+            "pull_rows", table=self.name,
+            ids=np.asarray(ids, np.int64),
+        )
+        return np.asarray(resp["rows"], np.float32)
+
+
+class _RemoteOptimizer:
+    """Optimizer-like view pushing row grads over RPC; the server
+    applies them (reference push_gradients semantics)."""
+
+    def __init__(self, stub: RpcStub):
+        self._stub = stub
+
+    def apply_gradients(self, table, ids, grads):
+        self._stub.call(
+            "push_row_grads", table=table.name,
+            ids=np.asarray(ids, np.int64),
+            grads=np.asarray(grads, np.float32),
+        )
+        return table
+
+
+def make_remote_engine(
+    addr: str, id_keys: Dict[str, str]
+) -> HostEmbeddingEngine:
+    """Client-side engine over a running `HostRowService`. Table names
+    and dims come from the service itself."""
+    stub = RpcStub(addr, SERVICE_NAME)
+    info = stub.call("table_info")["tables"]
+    tables = {
+        name: _RemoteTable(stub, name, meta["dim"])
+        for name, meta in info.items()
+    }
+    engine = HostEmbeddingEngine(
+        tables, _RemoteOptimizer(stub), id_keys=id_keys
+    )
+    engine.remote = True  # server owns checkpointing (see HostStepRunner)
+    return engine

@@ -123,7 +123,27 @@ def build_worker(args, master_client=None) -> Worker:
                 "host-tier models (make_host_runner) do not combine "
                 "with MeshStrategy; use the default strategy"
             )
-        step_runner = spec.make_host_runner()
+        row_addr = getattr(args, "row_service_addr", "")
+        if row_addr:
+            # Multi-process sharing: rows live behind the row service
+            # (embedding/row_service.py), the Pserver sparse role.
+            try:
+                step_runner = spec.make_host_runner(remote_addr=row_addr)
+            except TypeError:
+                raise ValueError(
+                    f"{args.model_def}: make_host_runner must accept "
+                    "remote_addr=... to run against --row_service_addr"
+                )
+        else:
+            if getattr(args, "num_workers", 1) > 1:
+                # Per-process tables would silently fork: each pod would
+                # train (and lose) its own rows.
+                raise ValueError(
+                    "host-tier models with num_workers > 1 need a shared "
+                    "row service: start embedding.row_service and pass "
+                    "--row_service_addr"
+                )
+            step_runner = spec.make_host_runner()
     if master_client is None:
         master_client = MasterClient(
             args.master_addr, worker_id=args.worker_id

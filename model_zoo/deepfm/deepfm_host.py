@@ -62,9 +62,19 @@ def custom_model():
     return HostDeepFM()
 
 
-def make_host_runner(row_lr: float = 0.05) -> HostStepRunner:
+def make_host_runner(
+    row_lr: float = 0.05, remote_addr: str = ""
+) -> HostStepRunner:
     """Step runner holding the host tables — the deployment unit a
-    reference user's PS pods mapped to."""
+    reference user's PS pods mapped to. ``remote_addr`` points at a
+    shared `HostRowService` for multi-process jobs
+    (--row_service_addr); the service then owns rows + checkpointing."""
+    if remote_addr:
+        from elasticdl_tpu.embedding import make_remote_engine
+
+        return HostStepRunner(make_remote_engine(
+            remote_addr, id_keys={TABLE_NAME: FEATURE_KEY}
+        ))
     from elasticdl_tpu.native.row_store import (
         make_host_optimizer,
         make_host_table,
@@ -76,6 +86,21 @@ def make_host_runner(row_lr: float = 0.05) -> HostStepRunner:
         id_keys={TABLE_NAME: FEATURE_KEY},
     )
     return HostStepRunner(engine)
+
+
+def make_row_service():
+    """Server side for multi-process jobs: run in its own process and
+    `.start(addr)` (tests: tests/test_row_service.py)."""
+    from elasticdl_tpu.embedding import HostRowService
+    from elasticdl_tpu.native.row_store import (
+        make_host_optimizer,
+        make_host_table,
+    )
+
+    return HostRowService(
+        {TABLE_NAME: make_host_table(TABLE_NAME, EMBEDDING_DIM)},
+        make_host_optimizer(SGD(lr=0.05)),
+    )
 
 
 def loss(labels, predictions, mask):
