@@ -135,3 +135,44 @@ def test_server_side_checkpoint_roundtrip(tmp_path):
         assert embeddings[acc_key].num_rows == 3
     finally:
         svc.stop(0)
+
+
+def test_build_worker_host_tier_guards(tmp_path):
+    """build_worker: host-tier model + num_workers>1 demands a row
+    service; with --row_service_addr it builds a remote runner."""
+    from elasticdl_tpu.common.args import parse_worker_args
+    from elasticdl_tpu.worker.main import build_worker
+    from model_zoo.deepfm import deepfm_host
+
+    train = create_frappe_record_file(str(tmp_path / "t.rec"), 32, seed=9)
+    base = [
+        "--worker_id", "0",
+        "--model_zoo", model_zoo_dir(),
+        "--model_def", "deepfm.deepfm_host.custom_model",
+        "--training_data", train,
+        "--minibatch_size", "16",
+        "--job_name", "host-guard-test",
+    ]
+
+    class _StubMaster:
+        pass
+
+    with pytest.raises(ValueError, match="row service"):
+        build_worker(
+            parse_worker_args([*base, "--num_workers", "2"]),
+            master_client=_StubMaster(),
+        )
+
+    svc = deepfm_host.make_row_service().start()
+    try:
+        worker = build_worker(
+            parse_worker_args([
+                *base, "--num_workers", "2",
+                "--row_service_addr", f"localhost:{svc.port}",
+            ]),
+            master_client=_StubMaster(),
+        )
+        assert worker._step_runner is not None
+        assert worker._step_runner.host_tables is None  # service owns rows
+    finally:
+        svc.stop(0)
