@@ -28,7 +28,13 @@ _CHANNEL_OPTIONS = [
 
 
 class RpcError(RuntimeError):
-    pass
+    """Client-side RPC failure; ``code`` is the grpc StatusCode name
+    (e.g. "UNAVAILABLE") so callers can distinguish transient transport
+    failures from permanent handler errors."""
+
+    def __init__(self, message: str, code: str = "UNKNOWN"):
+        super().__init__(message)
+        self.code = code
 
 
 def _serialize(obj: dict) -> bytes:
@@ -136,7 +142,8 @@ class RpcStub:
         except grpc.RpcError as exc:
             raise RpcError(
                 f"{self._service_name}.{method} failed: "
-                f"{exc.code().name}: {exc.details()}"
+                f"{exc.code().name}: {exc.details()}",
+                code=exc.code().name,
             ) from exc
 
     def close(self):

@@ -24,27 +24,86 @@ push (worker.py:487-599).
 """
 
 import threading
+import time
 from typing import Dict, Optional
 
 import numpy as np
 
 from elasticdl_tpu.common.log_utils import get_logger
-from elasticdl_tpu.comm.rpc import RpcServer, RpcStub
+from elasticdl_tpu.comm.rpc import RpcError, RpcServer, RpcStub
 from elasticdl_tpu.embedding.host_engine import HostEmbeddingEngine
 
 logger = get_logger("row_service")
 
 SERVICE_NAME = "RowService"
+SEQS_TABLE_NAME = "__row_service_seqs__"
+
+
+def _client_key(client: str) -> int:
+    """Stable 63-bit key for a client id string (dict/table row id)."""
+    import hashlib
+
+    return int.from_bytes(
+        hashlib.blake2b(client.encode("utf-8"), digest_size=8).digest(),
+        "big",
+    ) >> 1
+
+
+class _SeqTable:
+    """Checkpoint adapter persisting the push-dedup map ({client key:
+    last applied seq}) as a dim-1 table, closing the
+    die-between-checkpoint-and-reply double-apply window: a relaunch
+    restores the map with the rows it belongs to."""
+
+    dim = 1
+
+    def __init__(self, service: "HostRowService"):
+        self._service = service
+
+    def to_arrays(self):
+        items = sorted(self._service._applied_seq.items())
+        ids = np.array([k for k, _ in items], np.int64)
+        rows = np.array(
+            [[v] for _, v in items], np.float64
+        ).reshape(-1, 1)
+        return ids, rows
+
+    def set(self, ids, values):
+        values = np.asarray(values).reshape(len(list(ids)), -1)
+        for key, row in zip(ids, values):
+            self._service._applied_seq[int(key)] = int(round(float(row[0])))
 
 
 class HostRowService:
-    """Server side of the shared host tier."""
+    """Server side of the shared host tier.
 
-    def __init__(self, tables: Dict, optimizer):
+    ``checkpoint_dir``/``checkpoint_steps``: save rows + optimizer
+    state every N gradient pushes — the reference PS checkpoints inside
+    ``push_gradients`` every checkpoint_steps versions
+    (ps/servicer.py:242-257, pkg/ps/server.go:114-127); the push count
+    is the service's version. At start the newest valid version is
+    restored, so a relaunched service pod resumes lossless (reference
+    PS relaunch + checkpoint-restore semantics).
+    """
+
+    def __init__(self, tables: Dict, optimizer, checkpoint_dir: str = "",
+                 checkpoint_steps: int = 0, keep_max: int = 3):
         self._tables = tables
         self._optimizer = optimizer
         self._lock = threading.RLock()
         self._server: Optional[RpcServer] = None
+        self._push_count = 0
+        self._checkpoint_steps = 0
+        self._saver = None
+        self._ckpt_writer_free = threading.Semaphore(1)
+        # Push dedup: {client key: last applied seq} — retried pushes
+        # after an ambiguous failure must not double-apply. Persisted
+        # with the checkpoint (see _SeqTable).
+        self._applied_seq: Dict[int, int] = {}
+        if checkpoint_dir:
+            self.configure_checkpoint(
+                checkpoint_dir, checkpoint_steps, keep_max
+            )
 
     # ---- RPC handlers --------------------------------------------------
 
@@ -71,13 +130,87 @@ class HostRowService:
 
     def _push_row_grads(self, request: dict) -> dict:
         table = self._tables[request["table"]]
+        client = request.get("client", "")
+        seq = int(request.get("seq", -1))
         with self._lock:
+            if client and seq >= 0:
+                key = _client_key(client)
+                if seq <= self._applied_seq.get(key, -1):
+                    # Retried push whose first attempt DID apply before
+                    # the reply was lost (at-most-once semantics).
+                    return {"duplicate": True}
+                self._applied_seq[key] = seq
             self._optimizer.apply_gradients(
                 table,
                 np.asarray(request["ids"], np.int64),
                 np.asarray(request["grads"], np.float32),
             )
+            self._push_count += 1
+            version = self._push_count
+        if (
+            self._saver is not None and self._checkpoint_steps
+            and version % self._checkpoint_steps == 0
+        ):
+            self._checkpoint(version)
         return {}
+
+    # ---- checkpoint ----------------------------------------------------
+
+    def configure_checkpoint(self, checkpoint_dir: str,
+                             checkpoint_steps: int = 0, keep_max: int = 3):
+        """Attach (or re-point) the checkpoint saver and restore the
+        newest valid version."""
+        from elasticdl_tpu.checkpoint.saver import CheckpointSaver
+
+        self._saver = CheckpointSaver(checkpoint_dir, keep_max=keep_max)
+        self._checkpoint_steps = int(checkpoint_steps)
+        self._restore_latest()
+        return self
+
+    def _checkpoint(self, version: int):
+        """ONE lock acquisition across the whole snapshot so rows,
+        optimizer slots, and step counters are captured at the same
+        version; the file write happens outside (pushes keep flowing
+        during IO). A single writer at a time: overlapping triggers
+        skip (their version is covered by the next interval)."""
+        from elasticdl_tpu.embedding.table import EmbeddingTable
+
+        if not self._ckpt_writer_free.acquire(blocking=False):
+            return
+        try:
+            snapshot = {}
+            with self._lock:
+                for name, view in self.host_tables.items():
+                    ids, rows = view.to_arrays()
+                    snapshot[name] = EmbeddingTable.from_arrays(
+                        name, ids, rows,
+                        dtype=rows.dtype if rows.size else np.float32,
+                    )
+            self._saver.save(version, {}, embeddings=snapshot)
+        finally:
+            self._ckpt_writer_free.release()
+
+    def _restore_latest(self):
+        try:
+            version, _, embeddings = self._saver.restore()
+        except FileNotFoundError:
+            return
+        targets = self.host_tables
+        missing = [n for n in targets if n not in embeddings]
+        if missing:
+            raise ValueError(
+                "row-service checkpoint lacks payload for "
+                f"{sorted(missing)}; different optimizer or tables?"
+            )
+        for name, view in targets.items():
+            ids, rows = embeddings[name].to_arrays()
+            if ids.size:
+                view.set(ids, rows)
+        self._push_count = int(version)
+        logger.info(
+            "Row service restored version %d (%d tables)",
+            version, len(targets),
+        )
 
     # ---- lifecycle / checkpoint ---------------------------------------
 
@@ -96,34 +229,69 @@ class HostRowService:
         if self._server is not None:
             self._server.stop(grace)
 
+    def wait(self):
+        """Block until the server stops (process-main lifetime)."""
+        self._server.wait()
+
     @property
     def host_tables(self) -> Dict:
-        """Rows + optimizer slots + step counters, lock-guarded — pass
-        to CheckpointHook/restore_from_dir in the SERVER process (the
-        reference checkpoints on the PS for the same reason,
-        ps/servicer.py:242-257)."""
+        """Rows + optimizer slots + step counters + push-dedup map,
+        lock-guarded — pass to CheckpointHook/restore_from_dir in the
+        SERVER process (the reference checkpoints on the PS for the
+        same reason, ps/servicer.py:242-257)."""
         from elasticdl_tpu.embedding.host_engine import (
+            _LockedTable,
             locked_checkpoint_tables,
         )
 
-        return locked_checkpoint_tables(
+        out = locked_checkpoint_tables(
             self._tables, self._optimizer, self._lock
         )
+        out[SEQS_TABLE_NAME] = _LockedTable(_SeqTable(self), self._lock)
+        return out
+
+
+_TRANSIENT_CODES = ("UNAVAILABLE", "DEADLINE_EXCEEDED")
+
+
+def _call_with_retry(stub: RpcStub, method: str, retries: int,
+                     backoff_secs: float, **fields):
+    """Ride out a service relaunch (reference workers retry PS RPCs via
+    the ≤64 minibatch retry + 3x300s channel waits; here a bounded
+    exponential backoff on the row plane). Only transport-level codes
+    retry — INTERNAL (handler bugs, bad table names) is permanent and
+    surfaces immediately."""
+    delay = backoff_secs
+    for attempt in range(retries + 1):
+        try:
+            return stub.call(method, **fields)
+        except RpcError as exc:
+            if exc.code not in _TRANSIENT_CODES or attempt == retries:
+                raise
+            logger.warning(
+                "row service %s failed (attempt %d/%d); retrying in %.1fs",
+                method, attempt + 1, retries, delay,
+            )
+            time.sleep(delay)
+            delay = min(delay * 2, 30.0)
 
 
 class _RemoteTable:
     """Table-like view pulling rows over RPC (get-only: writes happen
     server-side via the optimizer push)."""
 
-    def __init__(self, stub: RpcStub, name: str, dim: int):
+    def __init__(self, stub: RpcStub, name: str, dim: int,
+                 retries: int = 5, backoff_secs: float = 0.5):
         self._stub = stub
         self.name = name
         self.dim = dim
+        self._retries = retries
+        self._backoff = backoff_secs
 
     def get(self, ids) -> np.ndarray:
-        resp = self._stub.call(
-            "pull_rows", table=self.name,
-            ids=np.asarray(ids, np.int64),
+        resp = _call_with_retry(
+            self._stub, "pull_rows", self._retries, self._backoff,
+            table=self.name, ids=np.asarray(ids, np.int64),
         )
         return np.asarray(resp["rows"], np.float32)
 
@@ -132,31 +300,87 @@ class _RemoteOptimizer:
     """Optimizer-like view pushing row grads over RPC; the server
     applies them (reference push_gradients semantics)."""
 
-    def __init__(self, stub: RpcStub):
+    def __init__(self, stub: RpcStub, retries: int = 5,
+                 backoff_secs: float = 0.5):
+        import uuid
+
         self._stub = stub
+        self._retries = retries
+        self._backoff = backoff_secs
+        self._client = uuid.uuid4().hex
+        self._seq = 0
 
     def apply_gradients(self, table, ids, grads):
-        self._stub.call(
-            "push_row_grads", table=table.name,
+        # (client, seq) lets the server drop a retried push whose first
+        # attempt applied but whose reply was lost.
+        self._seq += 1
+        _call_with_retry(
+            self._stub, "push_row_grads", self._retries, self._backoff,
+            table=table.name,
             ids=np.asarray(ids, np.int64),
             grads=np.asarray(grads, np.float32),
+            client=self._client, seq=self._seq,
         )
         return table
 
 
 def make_remote_engine(
-    addr: str, id_keys: Dict[str, str]
+    addr: str, id_keys: Dict[str, str],
+    retries: int = 5, backoff_secs: float = 0.5,
 ) -> HostEmbeddingEngine:
     """Client-side engine over a running `HostRowService`. Table names
-    and dims come from the service itself."""
+    and dims come from the service itself; pulls/pushes retry with
+    bounded backoff across a service relaunch."""
     stub = RpcStub(addr, SERVICE_NAME)
-    info = stub.call("table_info")["tables"]
+    info = _call_with_retry(stub, "table_info", retries, backoff_secs)[
+        "tables"
+    ]
     tables = {
-        name: _RemoteTable(stub, name, meta["dim"])
+        name: _RemoteTable(stub, name, meta["dim"], retries, backoff_secs)
         for name, meta in info.items()
     }
     engine = HostEmbeddingEngine(
-        tables, _RemoteOptimizer(stub), id_keys=id_keys
+        tables, _RemoteOptimizer(stub, retries, backoff_secs),
+        id_keys=id_keys,
     )
     engine.remote = True  # server owns checkpointing (see HostStepRunner)
     return engine
+
+
+def main(argv=None):
+    """Process entry: ``python -m elasticdl_tpu.embedding.row_service
+    --model_zoo ... --model_def ... [--addr :6100] [--checkpoint_dir ...]``
+    — the zoo module supplies ``make_row_service()`` (the deployment
+    unit the reference's PS pod mapped to)."""
+    import argparse
+
+    from elasticdl_tpu.core.model_spec import load_model_zoo_module
+
+    parser = argparse.ArgumentParser("elasticdl_tpu-row-service")
+    parser.add_argument("--model_zoo", required=True)
+    parser.add_argument("--model_def", required=True)
+    parser.add_argument("--addr", default="[::]:6100")
+    parser.add_argument("--checkpoint_dir", default="")
+    parser.add_argument("--checkpoint_steps", type=int, default=0)
+    parser.add_argument("--keep_checkpoint_max", type=int, default=3)
+    args = parser.parse_args(argv)
+
+    module, _ = load_model_zoo_module(args.model_zoo, args.model_def)
+    factory = getattr(module, "make_row_service", None)
+    if factory is None:
+        raise SystemExit(
+            f"{args.model_def}: module defines no make_row_service()"
+        )
+    service = factory()
+    if args.checkpoint_dir:
+        service.configure_checkpoint(
+            args.checkpoint_dir, args.checkpoint_steps,
+            args.keep_checkpoint_max,
+        )
+    service.start(args.addr)
+    logger.info("Row service serving on %s", args.addr)
+    service.wait()
+
+
+if __name__ == "__main__":
+    main()
