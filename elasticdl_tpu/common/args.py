@@ -182,6 +182,12 @@ def add_train_params(parser):
                         help="Address of a shared host-tier row service "
                              "(embedding/row_service.py) — required for "
                              "host-tier models with num_workers > 1")
+    parser.add_argument("--row_service_resource_request",
+                        default="cpu=1,memory=4096Mi",
+                        help="Resources for the row-service pod (the "
+                             "reference's --ps_resource_request role); "
+                             "CPU-only, independent of worker sizing")
+    parser.add_argument("--row_service_resource_limit", default="")
     add_bool_param(parser, "--fuse_task_steps", False,
                    "Scan a whole task's minibatches in one XLA program "
                    "(removes per-step host dispatch)")

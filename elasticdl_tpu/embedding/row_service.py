@@ -281,7 +281,7 @@ class _RemoteTable:
     server-side via the optimizer push)."""
 
     def __init__(self, stub: RpcStub, name: str, dim: int,
-                 retries: int = 5, backoff_secs: float = 0.5):
+                 retries: int = 12, backoff_secs: float = 0.5):
         self._stub = stub
         self.name = name
         self.dim = dim
@@ -300,7 +300,7 @@ class _RemoteOptimizer:
     """Optimizer-like view pushing row grads over RPC; the server
     applies them (reference push_gradients semantics)."""
 
-    def __init__(self, stub: RpcStub, retries: int = 5,
+    def __init__(self, stub: RpcStub, retries: int = 12,
                  backoff_secs: float = 0.5):
         import uuid
 
@@ -326,11 +326,14 @@ class _RemoteOptimizer:
 
 def make_remote_engine(
     addr: str, id_keys: Dict[str, str],
-    retries: int = 5, backoff_secs: float = 0.5,
+    retries: int = 12, backoff_secs: float = 0.5,
 ) -> HostEmbeddingEngine:
     """Client-side engine over a running `HostRowService`. Table names
     and dims come from the service itself; pulls/pushes retry with
-    bounded backoff across a service relaunch."""
+    bounded backoff across a service relaunch. The default budget
+    (0.5s doubling, capped 30s, 12 retries ≈ 4 minutes) spans a real
+    pod relaunch — scheduling + image pull + checkpoint restore — like
+    the reference workers' 3x300s channel waits."""
     stub = RpcStub(addr, SERVICE_NAME)
     info = _call_with_retry(stub, "table_info", retries, backoff_secs)[
         "tables"

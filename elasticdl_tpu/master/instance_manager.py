@@ -22,6 +22,8 @@ from elasticdl_tpu.platform.k8s_client import (
     ELASTICDL_REPLICA_INDEX_KEY,
     ELASTICDL_REPLICA_TYPE_KEY,
     build_pod_manifest,
+    build_row_service_service_manifest,
+    get_row_service_pod_name,
     get_worker_pod_name,
 )
 
@@ -85,6 +87,9 @@ class InstanceManager:
         # for the life of the job; task retries are capped instead)
         on_worker_relaunch: Optional[Callable[[int, int], None]] = None,
         multihost: bool = False,
+        row_service_command: Optional[Callable[[], List[str]]] = None,
+        row_service_resource_request: str = "cpu=1,memory=4096Mi",
+        row_service_resource_limit: str = "",
     ):
         self._task_d = task_dispatcher
         self._client = k8s_client
@@ -117,6 +122,20 @@ class InstanceManager:
         # pods be recognized (name mismatch) instead of cascading.
         self._multihost = multihost
         self._generation = 0
+        # Host-tier row service (reference PS pod lifecycle: fixed
+        # service name, relaunch on death — k8s_instance_manager.py
+        # :303-308). One replica; its state survives via its own
+        # checkpoint (row_service.py), which the reference PS also
+        # relied on when re-init from workers wasn't possible.
+        self._row_service_command = row_service_command
+        # Dedicated sizing: the CPU-only row pod must not inherit the
+        # workers' accelerator-sized resources (reference had its own
+        # --ps_resource_* knobs).
+        self._rs_resource_request = row_service_resource_request
+        self._rs_resource_limit = row_service_resource_limit
+        self._row_service_pod: Optional[str] = None
+        self._rs_generation = 0
+        self._rs_relaunch_count = 0
 
     # ---- pod creation ---------------------------------------------------
 
@@ -148,12 +167,89 @@ class InstanceManager:
         for worker_id in range(self._num_workers):
             self._start_worker(worker_id)
 
+    # ---- row service (PS-pod lifecycle) --------------------------------
+
+    def start_row_service(self):
+        """Create the stable Service + the serving pod."""
+        if self._row_service_command is None:
+            return
+        self._client.create_service(build_row_service_service_manifest(
+            self._job_name, namespace=self._namespace
+        ))
+        self._start_row_service_pod()
+
+    def _start_row_service_pod(self):
+        with self._lock:
+            if self._stopped:
+                # A death event racing stop() must not recreate a pod
+                # nothing will ever delete (same re-check the worker
+                # relaunch path does).
+                return
+            name = get_row_service_pod_name(
+                self._job_name, self._rs_generation
+            )
+        manifest = build_pod_manifest(
+            name=name,
+            job_name=self._job_name,
+            replica_type="rowservice",
+            replica_index=0,
+            image=self._image,
+            command=self._row_service_command(),
+            namespace=self._namespace,
+            resource_request=self._rs_resource_request,
+            resource_limit=self._rs_resource_limit,
+            volume=self._volume,
+            envs=self._envs,
+            restart_policy=self._restart_policy,
+            owner=self._owner,
+        )
+        self._client.create_pod(manifest)
+        with self._lock:
+            self._row_service_pod = name
+        logger.info("Started row service pod %s", name)
+
+    def _handle_dead_row_service(self):
+        """Same stable service name, fresh pod generation; workers ride
+        the outage on their RPC retry/backoff (generous default budget,
+        row_service.make_remote_engine) and the relaunched pod restores
+        from its checkpoint (row_service.py). Unlike workers, ANY
+        failure relaunches: the singleton service runs no user code, so
+        the crash-loop concern behind the workers' exit-137-only policy
+        does not apply; max_relaunches (when set) still bounds it."""
+        with self._lock:
+            if self._stopped:
+                return
+            if self._max_relaunches and (
+                self._rs_relaunch_count >= self._max_relaunches
+            ):
+                logger.error(
+                    "Row service relaunch budget (%d) exhausted",
+                    self._max_relaunches,
+                )
+                return
+            self._rs_relaunch_count += 1
+            self._rs_generation += 1
+        logger.warning(
+            "Row service pod died; relaunching (generation %d)",
+            self._rs_generation,
+        )
+        self._start_row_service_pod()
+
     # ---- event handling -------------------------------------------------
 
     def _event_cb(self, event):
         """k8s watch callback (reference :219-308)."""
         info = classify_pod_event(event)
-        if info is None or info["replica_type"] != "worker":
+        if info is None:
+            return
+        if info["replica_type"] == "rowservice":
+            dead = info["type"] == "DELETED" or info["phase"] == "Failed"
+            with self._lock:
+                current = self._row_service_pod
+            if dead and info["name"] == current:
+                self._handle_dead_row_service()
+            return
+        if info["replica_type"] != "worker":
             return
         worker_id = info["replica_index"]
         # Relaunch only involuntary deaths: DELETED (preempted pod) or
@@ -284,6 +380,9 @@ class InstanceManager:
             self._stopped = True
             pods = list(self._worker_pods.values())
             self._worker_pods.clear()
+            if self._row_service_pod is not None:
+                pods.append(self._row_service_pod)
+                self._row_service_pod = None
         for name in pods:
             self._client.delete_pod(name)
 

@@ -149,7 +149,7 @@ class Master:
             # jax_process_id filtered: the master's own value (-1) must
             # not override the per-worker flag set below.
             filter_args=["worker_id", "force", "master_addr",
-                         "jax_process_id"],
+                         "jax_process_id", "row_service_addr"],
         )
         # The user's --checkpoint_dir_for_init (warm start) passes through
         # untouched; elastic relaunch resume comes from the worker itself
@@ -158,6 +158,8 @@ class Master:
         cmd = [sys.executable, "-m", "elasticdl_tpu.worker.main",
                "--worker_id", str(worker_id),
                "--master_addr", self._master_addr_for_workers()]
+        if self._uses_row_service():
+            cmd += ["--row_service_addr", self._row_service_addr()]
         if getattr(self._args, "num_jax_processes", 1) > 1:
             # Stable jax.distributed process id across gang restarts
             # (multi-host workers always relaunch with original ids).
@@ -166,6 +168,46 @@ class Master:
             cmd
             + passthrough
         )
+
+    def _uses_row_service(self) -> bool:
+        """Host-tier models whose zoo module defines make_row_service get
+        a service pod (the reference always ran PS pods for the PS
+        strategy; modules wanting process-local tables simply don't
+        define the factory)."""
+        return (
+            self._spec.make_host_runner is not None
+            and getattr(self._spec.module, "make_row_service", None)
+            is not None
+        )
+
+    def _row_service_addr(self) -> str:
+        from elasticdl_tpu.platform.k8s_client import (
+            ROW_SERVICE_PORT,
+            get_row_service_service_name,
+        )
+
+        return "%s:%d" % (
+            get_row_service_service_name(self._args.job_name),
+            ROW_SERVICE_PORT,
+        )
+
+    def _row_service_command(self):
+        from elasticdl_tpu.platform.k8s_client import ROW_SERVICE_PORT
+
+        cmd = [sys.executable, "-m", "elasticdl_tpu.embedding.row_service",
+               "--model_zoo", self._args.model_zoo,
+               "--model_def", self._args.model_def,
+               "--addr", f"[::]:{ROW_SERVICE_PORT}"]
+        ckpt = getattr(self._args, "checkpoint_dir", "")
+        if ckpt:
+            # Its own subdir: the service's row payload is keyed by push
+            # count, the workers' by model version.
+            cmd += ["--checkpoint_dir", f"{ckpt}/row_service",
+                    "--checkpoint_steps",
+                    str(getattr(self._args, "checkpoint_steps", 0)),
+                    "--keep_checkpoint_max",
+                    str(getattr(self._args, "keep_checkpoint_max", 3))]
+        return cmd
 
     def _master_addr_for_workers(self) -> str:
         from elasticdl_tpu.platform.k8s_client import (
@@ -231,8 +273,23 @@ class Master:
                 multihost=(
                     getattr(self._args, "num_jax_processes", 1) > 1
                 ),
+                row_service_command=(
+                    self._row_service_command
+                    if self._uses_row_service() else None
+                ),
+                row_service_resource_request=getattr(
+                    self._args, "row_service_resource_request",
+                    "cpu=1,memory=4096Mi",
+                ),
+                row_service_resource_limit=getattr(
+                    self._args, "row_service_resource_limit", ""
+                ),
             )
             self.instance_manager.start_watch()
+            # Row service first (reference Master.prepare starts PS pods
+            # before workers, master.py:202-205); workers retry until it
+            # answers.
+            self.instance_manager.start_row_service()
             self.instance_manager.start_workers()
 
     def run(self, poll_secs: float = 5.0):

@@ -135,6 +135,41 @@ def build_master_service_manifest(
 
 
 TENSORBOARD_PORT = 6006
+ROW_SERVICE_PORT = 6100
+
+
+def get_row_service_pod_name(job_name: str, generation: int = 0) -> str:
+    """Reference PS pods relaunch with the SAME id behind a fixed
+    service name (k8s_instance_manager.py:303-308); pod deletion is
+    async, so each relaunch generation gets a fresh pod name while the
+    stable Service keeps routing."""
+    base = f"elasticdl-tpu-{job_name}-rowservice"
+    return base if generation == 0 else f"{base}-r{generation}"
+
+
+def get_row_service_service_name(job_name: str) -> str:
+    """Stable DNS name workers dial (reference fixed service names
+    `elasticdl-{job}-ps-{id}` port 2222, k8s_client.py:19-22)."""
+    return f"elasticdl-tpu-{job_name}-rowservice"
+
+
+def build_row_service_service_manifest(
+    job_name: str, namespace: str = "default", port: int = ROW_SERVICE_PORT
+) -> dict:
+    return {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {
+            "name": get_row_service_service_name(job_name),
+            "namespace": namespace,
+            "labels": _labels(job_name, "rowservice"),
+        },
+        "spec": {
+            "selector": _labels(job_name, "rowservice"),
+            "ports": [{"port": port, "targetPort": port}],
+            "clusterIP": "None",
+        },
+    }
 
 
 def get_tensorboard_service_name(job_name: str) -> str:
@@ -306,13 +341,17 @@ class Client:
             if not force:
                 raise
             errors.append(f"service: {exc}")
-        try:
-            # Optional resource (exists only when --tensorboard_log_dir
-            # was set at submit); delete_service no-ops on 404.
-            self.delete_service(get_tensorboard_service_name(job_name))
-        except Exception as exc:
-            if not force:
-                raise
-            errors.append(f"tensorboard service: {exc}")
+        for optional_service in (
+            # Exist only for some job shapes (--tensorboard_log_dir /
+            # host-tier models); delete_service no-ops on 404.
+            get_tensorboard_service_name(job_name),
+            get_row_service_service_name(job_name),
+        ):
+            try:
+                self.delete_service(optional_service)
+            except Exception as exc:
+                if not force:
+                    raise
+                errors.append(f"{optional_service}: {exc}")
         for err in errors:
             logger.warning("clean --force skipped error: %s", err)

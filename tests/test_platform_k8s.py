@@ -150,6 +150,9 @@ class FakeK8sClient:
     def create_pod(self, manifest):
         self.created.append(manifest)
 
+    def create_service(self, manifest):
+        self.created.append(manifest)
+
     def delete_pod(self, name, **kw):
         self.deleted.append(name)
         return True  # pod existed; None would mean already-gone (404)
@@ -381,3 +384,142 @@ class TestMaxStepsDispatch:
             disp.report(t.task_id, True)
         assert total == 48
         assert disp.finished()
+
+
+class TestRowServicePods:
+    """The reference PS-pod lifecycle (same service name, relaunch on
+    death, k8s_instance_manager.py:303-308) mapped to the host-tier row
+    service."""
+
+    def _manager(self, **kw):
+        client = FakeK8sClient()
+        mgr = InstanceManager(
+            _dispatcher(), client, job_name="j", image_name="img",
+            worker_command=lambda wid: ["run", str(wid)],
+            num_workers=1,
+            row_service_command=lambda: ["serve-rows"],
+            **kw,
+        )
+        return mgr, client
+
+    def _rs_dead_event(self, name):
+        return {
+            "type": "DELETED",
+            "object": {
+                "metadata": {
+                    "name": name,
+                    "labels": {
+                        "elasticdl-tpu-replica-type": "rowservice",
+                        "elasticdl-tpu-replica-index": "0",
+                    },
+                },
+                "status": {"phase": "", "exit_code": None},
+            },
+        }
+
+    def test_start_creates_service_and_pod(self):
+        from elasticdl_tpu.platform.k8s_client import (
+            get_row_service_pod_name,
+            get_row_service_service_name,
+        )
+
+        mgr, client = self._manager()
+        mgr.start_row_service()
+        kinds = [m.get("kind", "Pod") for m in client.created]
+        assert "Service" in kinds
+        svc = next(m for m in client.created if m.get("kind") == "Service")
+        assert svc["metadata"]["name"] == get_row_service_service_name("j")
+        pod = next(m for m in client.created if m.get("kind") != "Service")
+        assert pod["metadata"]["name"] == get_row_service_pod_name("j")
+        assert pod["spec"]["containers"][0]["command"] == ["serve-rows"]
+
+    def test_death_relaunches_fresh_pod_same_service(self):
+        from elasticdl_tpu.platform.k8s_client import (
+            get_row_service_pod_name,
+        )
+
+        mgr, client = self._manager()
+        mgr.start_row_service()
+        first = get_row_service_pod_name("j")
+        mgr._event_cb(self._rs_dead_event(first))
+        pods = [m for m in client.created if m.get("kind") != "Service"]
+        assert pods[-1]["metadata"]["name"] == get_row_service_pod_name(
+            "j", generation=1
+        )
+        # Only ONE Service ever created: the stable name keeps routing.
+        assert sum(
+            1 for m in client.created if m.get("kind") == "Service"
+        ) == 1
+
+    def test_stale_event_for_old_generation_ignored(self):
+        from elasticdl_tpu.platform.k8s_client import (
+            get_row_service_pod_name,
+        )
+
+        mgr, client = self._manager()
+        mgr.start_row_service()
+        first = get_row_service_pod_name("j")
+        mgr._event_cb(self._rs_dead_event(first))
+        n_pods = len(
+            [m for m in client.created if m.get("kind") != "Service"]
+        )
+        # A late duplicate event for the gen-0 pod must not relaunch.
+        mgr._event_cb(self._rs_dead_event(first))
+        assert len(
+            [m for m in client.created if m.get("kind") != "Service"]
+        ) == n_pods
+
+    def test_no_row_service_without_command(self):
+        client = FakeK8sClient()
+        mgr = InstanceManager(
+            _dispatcher(), client, job_name="j", image_name="img",
+            worker_command=lambda wid: ["run", str(wid)], num_workers=1,
+        )
+        mgr.start_row_service()
+        assert client.created == []
+
+
+def test_master_wires_row_service_for_host_models(tmp_path):
+    """Host-tier zoo module + k8s: worker commands carry the stable
+    --row_service_addr; the row-service command checkpoints under the
+    job's checkpoint dir."""
+    from elasticdl_tpu.common.args import parse_master_args
+    from elasticdl_tpu.master.main import Master
+    from elasticdl_tpu.testing.data import (
+        create_frappe_record_file,
+        model_zoo_dir,
+    )
+
+    train = create_frappe_record_file(str(tmp_path / "t.rec"), 32, seed=10)
+    args = parse_master_args([
+        "--model_zoo", model_zoo_dir(),
+        "--model_def", "deepfm.deepfm_host.custom_model",
+        "--training_data", train,
+        "--minibatch_size", "16",
+        "--num_workers", "2",
+        "--job_name", "hostjob",
+        "--checkpoint_dir", str(tmp_path / "ckpt"),
+        "--checkpoint_steps", "4",
+    ])
+    master = Master(args)
+    assert master._uses_row_service()
+    wcmd = master._worker_command(0)
+    i = wcmd.index("--row_service_addr")
+    assert wcmd[i + 1] == (
+        "elasticdl-tpu-hostjob-rowservice:6100"
+    )
+    rcmd = master._row_service_command()
+    assert "-m" in rcmd and "elasticdl_tpu.embedding.row_service" in rcmd
+    assert rcmd[rcmd.index("--checkpoint_dir") + 1].endswith(
+        "/row_service"
+    )
+    # Non-host model: no row service.
+    args2 = parse_master_args([
+        "--model_zoo", model_zoo_dir(),
+        "--model_def", "mnist.mnist_functional.custom_model",
+        "--training_data", train,
+        "--minibatch_size", "16",
+        "--job_name", "plainjob",
+    ])
+    assert not Master(args2)._uses_row_service()
+    assert "--row_service_addr" not in Master(args2)._worker_command(0)
