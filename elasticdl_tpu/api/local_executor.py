@@ -106,8 +106,11 @@ class LocalExecutor:
             set_callback_parameters,
         )
 
-        self._callbacks = (
-            self._spec.callbacks_fn() if self._spec.callbacks_fn else []
+        from elasticdl_tpu.callbacks import ensure_saved_model_exporter
+
+        self._callbacks = ensure_saved_model_exporter(
+            self._spec.callbacks_fn() if self._spec.callbacks_fn else [],
+            getattr(args, "output", ""),
         )
         set_callback_parameters(
             self._callbacks, batch_size=self._batch_size,

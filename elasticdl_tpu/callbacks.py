@@ -61,17 +61,60 @@ class SavedModelExporter(Callback):
             logger.warning("SavedModelExporter: no trained state to export")
             return
         spec = getattr(owner, "_spec", None) or getattr(owner, "spec", None)
-        export_serving_bundle(
-            self._output_dir,
-            model=spec.model if spec is not None else None,
-            state=owner.state,
-            batch_example=(
-                self._batch_example
-                if self._batch_example is not None
-                else getattr(owner, "last_batch", None)
-            ),
-            model_def=getattr(spec, "model_fn_name", ""),
+        # Host-tier models: materialize the tables dense into the bundle
+        # (reference model_handler export restored PS EmbeddingTables
+        # into Keras embedding weights, :234-260). Vocab sizes come from
+        # the zoo module's host_serving_vocab.
+        host_tables = host_vocab = host_lock = None
+        batch_example = (
+            self._batch_example
+            if self._batch_example is not None
+            else getattr(owner, "last_batch", None)
         )
+        runner = getattr(owner, "_step_runner", None)
+        engine = getattr(runner, "engine", None)
+        if engine is not None and spec is not None:
+            host_vocab = getattr(spec.module, "host_serving_vocab", None)
+            if host_vocab:
+                host_tables = engine.tables
+                host_lock = engine.lock
+            else:
+                # Without vocab there is no rows collection to bake in,
+                # and the host model cannot trace without it — degrade
+                # to a params-only bundle instead of half-writing one.
+                logger.warning(
+                    "SavedModelExporter: host-tier model without "
+                    "host_serving_vocab — exporting params-only bundle"
+                )
+                batch_example = None
+        try:
+            export_serving_bundle(
+                self._output_dir,
+                model=spec.model if spec is not None else None,
+                state=owner.state,
+                batch_example=batch_example,
+                model_def=getattr(spec, "model_fn_name", ""),
+                host_tables=host_tables,
+                host_vocab=host_vocab,
+                host_lock=host_lock,
+            )
+        except ValueError as exc:
+            if host_tables is None:
+                raise
+            # Misconfigured host_serving_vocab must not lose the whole
+            # export at the end of a training run — degrade like the
+            # missing-vocab path.
+            logger.warning(
+                "SavedModelExporter: %s — falling back to a params-only "
+                "bundle", exc,
+            )
+            export_serving_bundle(
+                self._output_dir,
+                model=spec.model if spec is not None else None,
+                state=owner.state,
+                batch_example=None,
+                model_def=getattr(spec, "model_fn_name", ""),
+            )
         logger.info("Exported serving bundle to %s", self._output_dir)
 
 
@@ -135,4 +178,21 @@ def set_callback_parameters(
     }
     for cb in callbacks or []:
         cb.set_params(params)
+    return callbacks
+
+
+def ensure_saved_model_exporter(
+    callbacks: Optional[List[Callback]], output_dir: str
+) -> List[Callback]:
+    """``--output`` wiring (reference `elasticdl train --output`): point
+    an existing SavedModelExporter at the dir, or append one. No-op
+    without an output dir."""
+    callbacks = list(callbacks or [])
+    if not output_dir:
+        return callbacks
+    for cb in callbacks:
+        if isinstance(cb, SavedModelExporter):
+            cb._output_dir = cb._output_dir or output_dir
+            return callbacks
+    callbacks.append(SavedModelExporter(output_dir))
     return callbacks

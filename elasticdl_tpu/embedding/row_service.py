@@ -112,6 +112,7 @@ class HostRowService:
             "table_info": self._table_info,
             "pull_rows": self._pull_rows,
             "push_row_grads": self._push_row_grads,
+            "export_rows": self._export_rows,
         }
 
     def _table_info(self, request: dict) -> dict:
@@ -127,6 +128,22 @@ class HostRowService:
         with self._lock:
             rows = table.get(np.asarray(request["ids"], np.int64))
         return {"rows": np.asarray(rows, np.float32)}
+
+    def _export_rows(self, request: dict) -> dict:
+        """Dense [lo, hi) rows for serving export WITHOUT inflating the
+        live table: trained rows overlay a throwaway table's
+        deterministic lazy init (serving/export.py materialization,
+        server side)."""
+        table = self._tables[request["table"]]
+        lo, hi = int(request["lo"]), int(request["hi"])
+        with self._lock:
+            ids, rows = table.to_arrays()
+        from elasticdl_tpu.serving.export import _clone_empty
+
+        dense = np.asarray(_clone_empty(table).get(np.arange(lo, hi)))
+        keep = (ids >= lo) & (ids < hi)
+        dense[ids[keep] - lo] = rows[keep]
+        return {"rows": dense.astype(np.float32)}
 
     def _push_row_grads(self, request: dict) -> dict:
         table = self._tables[request["table"]]
@@ -294,6 +311,18 @@ class _RemoteTable:
             table=self.name, ids=np.asarray(ids, np.int64),
         )
         return np.asarray(resp["rows"], np.float32)
+
+    def export_dense(self, vocab: int, chunk: int = 65536) -> np.ndarray:
+        """Serving-export materialization, served chunk-wise by the
+        service (no live-table inflation; see _export_rows)."""
+        parts = [
+            np.asarray(_call_with_retry(
+                self._stub, "export_rows", self._retries, self._backoff,
+                table=self.name, lo=lo, hi=min(lo + chunk, vocab),
+            )["rows"], np.float32)
+            for lo in range(0, int(vocab), chunk)
+        ]
+        return np.concatenate(parts, axis=0)
 
 
 class _RemoteOptimizer:

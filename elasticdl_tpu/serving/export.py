@@ -44,16 +44,111 @@ def _variables(state):
     return variables
 
 
+def _clone_empty(table):
+    """Fresh table of the same type AND configuration (initializer,
+    slot settings, dtype) — the lazy init for untouched ids must match
+    the live table exactly."""
+    return type(table)(
+        table.name,
+        table.dim,
+        initializer=getattr(table, "initializer", "uniform"),
+        is_slot=getattr(table, "is_slot", False),
+        slot_init_value=getattr(table, "slot_init_value", 0.0),
+        dtype=getattr(table, "dtype", np.float32),
+    )
+
+
+def _dense_overlay(table, vocab: int, chunk: int):
+    """Dense (vocab, dim) WITHOUT touching the live table: trained rows
+    come from a to_arrays snapshot; untouched ids materialize through
+    per-chunk THROWAWAY tables with identical configuration (identical
+    deterministic lazy init), so neither the live store nor any single
+    throwaway inflates to full vocab."""
+    ids, rows = table.to_arrays()
+    parts = []
+    for lo in range(0, int(vocab), chunk):
+        hi = min(lo + chunk, vocab)
+        # Fresh throwaway per chunk: a reused one would retain every
+        # lazily inserted row and grow to full vocab itself.
+        parts.append(
+            np.asarray(_clone_empty(table).get(np.arange(lo, hi)))
+        )
+    dense = np.concatenate(parts, axis=0)
+    keep = (ids >= 0) & (ids < vocab)
+    dense[ids[keep]] = rows[keep]
+    return dense
+
+
+def materialize_host_rows(tables, vocab_sizes, chunk: int = 65536,
+                          lock=None):
+    """Full dense (vocab, dim) arrays from host/remote tables — the
+    reference export path's EmbeddingTable→dense-weights conversion
+    (model_handler.py:31-46, :234-260). Untouched ids materialize from
+    the lazy initializer, like the reference, WITHOUT inserting them
+    into the live store (export must not blow a >HBM table up to full
+    vocab, nor race training threads — pass the engine lock)."""
+    import contextlib
+
+    missing = set(vocab_sizes) - set(tables)
+    if missing:
+        raise ValueError(
+            f"host_serving_vocab names unknown tables {sorted(missing)}; "
+            f"model tables: {sorted(tables)}"
+        )
+    out = {}
+    for name, vocab in vocab_sizes.items():
+        table = tables[name]
+        if hasattr(table, "export_dense"):
+            # Remote table: the service materializes server-side.
+            out[name] = table.export_dense(int(vocab), chunk)
+            continue
+        with (lock if lock is not None else contextlib.nullcontext()):
+            out[name] = _dense_overlay(table, int(vocab), chunk)
+    return out
+
+
 def export_serving_bundle(
     output_dir: str,
     model: Any,
     state: Any,
     batch_example: Optional[Any] = None,
     model_def: str = "",
+    host_tables: Optional[dict] = None,
+    host_vocab: Optional[dict] = None,
+    host_lock=None,
 ) -> str:
-    """Write the serving bundle; returns ``output_dir``."""
+    """Write the serving bundle; returns ``output_dir``.
+
+    ``host_tables``+``host_vocab`` (host-tier models): each table is
+    materialized dense into the ``host_rows`` collection so the bundle
+    is standalone and serves raw ids (requires ``batch_example`` for
+    the collection template; ``host_lock`` guards live tables)."""
     os.makedirs(output_dir, exist_ok=True)
+    if batch_example is not None and not (
+        isinstance(batch_example, dict) and "features" in batch_example
+    ):
+        batch_example = {"features": batch_example}
     variables = _variables(state)
+    if host_tables and host_vocab and batch_example is not None:
+        from elasticdl_tpu.embedding.host_engine import (
+            HOST_ROWS_COLLECTION,
+            _nest_rows,
+            host_rows_template,
+        )
+
+        template = host_rows_template(model, batch_example)
+        from elasticdl_tpu.embedding.host_engine import _iter_leaves
+
+        model_tables = {k for k, _ in _iter_leaves(template)}
+        if model_tables - set(host_vocab):
+            raise ValueError(
+                "host_serving_vocab is missing entries for model "
+                f"tables {sorted(model_tables - set(host_vocab))}"
+            )
+        flat = materialize_host_rows(
+            host_tables, host_vocab, lock=host_lock
+        )
+        variables[HOST_ROWS_COLLECTION] = _nest_rows(template, flat)
     with open(os.path.join(output_dir, PARAMS_FILE), "wb") as f:
         f.write(serialization.to_bytes(variables))
 
@@ -63,8 +158,13 @@ def export_serving_bundle(
         "format": 1,
     }
     hlo_written = False
+    if host_tables and host_vocab and batch_example is None:
+        # No example -> no collection template: the host model cannot
+        # trace (HostEmbedding reads the host_rows collection), so the
+        # bundle degrades to params-only.
+        model = None
     if model is not None and batch_example is not None:
-        features = batch_example.get("features", batch_example)
+        features = batch_example["features"]
         var_shapes = jax.tree.map(
             lambda x: jax.ShapeDtypeStruct(np.shape(x), x.dtype), variables
         )

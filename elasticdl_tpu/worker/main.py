@@ -177,9 +177,15 @@ def build_worker(args, master_client=None) -> Worker:
             backend="orbax" if mesh_multihost else "native",
             host_tables=getattr(step_runner, "host_tables", None),
         )
-    callbacks = spec.callbacks_fn() if spec.callbacks_fn else []
-    from elasticdl_tpu.callbacks import set_callback_parameters
+    from elasticdl_tpu.callbacks import (
+        ensure_saved_model_exporter,
+        set_callback_parameters,
+    )
 
+    callbacks = ensure_saved_model_exporter(
+        spec.callbacks_fn() if spec.callbacks_fn else [],
+        getattr(args, "output", ""),
+    )
     set_callback_parameters(
         callbacks,
         batch_size=args.minibatch_size,

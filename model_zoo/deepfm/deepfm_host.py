@@ -33,6 +33,9 @@ MAX_ID = 5500
 EMBEDDING_DIM = 16
 TABLE_NAME = "deepfm_host_embedding"
 FEATURE_KEY = "feature_ids"
+# Serving export materializes the host table dense up to this vocab
+# (reference model_handler export restored PS rows into dense weights).
+host_serving_vocab = {TABLE_NAME: MAX_ID}
 
 
 class HostDeepFM(nn.Module):

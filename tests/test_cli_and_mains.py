@@ -291,3 +291,34 @@ def test_compilation_cache_flag_plumb(tmp_path):
 
     _enable_compilation_cache(Off())  # no-op, no error
     assert jax.config.jax_compilation_cache_dir is None
+
+
+def test_cli_output_flag_exports_bundle(tmp_path):
+    """--output auto-injects a SavedModelExporter (reference
+    `elasticdl train --output`): the bundle appears without the zoo
+    module defining any callbacks."""
+    import sys
+
+    from elasticdl_tpu.api.client import main as cli_main
+
+    train = create_mnist_record_file(str(tmp_path / "t.rec"), 32)
+    out = str(tmp_path / "bundle")
+    argv = ["prog", "train",
+            "--model_zoo", model_zoo_dir(),
+            "--model_def", MODEL_DEF,
+            "--minibatch_size", "16",
+            "--distribution_strategy", "Local",
+            "--job_name", "outjob",
+            "--training_data", train,
+            "--num_epochs", "1",
+            "--output", out]
+    old = sys.argv
+    try:
+        sys.argv = argv
+        assert cli_main() == 0
+    finally:
+        sys.argv = old
+    import os
+
+    assert os.path.exists(os.path.join(out, "params.msgpack"))
+    assert os.path.exists(os.path.join(out, "metadata.json"))

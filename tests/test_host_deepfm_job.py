@@ -220,3 +220,98 @@ def test_two_workers_share_one_host_table(tmp_path):
     r1 = cluster.workers[1]._step_runner
     assert r0 is r1  # one shared runner, not forked tables
     assert r0.host_tables[deepfm_host.TABLE_NAME].num_rows > 0
+
+
+def test_host_model_serving_export_serves_raw_ids(tmp_path):
+    """Reference parity for the export path (model_handler.py:234-260):
+    host rows materialize dense into the bundle, and the standalone
+    predictor serves RAW ids (no engine, no inverse maps)."""
+    from elasticdl_tpu.serving.export import (
+        export_serving_bundle,
+        load_predictor,
+    )
+
+    runner = deepfm_host.make_host_runner()
+    raw = {
+        "features": {
+            deepfm_host.FEATURE_KEY: np.random.RandomState(0).randint(
+                0, deepfm_host.MAX_ID, (8, deepfm_host.INPUT_LENGTH)
+            ).astype(np.int64)
+        },
+        "labels": np.zeros((8,), np.int32),
+        "mask": np.ones((8,), np.float32),
+    }
+    from elasticdl_tpu.core.model_spec import get_model_spec
+
+    spec = get_model_spec(model_zoo_dir(), "deepfm.deepfm_host.custom_model")
+    state = runner.init_state(spec.model, spec.make_optimizer(), raw)
+    step = runner.train_step(spec.loss)
+    state, _ = step(state, raw)  # touch some rows
+
+    prepared, _, _ = runner.engine.prepare_batch(raw)
+    bundle = export_serving_bundle(
+        str(tmp_path / "bundle"),
+        model=spec.model,
+        state=state,
+        batch_example=prepared,
+        model_def="custom_model",
+        host_tables=runner.engine.tables,
+        host_vocab=deepfm_host.host_serving_vocab,
+    )
+    predictor = load_predictor(bundle)  # standalone: no model passed
+    raw_ids = raw["features"]
+    preds = predictor(
+        {deepfm_host.FEATURE_KEY: raw_ids[deepfm_host.FEATURE_KEY]
+         .astype(np.int32)}
+    )
+    assert np.asarray(preds).shape == (8,)
+    assert np.all(np.isfinite(np.asarray(preds)))
+
+    # Ground truth: the engine's own eval on the same raw batch.
+    eval_step = runner.eval_step()
+    expected_preds = eval_step(state, raw)
+    np.testing.assert_allclose(
+        np.asarray(preds), np.asarray(expected_preds), rtol=2e-2, atol=1e-2
+    )
+
+
+def test_export_does_not_inflate_live_table(tmp_path):
+    """Materialization must not lazy-insert the full vocab into the live
+    store (a >HBM table would blow up RAM and every later checkpoint)."""
+    from elasticdl_tpu.serving.export import materialize_host_rows
+    from elasticdl_tpu.embedding.table import EmbeddingTable
+
+    table = EmbeddingTable("t", 4)
+    table.get([5, 9])  # two touched rows
+    dense = materialize_host_rows({"t": table}, {"t": 100})["t"]
+    assert dense.shape == (100, 4)
+    assert table.num_rows == 2  # live table untouched
+    # Untouched ids match the deterministic lazy init; touched rows are
+    # the live values.
+    ref = EmbeddingTable("t", 4)
+    np.testing.assert_array_equal(dense[7], ref.get([7])[0])
+    np.testing.assert_array_equal(dense[5], table.get([5])[0])
+
+
+def test_export_preserves_initializer_and_rejects_bad_vocab(tmp_path):
+    from elasticdl_tpu.serving.export import materialize_host_rows
+    from elasticdl_tpu.embedding.table import EmbeddingTable
+
+    # zeros-initialized table: untouched ids must export as zeros, not
+    # the default uniform init.
+    table = EmbeddingTable("z", 4, initializer="zeros")
+    table.set([1], np.full((1, 4), 7.0, np.float32))
+    dense = materialize_host_rows({"z": table}, {"z": 6})["z"]
+    np.testing.assert_array_equal(dense[3], np.zeros(4))
+    np.testing.assert_array_equal(dense[1], np.full(4, 7.0))
+
+    # Negative trained id must not clobber the dense tail.
+    t2 = EmbeddingTable("n", 2)
+    t2.set([-1], np.full((1, 2), 9.0, np.float32))
+    dense2 = materialize_host_rows({"n": t2}, {"n": 6})["n"]
+    ref = EmbeddingTable("n", 2)
+    np.testing.assert_array_equal(dense2[5], ref.get([5])[0])
+
+    # Unknown table names fail loudly.
+    with pytest.raises(ValueError, match="unknown tables"):
+        materialize_host_rows({"n": t2}, {"typo": 6})

@@ -213,7 +213,14 @@ def test_service_relaunch_restores_and_clients_retry(tmp_path):
 
     def relaunch_later():
         _time.sleep(0.8)
-        relaunched["svc"] = fresh_service(port)
+        # Rebinding the same port can transiently fail right after
+        # stop() under load; retry like a pod reschedule would.
+        for _ in range(20):
+            try:
+                relaunched["svc"] = fresh_service(port)
+                return
+            except Exception:
+                _time.sleep(0.5)
 
     t = threading.Thread(target=relaunch_later)
     t.start()
@@ -305,3 +312,17 @@ def test_retried_push_after_relaunch_is_deduplicated(tmp_path):
         np.testing.assert_allclose(after, before - 0.5, rtol=1e-6)
     finally:
         svc2.stop(0)
+
+
+def test_remote_export_dense_no_server_inflation(service):
+    engine = make_remote_engine(
+        f"localhost:{service.port}", id_keys={"items": "ids"}
+    )
+    table = engine.tables["items"]
+    table.get(np.array([3]))  # one touched row on the server
+    dense = table.export_dense(50, chunk=16)
+    assert dense.shape == (50, DIM)
+    # Server table not inflated to vocab by the export.
+    assert service.host_tables["items"].num_rows == 1
+    ref = EmbeddingTable("items", DIM)
+    np.testing.assert_array_equal(dense[10], ref.get([10])[0])
