@@ -419,3 +419,57 @@ def test_concurrent_pushes_and_checkpoints_stay_consistent(tmp_path):
             )
     finally:
         svc.stop(0)
+
+
+def test_service_restart_mid_job_drains(tmp_path):
+    """The reference's PS fault-tolerance test shape
+    (worker_ps_interaction_test.py:337 restarts the localhost PS
+    mid-training): kill the row service while a MiniCluster job is
+    running, relaunch it on the same port from its checkpoint, and the
+    job still drains."""
+    import threading
+    import time as _time
+
+    from model_zoo.deepfm import deepfm_host
+
+    train = create_frappe_record_file(str(tmp_path / "t.rec"), 128, seed=12)
+    ckpt = str(tmp_path / "svc_ckpt")
+
+    def fresh(port=0):
+        svc = deepfm_host.make_row_service()
+        svc.configure_checkpoint(ckpt, checkpoint_steps=2)
+        return svc.start(f"localhost:{port}")
+
+    svc = fresh()
+    port = svc.port
+    cluster = MiniCluster(
+        model_zoo=model_zoo_dir(),
+        model_def="deepfm.deepfm_host.custom_model",
+        training_data=train,
+        minibatch_size=16,
+        num_minibatches_per_task=2,
+        step_runner_factory=lambda: deepfm_host.make_host_runner(
+            remote_addr=f"localhost:{port}"
+        ),
+    )
+    holder = {}
+
+    def kill_and_relaunch():
+        _time.sleep(1.0)
+        svc.stop(0)
+        _time.sleep(0.5)
+        for _ in range(20):
+            try:
+                holder["svc"] = fresh(port)
+                return
+            except Exception:
+                _time.sleep(0.5)
+
+    t = threading.Thread(target=kill_and_relaunch)
+    t.start()
+    cluster.run()
+    t.join(timeout=60)
+    assert cluster.finished
+    assert "svc" in holder
+    assert holder["svc"].host_tables[deepfm_host.TABLE_NAME].num_rows > 0
+    holder["svc"].stop(0)
