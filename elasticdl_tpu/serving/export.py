@@ -25,6 +25,10 @@ import jax
 import numpy as np
 from flax import serialization
 
+from elasticdl_tpu.common.log_utils import get_logger
+
+logger = get_logger("serving_export")
+
 PARAMS_FILE = "params.msgpack"
 META_FILE = "metadata.json"
 HLO_FILE = "predict.stablehlo"
@@ -168,16 +172,49 @@ def export_serving_bundle(
         var_shapes = jax.tree.map(
             lambda x: jax.ShapeDtypeStruct(np.shape(x), x.dtype), variables
         )
-        feat_shapes = jax.tree.map(
-            lambda x: jax.ShapeDtypeStruct(np.shape(x), np.asarray(x).dtype),
-            features,
+
+        leaves = jax.tree.leaves(features)
+        example_batch_dim = (
+            np.shape(leaves[0])[0]
+            if leaves and np.ndim(leaves[0]) else 0
         )
-        exported = jax.export.export(jax.jit(_predict_fn(model)))(
-            var_shapes, feat_shapes
-        )
+
+        def feat_shapes_with(batch_dim):
+            # Only leaves that actually carry the batch dim get the
+            # symbolic size; scalars / non-batch leaves keep their
+            # static shapes.
+            def leaf_shape(x):
+                shape = tuple(np.shape(x))
+                if shape and shape[0] == example_batch_dim:
+                    shape = (batch_dim,) + shape[1:]
+                return jax.ShapeDtypeStruct(shape, np.asarray(x).dtype)
+
+            return jax.tree.map(leaf_shape, features)
+
+        # Prefer a batch-POLYMORPHIC artifact (serves any batch size —
+        # the reference's SavedModel signatures had a None batch dim);
+        # fall back to the example's static batch if the model's
+        # computation can't be traced with a symbolic dim.
+        export_fn = jax.export.export(jax.jit(_predict_fn(model)))
+        batch_polymorphic = False
+        try:
+            sym_b = jax.export.symbolic_shape("b")[0]
+            exported = export_fn(var_shapes, feat_shapes_with(sym_b))
+            batch_polymorphic = True
+        except Exception as exc:
+            logger.warning(
+                "Batch-polymorphic export failed (%s: %s); falling back "
+                "to the example's static batch size %d — the bundle "
+                "serves ONLY that batch size",
+                type(exc).__name__, exc, example_batch_dim,
+            )
+            exported = export_fn(
+                var_shapes, feat_shapes_with(example_batch_dim)
+            )
         with open(os.path.join(output_dir, HLO_FILE), "wb") as f:
             f.write(exported.serialize())
         hlo_written = True
+        meta["batch_polymorphic"] = batch_polymorphic
         meta["batch_size"] = int(
             jax.tree.leaves(features)[0].shape[0]
             if jax.tree.leaves(features)

@@ -323,3 +323,39 @@ class TestTfEvents:
             os.path.join(str(tmp_path / "tb2"), "scalars.jsonl")
         ).readlines()
         assert len(lines) == 2
+
+
+def test_export_is_batch_polymorphic(tmp_path):
+    """The bundle serves ANY batch size (reference SavedModel signatures
+    carried a None batch dim)."""
+    import json
+
+    import numpy as np
+
+    from elasticdl_tpu.core.model_spec import get_model_spec
+    from elasticdl_tpu.core.train_state import init_train_state
+    from elasticdl_tpu.serving.export import (
+        export_serving_bundle,
+        load_predictor,
+    )
+    from elasticdl_tpu.testing.data import model_zoo_dir
+
+    spec = get_model_spec(
+        model_zoo_dir(), "mnist.mnist_functional.custom_model"
+    )
+    batch = {
+        "features": np.zeros((4, 28, 28), np.float32),
+        "labels": np.zeros((4,), np.int32),
+        "mask": np.ones((4,), np.float32),
+    }
+    state = init_train_state(spec.model, spec.make_optimizer(), batch)
+    bundle = export_serving_bundle(
+        str(tmp_path / "b"), model=spec.model, state=state,
+        batch_example=batch,
+    )
+    with open(f"{bundle}/metadata.json") as f:
+        assert json.load(f)["batch_polymorphic"] is True
+    predictor = load_predictor(bundle)
+    for b in (1, 4, 9):
+        out = np.asarray(predictor(np.zeros((b, 28, 28), np.float32)))
+        assert out.shape == (b, 10)
