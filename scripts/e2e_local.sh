@@ -1,0 +1,58 @@
+#!/usr/bin/env bash
+# End-to-end CLI smoke (reference scripts/client_test.sh): train, then
+# evaluate and predict from the checkpoint, for a dense model (mnist) and
+# the host-tier sparse model (deepfm_host), on synthetic record files.
+# Usage: scripts/e2e_local.sh [workdir]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+WORK="${1:-$(mktemp -d)}"
+mkdir -p "$WORK"
+export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
+
+python - "$WORK" <<'PY'
+import sys, os
+from elasticdl_tpu.testing.data import (
+    create_mnist_record_file, create_frappe_record_file)
+w = sys.argv[1]
+create_mnist_record_file(os.path.join(w, "mnist_train.rec"), 192, seed=1)
+create_mnist_record_file(os.path.join(w, "mnist_val.rec"), 64, seed=2)
+create_frappe_record_file(os.path.join(w, "frappe_train.rec"), 96, seed=3)
+create_frappe_record_file(os.path.join(w, "frappe_val.rec"), 32, seed=4)
+PY
+
+run() { echo "+ $*"; python -m elasticdl_tpu "$@"; }
+
+# --- mnist: train -> evaluate -> predict (reference client_test.sh flow)
+run train --model_zoo model_zoo \
+  --model_def mnist.mnist_functional.custom_model \
+  --training_data "$WORK/mnist_train.rec" --minibatch_size 16 \
+  --num_epochs 2 --distribution_strategy Local --job_name e2e-mnist \
+  --checkpoint_dir "$WORK/mnist_ckpt" --checkpoint_steps 4 \
+  --output "$WORK/mnist_bundle"
+run evaluate --model_zoo model_zoo \
+  --model_def mnist.mnist_functional.custom_model \
+  --validation_data "$WORK/mnist_val.rec" --minibatch_size 16 \
+  --distribution_strategy Local --job_name e2e-mnist \
+  --checkpoint_dir_for_init "$WORK/mnist_ckpt"
+run predict --model_zoo model_zoo \
+  --model_def mnist.mnist_functional.custom_model \
+  --prediction_data "$WORK/mnist_val.rec" --minibatch_size 16 \
+  --distribution_strategy Local --job_name e2e-mnist \
+  --checkpoint_dir_for_init "$WORK/mnist_ckpt"
+
+# --- host-tier deepfm: train with export -> evaluate
+run train --model_zoo model_zoo \
+  --model_def deepfm.deepfm_host.custom_model \
+  --training_data "$WORK/frappe_train.rec" --minibatch_size 16 \
+  --num_epochs 1 --distribution_strategy Local --job_name e2e-deepfm \
+  --checkpoint_dir "$WORK/deepfm_ckpt" --checkpoint_steps 2 \
+  --output "$WORK/deepfm_bundle"
+run evaluate --model_zoo model_zoo \
+  --model_def deepfm.deepfm_host.custom_model \
+  --validation_data "$WORK/frappe_val.rec" --minibatch_size 16 \
+  --distribution_strategy Local --job_name e2e-deepfm \
+  --checkpoint_dir_for_init "$WORK/deepfm_ckpt"
+
+test -f "$WORK/mnist_bundle/metadata.json"
+test -f "$WORK/deepfm_bundle/predict.stablehlo"
+echo "E2E OK ($WORK)"
