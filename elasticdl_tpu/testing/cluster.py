@@ -11,7 +11,6 @@ path stays testable without a cluster (SURVEY.md §4 lesson).
 import threading
 from typing import Dict, List, Optional
 
-from elasticdl_tpu.common.constants import JobType, TaskType
 from elasticdl_tpu.comm.rpc import RpcServer
 from elasticdl_tpu.core.model_spec import get_model_spec
 from elasticdl_tpu.data.factory import create_data_reader
