@@ -95,6 +95,11 @@ def _submit_job(args, mode: str) -> int:
         "Submitted job %s (master pod %s)",
         args.job_name, manifests[0]["metadata"]["name"],
     )
+    if getattr(args, "wait", False):
+        from elasticdl_tpu.platform.job_monitor import JobMonitor
+
+        ok = JobMonitor(client, args.job_name).wait()
+        return 0 if ok else 1
     return 0
 
 

@@ -134,6 +134,10 @@ def add_common_params(parser):
     )
     add_bool_param(parser, "--use_bf16", True,
                    "Run matmuls in bfloat16 on the MXU")
+    add_bool_param(parser, "--wait", False,
+                   "After submitting to k8s, poll the job to completion "
+                   "(exit 0 on master Succeeded) — reference "
+                   "k8s_job_monitor semantics")
 
 
 def add_train_params(parser):

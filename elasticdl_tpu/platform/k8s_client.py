@@ -270,6 +270,17 @@ class Client:
                 return None
             raise
 
+    def get_pod_log(self, name: str, tail_lines: int = 100) -> str:
+        """Tail of a pod's log (job monitor failure reporting)."""
+        from kubernetes.client.rest import ApiException
+
+        try:
+            return self._core.read_namespaced_pod_log(
+                name, self.namespace, tail_lines=tail_lines
+            )
+        except ApiException as exc:
+            return f"<no log: {exc.status}>"
+
     def create_service(self, manifest: dict):
         return self._core.create_namespaced_service(
             self.namespace, manifest

@@ -523,3 +523,94 @@ def test_master_wires_row_service_for_host_models(tmp_path):
     ])
     assert not Master(args2)._uses_row_service()
     assert "--row_service_addr" not in Master(args2)._worker_command(0)
+
+
+class TestJobMonitor:
+    """Reference k8s_job_monitor parity (PodMonitor / EdlJobMonitor)."""
+
+    class _Pod:
+        def __init__(self, name, phase, rtype="worker"):
+            class Meta:
+                pass
+
+            class Status:
+                pass
+
+            self.metadata = Meta()
+            self.metadata.name = name
+            self.metadata.labels = {
+                "elasticdl-tpu-replica-type": rtype,
+            }
+            self.status = Status()
+            self.status.phase = phase
+
+    class _Client:
+        def __init__(self, phases, pods=()):
+            self._phases = list(phases)  # master phases per poll
+            self._pods = list(pods)
+            self.logs_fetched = []
+
+        def get_pod(self, name):
+            phase = (
+                self._phases.pop(0)
+                if len(self._phases) > 1 else self._phases[0]
+            )
+            if phase is None:
+                return None
+            return TestJobMonitor._Pod(name, phase, rtype="master")
+
+        def get_pod_log(self, name, tail_lines=100):
+            self.logs_fetched.append(name)
+            return "boom"
+
+        def list_job_pods(self, job):
+            return self._pods
+
+    def test_pod_monitor_succeeds(self):
+        from elasticdl_tpu.platform.job_monitor import PodMonitor
+
+        client = self._Client(["Running", "Succeeded"])
+        assert PodMonitor(client, "p", poll_secs=0.01).wait() is True
+
+    def test_pod_monitor_failure_tails_log(self):
+        from elasticdl_tpu.platform.job_monitor import PodMonitor
+
+        client = self._Client(["Running", "Failed"])
+        assert PodMonitor(client, "p", poll_secs=0.01).wait() is False
+        assert client.logs_fetched == ["p"]
+
+    def test_pod_monitor_not_found_gives_up(self):
+        from elasticdl_tpu.platform.job_monitor import PodMonitor
+
+        client = self._Client([None])
+        mon = PodMonitor(client, "p", poll_secs=0.01, not_found_retries=2)
+        assert mon.wait() is False
+
+    def test_job_monitor_snapshot_and_wait(self):
+        from elasticdl_tpu.platform.job_monitor import JobMonitor
+
+        pods = [
+            self._Pod("w0", "Running", "worker"),
+            self._Pod("rs", "Failed", "rowservice"),
+        ]
+        client = self._Client(["Running", "Succeeded"], pods=pods)
+        mon = JobMonitor(client, "j", poll_secs=0.01)
+        snap = mon.snapshot()
+        assert snap["worker"]["w0"] == "Running"
+        assert snap["rowservice"]["rs"] == "Failed"
+        assert mon.wait() is True
+
+    def test_job_monitor_failed_master_tails_log(self):
+        from elasticdl_tpu.platform.job_monitor import JobMonitor
+
+        client = self._Client(["Running", "Failed"])
+        mon = JobMonitor(client, "j", poll_secs=0.01)
+        assert mon.wait() is False
+        assert client.logs_fetched  # master log tailed
+
+    def test_job_monitor_tolerates_transient_404(self):
+        from elasticdl_tpu.platform.job_monitor import JobMonitor
+
+        client = self._Client([None, "Running", "Succeeded"])
+        mon = JobMonitor(client, "j", poll_secs=0.01)
+        assert mon.wait() is True
