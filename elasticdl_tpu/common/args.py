@@ -192,6 +192,15 @@ def add_train_params(parser):
                              "reference's --ps_resource_request role); "
                              "CPU-only, independent of worker sizing")
     parser.add_argument("--row_service_resource_limit", default="")
+    parser.add_argument("--row_service_checkpoint_steps", type=non_neg_int,
+                        default=0,
+                        help="Checkpoint interval for the row service, in "
+                             "gradient PUSHES (its version unit). 0 = "
+                             "derive from --checkpoint_steps scaled by "
+                             "num_workers (each worker step pushes once "
+                             "per table-holding step), so the service "
+                             "checkpoints at roughly the cadence the "
+                             "user asked for in model versions")
     add_bool_param(parser, "--fuse_task_steps", False,
                    "Scan a whole task's minibatches in one XLA program "
                    "(removes per-step host dispatch)")

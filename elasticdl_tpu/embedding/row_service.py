@@ -156,12 +156,16 @@ class HostRowService:
                     # Retried push whose first attempt DID apply before
                     # the reply was lost (at-most-once semantics).
                     return {"duplicate": True}
-                self._applied_seq[key] = seq
             self._optimizer.apply_gradients(
                 table,
                 np.asarray(request["ids"], np.int64),
                 np.asarray(request["grads"], np.float32),
             )
+            if client and seq >= 0:
+                # Record only AFTER apply succeeds: a failed apply must
+                # leave the seq unburned so the client's retry is not
+                # dropped as a duplicate (the gradient would be lost).
+                self._applied_seq[_client_key(client)] = seq
             self._push_count += 1
             version = self._push_count
         if (
@@ -268,7 +272,10 @@ class HostRowService:
         return out
 
 
-_TRANSIENT_CODES = ("UNAVAILABLE", "DEADLINE_EXCEEDED")
+# CANCELLED is transient too: a server-initiated GOAWAY during service
+# shutdown cancels in-flight calls, and every method here is safe to
+# retry (pulls are idempotent; pushes are deduped by (client, seq)).
+_TRANSIENT_CODES = ("UNAVAILABLE", "DEADLINE_EXCEEDED", "CANCELLED")
 
 
 def _call_with_retry(stub: RpcStub, method: str, retries: int,

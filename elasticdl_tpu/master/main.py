@@ -201,10 +201,19 @@ class Master:
         ckpt = getattr(self._args, "checkpoint_dir", "")
         if ckpt:
             # Its own subdir: the service's row payload is keyed by push
-            # count, the workers' by model version.
+            # count, the workers' by model version. checkpoint_steps is
+            # in model versions; the service counts gradient pushes
+            # (~num_workers per version), so scale unless the user set
+            # the push-unit knob explicitly.
+            steps = int(getattr(
+                self._args, "row_service_checkpoint_steps", 0
+            ) or 0)
+            if not steps:
+                steps = int(getattr(self._args, "checkpoint_steps", 0)) * max(
+                    1, int(getattr(self._args, "num_workers", 1))
+                )
             cmd += ["--checkpoint_dir", f"{ckpt}/row_service",
-                    "--checkpoint_steps",
-                    str(getattr(self._args, "checkpoint_steps", 0)),
+                    "--checkpoint_steps", str(steps),
                     "--keep_checkpoint_max",
                     str(getattr(self._args, "keep_checkpoint_max", 3))]
         return cmd

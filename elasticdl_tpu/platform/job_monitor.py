@@ -47,16 +47,28 @@ class PodMonitor:
             time.time() + timeout if timeout is not None else None
         )
         misses = 0
+        ever_seen = False
         while True:
             pod = self._client.get_pod(self._pod_name)
             if pod is None:
                 misses += 1
                 if misses > self._not_found_retries:
+                    if ever_seen:
+                        # Seen-then-gone = pod GC after completion, not
+                        # a job that never started; don't report failure.
+                        logger.warning(
+                            "%s disappeared after running; assuming "
+                            "completed (pod GC)", self._pod_name,
+                        )
+                        return True
                     logger.error("%s not found", self._pod_name)
                     return False
             else:
                 misses = 0
                 phase = _phase(pod)
+                # Only a pod that actually RAN can be GC'd-after-success;
+                # Pending-then-gone (unschedulable, deleted) is failure.
+                ever_seen = ever_seen or phase in ("Running", SUCCEEDED)
                 logger.info("%s phase: %s", self._pod_name, phase)
                 if phase == SUCCEEDED:
                     return True
@@ -99,6 +111,7 @@ class JobMonitor:
             time.time() + timeout if timeout is not None else None
         )
         misses = 0
+        ever_seen = False
         while True:
             pod = self._client.get_pod(master)
             if pod is None:
@@ -106,6 +119,17 @@ class JobMonitor:
                 # submit) must not read as job failure.
                 misses += 1
                 if misses > not_found_retries:
+                    if ever_seen:
+                        # Seen-then-gone: a fast job whose Succeeded
+                        # master was GC-deleted between polls. Unknown,
+                        # not failure — don't make --wait exit 1 for a
+                        # job that likely completed.
+                        logger.warning(
+                            "job %s: master pod %s disappeared after "
+                            "running; assuming completed (pod GC)",
+                            self._job_name, master,
+                        )
+                        return True
                     logger.error(
                         "job %s: master pod %s not found",
                         self._job_name, master,
@@ -115,6 +139,9 @@ class JobMonitor:
                 continue
             misses = 0
             phase = _phase(pod)
+            # Only a master that RAN can be GC'd-after-success;
+            # Pending-then-gone (unschedulable, deleted) is failure.
+            ever_seen = ever_seen or phase in ("Running", SUCCEEDED)
             snap = self.snapshot()
             logger.info(
                 "job %s: master=%s %s", self._job_name, phase,

@@ -127,13 +127,22 @@ def build_worker(args, master_client=None) -> Worker:
         if row_addr:
             # Multi-process sharing: rows live behind the row service
             # (embedding/row_service.py), the Pserver sparse role.
-            try:
-                step_runner = spec.make_host_runner(remote_addr=row_addr)
-            except TypeError:
+            # Check the signature up front — catching TypeError around
+            # the call would also swallow TypeErrors raised INSIDE the
+            # factory and misreport genuine zoo bugs.
+            import inspect
+
+            params = inspect.signature(spec.make_host_runner).parameters
+            accepts_remote = "remote_addr" in params or any(
+                p.kind is inspect.Parameter.VAR_KEYWORD
+                for p in params.values()
+            )
+            if not accepts_remote:
                 raise ValueError(
                     f"{args.model_def}: make_host_runner must accept "
                     "remote_addr=... to run against --row_service_addr"
                 )
+            step_runner = spec.make_host_runner(remote_addr=row_addr)
         else:
             if getattr(args, "num_workers", 1) > 1:
                 # Per-process tables would silently fork: each pod would

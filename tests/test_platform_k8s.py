@@ -614,3 +614,59 @@ class TestJobMonitor:
         client = self._Client([None, "Running", "Succeeded"])
         mon = JobMonitor(client, "j", poll_secs=0.01)
         assert mon.wait() is True
+
+
+class TestJobMonitorGone:
+    """ADVICE round 1: seen-then-gone must not read as failure.
+
+    Plain class (NOT a TestJobMonitor subclass — inheriting would
+    re-collect every base test); helpers referenced directly.
+    """
+
+    class _GoneClient(TestJobMonitor._Client):
+        """Phases run out → pod gone for good (GC), not last-repeats."""
+
+        def get_pod(self, name):
+            if not self._phases:
+                return None
+            phase = self._phases.pop(0)
+            if phase is None:
+                return None
+            return TestJobMonitor._Pod(name, phase, rtype="master")
+
+    def test_job_monitor_seen_then_gone_is_success(self):
+        from elasticdl_tpu.platform.job_monitor import JobMonitor
+
+        # Master observed Running, then GC-deleted before the next poll
+        # ever sees Succeeded: report completed, not failed.
+        client = self._GoneClient(["Running"])
+        mon = JobMonitor(client, "j", poll_secs=0.01)
+        assert mon.wait(not_found_retries=2) is True
+
+    def test_job_monitor_never_seen_is_failure(self):
+        from elasticdl_tpu.platform.job_monitor import JobMonitor
+
+        client = self._GoneClient([])
+        mon = JobMonitor(client, "j", poll_secs=0.01)
+        assert mon.wait(not_found_retries=2) is False
+
+    def test_pod_monitor_seen_then_gone_is_success(self):
+        from elasticdl_tpu.platform.job_monitor import PodMonitor
+
+        client = self._GoneClient(["Running"])
+        mon = PodMonitor(client, "p", poll_secs=0.01, not_found_retries=2)
+        assert mon.wait() is True
+
+    def test_pending_then_gone_is_failure(self):
+        # Code-review finding: a pod that only ever sat Pending and then
+        # vanished never ran — must NOT be reported as success.
+        from elasticdl_tpu.platform.job_monitor import JobMonitor, PodMonitor
+
+        client = self._GoneClient(["Pending"])
+        assert JobMonitor(client, "j", poll_secs=0.01).wait(
+            not_found_retries=2
+        ) is False
+        client = self._GoneClient(["Pending"])
+        assert PodMonitor(
+            client, "p", poll_secs=0.01, not_found_retries=2
+        ).wait() is False

@@ -473,3 +473,39 @@ def test_service_restart_mid_job_drains(tmp_path):
     assert "svc" in holder
     assert holder["svc"].host_tables[deepfm_host.TABLE_NAME].num_rows > 0
     holder["svc"].stop(0)
+
+
+def test_failed_apply_does_not_burn_seq():
+    """ADVICE round 1: a push whose apply raises must leave the seq
+    unrecorded so the client's retry applies instead of being dropped
+    as a duplicate (gradient silently lost)."""
+
+    class FlakyOptimizer(HostOptimizerWrapper):
+        def __init__(self):
+            super().__init__(SGD(lr=0.5))
+            self.fail_next = True
+
+        def apply_gradients(self, table, ids, grads):
+            if self.fail_next:
+                self.fail_next = False
+                raise RuntimeError("transient apply failure")
+            return super().apply_gradients(table, ids, grads)
+
+    svc = HostRowService(
+        {"items": EmbeddingTable("items", DIM)}, FlakyOptimizer()
+    )
+    ids = np.array([1, 2], np.int64)
+    grads = np.ones((2, DIM), np.float32)
+    before = svc._tables["items"].get(ids).copy()
+    push = {"table": "items", "ids": ids, "grads": grads,
+            "client": "w0", "seq": 1}
+    with pytest.raises(RuntimeError):
+        svc._push_row_grads(dict(push))
+    # Retry of the SAME seq must apply, not be treated as duplicate.
+    resp = svc._push_row_grads(dict(push))
+    assert not resp.get("duplicate")
+    after = svc._tables["items"].get(ids)
+    np.testing.assert_allclose(after, before - 0.5 * grads, rtol=1e-6)
+    # And a genuine duplicate is still dropped.
+    resp = svc._push_row_grads(dict(push))
+    assert resp.get("duplicate") is True
