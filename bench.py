@@ -1,74 +1,111 @@
-"""Benchmark: examples/sec/chip on the MNIST CNN training step.
+"""Driver benchmark entry: the WHOLE perf surface in one artifact.
 
-Measures the task-granular execution mode (core/step.build_multi_step):
-the framework's unit of work is a task of N minibatches (reference
-task_dispatcher records_per_task), and fusing those N optimizer steps
-into one XLA program via lax.scan removes N-1 host dispatches per task —
-the dominant cost for small models. Distinct batches are stacked on
-device; per-step losses remain observable.
+Runs, as subprocesses (one TPU client at a time):
+  1. bench_suite.py --check-floors — all six BASELINE.md configs
+     (mnist / cifar10 / resnet50 / deepfm / census / transformer LM),
+     each reporting examples-or-tokens/sec/chip, vs_floor, and MFU
+     (achieved FLOPs/sec from XLA cost analysis over the chip's bf16
+     peak — benchlib.program_flops).
+  2. bench_elasticity.py — job throughput under a mid-task worker kill
+     (baseline/preempted records/sec, recovery seconds).
 
-Prints ONE JSON line {"metric","value","unit","vs_baseline"}. The
-reference publishes no numbers (BASELINE.md), so the regression floor is
-this repo's own first TPU run, recorded in BENCH_FLOOR.json; until that
-file exists vs_baseline is 1.0 and the floor is written on a TPU run.
+Prints one human-readable JSON line per sub-metric, then ONE final
+summary line {"metric","value","unit","vs_baseline","configs",
+"elasticity"} — the driver parses the last line, so regressions in ANY
+config surface in BENCH_r{N}.json: the headline value is the WORST
+vs_floor across configs (the regression gate; >= 1.0 means every config
+is at or above its recorded floor).
 
-The measurement harness lives in benchlib.py (shared with the breadth
-suite bench_suite.py).
+The reference's analogue is scripts/client_test.sh — the e2e job matrix
+every change must keep green; here the matrix is perf-gated too.
 """
 
 import json
 import os
+import subprocess
+import sys
 
-import numpy as np
+HERE = os.path.dirname(os.path.abspath(__file__))
 
-from benchlib import load_json, make_mnist_batch, measure_multi_step
 
-BATCH = 512
-STEPS_PER_TASK = 16   # reference num_minibatches_per_task granularity
-MEASURE_TASKS = 4
-MEASURE_ROUNDS = 5    # median over rounds (tunnel throughput varies)
-FLOOR_FILE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                          "BENCH_FLOOR.json")
+class _Failed:
+    returncode = 1
+    stdout = ""
+
+
+def _run(script, *args):
+    """Run a bench subprocess, echoing its output; return the proc (a
+    stub with returncode=1 on timeout, so the summary line still
+    prints)."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(HERE, script), *args],
+            capture_output=True, text=True, timeout=3600,
+        )
+    except subprocess.TimeoutExpired as exc:
+        sys.stderr.write(f"{script} timed out after {exc.timeout}s\n")
+        return _Failed()
+    for line in proc.stdout.splitlines():
+        print(line)
+    if proc.returncode != 0:
+        sys.stderr.write(proc.stderr[-4000:])
+    return proc
+
+
+def _parse_metric_lines(stdout):
+    for line in stdout.splitlines():
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if "metric" in rec:
+            yield rec
 
 
 def main():
-    import jax
+    # Summary is built from THIS run's printed lines, not the merged
+    # BENCH_SUITE.json — a partially-crashed run must not present stale
+    # (or CPU-smoke) entries as current measurements.
+    suite = _run("bench_suite.py", "--check-floors")
+    configs = {}
+    platform = "unknown"
+    for rec in _parse_metric_lines(suite.stdout):
+        metric = rec["metric"]
+        name = metric.split("_train_")[0]
+        if "[" in metric:
+            platform = metric.rsplit("[", 1)[1].rstrip("]")
+        configs[name] = {
+            "rate": rec["value"], "unit": rec["unit"],
+            "vs_floor": rec["vs_baseline"], "mfu": rec.get("mfu"),
+            "platform": platform,
+        }
 
-    from elasticdl_tpu.core.model_spec import get_model_spec
-    from elasticdl_tpu.core.step import stack_batches
-    from elasticdl_tpu.testing.data import model_zoo_dir
+    elasticity = {}
+    elastic = _run("bench_elasticity.py")
+    for rec in _parse_metric_lines(elastic.stdout):
+        name = rec["metric"].split("[")[0]
+        if name.startswith("elastic_"):
+            elasticity[name[len("elastic_"):]] = {
+                "value": rec["value"], "unit": rec["unit"],
+                "vs_baseline": rec["vs_baseline"],
+            }
 
-    platform = jax.devices()[0].platform
-    spec = get_model_spec(
-        model_zoo_dir(), "mnist.mnist_functional.custom_model"
+    worst = min(
+        (c["vs_floor"] for c in configs.values()), default=0.0
     )
-    rng = np.random.RandomState(0)
-    task = jax.device_put(
-        stack_batches(
-            [make_mnist_batch(BATCH, rng) for _ in range(STEPS_PER_TASK)]
-        )
-    )
-    examples_per_sec = measure_multi_step(
-        spec, task, BATCH, STEPS_PER_TASK, MEASURE_TASKS,
-        measure_rounds=MEASURE_ROUNDS,
-    )
-
-    floor = load_json(FLOOR_FILE, {}).get("examples_per_sec")
-    vs_baseline = examples_per_sec / floor if floor else 1.0
-    if not floor and platform != "cpu":
-        with open(FLOOR_FILE, "w") as f:
-            json.dump(
-                {"examples_per_sec": examples_per_sec,
-                 "platform": platform, "batch": BATCH},
-                f,
-            )
     print(json.dumps({
-        "metric": f"mnist_cnn_train_examples_per_sec_per_chip[{platform}]",
-        "value": round(examples_per_sec, 2),
-        "unit": "examples/sec/chip",
-        "vs_baseline": round(vs_baseline, 4),
+        "metric": f"bench_suite_worst_vs_floor[{platform}]",
+        "value": round(worst, 4),
+        "unit": "x_floor",
+        "vs_baseline": round(worst, 4),
+        "configs": configs,
+        "elasticity": elasticity,
     }))
+    # Floor regressions and crashed sub-benches fail the bench loudly.
+    return (
+        0 if suite.returncode == 0 and elastic.returncode == 0 else 1
+    )
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

@@ -129,13 +129,17 @@ def run_config(name):
     task = jax.device_put(
         stack_batches([_make_batch(name, batch, rng) for _ in range(steps)])
     )
-    return measure_multi_step(spec, task, batch, steps, measure_tasks)
+    return measure_multi_step(
+        spec, task, batch, steps, measure_tasks, compute_mfu=True
+    )
 
 
 def main():
     import jax
 
-    names = sys.argv[1:] or list(CONFIGS)
+    argv = sys.argv[1:]
+    check_floors = "--check-floors" in argv
+    names = [a for a in argv if not a.startswith("--")] or list(CONFIGS)
     unknown = [n for n in names if n not in CONFIGS]
     if unknown:
         raise SystemExit(f"unknown configs {unknown}; pick from {list(CONFIGS)}")
@@ -145,7 +149,7 @@ def main():
 
     results = {}
     for name in names:
-        eps = run_config(name)
+        eps, mfu, tflops = run_config(name)
         if name == "transformer":
             eps *= TRANSFORMER_SEQ  # examples/sec -> tokens/sec
         unit = (
@@ -163,6 +167,7 @@ def main():
         results[name] = {
             "rate": round(eps, 2), "vs_floor": round(vs, 4),
             "unit": unit, "platform": platform,
+            "mfu": round(mfu, 4), "tflops_per_sec": round(tflops, 2),
         }
         print(json.dumps({
             "metric": f"{name}_train_{unit.split('/')[0]}_per_sec_per_chip"
@@ -170,6 +175,7 @@ def main():
             "value": round(eps, 2),
             "unit": unit,
             "vs_baseline": round(vs, 4),
+            "mfu": round(mfu, 4),
         }))
 
     if platform != "cpu":
@@ -177,6 +183,16 @@ def main():
             json.dump(floors, f, indent=1)
     merge_json(OUT_FILE, results)
 
+    if check_floors:
+        failed = {
+            n: r["vs_floor"] for n, r in results.items()
+            if r["vs_floor"] < 1.0
+        }
+        if failed:
+            print(json.dumps({"floor_failures": failed}), file=sys.stderr)
+            return 1
+    return 0
+
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

@@ -29,11 +29,55 @@ def make_mnist_batch(batch, rng, flat=False):
     }
 
 
+# Peak dense-matmul throughput per chip (bf16), for MFU accounting.
+# Sources: public TPU spec sheets; device_kind prefixes as reported by
+# jax.devices()[0].device_kind.
+PEAK_BF16_FLOPS = (
+    ("TPU v5 lite", 197e12),   # v5e
+    ("TPU v5e", 197e12),
+    ("TPU v5p", 459e12),
+    ("TPU v5", 459e12),
+    ("TPU v4", 275e12),
+    ("TPU v6", 918e12),        # Trillium
+)
+
+
+def peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "") or ""
+    for prefix, peak in PEAK_BF16_FLOPS:
+        if kind.startswith(prefix):
+            return peak
+    return 0.0
+
+
+def program_flops(spec, batch):
+    """FLOPs of ONE optimizer step (forward+backward+apply) from XLA's
+    cost analysis of the compiled single-step program. The bench configs
+    run without rematerialization, so this equals the model's analytic
+    FLOPs (no recompute inflation) — the numerator MFU is defined over."""
+    import jax
+
+    from elasticdl_tpu.core.step import build_train_step
+    from elasticdl_tpu.core.train_state import init_train_state
+
+    state = init_train_state(
+        spec.model, spec.make_optimizer(), batch, seed=0
+    )
+    compiled = build_train_step(spec.loss).lower(state, batch).compile()
+    cost = compiled.cost_analysis()
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else {}
+    return float((cost or {}).get("flops", 0.0))
+
+
 def measure_multi_step(spec, task, batch, steps_per_task, measure_tasks,
-                       warmup_tasks=2, measure_rounds=3):
+                       warmup_tasks=2, measure_rounds=3,
+                       compute_mfu=False):
     """Time the fused task-granular step (core/step.build_multi_step) on a
     device-resident task; returns examples/sec (median over rounds — the
-    device tunnel's throughput varies run to run)."""
+    device tunnel's throughput varies run to run). With ``compute_mfu``,
+    returns ``(examples_per_sec, mfu, tflops_per_sec)`` where MFU is
+    achieved FLOPs/sec over the chip's bf16 peak (program_flops)."""
     import jax
 
     from elasticdl_tpu.core.step import build_multi_step
@@ -64,7 +108,14 @@ def measure_multi_step(spec, task, batch, steps_per_task, measure_tasks,
         rounds.append(time.perf_counter() - start)
     elapsed = float(np.median(rounds))
     assert np.isfinite(final_loss), f"bench diverged: loss={final_loss}"
-    return batch * steps_per_task * measure_tasks / elapsed
+    eps = batch * steps_per_task * measure_tasks / elapsed
+    if not compute_mfu:
+        return eps
+    flops_step = program_flops(spec, jax.tree.map(lambda x: x[0], task))
+    achieved = flops_step * steps_per_task * measure_tasks / elapsed
+    peak = peak_flops(jax.devices()[0])
+    mfu = achieved / peak if peak else 0.0
+    return eps, mfu, achieved / 1e12
 
 
 def load_json(path, default):

@@ -31,7 +31,7 @@ def create_data_reader(data_origin: str, custom_reader=None, **kwargs):
     # Table origins (sqlite/csv-table/ODPS) route by URL scheme
     # (reference data_reader_factory.py: ODPS selected by env+path).
     if reader_type == ReaderType.TABLE or data_origin.startswith(
-        ("table+sqlite://", "table+csv://", "odps://")
+        ("table+sqlite://", "table+csv://", "table+rpc://", "odps://")
     ):
         from elasticdl_tpu.data.table_reader import TableDataReader
 

@@ -20,11 +20,15 @@ Rows are serialized to msgpack dicts (column name → value) so the user
 """
 
 import threading
+import time
 from typing import Dict, Iterator, List, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
 from elasticdl_tpu.common import tensor_utils
+from elasticdl_tpu.common.log_utils import get_logger
 from elasticdl_tpu.data.reader import AbstractDataReader, Metadata
+
+logger = get_logger("table_reader")
 
 
 class TableSource:
@@ -40,8 +44,134 @@ class TableSource:
         """Yield rows [start, end) as column dicts."""
         raise NotImplementedError
 
+    def is_transient_error(self, exc: BaseException) -> bool:
+        """Whether a read/count failure is worth retrying. Sources with
+        richer error models (RPC status codes) override this."""
+        return is_transient_error(exc)
+
     def close(self):
         pass
+
+
+def is_transient_error(exc: BaseException) -> bool:
+    """Default transient/permanent classification for table IO.
+
+    Transient (retry): network/file-system hiccups (OSError family incl.
+    ConnectionError/TimeoutError) and sqlite busy/locked
+    (sqlite3.OperationalError). Permanent (surface immediately): schema
+    and programming errors — ValueError/KeyError/TypeError, missing
+    tables — where a retry would just repeat the failure. The reference
+    retried *every* exception (odps_io.py:243-265 catches Exception);
+    classifying keeps genuine bugs loud, which its own tests relied on.
+    """
+    import sqlite3
+
+    if isinstance(exc, sqlite3.OperationalError):
+        # sqlite uses OperationalError for BOTH contention (locked/busy
+        # — transient) and misconfiguration (no such table/column, SQL
+        # syntax — permanent). Classify by message; unknown operational
+        # errors default to transient (IO-flavored in practice).
+        msg = str(exc).lower()
+        permanent = ("no such table", "no such column", "syntax error",
+                     "unable to open database")
+        return not any(p in msg for p in permanent)
+    if isinstance(exc, sqlite3.Error):
+        return False
+    if isinstance(exc, FileNotFoundError):
+        return False  # a missing file won't appear by retrying
+    return isinstance(exc, OSError)
+
+
+class RetryingSource(TableSource):
+    """Fault envelope around any TableSource (reference ``odps_io.py``
+    ``record_generator_with_retry`` / ``read_batch`` retry loops).
+
+    Improvements over the reference envelope:
+
+    - **Resume, don't restart**: the reference re-runs the generator
+      from ``start`` after a mid-stream failure, re-yielding rows the
+      consumer already saw (duplicated training records). Here the
+      retry resumes at ``start + rows_already_yielded``.
+    - **Error classification**: only transient errors retry
+      (``is_transient_error`` — the wrapped source can override);
+      permanent ones surface immediately.
+    - Exponential backoff with a cap, vs the reference's fixed 5 s.
+    """
+
+    def __init__(self, source: TableSource, max_retries: int = 5,
+                 backoff_secs: float = 0.5, backoff_cap: float = 30.0):
+        self._source = source
+        self._max_retries = int(max_retries)
+        self._backoff = float(backoff_secs)
+        self._cap = float(backoff_cap)
+
+    def _retry_loop(self, what: str, fn):
+        delay = self._backoff
+        for attempt in range(self._max_retries + 1):
+            try:
+                return fn()
+            except Exception as exc:
+                if (
+                    not self._source.is_transient_error(exc)
+                    or attempt == self._max_retries
+                ):
+                    raise
+                logger.warning(
+                    "table %s failed (%s: %s); retry %d/%d in %.1fs",
+                    what, type(exc).__name__, exc, attempt + 1,
+                    self._max_retries, delay,
+                )
+                time.sleep(delay)
+                delay = min(delay * 2, self._cap)
+
+    def count(self) -> int:
+        return self._retry_loop("count", self._source.count)
+
+    def column_names(self) -> List[str]:
+        return self._retry_loop("column_names", self._source.column_names)
+
+    def read(self, start: int, end: int) -> Iterator[dict]:
+        yielded = 0
+        delay = self._backoff
+        attempt = 0
+        progressed = False
+        while True:
+            try:
+                for row in self._source.read(start + yielded, end):
+                    yield row
+                    yielded += 1
+                    progressed = True
+                return
+            except Exception as exc:
+                if progressed:
+                    # A recovered-and-resumed stretch means the service
+                    # is healthy between failures: fresh budget per
+                    # failure, not cumulative over a minutes-long shard
+                    # (6 individually-recovered restarts must not kill
+                    # the task on the 6th).
+                    attempt = 0
+                    delay = self._backoff
+                    progressed = False
+                if (
+                    not self._source.is_transient_error(exc)
+                    or attempt >= self._max_retries
+                ):
+                    raise
+                attempt += 1
+                logger.warning(
+                    "table read [%d, %d) failed at +%d rows (%s: %s); "
+                    "retry %d/%d in %.1fs", start, end, yielded,
+                    type(exc).__name__, exc, attempt, self._max_retries,
+                    delay,
+                )
+                time.sleep(delay)
+                delay = min(delay * 2, self._cap)
+
+    def is_transient_error(self, exc: BaseException) -> bool:
+        return self._source.is_transient_error(exc)
+
+    def close(self):
+        self._source.close()
 
 
 class SqliteTableSource(TableSource):
@@ -164,6 +294,7 @@ def open_table_source(data_origin: str) -> TableSource:
 
     - ``table+sqlite:///path/to.db?table=name``
     - ``table+csv:///path/to.csv``
+    - ``table+rpc://host:port`` (a running data.table_service)
     - ``odps://project/tables/name``
     """
     parsed = urlparse(data_origin)
@@ -174,6 +305,10 @@ def open_table_source(data_origin: str) -> TableSource:
         return SqliteTableSource(parsed.path, table)
     if scheme == "table+csv":
         return CsvTableSource(parsed.path)
+    if scheme == "table+rpc":
+        from elasticdl_tpu.data.table_service import RemoteTableSource
+
+        return RemoteTableSource(parsed.netloc)
     if scheme == "odps":
         parts = parsed.path.strip("/").split("/")
         table = parts[-1] if parts else ""
@@ -188,10 +323,19 @@ class TableDataReader(AbstractDataReader):
 
     def __init__(self, data_origin: str, source: Optional[TableSource] =
                  None, num_prefetch_threads: int = 0,
-                 prefetch_chunk: int = 256, **kwargs):
+                 prefetch_chunk: int = 256, max_retries: int = 5,
+                 backoff_secs: float = 0.5, **kwargs):
         super().__init__(**kwargs)
         self._data_origin = data_origin
-        self._source = source or open_table_source(data_origin)
+        source = source or open_table_source(data_origin)
+        # Every source rides the fault envelope (reference readers
+        # retried inside odps_io; a transient error must not kill the
+        # task — the dispatcher's 3-retry budget is for real failures).
+        if not isinstance(source, RetryingSource):
+            source = RetryingSource(
+                source, max_retries=max_retries, backoff_secs=backoff_secs
+            )
+        self._source = source
         self._num_prefetch_threads = int(num_prefetch_threads)
         self._prefetch_chunk = int(prefetch_chunk)
 

@@ -12,19 +12,86 @@ TPU tunnel (and hang when it is unavailable).
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+# The TPU kernel-correctness lane (`make test-tpu`, tests marked `tpu`)
+# must run on the REAL chip — compiled, non-interpret — so it skips the
+# CPU forcing below and keeps the default (axon) platform.
+_TPU_LANE = os.environ.get("ELASTICDL_TPU_TESTS", "") == "1"
+
+if not _TPU_LANE:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+if not _TPU_LANE:
+    jax.config.update("jax_platforms", "cpu")
 
 
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long multi-process integration tests"
     )
+    config.addinivalue_line(
+        "markers", "tpu: requires the real TPU chip (compiled, "
+        "non-interpret kernel correctness lane; run via make test-tpu)"
+    )
+
+
+# Test tiering (VERDICT round 1 #10): `make test` runs the fast lane
+# (<4 min); `make test-all` runs everything. Modules/tests listed here
+# are auto-marked slow — measured >8s each on the CI box; the breadth
+# they add (zoo e2e, multi-process jobs, bench smoke, heavy numerics)
+# belongs in the full lane, not the edit-compile-test loop.
+_SLOW_MODULES = {
+    "test_example_zoo",
+    "test_multihost_job",
+    "test_multihost_2proc",
+    "test_bench_suite",
+    "test_elastic_mesh_resize",
+    "test_pipeline_lm",
+}
+_SLOW_TESTS = {
+    "test_fused_mesh_runner_matches_stepwise",
+    "test_remat_matches_plain",
+    "test_moe_top2_routing",
+    "test_training_learns_on_dp_sp_tp",
+    "test_mesh_training_matches_single_device",
+    "test_moe_expert_parallel",
+    "test_mesh_wiring_end_to_end",
+    "test_sharded_roundtrip",
+    "test_local_mnist_trains_and_loss_decreases",
+    "test_remat_transformer_with_dropout",
+    "test_incremental_decode_matches_full_forward",
+    "test_trained_model_generates_learned_chain",
+    "test_pallas_ring_matches_dense",
+    "test_ring_gradients_match_dense",
+    "test_single_worker_job_drains_and_learns",
+    "test_two_workers_share_the_queue",
+    "test_job_over_real_grpc",
+    "test_graceful_sigterm_checkpoints_and_returns_task",
+    "test_worker_death_checkpoint_resume",
+    "test_mesh_matches_local_trajectory",
+    "test_accum_steps_applies_every_n",
+    "test_mesh_worker_in_cluster",
+    "test_pipeline_gradients_match_sequential",
+    "test_checkpoint_and_resume",
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    import pytest
+
+    for item in items:
+        mod = getattr(item.module, "__name__", "")
+        base = item.name.split("[")[0]
+        if mod in _SLOW_MODULES or base in _SLOW_TESTS:
+            item.add_marker(pytest.mark.slow)
+        if not _TPU_LANE and item.get_closest_marker("tpu"):
+            item.add_marker(pytest.mark.skip(
+                reason="TPU lane: set ELASTICDL_TPU_TESTS=1 "
+                       "(make test-tpu) to run on the real chip"
+            ))

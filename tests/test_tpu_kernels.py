@@ -1,0 +1,220 @@
+"""TPU kernel-correctness lane: compiled (non-interpret) Pallas kernels
+on the REAL chip, asserted against the XLA reference paths.
+
+VERDICT round 1 #3: every other Pallas test runs ``interpret=True`` on
+CPU, which cannot catch Mosaic-compilation-only bugs (layout/tiling/DMA
+semantics). This lane runs the same numerics compiled on the bench chip:
+
+    make test-tpu    (ELASTICDL_TPU_TESTS=1 pytest -m tpu)
+
+and is a pre-bench gate (`make bench` depends on it). Reference
+analogue: ``pkg/kernel/kernel_test.go`` — numeric tolerance against
+hand-computed updates, run on the real build, not a simulator.
+
+Ring attention's cross-device collective needs >1 chip; its on-chip
+building block (``flash_chunk_update``) is covered here, the collective
+path by the virtual-mesh CPU tests (test_ring_attention.py).
+"""
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.tpu
+
+
+@pytest.fixture(scope="module")
+def tpu():
+    import jax
+
+    dev = jax.devices()[0]
+    if dev.platform != "tpu":
+        pytest.skip(f"needs a TPU device, have {dev.platform}")
+    return dev
+
+
+def _qkv(b=2, s=512, h=4, d=64, dtype="float32", seed=0):
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(seed)
+    mk = lambda: jnp.asarray(  # noqa: E731
+        rng.randn(b, s, h, d).astype(np.float32) * 0.3, dtype
+    )
+    return mk(), mk(), mk()
+
+
+class TestFlashAttentionOnChip:
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_forward_matches_dense_f32(self, tpu, causal):
+        import jax
+
+        from elasticdl_tpu.ops.flash_attention import flash_attention
+        from elasticdl_tpu.ops.ring_attention import dense_attention
+
+        q, k, v = _qkv()
+        got = jax.jit(
+            lambda q, k, v: flash_attention(q, k, v, causal=causal)
+        )(q, k, v)
+        want = dense_attention(q, k, v, causal=causal)
+        # On-chip tolerance: TPU matmuls accumulate at MXU default
+        # precision (bf16-ish passes), so flash-vs-dense differ by
+        # ~1e-3 even in f32 — an order-of-magnitude tighter than any
+        # real mask/layout bug (O(1)) this lane exists to catch.
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=1e-2, atol=5e-3
+        )
+
+    def test_forward_bf16(self, tpu):
+        import jax
+
+        from elasticdl_tpu.ops.flash_attention import flash_attention
+        from elasticdl_tpu.ops.ring_attention import dense_attention
+
+        q, k, v = _qkv(dtype="bfloat16")
+        got = jax.jit(
+            lambda q, k, v: flash_attention(q, k, v, causal=True)
+        )(q, k, v)
+        want = dense_attention(
+            q.astype(np.float32), k.astype(np.float32),
+            v.astype(np.float32), causal=True,
+        )
+        np.testing.assert_allclose(
+            np.asarray(got, np.float32), np.asarray(want),
+            rtol=3e-2, atol=3e-2,
+        )
+
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_backward_matches_dense(self, tpu, causal):
+        import jax
+        import jax.numpy as jnp
+
+        from elasticdl_tpu.ops.flash_attention import flash_attention
+        from elasticdl_tpu.ops.ring_attention import dense_attention
+
+        q, k, v = _qkv(s=256)
+
+        def loss_flash(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, causal=causal) ** 2)
+
+        def loss_dense(q, k, v):
+            return jnp.sum(dense_attention(q, k, v, causal=causal) ** 2)
+
+        got = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))(q, k, v)
+        want = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+        for g, w, name in zip(got, want, "qkv"):
+            np.testing.assert_allclose(
+                np.asarray(g), np.asarray(w), rtol=2e-2, atol=2e-2,
+                err_msg=f"d{name} mismatch on chip",
+            )
+
+    def test_chunk_update_streams_to_full_answer(self, tpu):
+        """The ring building block compiled on chip: folding K/V chunks
+        through flash_chunk_update must equal one-shot attention."""
+        import jax
+        import jax.numpy as jnp
+
+        from elasticdl_tpu.ops.flash_attention import flash_chunk_update
+        from elasticdl_tpu.ops.ring_attention import dense_attention
+
+        b, s, h, d = 1, 512, 2, 64
+        chunk = 256
+        q, k, v = _qkv(b=b, s=s, h=h, d=d)
+        bh = b * h
+
+        def to_bh(x):
+            return x.transpose(0, 2, 1, 3).reshape(bh, s, d)
+
+        @jax.jit
+        def run(q, k, v):
+            qb, kb, vb = to_bh(q), to_bh(k), to_bh(v)
+            m = jnp.full((bh, s, 1), -1e30, jnp.float32)
+            l = jnp.zeros((bh, s, 1), jnp.float32)
+            acc = jnp.zeros((bh, s, d), jnp.float32)
+            for off in range(0, s, chunk):
+                m, l, acc = flash_chunk_update(
+                    qb, kb[:, off:off + chunk], vb[:, off:off + chunk],
+                    m, l, acc, q_offset=0, k_offset=off, causal=True,
+                )
+            return acc / jnp.maximum(l, 1e-30)
+
+        got = run(q, k, v).reshape(b, h, s, d).transpose(0, 2, 1, 3)
+        want = dense_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=1e-2, atol=5e-3
+        )
+
+
+class TestEmbeddingKernelsOnChip:
+    def _table(self, vocab=1024, dim=128, seed=3):
+        rng = np.random.RandomState(seed)
+        return rng.randn(vocab, dim).astype(np.float32)
+
+    @pytest.mark.parametrize("combiner", ["sum", "mean", "sqrtn"])
+    def test_lookup_combine_pallas_matches_xla(self, tpu, combiner):
+        import jax
+        import jax.numpy as jnp
+
+        from elasticdl_tpu.ops.pallas_embedding import lookup_combine
+
+        table = jnp.asarray(self._table())
+        rng = np.random.RandomState(0)
+        ids = jnp.asarray(rng.randint(0, 1024, (64, 10)), jnp.int32)
+        weights = jnp.asarray(rng.rand(64, 10), jnp.float32)
+
+        got = jax.jit(
+            lambda t, i, w: lookup_combine(
+                t, i, w, combiner, force_pallas=True
+            )
+        )(table, ids, weights)
+        want = lookup_combine(table, ids, weights, combiner)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5
+        )
+
+    def test_sparse_sgd_matches_reference(self, tpu):
+        import jax
+        import jax.numpy as jnp
+
+        from elasticdl_tpu.ops.pallas_embedding import sparse_sgd_update
+
+        table = self._table()
+        rng = np.random.RandomState(1)
+        ids = np.unique(rng.randint(0, 1024, 32)).astype(np.int32)
+        grads = rng.randn(len(ids), 128).astype(np.float32)
+        lr = 0.1
+
+        got = jax.jit(
+            lambda t, i, g: sparse_sgd_update(t, i, g, lr)
+        )(jnp.asarray(table), jnp.asarray(ids), jnp.asarray(grads))
+        want = table.copy()
+        want[ids] -= lr * grads
+        np.testing.assert_allclose(
+            np.asarray(got), want, rtol=1e-6, atol=1e-6
+        )
+
+    def test_sparse_adagrad_matches_reference(self, tpu):
+        import jax
+        import jax.numpy as jnp
+
+        from elasticdl_tpu.ops.pallas_embedding import (
+            sparse_adagrad_update,
+        )
+
+        table = self._table()
+        accum = np.abs(self._table(seed=5)) * 0.1
+        rng = np.random.RandomState(2)
+        ids = np.unique(rng.randint(0, 1024, 32)).astype(np.int32)
+        grads = rng.randn(len(ids), 128).astype(np.float32)
+        lr, eps = 0.1, 1e-8
+
+        got_t, got_a = jax.jit(
+            lambda t, a, i, g: sparse_adagrad_update(t, a, i, g, lr, eps)
+        )(jnp.asarray(table), jnp.asarray(accum), jnp.asarray(ids),
+          jnp.asarray(grads))
+        want_a = accum.copy()
+        want_a[ids] += grads * grads
+        want_t = table.copy()
+        want_t[ids] -= lr * grads / (np.sqrt(want_a[ids]) + eps)
+        np.testing.assert_allclose(np.asarray(got_a), want_a,
+                                   rtol=1e-6, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(got_t), want_t,
+                                   rtol=1e-5, atol=1e-6)
