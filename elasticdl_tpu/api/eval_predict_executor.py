@@ -97,15 +97,25 @@ class EvalPredictExecutor:
             )
 
     def _restore(self, batch):
+        # The optimizer tree must match the TRAINED one or the
+        # checkpoint won't load: training folds LearningRateScheduler
+        # callbacks into the optax chain (local_executor.py:162-165,
+        # worker.py:135-138), so the restore-side skeleton must too —
+        # eval/predict never applies updates, but the opt_state leaves
+        # live in the checkpoint.
+        from elasticdl_tpu.callbacks import apply_callbacks_to_optimizer
+
+        tx = apply_callbacks_to_optimizer(
+            self._spec.make_optimizer(),
+            self._spec.callbacks_fn() if self._spec.callbacks_fn else [],
+        )
         if self._step_runner is not None:
             self.state = self._step_runner.init_state(
-                self._spec.model, self._spec.make_optimizer(), batch
+                self._spec.model, tx, batch
             )
             self._eval_step = self._step_runner.eval_step()
         else:
-            self.state = init_train_state(
-                self._spec.model, self._spec.make_optimizer(), batch
-            )
+            self.state = init_train_state(self._spec.model, tx, batch)
         self.state = restore_from_dir(
             self.state, self._ckpt_dir,
             host_tables=getattr(self._step_runner, "host_tables", None),

@@ -154,8 +154,57 @@ def slot_init_value(opt: RowOptimizer, slot_name: str) -> float:
 # ---- device path: sparse scatter apply on an in-HBM table ----------------
 
 
+def _pallas_sparse_apply(opt: RowOptimizer, table, slot_tables,
+                         unique_ids, row_grads, step,
+                         interpret: bool = False):
+    """Kernel edition of sparse_apply for the supported optimizers
+    (ops/pallas_embedding in-place updates; same OOR pad contract)."""
+    from elasticdl_tpu.ops import pallas_embedding as pe
+
+    if isinstance(opt, Adam) and not opt.amsgrad:
+        new_table, m, v = pe.sparse_adam_update(
+            table, slot_tables["m"], slot_tables["v"], unique_ids,
+            row_grads, lr=opt.lr, beta1=opt.beta1, beta2=opt.beta2,
+            epsilon=opt.epsilon, step=step, interpret=interpret,
+        )
+        return new_table, {**slot_tables, "m": m, "v": v}
+    if isinstance(opt, Adagrad):
+        new_table, acc = pe.sparse_adagrad_update(
+            table, slot_tables["accumulator"], unique_ids, row_grads,
+            lr=opt.lr, epsilon=opt.epsilon, interpret=interpret,
+        )
+        return new_table, {**slot_tables, "accumulator": acc}
+    if not isinstance(opt, SGD) or isinstance(opt, Momentum):
+        # Loud, not a silent SGD downgrade: Momentum/amsgrad have no
+        # kernel — their slots would go stale and the math would drift.
+        raise ValueError(
+            f"no Pallas kernel for {type(opt).__name__}; "
+            "use use_pallas='never' (XLA path)"
+        )
+    new_table = pe.sparse_sgd_update(
+        table, unique_ids, row_grads, lr=opt.lr, interpret=interpret
+    )
+    return new_table, slot_tables
+
+
+def kernelizable(opt: RowOptimizer, dim: int) -> bool:
+    """Whether the Pallas in-place kernels cover (opt, dim): lane-aligned
+    rows and one of SGD / Adagrad / Adam-without-amsgrad (Momentum and
+    amsgrad stay on XLA)."""
+    from elasticdl_tpu.ops import pallas_embedding as pe
+
+    if not pe.dim_supported(dim):
+        return False
+    if isinstance(opt, Adam):
+        return not opt.amsgrad
+    return isinstance(opt, (SGD, Adagrad)) and not isinstance(
+        opt, Momentum
+    )
+
+
 def sparse_apply(opt: RowOptimizer, table, slot_tables: Dict[str, "jnp.ndarray"],
-                 unique_ids, row_grads, step):
+                 unique_ids, row_grads, step, use_pallas: str = "auto",
+                 interpret: bool = False):
     """Scatter-update only ``unique_ids`` rows of a full ``(V, D)`` table.
 
     ``unique_ids`` must be deduplicated with padding set to an
@@ -163,8 +212,29 @@ def sparse_apply(opt: RowOptimizer, table, slot_tables: Dict[str, "jnp.ndarray"]
     clamp (their grads are zero so values are irrelevant) and pad
     scatters are dropped — a pad id aliasing a real row would otherwise
     race its duplicate scatter and, for Adam/Adagrad, corrupt slot state
-    even with zero grad.
+    even with zero grad. The Pallas kernels skip OOR ids outright.
+
+    ``use_pallas``: "auto" routes supported (opt, dim) pairs through the
+    in-place Pallas kernels (one HBM read+write per touched row vs the
+    XLA gather/scatter's two of each); "never"/"always" pin a path.
     """
+    if use_pallas not in ("auto", "never", "always"):
+        raise ValueError(f"use_pallas={use_pallas!r}")
+    import jax
+
+    dim = int(table.shape[1])
+    # Auto only engages where the Mosaic kernels actually lower: the
+    # TPU backend (or the interpreter, which tests use on CPU).
+    kernel_ok = kernelizable(opt, dim) and (
+        interpret or jax.default_backend() == "tpu"
+    )
+    if use_pallas == "always" or (
+        use_pallas == "auto" and kernel_ok
+    ):
+        return _pallas_sparse_apply(
+            opt, table, slot_tables, unique_ids, row_grads, step,
+            interpret=interpret,
+        )
     rows = table.at[unique_ids].get(mode="clip")
     slots = {
         name: slot_tables[name].at[unique_ids].get(mode="clip")

@@ -52,6 +52,19 @@ class TransformerConfig:
     # for deep/long-context configs (HBM is the usual TPU bottleneck).
     remat: bool = False
     compute_dtype: jnp.dtype = jnp.bfloat16
+    # Fused head+loss mode: during TRAINING the model returns
+    # (hidden, lm_head kernel, bias) instead of materializing the
+    # (B, S, vocab) logits, and ops/losses.py
+    # fused_next_token_cross_entropy computes per-chunk logits inside a
+    # rematerialized scan. This is the MEMORY lever for configs whose
+    # logits don't fit (very large vocab / long sequence / big batch:
+    # full f32 logits are B*S*V*4 bytes — 1 GB at B8/S1024/V32k). It is
+    # NOT a throughput win at the bench flagship size: measured ~4%
+    # SLOWER there (paired duel, v5e) because the chunk scan serializes
+    # the head matmul; the bench keeps the materialized path. Eval/
+    # decode always return logits; the param tree is unchanged either
+    # way.
+    fused_head: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -273,8 +286,44 @@ class Block(nn.Module):
         return x + h
 
 
+class _LMHead(nn.Module):
+    """The output projection with an escape hatch: ``fused=True``
+    returns (hidden, kernel, bias) for the chunked fused loss instead
+    of computing logits. Param names/init match ``nn.Dense`` exactly
+    (lm_head/kernel, lm_head/bias, f32 params, lecun-normal) so
+    checkpoints and sharding rules are identical either way."""
+
+    vocab_size: int
+    dtype: jnp.dtype
+    fused: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        kernel = self.param(
+            "kernel", nn.initializers.lecun_normal(),
+            (x.shape[-1], self.vocab_size), jnp.float32,
+        )
+        bias = self.param(
+            "bias", nn.initializers.zeros_init(),
+            (self.vocab_size,), jnp.float32,
+        )
+        if self.fused:
+            return x, kernel.astype(self.dtype), bias
+        y = jax.lax.dot_general(
+            x.astype(self.dtype), kernel.astype(self.dtype),
+            (((x.ndim - 1,), (0,)), ((), ())),
+        )
+        return y + bias.astype(y.dtype)
+
+
 class TransformerLM(nn.Module):
-    """``features`` = int32 token ids (B, S); returns f32 logits (B,S,V)."""
+    """``features`` = int32 token ids (B, S).
+
+    Output: f32 logits (B, S, V) — EXCEPT when ``cfg.fused_head`` and
+    ``training=True`` (not decode), where it returns the fused-loss
+    triple ``(hidden bf16 (B,S,D), lm_head kernel, bias)`` for
+    ``ops.fused_next_token_cross_entropy``. Eval/decode always get
+    logits."""
 
     cfg: TransformerConfig
     mesh: Optional[Mesh] = None
@@ -326,10 +375,20 @@ class TransformerLM(nn.Module):
                 name=f"block_{i}",
             )(x, training)
         x = nn.LayerNorm(dtype=cfg.compute_dtype, name="ln_f")(x)
-        logits = nn.Dense(
-            cfg.vocab_size, dtype=cfg.compute_dtype, name="lm_head"
-        )(x)
-        return wsc(logits.astype(jnp.float32), "dp", "sp", "tp")
+        head = _LMHead(
+            cfg.vocab_size, cfg.compute_dtype,
+            fused=(cfg.fused_head and training and not self.decode),
+            name="lm_head",
+        )
+        out = head(x)
+        if isinstance(out, tuple):
+            hidden, kernel, bias = out
+            return (
+                wsc(hidden, "dp", "sp", None),
+                wsc(kernel, None, "tp"),
+                wsc(bias, "tp"),
+            )
+        return wsc(out.astype(jnp.float32), "dp", "sp", "tp")
 
 
 import functools as _functools

@@ -1,4 +1,4 @@
-"""Fused flash attention for TPU (Pallas forward, blockwise XLA backward).
+"""Fused flash attention for TPU (Pallas forward AND backward).
 
 The transformer flagship's single-chip hot path. ``dense_attention``
 (ops/ring_attention.py) materializes the (B, H, S, S) score matrix in
@@ -9,10 +9,12 @@ so scores only ever exist as (block_q, block_k) tiles on-chip, and the
 causal path skips fully-masked K blocks entirely (~2× fewer FLOPs).
 
 Backward is a custom VJP: the forward saves only o and the logsumexp
-L = m + log(l) (the flash-attention residual trick), and the backward
-recomputes probability tiles blockwise inside a ``lax.scan`` over K
-blocks — O(S·block_k) live memory, pure XLA so it fuses and stays
-differentiable-correct without a second hand-written kernel.
+L = m + log(l) (the flash-attention residual trick); the backward runs
+the same tiled Pallas kernels as the ring path (``flash_chunk_grads``:
+dq k-sequential, dk/dv q-sequential) with probability tiles recomputed
+from the residuals in VMEM. An earlier pure-XLA blockwise-scan backward
+measured ~3.2x the forward's device time on v5e (~22% of the whole
+transformer train step) and was replaced by these kernels.
 
 Numerics: QK^T and PV matmuls run in the input dtype on the MXU with
 float32 accumulation (``preferred_element_type``); softmax state is
@@ -159,49 +161,6 @@ def _flash_forward(q, k, v, causal: bool, scale: float, block_q: int,
     )(q, k, v)
 
 
-def _bwd_blockwise(q, k, v, o, lse, do, causal: bool, scale: float,
-                   block_k: int):
-    """Flash backward, blockwise over K inside a scan (O(S·block_k) mem).
-
-    dS = P ∘ (dO·Vᵀ − Δ), Δ = rowsum(dO ∘ O); P recomputed from the
-    saved logsumexp.
-    """
-    bh, s_len, d = q.shape
-    qf = q.astype(jnp.float32)
-    dof = do.astype(jnp.float32)
-    delta = (dof * o.astype(jnp.float32)).sum(axis=-1)      # (BH, S)
-    qpos = jnp.arange(s_len)
-
-    num_kb = s_len // block_k
-    k_blocks = k.astype(jnp.float32).reshape(bh, num_kb, block_k, d)
-    v_blocks = v.astype(jnp.float32).reshape(bh, num_kb, block_k, d)
-
-    def step(dq, inputs):
-        kb, k_blk, v_blk = inputs
-        s = jnp.einsum("bqd,bkd->bqk", qf, k_blk) * scale
-        if causal:
-            kpos = kb * block_k + jnp.arange(block_k)
-            mask = qpos[:, None] >= kpos[None, :]
-            s = jnp.where(mask[None], s, _NEG_INF)
-        p = jnp.exp(s - lse[..., None])                     # (BH, S, bk)
-        dp = jnp.einsum("bqd,bkd->bqk", dof, v_blk)
-        ds = p * (dp - delta[..., None])
-        dq = dq + jnp.einsum("bqk,bkd->bqd", ds, k_blk) * scale
-        dk_blk = jnp.einsum("bqk,bqd->bkd", ds, qf) * scale
-        dv_blk = jnp.einsum("bqk,bqd->bkd", p, dof)
-        return dq, (dk_blk, dv_blk)
-
-    dq0 = jnp.zeros((bh, s_len, d), jnp.float32)
-    dq, (dk, dv) = jax.lax.scan(
-        step, dq0,
-        (jnp.arange(num_kb),
-         jnp.moveaxis(k_blocks, 1, 0), jnp.moveaxis(v_blocks, 1, 0)),
-    )
-    dk = jnp.moveaxis(dk, 0, 1).reshape(bh, s_len, d)
-    dv = jnp.moveaxis(dv, 0, 1).reshape(bh, s_len, d)
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
-
-
 @functools.partial(
     jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7)
 )
@@ -218,8 +177,23 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
 
 
 def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
+    """Backward via the tiled Pallas kernels (flash_chunk_grads with the
+    whole sequence as one chunk). Profiled on v5e: the previous XLA
+    blockwise-scan backward was ~22% of transformer step device time at
+    ~3.2x the Pallas forward's cost per call; the kernels (shared with
+    the ring path, gradient-verified there) keep score tiles in VMEM
+    and run both passes on the MXU."""
     q, k, v, o, lse = res
-    return _bwd_blockwise(q, k, v, o, lse, g, causal, scale, block_k)
+    dof = g.astype(jnp.float32)
+    delta = (dof * o.astype(jnp.float32)).sum(
+        axis=-1, keepdims=True
+    )                                                   # (BH, S, 1)
+    dq, dk, dv = flash_chunk_grads(
+        q, k, v, g, lse[..., None], delta, 0, 0, causal=causal,
+        scale=scale, block_q=block_q, block_k=block_k,
+        interpret=interpret,
+    )
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)

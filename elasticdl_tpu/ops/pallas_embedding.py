@@ -9,19 +9,25 @@ combine (double-buffered) and never forms the intermediate.
 
 - ``lookup_combine``: fused gather + sum/mean/sqrtn combine over a padded
   ragged batch (embedding/combiner.py RaggedIds semantics).
-- ``sparse_sgd_update`` / ``sparse_adagrad_update``: in-place
-  (input_output_aliased) row updates on (V, D) tables given deduplicated
-  ids. Pad ids MUST point at row 0 with zero grads — zero-grad updates
-  are no-ops for SGD/Adagrad (Adam's decay is not, so Adam stays on the
-  XLA ``sparse_apply`` path).
+- ``sparse_sgd_update`` / ``sparse_adagrad_update`` /
+  ``sparse_adam_update``: in-place (input_output_aliased) row updates on
+  (V, D) tables given deduplicated ids. Padding contract matches
+  ``embedding/optimizer.unique_pad``: pad ids are OUT-OF-RANGE
+  (>= vocab) and their grid steps are skipped entirely (``pl.when``) —
+  no DMA, no update, which also makes Adam's decay-on-touch semantics
+  exact (a padded row is not "touched").
 
 Layout notes (Mosaic tiling): ids and weights ride scalar prefetch
 (SMEM) since they are read one element at a time; tables/grads/outputs
-stay in ``pl.ANY`` (HBM) and move row-by-row via explicit DMA, so no
-VMEM block ever violates the (8, 128) tile constraint and the embedding
-dim only needs lane alignment (D % 128 == 0; other dims fall back to the
-jnp path). Every entry point takes ``interpret=`` so CPU tests run the
-same kernels (tests/conftest.py forces the CPU backend).
+stay in ``pl.ANY`` (HBM) and move row-by-row via explicit DMA. Mosaic
+only accepts (1, 128)-shaped HBM row slices (wider rows hit "slice dim 0
+must be aligned to tiling (8)" — found by the on-chip lane, invisible to
+the interpreter), so every (V, D) table is viewed as (V·C, 128) with
+C = D/128 and each logical row moves as C lane-width chunk DMAs
+(pipelined; VMEM buffers are (..., C, 128) and outputs reshape back).
+D % 128 != 0 falls back to the jnp path. Every entry point takes
+``interpret=`` so CPU tests run the same kernels (tests/conftest.py
+forces the CPU backend).
 """
 
 import functools
@@ -49,26 +55,36 @@ _LOOKUP_PIPELINE = 16  # outstanding row DMAs (latency-bound otherwise)
 _LOOKUP_ROWS = 8       # output rows per grid step (sublane-aligned)
 
 
-def _lookup_kernel(num_ids, combiner_id, ids_ref, w_ref, table_ref,
-                   out_ref, row_buf, acc_buf, denom_buf, sems):
+def _lookup_kernel(num_ids, combiner_id, chunks, ids_ref, w_ref,
+                   table_ref, out_ref, row_buf, acc_buf, denom_buf,
+                   sems):
     """One grid step combines _LOOKUP_ROWS output rows; their
     ``_LOOKUP_ROWS × num_ids`` row fetches share one flat DMA ring of
     depth ``_LOOKUP_PIPELINE`` (amortizes grid overhead and keeps many
-    copies in flight — a 2-deep ring is DMA-latency-bound)."""
+    copies in flight — a 2-deep ring is DMA-latency-bound). Each row
+    moves as ``chunks`` (1, 128) DMAs (see module docstring)."""
     blk = pl.program_id(0)
     total = _LOOKUP_ROWS * num_ids
     depth = min(_LOOKUP_PIPELINE, total)
     base = blk * total
 
-    def row_dma(slot, k):
+    def row_dma(slot, k, c):
         return pltpu.make_async_copy(
-            table_ref.at[pl.ds(ids_ref[base + k], 1), :],
-            row_buf.at[slot],
-            sems.at[slot],
+            table_ref.at[pl.ds(ids_ref[base + k] * chunks + c, 1), :],
+            row_buf.at[slot, pl.ds(c, 1)],
+            sems.at[slot, c],
         )
 
+    def start_row(slot, k):
+        for c in range(chunks):
+            row_dma(slot, k, c).start()
+
+    def wait_row(slot, k):
+        for c in range(chunks):
+            row_dma(slot, k, c).wait()
+
     for k in range(depth):
-        row_dma(k, k).start()
+        start_row(k, k)
 
     acc_buf[...] = jnp.zeros_like(acc_buf)
     for r in range(_LOOKUP_ROWS):
@@ -77,9 +93,9 @@ def _lookup_kernel(num_ids, combiner_id, ids_ref, w_ref, table_ref,
     def body(k, _):
         slot = k % depth
         r = k // num_ids
-        row_dma(slot, k).wait()
+        wait_row(slot, k)
         w = w_ref[base + k]
-        acc_buf[r, :] = acc_buf[r, :] + w * row_buf[slot, 0, :]
+        acc_buf[r] = acc_buf[r] + w * row_buf[slot]
         denom_buf[r] = denom_buf[r] + jnp.where(
             combiner_id == 2, w * w, w
         )
@@ -88,28 +104,36 @@ def _lookup_kernel(num_ids, combiner_id, ids_ref, w_ref, table_ref,
         # depth-1 slots stay in flight.
         @pl.when(k + depth < total)
         def _():
-            row_dma(slot, k + depth).start()
+            start_row(slot, k + depth)
 
         return 0
 
     jax.lax.fori_loop(0, total, body, 0)
-    # SMEM scalars -> (rows, 1) vector for the broadcasted normalize.
-    denom = jnp.stack(
-        [denom_buf[r] for r in range(_LOOKUP_ROWS)]
-    ).reshape(_LOOKUP_ROWS, 1)
-    if combiner_id == 0:
-        denom = jnp.ones_like(denom)
-    elif combiner_id == 2:
-        denom = jnp.sqrt(denom)
-    safe = jnp.where(denom > 0, denom, 1.0)
-    acc_buf[...] = jnp.where(denom > 0, acc_buf[...] / safe, 0.0)
-    out = pltpu.make_async_copy(
-        acc_buf,
-        out_ref.at[pl.ds(blk * _LOOKUP_ROWS, _LOOKUP_ROWS), :],
-        sems.at[0],
-    )
-    out.start()
-    out.wait()
+    # Normalize per output row with 2D (chunks, LANE) vector ops and
+    # scalar broadcasts (Mosaic rejects the 3D stacked form), then
+    # store each row as chunk DMAs — the (1, 128) shape that compiles
+    # everywhere (module docstring).
+    for r in range(_LOOKUP_ROWS):
+        d = denom_buf[r]
+        if combiner_id == 0:
+            d = jnp.float32(1.0)
+        elif combiner_id == 2:
+            d = jnp.sqrt(d)
+        safe = jnp.where(d > 0, d, 1.0)
+        acc_buf[r] = jnp.where(d > 0, acc_buf[r] / safe, 0.0)
+    stores = [
+        pltpu.make_async_copy(
+            acc_buf.at[r, pl.ds(c, 1)],
+            out_ref.at[pl.ds((blk * _LOOKUP_ROWS + r) * chunks + c, 1),
+                       :],
+            # depth >= _LOOKUP_ROWS always (min(16, 8*num_ids)), so
+            # (r, c) indexes a distinct semaphore per in-flight store.
+            sems.at[r, c],
+        )
+        for r in range(_LOOKUP_ROWS)
+        for c in range(chunks)
+    ]
+    _run(stores)
 
 
 def lookup_combine_pallas(table, ids, weights, combiner: str,
@@ -117,6 +141,7 @@ def lookup_combine_pallas(table, ids, weights, combiner: str,
     """(V, D) table, (B, L) int32 ids, (B, L) f32 weights -> (B, D)."""
     batch, num_ids = ids.shape
     dim = table.shape[1]
+    chunks = dim // LANE
     # Pad the batch to a whole number of _LOOKUP_ROWS blocks with
     # weight-0 rows pointing at row 0 (combine to zeros, sliced off).
     padded = -(-batch // _LOOKUP_ROWS) * _LOOKUP_ROWS
@@ -129,7 +154,7 @@ def lookup_combine_pallas(table, ids, weights, combiner: str,
             [weights, jnp.zeros((pad, num_ids), weights.dtype)], axis=0
         )
     kernel = functools.partial(
-        _lookup_kernel, num_ids, _COMBINER_ID[combiner]
+        _lookup_kernel, num_ids, _COMBINER_ID[combiner], chunks
     )
     depth = min(_LOOKUP_PIPELINE, _LOOKUP_ROWS * num_ids)
     grid_spec = pltpu.PrefetchScalarGridSpec(
@@ -138,44 +163,79 @@ def lookup_combine_pallas(table, ids, weights, combiner: str,
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)],  # table in HBM
         out_specs=pl.BlockSpec(memory_space=pl.ANY),
         scratch_shapes=[
-            pltpu.VMEM((depth, 1, dim), jnp.float32),
-            pltpu.VMEM((_LOOKUP_ROWS, dim), jnp.float32),  # accumulators
-            pltpu.SMEM((_LOOKUP_ROWS,), jnp.float32),      # denominators
-            pltpu.SemaphoreType.DMA((depth,)),
+            pltpu.VMEM((depth, chunks, LANE), jnp.float32),
+            pltpu.VMEM((_LOOKUP_ROWS, chunks, LANE), jnp.float32),
+            pltpu.SMEM((_LOOKUP_ROWS,), jnp.float32),   # denominators
+            pltpu.SemaphoreType.DMA((depth, chunks)),
         ],
     )
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((padded, dim), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct(
+            (padded * chunks, LANE), jnp.float32
+        ),
         interpret=interpret,
     )(
         jnp.ravel(ids).astype(jnp.int32),
         jnp.ravel(weights).astype(jnp.float32),
-        table.astype(jnp.float32),
+        table.astype(jnp.float32).reshape(-1, LANE),
     )
-    return out[:batch]
+    return out.reshape(padded, dim)[:batch]
+
+
+# Auto-dispatch tier, measured on v5e over a 1M-row (>>VMEM) table
+# (tools/bench_embedding_sweep.py → EMBEDDING_SWEEP.json, two timing
+# harnesses agree): the kernel reads each touched row from HBM exactly
+# once while the XLA path materializes and re-reads the (B, L, D)
+# gather intermediate, so the kernel wins on WIDE rows — 1.44-3.12x at
+# D=256/512 with L<=64 — and loses where per-row DMA count dominates
+# (D=128 is a wash; L=128 at D=512 is 0.3x). Dispatch takes the kernel
+# for D >= 256 with L <= 64.
+PALLAS_MIN_DIM = 256
+PALLAS_MAX_IDS = 64
+
+
+def use_pallas_lookup(dim: int, num_ids: int) -> bool:
+    """The measured auto-dispatch rule (see PALLAS_MIN_DIM/MAX_IDS)."""
+    return (
+        dim_supported(dim)
+        and dim >= PALLAS_MIN_DIM
+        and num_ids <= PALLAS_MAX_IDS
+    )
 
 
 def lookup_combine(table, ids, weights, combiner: str,
-                   interpret: bool = False, force_pallas: bool = False):
-    """Public wrapper. Default is the XLA gather+combine — measured
-    faster on v5e for in-HBM tables (3.99 ms vs 5.22 ms at B=4096, L=10,
-    D=128: XLA's wide vectorized gather beats ~B·L sequential row DMAs).
-    ``force_pallas=True`` opts into the kernel (requires D % 128 == 0);
-    it is the building block for tiers where the gather intermediate
-    cannot be materialized."""
+                   interpret: bool = False, force_pallas: bool = False,
+                   force_xla: bool = False):
+    """Public wrapper with measured auto-dispatch: wide tables
+    (``use_pallas_lookup``) take the Pallas row-streaming kernel,
+    narrow ones XLA's gather+combine. ``force_pallas`` /``force_xla``
+    pin a path (bench/test overrides)."""
     if combiner not in COMBINERS:
         raise ValueError(f"combiner must be one of {COMBINERS}")
-    if force_pallas:
+    if force_pallas and force_xla:
+        raise ValueError("force_pallas and force_xla are exclusive")
+    # Auto engages only where Mosaic lowers (TPU backend or the
+    # interpreter); CPU/GPU hosts keep the XLA path by default.
+    backend_ok = interpret or jax.default_backend() == "tpu"
+    use_kernel = force_pallas or (
+        not force_xla
+        and backend_ok
+        and use_pallas_lookup(table.shape[1], ids.shape[1])
+    )
+    if use_kernel:
         if not dim_supported(table.shape[1]):
             raise ValueError(
                 f"Pallas lookup needs dim % {LANE} == 0, "
                 f"got {table.shape[1]}"
             )
-        return lookup_combine_pallas(
+        out = lookup_combine_pallas(
             table, ids, weights, combiner, interpret=interpret
         )
+        # The kernel accumulates/returns f32; match the XLA path's
+        # dtype contract (preserves the table dtype).
+        return out.astype(table.dtype)
     rows = jnp.take(table, ids, axis=0)
     return combine(rows, weights, combiner)
 
@@ -183,33 +243,64 @@ def lookup_combine(table, ids, weights, combiner: str,
 # ---- in-place sparse optimizer updates -----------------------------------
 
 
-def _sgd_kernel(lr, ids_ref, grads_ref, _table_in, table_ref,
-                row_buf, grad_buf, sems):
+def _row_chunk_dmas(hbm_ref, logical_row, buf, sems, chunks):
+    """C (1, 128) chunk copies HBM row -> VMEM (chunks, LANE) buffer
+    (or back: swap with ``reverse=True`` on the returned handles).
+    ``hbm_ref`` is the (V*C, 128) flat view; see module docstring."""
+    return [
+        pltpu.make_async_copy(
+            hbm_ref.at[pl.ds(logical_row * chunks + c, 1), :],
+            buf.at[pl.ds(c, 1)],
+            sems.at[c],
+        )
+        for c in range(chunks)
+    ]
+
+
+def _row_chunk_stores(hbm_ref, logical_row, buf, sems, chunks):
+    return [
+        pltpu.make_async_copy(
+            buf.at[pl.ds(c, 1)],
+            hbm_ref.at[pl.ds(logical_row * chunks + c, 1), :],
+            sems.at[c],
+        )
+        for c in range(chunks)
+    ]
+
+
+def _run(copies):
+    for c in copies:
+        c.start()
+    for c in copies:
+        c.wait()
+
+
+def _sgd_kernel(lr, vocab, chunks, ids_ref, grads_ref, _table_in,
+                table_ref, row_buf, grad_buf, sems):
     i = pl.program_id(0)
     row = ids_ref[i]
-    load_w = pltpu.make_async_copy(
-        table_ref.at[pl.ds(row, 1), :], row_buf, sems.at[0]
-    )
-    load_g = pltpu.make_async_copy(
-        grads_ref.at[pl.ds(i, 1), :], grad_buf, sems.at[1]
-    )
-    load_w.start()
-    load_g.start()
-    load_w.wait()
-    load_g.wait()
-    row_buf[0, :] = row_buf[0, :] - lr * grad_buf[0, :]
-    store = pltpu.make_async_copy(
-        row_buf, table_ref.at[pl.ds(row, 1), :], sems.at[0]
-    )
-    store.start()
-    store.wait()
+
+    # Out-of-range ids are padding (sparse_apply's unique_pad fills with
+    # the vocab size): skip entirely — no DMA, no update.
+    @pl.when(row < vocab)
+    def _():
+        _run(
+            _row_chunk_dmas(table_ref, row, row_buf, sems.at[0], chunks)
+            + _row_chunk_dmas(grads_ref, i, grad_buf, sems.at[1],
+                              chunks)
+        )
+        row_buf[...] = row_buf[...] - lr * grad_buf[...]
+        _run(_row_chunk_stores(table_ref, row, row_buf, sems.at[0],
+                               chunks))
 
 
 def sparse_sgd_update(table, unique_ids, row_grads, lr: float,
                       interpret: bool = False):
-    """In-place ``table[ids] -= lr * grads``. Pad ids with 0 + zero grads
-    (zero-grad SGD is a no-op)."""
+    """In-place ``table[ids] -= lr * grads``. Pad ids with any value
+    >= vocab (``unique_pad`` fill): out-of-range rows are skipped
+    entirely — no DMA, no update."""
     n, dim = row_grads.shape
+    chunks = dim // LANE
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(n,),
@@ -219,60 +310,62 @@ def sparse_sgd_update(table, unique_ids, row_grads, lr: float,
         ],
         out_specs=pl.BlockSpec(memory_space=pl.ANY),
         scratch_shapes=[
-            pltpu.VMEM((1, dim), jnp.float32),
-            pltpu.VMEM((1, dim), jnp.float32),
-            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.VMEM((chunks, LANE), jnp.float32),
+            pltpu.VMEM((chunks, LANE), jnp.float32),
+            pltpu.SemaphoreType.DMA((2, chunks)),
         ],
     )
-    return pl.pallas_call(
-        functools.partial(_sgd_kernel, lr),
+    out = pl.pallas_call(
+        functools.partial(_sgd_kernel, lr, table.shape[0], chunks),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct(table.shape, jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((table.shape[0] * chunks, LANE),
+                                       jnp.float32),
         # inputs (after scalar prefetch): 1=grads, 2=table -> out 0
         input_output_aliases={2: 0},
         interpret=interpret,
     )(
         unique_ids.astype(jnp.int32),
-        row_grads.astype(jnp.float32),
-        table.astype(jnp.float32),
+        row_grads.astype(jnp.float32).reshape(-1, LANE),
+        table.astype(jnp.float32).reshape(-1, LANE),
     )
+    return out.reshape(table.shape)
 
 
-def _adagrad_kernel(lr, eps, ids_ref, grads_ref, _table_in, _accum_in,
-                    table_ref, accum_ref, buf, sems):
+def _adagrad_kernel(lr, eps, vocab, chunks, ids_ref, grads_ref,
+                    _table_in, _accum_in, table_ref, accum_ref, buf,
+                    sems):
     i = pl.program_id(0)
     row = ids_ref[i]
 
-    def dma(src, dst, sem):
-        c = pltpu.make_async_copy(src, dst, sem)
-        c.start()
-        return c
-
-    loads = [
-        dma(table_ref.at[pl.ds(row, 1), :], buf.at[0], sems.at[0]),
-        dma(accum_ref.at[pl.ds(row, 1), :], buf.at[1], sems.at[1]),
-        dma(grads_ref.at[pl.ds(i, 1), :], buf.at[2], sems.at[2]),
-    ]
-    for c in loads:
-        c.wait()
-    g = buf[2, 0, :]
-    acc = buf[1, 0, :] + g * g
-    buf[1, 0, :] = acc
-    buf[0, 0, :] = buf[0, 0, :] - lr * g / (jnp.sqrt(acc) + eps)
-    stores = [
-        dma(buf.at[0], table_ref.at[pl.ds(row, 1), :], sems.at[0]),
-        dma(buf.at[1], accum_ref.at[pl.ds(row, 1), :], sems.at[1]),
-    ]
-    for c in stores:
-        c.wait()
+    @pl.when(row < vocab)  # out-of-range = padding: skip
+    def _():
+        _run(
+            _row_chunk_dmas(table_ref, row, buf.at[0], sems.at[0],
+                            chunks)
+            + _row_chunk_dmas(accum_ref, row, buf.at[1], sems.at[1],
+                              chunks)
+            + _row_chunk_dmas(grads_ref, i, buf.at[2], sems.at[2],
+                              chunks)
+        )
+        g = buf[2]
+        acc = buf[1] + g * g
+        buf[1] = acc
+        buf[0] = buf[0] - lr * g / (jnp.sqrt(acc) + eps)
+        _run(
+            _row_chunk_stores(table_ref, row, buf.at[0], sems.at[0],
+                              chunks)
+            + _row_chunk_stores(accum_ref, row, buf.at[1], sems.at[1],
+                                chunks)
+        )
 
 
 def sparse_adagrad_update(table, accum, unique_ids, row_grads, lr: float,
                           epsilon: float = 1e-8,
                           interpret: bool = False):
-    """In-place Adagrad on (table, accum). Same pad contract as SGD
-    (zero grad leaves both rows unchanged)."""
+    """In-place Adagrad on (table, accum). Same pad contract as SGD:
+    out-of-range ids are skipped (no DMA, no update)."""
     n, dim = row_grads.shape
+    chunks = dim // LANE
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(n,),
@@ -286,22 +379,126 @@ def sparse_adagrad_update(table, accum, unique_ids, row_grads, lr: float,
             pl.BlockSpec(memory_space=pl.ANY),
         ],
         scratch_shapes=[
-            pltpu.VMEM((3, 1, dim), jnp.float32),
-            pltpu.SemaphoreType.DMA((3,)),
+            pltpu.VMEM((3, chunks, LANE), jnp.float32),
+            pltpu.SemaphoreType.DMA((3, chunks)),
         ],
     )
-    return pl.pallas_call(
-        functools.partial(_adagrad_kernel, lr, epsilon),
+    flat = table.shape[0] * chunks
+    new_table, new_accum = pl.pallas_call(
+        functools.partial(_adagrad_kernel, lr, epsilon, table.shape[0],
+                          chunks),
         grid_spec=grid_spec,
         out_shape=[
-            jax.ShapeDtypeStruct(table.shape, jnp.float32),
-            jax.ShapeDtypeStruct(accum.shape, jnp.float32),
+            jax.ShapeDtypeStruct((flat, LANE), jnp.float32),
+            jax.ShapeDtypeStruct((flat, LANE), jnp.float32),
         ],
         input_output_aliases={2: 0, 3: 1},
         interpret=interpret,
     )(
         unique_ids.astype(jnp.int32),
-        row_grads.astype(jnp.float32),
-        table.astype(jnp.float32),
-        accum.astype(jnp.float32),
+        row_grads.astype(jnp.float32).reshape(-1, LANE),
+        table.astype(jnp.float32).reshape(-1, LANE),
+        accum.astype(jnp.float32).reshape(-1, LANE),
     )
+    return new_table.reshape(table.shape), new_accum.reshape(accum.shape)
+
+
+def _adam_kernel(lr, beta1, beta2, eps, vocab, chunks, bc_ref, ids_ref,
+                 grads_ref, _t, _m, _v, table_ref, m_ref, v_ref, buf,
+                 sems):
+    """Closes the gap with the reference's C++ Adam kernel
+    (kernel_api.cc:40-77: fused m/v decay + bias-corrected update per
+    row). ``bc_ref`` carries the traced bias corrections
+    [1-beta1^t, 1-beta2^t] via scalar prefetch."""
+    i = pl.program_id(0)
+    row = ids_ref[i]
+
+    @pl.when(row < vocab)  # out-of-range = padding: skip
+    def _():
+        _run(
+            _row_chunk_dmas(table_ref, row, buf.at[0], sems.at[0],
+                            chunks)
+            + _row_chunk_dmas(m_ref, row, buf.at[1], sems.at[1],
+                              chunks)
+            + _row_chunk_dmas(v_ref, row, buf.at[2], sems.at[2],
+                              chunks)
+            + _row_chunk_dmas(grads_ref, i, buf.at[3], sems.at[3],
+                              chunks)
+        )
+        g = buf[3]
+        m = beta1 * buf[1] + (1.0 - beta1) * g
+        v = beta2 * buf[2] + (1.0 - beta2) * g * g
+        buf[1] = m
+        buf[2] = v
+        m_hat = m / bc_ref[0]
+        v_hat = v / bc_ref[1]
+        buf[0] = buf[0] - lr * m_hat / (jnp.sqrt(v_hat) + eps)
+        _run(
+            _row_chunk_stores(table_ref, row, buf.at[0], sems.at[0],
+                              chunks)
+            + _row_chunk_stores(m_ref, row, buf.at[1], sems.at[1],
+                                chunks)
+            + _row_chunk_stores(v_ref, row, buf.at[2], sems.at[2],
+                                chunks)
+        )
+
+
+def sparse_adam_update(table, m, v, unique_ids, row_grads, lr: float,
+                       beta1: float = 0.9, beta2: float = 0.999,
+                       epsilon: float = 1e-8, step=1,
+                       interpret: bool = False):
+    """In-place Adam on (table, m, v); ``step`` is the 1-based apply
+    count for bias correction (may be traced). Same pad contract as
+    SGD/Adagrad: out-of-range ids are skipped. amsgrad is not kernelized
+    (use the XLA path)."""
+    n, dim = row_grads.shape
+    chunks = dim // LANE
+    step_f = jnp.asarray(step, jnp.float32)
+    bias_corr = jnp.stack([
+        1.0 - jnp.float32(beta1) ** step_f,
+        1.0 - jnp.float32(beta2) ** step_f,
+    ])
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,  # bias corrections, ids
+        grid=(n,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),  # grads
+            pl.BlockSpec(memory_space=pl.ANY),  # table (aliased)
+            pl.BlockSpec(memory_space=pl.ANY),  # m (aliased)
+            pl.BlockSpec(memory_space=pl.ANY),  # v (aliased)
+        ],
+        out_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((4, chunks, LANE), jnp.float32),
+            pltpu.SemaphoreType.DMA((4, chunks)),
+        ],
+    )
+    flat = table.shape[0] * chunks
+    new_t, new_m, new_v = pl.pallas_call(
+        functools.partial(
+            _adam_kernel, lr, beta1, beta2, epsilon, table.shape[0],
+            chunks,
+        ),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((flat, LANE), jnp.float32),
+            jax.ShapeDtypeStruct((flat, LANE), jnp.float32),
+            jax.ShapeDtypeStruct((flat, LANE), jnp.float32),
+        ],
+        # inputs after scalar prefetch: 2=grads, 3=table, 4=m, 5=v
+        input_output_aliases={3: 0, 4: 1, 5: 2},
+        interpret=interpret,
+    )(
+        bias_corr,
+        unique_ids.astype(jnp.int32),
+        row_grads.astype(jnp.float32).reshape(-1, LANE),
+        table.astype(jnp.float32).reshape(-1, LANE),
+        m.astype(jnp.float32).reshape(-1, LANE),
+        v.astype(jnp.float32).reshape(-1, LANE),
+    )
+    return (new_t.reshape(table.shape), new_m.reshape(m.shape),
+            new_v.reshape(v.shape))

@@ -65,9 +65,16 @@ def batch_sharding_rule(path, leaf):
 
 def loss(labels, predictions, mask):
     """Per-token next-token cross entropy; ``mask`` is the (B,) padded-row
-    mask from the batcher, broadcast over the token dim."""
-    from elasticdl_tpu.ops import masked_next_token_cross_entropy
+    mask from the batcher, broadcast over the token dim. Fused-head
+    models (config.fused_head) emit (hidden, kernel, bias) during
+    training and take the chunked no-logits-materialization path."""
+    from elasticdl_tpu.ops import (
+        fused_next_token_cross_entropy,
+        masked_next_token_cross_entropy,
+    )
 
+    if isinstance(predictions, tuple):
+        return fused_next_token_cross_entropy(labels, predictions, mask)
     return masked_next_token_cross_entropy(labels, predictions, mask)
 
 

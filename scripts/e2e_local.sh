@@ -53,6 +53,56 @@ run evaluate --model_zoo model_zoo \
   --distribution_strategy Local --job_name e2e-deepfm \
   --checkpoint_dir_for_init "$WORK/deepfm_ckpt"
 
+
+# --- raw-data path (VERDICT r1 #6): raw files -> converters -> train
+# -> predict, for census (adult.data-format CSV) and mnist (npz).
+python - "$WORK" <<'PY'
+import sys, os
+import numpy as np
+from elasticdl_tpu.testing.data import create_adult_csv
+w = sys.argv[1]
+# Raw census: adult.data format (15 cols, no header), learnable signal.
+create_adult_csv(os.path.join(w, "adult.data"), 256, seed=5)
+rng = np.random.RandomState(5)
+# Raw mnist: npz of label-correlated images on the REAL MNIST 0-255
+# scale (the zoo dataset_fn divides by 255; near-zero-scale pixels
+# starve BatchNorm and diverge).
+n = 192
+labels = rng.randint(0, 10, n).astype(np.int64)
+x = (rng.rand(n, 28, 28) * 32.0).astype(np.float32)
+block = (28 * 28) // 10
+flat = x.reshape(n, -1)
+for i, l in enumerate(labels):
+    flat[i, l * block:(l + 1) * block] += 192.0
+np.savez(os.path.join(w, "mnist_raw.npz"), x_train=x, y_train=labels)
+PY
+
+python tools/record_gen/census_gen.py "$WORK/adult.data" "$WORK/census_rec" \
+  --val_fraction 0.25
+python tools/record_gen/numpy_to_records.py "$WORK/mnist_raw.npz" \
+  "$WORK/mnist_from_raw.rec"
+
+run train --model_zoo model_zoo \
+  --model_def census.census_wide_deep.custom_model \
+  --training_data "$WORK/census_rec/census_train.rec" --minibatch_size 16 \
+  --num_epochs 2 --distribution_strategy Local --job_name e2e-census-raw \
+  --checkpoint_dir "$WORK/census_ckpt" --checkpoint_steps 4
+run predict --model_zoo model_zoo \
+  --model_def census.census_wide_deep.custom_model \
+  --prediction_data "$WORK/census_rec/census_val.rec" --minibatch_size 16 \
+  --distribution_strategy Local --job_name e2e-census-raw \
+  --checkpoint_dir_for_init "$WORK/census_ckpt"
+run train --model_zoo model_zoo \
+  --model_def mnist.mnist_functional.custom_model \
+  --training_data "$WORK/mnist_from_raw.rec" --minibatch_size 16 \
+  --num_epochs 1 --distribution_strategy Local --job_name e2e-mnist-raw \
+  --checkpoint_dir "$WORK/mnist_raw_ckpt" --checkpoint_steps 4
+run predict --model_zoo model_zoo \
+  --model_def mnist.mnist_functional.custom_model \
+  --prediction_data "$WORK/mnist_from_raw.rec" --minibatch_size 16 \
+  --distribution_strategy Local --job_name e2e-mnist-raw \
+  --checkpoint_dir_for_init "$WORK/mnist_raw_ckpt"
+
 test -f "$WORK/mnist_bundle/metadata.json"
 test -f "$WORK/deepfm_bundle/predict.stablehlo"
 echo "E2E OK ($WORK)"

@@ -322,3 +322,34 @@ def test_cli_output_flag_exports_bundle(tmp_path):
 
     assert os.path.exists(os.path.join(out, "params.msgpack"))
     assert os.path.exists(os.path.join(out, "metadata.json"))
+
+
+def test_predict_from_checkpoint_with_lr_scheduler_callback(tmp_path):
+    """Regression (caught by the raw-data e2e): a model whose callbacks
+    wrap the optimizer (LearningRateScheduler -> optax chain) saves a
+    chained opt_state; the eval/predict executor must rebuild the SAME
+    optimizer tree or restore fails on the extra schedule leaves."""
+    from elasticdl_tpu.testing.data import create_census_record_file
+
+    train = create_census_record_file(str(tmp_path / "c.rec"), 64)
+    census = "census.census_wide_deep.custom_model"
+    rc = cli_main([
+        "train",
+        "--model_zoo", model_zoo_dir(),
+        "--model_def", census,
+        "--training_data", train,
+        "--minibatch_size", "16",
+        "--num_epochs", "1",
+        "--job_name", "cb-restore",
+        "--checkpoint_dir", str(tmp_path / "ckpt"),
+    ])
+    assert rc == 0
+    rc = cli_main([
+        "predict",
+        "--model_zoo", model_zoo_dir(),
+        "--model_def", census,
+        "--prediction_data", train,
+        "--checkpoint_dir_for_init", str(tmp_path / "ckpt"),
+        "--minibatch_size", "16",
+    ])
+    assert rc == 0

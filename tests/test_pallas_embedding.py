@@ -109,3 +109,122 @@ def test_lookup_odd_batch_pad_path():
     np.testing.assert_allclose(
         np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5
     )
+
+
+def test_sparse_adam_update_matches_row_optimizer():
+    from elasticdl_tpu.embedding.optimizer import Adam
+    from elasticdl_tpu.ops.pallas_embedding import sparse_adam_update
+
+    rng = np.random.RandomState(4)
+    table = rng.randn(V, D).astype(np.float32)
+    m = rng.randn(V, D).astype(np.float32) * 0.01
+    v = np.abs(rng.randn(V, D)).astype(np.float32) * 0.01
+    ids = np.array([5, 11, V, V], np.int32)  # 2 real + 2 OOR pads
+    grads = rng.randn(4, D).astype(np.float32)
+    opt = Adam(lr=0.01)
+
+    for step in (1, 7):
+        new_t, new_m, new_v = sparse_adam_update(
+            jnp.asarray(table), jnp.asarray(m), jnp.asarray(v),
+            jnp.asarray(ids), jnp.asarray(grads), lr=0.01, step=step,
+            interpret=True,
+        )
+        real = ids[:2]
+        want_rows, want_slots = opt.apply_rows(
+            table[real], grads[:2], {"m": m[real], "v": v[real]},
+            step=step,
+        )
+        np.testing.assert_allclose(
+            np.asarray(new_t)[real], want_rows, rtol=1e-5, atol=1e-6
+        )
+        np.testing.assert_allclose(
+            np.asarray(new_m)[real], want_slots["m"], rtol=1e-5,
+            atol=1e-6,
+        )
+        np.testing.assert_allclose(
+            np.asarray(new_v)[real], want_slots["v"], rtol=1e-5,
+            atol=1e-6,
+        )
+        # Pads: NO rows touched (incl. slot decay) — the OOR skip, not
+        # the zero-grad trick, which would still decay Adam's m/v.
+        mask = np.ones(V, bool)
+        mask[real] = False
+        np.testing.assert_array_equal(np.asarray(new_t)[mask],
+                                      table[mask])
+        np.testing.assert_array_equal(np.asarray(new_m)[mask], m[mask])
+        np.testing.assert_array_equal(np.asarray(new_v)[mask], v[mask])
+
+
+def test_sgd_adagrad_skip_out_of_range_pads():
+    rng = np.random.RandomState(5)
+    table = rng.randn(V, D).astype(np.float32)
+    accum = np.full((V, D), 0.1, np.float32)
+    ids = np.array([2, V], np.int32)   # one real, one OOR pad
+    grads = rng.randn(2, D).astype(np.float32)
+
+    got = sparse_sgd_update(
+        jnp.asarray(table), jnp.asarray(ids), jnp.asarray(grads), 0.1,
+        interpret=True,
+    )
+    want = table.copy()
+    want[2] -= 0.1 * grads[0]
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5,
+                               atol=1e-6)
+
+    new_t, new_a = sparse_adagrad_update(
+        jnp.asarray(table), jnp.asarray(accum), jnp.asarray(ids),
+        jnp.asarray(grads), lr=0.1, interpret=True,
+    )
+    mask = np.ones(V, bool)
+    mask[2] = False
+    np.testing.assert_array_equal(np.asarray(new_t)[mask], table[mask])
+    np.testing.assert_array_equal(np.asarray(new_a)[mask], accum[mask])
+
+
+def test_lookup_auto_dispatch_by_dim(monkeypatch):
+    """Auto-dispatch: wide tables take the kernel, narrow ones XLA;
+    force flags pin either path."""
+    import elasticdl_tpu.ops.pallas_embedding as pe
+
+    calls = {"pallas": 0}
+    real = pe.lookup_combine_pallas
+
+    def spy(*a, **kw):
+        calls["pallas"] += 1
+        return real(*a, interpret=True)
+
+    monkeypatch.setattr(pe, "lookup_combine_pallas",
+                        lambda t, i, w, c, interpret=False: spy(t, i, w, c))
+    # Auto-dispatch is additionally gated on the TPU backend (Mosaic
+    # kernels don't lower on CPU); simulate it.
+    monkeypatch.setattr(pe.jax, "default_backend", lambda: "tpu")
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, 16, (4, 3)), jnp.int32)
+    w = jnp.ones((4, 3), jnp.float32)
+
+    wide = jnp.asarray(rng.randn(16, pe.PALLAS_MIN_DIM), jnp.float32)
+    out = pe.lookup_combine(wide, ids, w, "sum")
+    assert calls["pallas"] == 1
+    np.testing.assert_allclose(
+        np.asarray(out),
+        np.asarray(pe.lookup_combine(wide, ids, w, "sum",
+                                     force_xla=True)),
+        rtol=1e-5, atol=1e-5,
+    )
+
+    narrow = jnp.asarray(rng.randn(16, 128), jnp.float32)
+    pe.lookup_combine(narrow, ids, w, "sum")
+    assert calls["pallas"] == 1  # unchanged: XLA path taken
+
+    # Long id lists route to XLA even on wide tables (measured tier).
+    long_ids = jnp.zeros((4, pe.PALLAS_MAX_IDS + 1), jnp.int32)
+    long_w = jnp.ones((4, pe.PALLAS_MAX_IDS + 1), jnp.float32)
+    pe.lookup_combine(wide, long_ids, long_w, "sum")
+    assert calls["pallas"] == 1
+
+    pe.lookup_combine(narrow, ids, w, "sum", force_pallas=True)
+    assert calls["pallas"] == 2
+
+    with pytest.raises(ValueError):
+        pe.lookup_combine(narrow, ids, w, "sum",
+                          force_pallas=True, force_xla=True)

@@ -223,3 +223,64 @@ class TestHostWrapper:
             wrapper.apply_gradients(
                 table, [1, 1], np.ones((2, 2), np.float32)
             )
+
+
+class TestSparseApplyKernelDispatch:
+    """sparse_apply auto-routes supported (opt, dim) pairs through the
+    in-place Pallas kernels and matches the XLA gather/scatter path."""
+
+    def _fixture(self, dim=128, vocab=64, n=6, seed=0):
+        rng = np.random.RandomState(seed)
+        table = jnp.asarray(rng.randn(vocab, dim).astype(np.float32))
+        ids = np.unique(rng.randint(0, vocab, n)).astype(np.int32)
+        padded = np.concatenate([ids, [vocab]]).astype(np.int32)
+        grads = jnp.asarray(
+            rng.randn(len(padded), dim).astype(np.float32)
+        )
+        return table, jnp.asarray(padded), grads, vocab, dim
+
+    @pytest.mark.parametrize("opt_name", ["SGD", "Adagrad", "Adam"])
+    def test_kernel_path_matches_xla(self, opt_name):
+        from elasticdl_tpu.embedding.optimizer import (
+            init_slot_tables,
+            make_row_optimizer,
+            sparse_apply,
+        )
+
+        opt = make_row_optimizer(opt_name, lr=0.05)
+        table, ids, grads, vocab, dim = self._fixture()
+        slots = init_slot_tables(opt, vocab, dim)
+
+        t_kernel, s_kernel = sparse_apply(
+            opt, table, dict(slots), ids, grads, step=3,
+            use_pallas="always", interpret=True,
+        )
+        t_xla, s_xla = sparse_apply(
+            opt, table, dict(slots), ids, grads, step=3,
+            use_pallas="never",
+        )
+        np.testing.assert_allclose(np.asarray(t_kernel),
+                                   np.asarray(t_xla),
+                                   rtol=1e-5, atol=1e-6)
+        for name in opt.slot_names:
+            np.testing.assert_allclose(
+                np.asarray(s_kernel[name]), np.asarray(s_xla[name]),
+                rtol=1e-5, atol=1e-6, err_msg=f"slot {name}",
+            )
+
+    def test_auto_respects_coverage(self):
+        from elasticdl_tpu.embedding.optimizer import (
+            AdamAmsgrad,
+            Adagrad,
+            Momentum,
+            SGD,
+            kernelizable,
+        )
+
+        assert kernelizable(SGD(), 128)
+        assert kernelizable(Adagrad(), 256)
+        assert not kernelizable(SGD(), 100)        # lane-misaligned
+        assert not kernelizable(Momentum(), 128)   # not kernelized
+        assert not kernelizable(
+            AdamAmsgrad(slot_names=("m", "v", "max_v")), 128
+        )

@@ -1,0 +1,116 @@
+"""Pallas-vs-XLA embedding lookup crossover sweep (VERDICT r1 #4).
+
+Measures ``lookup_combine`` both ways across (vocab, D, L, B) tiers on
+the real chip and records the crossover that drives auto-dispatch
+(ops/pallas_embedding.py ``lookup_combine``). Rationale: the XLA path
+materializes the (B, L, D) gather intermediate in HBM and re-reads it
+for the combine (~2x row traffic + intermediate); the Pallas kernel
+streams each row through VMEM once — but pays per-row DMA latency, so
+it needs wide rows (D) to amortize.
+
+Usage: python tools/bench_embedding_sweep.py [--quick]
+Writes EMBEDDING_SWEEP.json at the repo root.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), ".."))
+
+REPO = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+
+
+def measure(fn, arg_sets, iters=24):
+    """Time a STREAM of calls over varying inputs with one final sync:
+    per-call block_until_ready through the device tunnel measured
+    impossibly low (identical-input calls report >HBM-bandwidth rates);
+    a pipelined stream with distinct ids per call keeps the device queue
+    honest and divides out dispatch overhead."""
+    import jax
+
+    jax.block_until_ready(fn(*arg_sets[0]))
+    reps = 2
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = None
+        for i in range(iters):
+            out = fn(*arg_sets[i % len(arg_sets)])
+        jax.block_until_ready(out)
+        times.append((time.perf_counter() - t0) / iters)
+    return float(min(times))
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from elasticdl_tpu.ops.pallas_embedding import lookup_combine
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--quick", action="store_true")
+    args = parser.parse_args()
+
+    vocab = 1_000_000          # table >> VMEM at every D
+    tiers = [
+        # (D, L, B)
+        (128, 10, 4096),
+        (128, 64, 1024),
+        (256, 10, 4096),
+        (256, 64, 1024),
+        (512, 10, 4096),
+        (512, 64, 1024),
+        (512, 128, 512),
+        (768, 32, 1024),
+    ]
+    if args.quick:
+        tiers = tiers[:2]
+
+    rng = np.random.RandomState(0)
+    results = []
+    for dim, L, B in tiers:
+        table = jnp.asarray(
+            rng.rand(vocab, dim).astype(np.float32) * 0.1
+        )
+        weights = jnp.ones((B, L), jnp.float32)
+        arg_sets = [
+            (table,
+             jnp.asarray(rng.randint(0, vocab, (B, L)), jnp.int32),
+             weights)
+            for _ in range(6)
+        ]
+
+        xla = jax.jit(lambda t, i, w: lookup_combine(
+            t, i, w, "mean", force_xla=True))
+        pal = jax.jit(lambda t, i, w: lookup_combine(
+            t, i, w, "mean", force_pallas=True))
+        t_xla = measure(xla, arg_sets)
+        t_pal = measure(pal, arg_sets)
+        rec = {
+            "dim": dim, "L": L, "batch": B, "vocab": vocab,
+            "xla_ms": round(t_xla * 1e3, 3),
+            "pallas_ms": round(t_pal * 1e3, 3),
+            "pallas_speedup": round(t_xla / t_pal, 3),
+        }
+        results.append(rec)
+        print(json.dumps(rec), flush=True)
+        del table
+
+    out = os.path.join(REPO, "EMBEDDING_SWEEP.json")
+    with open(out, "w") as f:
+        json.dump({
+            "platform": jax.devices()[0].platform,
+            "device_kind": getattr(jax.devices()[0], "device_kind", ""),
+            "tiers": results,
+        }, f, indent=1)
+    print(f"wrote {out}")
+
+
+if __name__ == "__main__":
+    main()

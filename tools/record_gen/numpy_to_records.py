@@ -27,16 +27,35 @@ from elasticdl_tpu.data.record_file import RecordFileWriter  # noqa: E402
 
 
 def convert(features: np.ndarray, labels: np.ndarray, out_path: str,
-            key: str = "image") -> int:
+            key: str = "image", records_per_shard: int = 0,
+            fraction: float = 1.0) -> int:
+    """``records_per_shard > 0`` writes numbered shard files
+    ``out_path-%05d`` (reference image_label.py convert: data-%05d
+    shards); ``fraction`` keeps the leading subset like its
+    ``--fraction`` flag."""
     assert len(features) == len(labels), (
         f"{len(features)} features vs {len(labels)} labels"
     )
-    with RecordFileWriter(out_path) as writer:
-        for x, y in zip(features, labels):
-            writer.write(tensor_utils.dumps(
-                {key: np.asarray(x), "label": int(y)}
-            ))
-    return len(features)
+    total = int(len(features) * fraction)
+    if not records_per_shard:
+        with RecordFileWriter(out_path) as writer:
+            for x, y in zip(features[:total], labels[:total]):
+                writer.write(tensor_utils.dumps(
+                    {key: np.asarray(x), "label": int(y)}
+                ))
+        return total
+    written = 0
+    shard = 0
+    while written < total:
+        hi = min(written + records_per_shard, total)
+        with RecordFileWriter(f"{out_path}-{shard:05d}") as writer:
+            for x, y in zip(features[written:hi], labels[written:hi]):
+                writer.write(tensor_utils.dumps(
+                    {key: np.asarray(x), "label": int(y)}
+                ))
+        written = hi
+        shard += 1
+    return total
 
 
 def main():
@@ -47,6 +66,10 @@ def main():
     parser.add_argument("--key", default="image")
     parser.add_argument("--features_key", default="x_train")
     parser.add_argument("--labels_key", default="y_train")
+    parser.add_argument("--records_per_shard", type=int, default=0,
+                        help="split output into out_path-%%05d shards")
+    parser.add_argument("--fraction", type=float, default=1.0,
+                        help="keep only the leading fraction of rows")
     args = parser.parse_args()
     if len(args.inputs) == 1 and args.inputs[0].endswith(".npz"):
         data = np.load(args.inputs[0])
@@ -56,7 +79,9 @@ def main():
         labels = np.load(args.inputs[1])
     else:
         parser.error("pass features.npy labels.npy, or one .npz")
-    n = convert(features, labels, args.out_path, key=args.key)
+    n = convert(features, labels, args.out_path, key=args.key,
+                records_per_shard=args.records_per_shard,
+                fraction=args.fraction)
     print(f"wrote {n} records to {args.out_path}")
 
 
