@@ -37,22 +37,26 @@ FLOOR_FILE = os.path.join(HERE, "BENCH_SUITE_FLOOR.json")
 OUT_FILE = os.path.join(HERE, "BENCH_SUITE.json")
 
 # name -> (zoo model_def, batch, steps_per_task, measure_tasks)
-# resnet50 runs ImageNet-shaped inputs, so smaller batch / fewer steps.
+# 32 fused steps/task for the fast-step configs: per-program dispatch
+# through the device tunnel costs ~10ms, which dominates sub-ms steps
+# (cifar10 measured 95k ex/s at 16 steps vs 118k at 32, same model) —
+# production amortizes the same way via num_minibatches_per_task +
+# fuse_task_steps. resnet50's ~40ms steps only need 8.
 CONFIGS = {
-    "mnist": ("mnist.mnist_functional.custom_model", 512, 16, 4),
-    "cifar10": ("cifar10.cifar10_functional.custom_model", 256, 16, 4),
-    "resnet50": ("resnet50.resnet50.custom_model", 64, 4, 2),
-    "deepfm": ("deepfm.deepfm_functional.custom_model", 512, 16, 4),
-    "census": ("census.census_wide_deep.custom_model", 512, 16, 4),
+    "mnist": ("mnist.mnist_functional.custom_model", 512, 32, 2),
+    "cifar10": ("cifar10.cifar10_functional.custom_model", 256, 32, 2),
+    "resnet50": ("resnet50.resnet50.custom_model", 64, 8, 1),
+    "deepfm": ("deepfm.deepfm_functional.custom_model", 512, 32, 2),
+    "census": ("census.census_wide_deep.custom_model", 512, 32, 2),
     # Flagship LM (net-new vs the reference): GPT-style blocks at a
     # realistic small-LM size; seq 1024 engages the Pallas flash
     # attention kernels (fwd + bwd). Reported in tokens/sec
-    # (= examples x seq). 16 steps/task: the fused-task program
-    # amortizes host->device dispatch, measured +17% over 4-step tasks
-    # through the device tunnel (per-dispatch overhead is real in
-    # production too — the reference tunes the same knob as
-    # num_minibatches_per_task).
-    "transformer": ("transformer.transformer_lm.custom_model", 8, 16, 2),
+    # (= examples x seq). 32 steps/task: the fused-task program
+    # amortizes host->device dispatch, measured +17% at 16 steps / +26%
+    # at 32 over 4-step tasks through the device tunnel (per-dispatch
+    # overhead is real in production too — the reference tunes the same
+    # knob as num_minibatches_per_task).
+    "transformer": ("transformer.transformer_lm.custom_model", 8, 32, 2),
 }
 TRANSFORMER_SEQ = 1024
 TRANSFORMER_VOCAB = 32768
@@ -165,9 +169,16 @@ def main():
         floor = entry.get("rate", entry.get("examples_per_sec"))
         vs = eps / floor if floor else 1.0
         if not floor and platform != "cpu":
+            # Floor = 0.9x the first clean run: the device tunnel swings
+            # dispatch-bound configs by up to ~20% run to run
+            # (BASELINE.md "Floor re-baseline"); the band absorbs
+            # weather, a real >10% regression still fails loudly.
             floors[name] = {
-                "rate": eps, "unit": unit, "platform": platform,
-                "batch": CONFIGS[name][1],
+                "rate": round(eps * 0.9, 2), "unit": unit,
+                "platform": platform, "batch": CONFIGS[name][1],
+                "rebaselined_from_rate": round(eps, 2),
+                "procedure": "0.9 x first clean-run rate "
+                             "(tunnel noise band; see BASELINE.md)",
             }
         results[name] = {
             "rate": round(eps, 2), "vs_floor": round(vs, 4),
