@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: test test-all test-tpu native bench dryrun clean lint
+.PHONY: test test-all test-tpu test-k8s native bench dryrun clean lint
 
 # Fast lane (<4 min): everything not marked slow. conftest.py
 # auto-marks the heavy zoo/multi-process/bench suites.
@@ -20,6 +20,11 @@ test-all:
 # conftest CPU mesh.
 test-tpu:
 	ELASTICDL_TPU_TESTS=1 $(PY) -m pytest tests/ -q -m tpu
+
+# Live-cluster lane (reference K8S_TESTS minikube gating): skipped
+# unless ELASTICDL_K8S_TESTS=1 and a cluster is reachable.
+test-k8s:
+	ELASTICDL_K8S_TESTS=1 $(PY) -m pytest tests/test_k8s_live.py -q -m k8s
 
 # Force-rebuild the native components (row store + record reader).
 native:

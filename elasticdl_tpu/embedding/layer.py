@@ -51,6 +51,24 @@ class Embedding(nn.Module):
     output_dim: int
     combiner: Optional[str] = None
     param_dtype: jnp.dtype = jnp.float32
+    # Pallas row-streaming lookup for the ragged path: None = auto
+    # (kernel on the measured winning tier — ops/pallas_embedding
+    # use_pallas_lookup — but only on single-device runs: under a
+    # sharded mesh the kernel would force GSPMD to materialize the
+    # full table per shard, so mesh models keep the XLA gather that
+    # GSPMD partitions natively). True/False pin a path.
+    pallas: Optional[bool] = None
+
+    def _use_pallas(self, table, ids):
+        from elasticdl_tpu.ops.pallas_embedding import use_pallas_lookup
+
+        if self.pallas is not None:
+            return self.pallas
+        return (
+            jax.default_backend() == "tpu"
+            and jax.device_count() == 1
+            and use_pallas_lookup(table.shape[1], ids.shape[1])
+        )
 
     @nn.compact
     def __call__(self, ids):
@@ -65,6 +83,18 @@ class Embedding(nn.Module):
                 raise ValueError(
                     "RaggedIds input requires a combiner "
                     "(reference embedding.py:111-133)"
+                )
+            if self._use_pallas(table, ids.ids):
+                from elasticdl_tpu.ops.pallas_embedding import (
+                    lookup_combine,
+                )
+
+                return lookup_combine(
+                    table, ids.ids, ids.weights, self.combiner,
+                    force_pallas=True,
+                    # An explicit pallas=True pin on a non-TPU backend
+                    # (CPU tests) runs the interpreter.
+                    interpret=jax.default_backend() != "tpu",
                 )
             rows = jnp.take(table, ids.ids, axis=0)
             return combine(rows, ids.weights, self.combiner)

@@ -205,6 +205,37 @@ def use_pallas_lookup(dim: int, num_ids: int) -> bool:
     )
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _lookup_combine_diff(table, ids, weights, combiner, interpret):
+    """Differentiable kernel path: Pallas forward, reference-math
+    backward (jax.vjp of the XLA gather+combine — mathematically the
+    same function, so gradients are exact; the scatter-add backward is
+    XLA's native strength anyway)."""
+    return lookup_combine_pallas(
+        table, ids, weights, combiner, interpret=interpret
+    )
+
+
+def _lookup_combine_diff_fwd(table, ids, weights, combiner, interpret):
+    out = _lookup_combine_diff(table, ids, weights, combiner, interpret)
+    return out, (table, ids, weights)
+
+
+def _lookup_combine_diff_bwd(combiner, interpret, res, g):
+    table, ids, weights = res
+    _, vjp = jax.vjp(
+        lambda t, w: combine(jnp.take(t, ids, axis=0), w, combiner),
+        table, weights,
+    )
+    d_table, d_weights = vjp(g.astype(jnp.float32))
+    return d_table.astype(table.dtype), None, d_weights
+
+
+_lookup_combine_diff.defvjp(
+    _lookup_combine_diff_fwd, _lookup_combine_diff_bwd
+)
+
+
 def lookup_combine(table, ids, weights, combiner: str,
                    interpret: bool = False, force_pallas: bool = False,
                    force_xla: bool = False):
@@ -230,12 +261,13 @@ def lookup_combine(table, ids, weights, combiner: str,
                 f"Pallas lookup needs dim % {LANE} == 0, "
                 f"got {table.shape[1]}"
             )
-        out = lookup_combine_pallas(
-            table, ids, weights, combiner, interpret=interpret
+        # The kernel accumulates and returns f32 — the same dtype the
+        # XLA path produces for any table dtype (combine promotes
+        # bf16 rows with the f32 weights), so dispatch never changes
+        # the output dtype.
+        return _lookup_combine_diff(
+            table, ids, weights, combiner, interpret
         )
-        # The kernel accumulates/returns f32; match the XLA path's
-        # dtype contract (preserves the table dtype).
-        return out.astype(table.dtype)
     rows = jnp.take(table, ids, axis=0)
     return combine(rows, weights, combiner)
 

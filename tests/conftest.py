@@ -39,6 +39,10 @@ def pytest_configure(config):
         "markers", "tpu: requires the real TPU chip (compiled, "
         "non-interpret kernel correctness lane; run via make test-tpu)"
     )
+    config.addinivalue_line(
+        "markers", "k8s: live-cluster integration lane, gated on "
+        "ELASTICDL_K8S_TESTS=1 + a reachable cluster (make test-k8s)"
+    )
 
 
 # Test tiering (VERDICT round 1 #10): `make test` runs the fast lane
