@@ -59,20 +59,32 @@ CONFIGS = {
     # overhead is real in production too — the reference tunes the same
     # knob as num_minibatches_per_task).
     "transformer": ("transformer.transformer_lm.custom_model", 8, 32, 2),
+    # Large-LM edition (d1024/H16/L12/ff4096): bigger matmuls stretch
+    # the MXU where the d512 flagship is dispatch/HBM-shaped — the
+    # config that shows the framework's MFU headroom at sizes closer to
+    # real LM training. Fewer steps/task: each step is ~6x the d512
+    # cost, so dispatch amortization needs less fusing.
+    "transformer_l": ("transformer.transformer_lm.custom_model", 8, 8, 2),
 }
 TRANSFORMER_SEQ = 1024
 TRANSFORMER_VOCAB = 32768
 
+_TRANSFORMER_SIZES = {
+    "transformer": dict(d_model=512, n_heads=8, n_layers=8, d_ff=2048),
+    "transformer_l": dict(d_model=1024, n_heads=16, n_layers=12,
+                          d_ff=4096),
+}
 
-def _transformer_spec(spec):
+
+def _transformer_spec(spec, name="transformer"):
     from elasticdl_tpu.models.transformer import TransformerConfig
 
-    # remat=False: activations at this size are far under HBM, and
+    # remat=False: activations at these sizes are under HBM, and
     # rematerialization costs ~10% measured; remat is the lever for
-    # deep/long-context configs, not this one.
+    # deep/long-context configs, not these.
     cfg = TransformerConfig(
-        vocab_size=TRANSFORMER_VOCAB, d_model=512, n_heads=8, n_layers=8,
-        d_ff=2048, max_len=TRANSFORMER_SEQ, remat=False,
+        vocab_size=TRANSFORMER_VOCAB, max_len=TRANSFORMER_SEQ,
+        remat=False, **_TRANSFORMER_SIZES[name],
     )
     spec.model = spec.module.custom_model(config=cfg)
     # Keep the spec coherent for canonical make_model() callers too.
@@ -98,7 +110,7 @@ def _make_batch(name, batch, rng):
         features = rng.randint(
             0, m.MAX_ID, (batch, m.INPUT_LENGTH)
         ).astype(np.int32)
-    elif name == "transformer":
+    elif name.startswith("transformer"):
         start = rng.randint(0, TRANSFORMER_VOCAB, (batch, 1))
         seq = (
             start + np.arange(TRANSFORMER_SEQ + 1)[None, :]
@@ -134,8 +146,8 @@ def run_config(name):
 
     model_def, batch, steps, measure_tasks = CONFIGS[name]
     spec = get_model_spec(model_zoo_dir(), model_def)
-    if name == "transformer":
-        spec = _transformer_spec(spec)
+    if name.startswith("transformer"):
+        spec = _transformer_spec(spec, name)
     rng = np.random.RandomState(0)
     task = jax.device_put(
         stack_batches([_make_batch(name, batch, rng) for _ in range(steps)])
@@ -161,10 +173,10 @@ def main():
     results = {}
     for name in names:
         eps, mfu, tflops = run_config(name)
-        if name == "transformer":
+        if name.startswith("transformer"):
             eps *= TRANSFORMER_SEQ  # examples/sec -> tokens/sec
         unit = (
-            "tokens/sec/chip" if name == "transformer"
+            "tokens/sec/chip" if name.startswith("transformer")
             else "examples/sec/chip"
         )
         entry = floors.get(name) or {}
@@ -178,7 +190,7 @@ def main():
             # ±12% with tunnel weather (BASELINE.md re-baseline notes);
             # a dip vanishes on retry, a real regression persists.
             eps2, mfu2, tflops2 = run_config(name)
-            if name == "transformer":
+            if name.startswith("transformer"):
                 eps2 *= TRANSFORMER_SEQ
             if eps2 > eps:
                 eps, mfu, tflops = eps2, mfu2, tflops2

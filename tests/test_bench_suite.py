@@ -29,7 +29,7 @@ def tiny_configs(monkeypatch):
     monkeypatch.setattr(bench_suite, "CONFIGS", tiny)
     monkeypatch.setattr(bench_suite, "TRANSFORMER_SEQ", 16)
 
-    def tiny_transformer(spec):
+    def tiny_transformer(spec, name="transformer"):
         from elasticdl_tpu.models.transformer import TransformerConfig
 
         cfg = TransformerConfig(
