@@ -174,9 +174,16 @@ def _pallas_sparse_apply(opt: RowOptimizer, table, slot_tables,
             lr=opt.lr, epsilon=opt.epsilon, interpret=interpret,
         )
         return new_table, {**slot_tables, "accumulator": acc}
-    if not isinstance(opt, SGD) or isinstance(opt, Momentum):
-        # Loud, not a silent SGD downgrade: Momentum/amsgrad have no
-        # kernel — their slots would go stale and the math would drift.
+    if isinstance(opt, Momentum):
+        new_table, vel = pe.sparse_momentum_update(
+            table, slot_tables["momentum"], unique_ids, row_grads,
+            lr=opt.lr, momentum=opt.momentum, nesterov=opt.nesterov,
+            interpret=interpret,
+        )
+        return new_table, {**slot_tables, "momentum": vel}
+    if not isinstance(opt, SGD):
+        # Loud, not a silent SGD downgrade: an unkernelized optimizer's
+        # slots would go stale and the math would drift.
         raise ValueError(
             f"no Pallas kernel for {type(opt).__name__}; "
             "use use_pallas='never' (XLA path)"
@@ -189,17 +196,16 @@ def _pallas_sparse_apply(opt: RowOptimizer, table, slot_tables,
 
 def kernelizable(opt: RowOptimizer, dim: int) -> bool:
     """Whether the Pallas in-place kernels cover (opt, dim): lane-aligned
-    rows and one of SGD / Adagrad / Adam-without-amsgrad (Momentum and
-    amsgrad stay on XLA)."""
+    rows and one of SGD / Momentum(+Nesterov) / Adagrad /
+    Adam-without-amsgrad — the reference's full C++ kernel family
+    (kernel_api.cc); only amsgrad stays on XLA."""
     from elasticdl_tpu.ops import pallas_embedding as pe
 
     if not pe.dim_supported(dim):
         return False
     if isinstance(opt, Adam):
         return not opt.amsgrad
-    return isinstance(opt, (SGD, Adagrad)) and not isinstance(
-        opt, Momentum
-    )
+    return isinstance(opt, (SGD, Momentum, Adagrad))
 
 
 def sparse_apply(opt: RowOptimizer, table, slot_tables: Dict[str, "jnp.ndarray"],

@@ -9,8 +9,9 @@ combine (double-buffered) and never forms the intermediate.
 
 - ``lookup_combine``: fused gather + sum/mean/sqrtn combine over a padded
   ragged batch (embedding/combiner.py RaggedIds semantics).
-- ``sparse_sgd_update`` / ``sparse_adagrad_update`` /
-  ``sparse_adam_update``: in-place (input_output_aliased) row updates on
+- ``sparse_sgd_update`` / ``sparse_momentum_update`` /
+  ``sparse_adagrad_update`` / ``sparse_adam_update``: in-place
+  (input_output_aliased) row updates on
   (V, D) tables given deduplicated ids. Padding contract matches
   ``embedding/optimizer.unique_pad``: pad ids are OUT-OF-RANGE
   (>= vocab) and their grid steps are skipped entirely (``pl.when``) —
@@ -308,7 +309,7 @@ def _run(copies):
 
 
 def _sgd_kernel(lr, vocab, chunks, ids_ref, grads_ref, _table_in,
-                table_ref, row_buf, grad_buf, sems):
+                table_ref, buf, sems):
     i = pl.program_id(0)
     row = ids_ref[i]
 
@@ -317,13 +318,60 @@ def _sgd_kernel(lr, vocab, chunks, ids_ref, grads_ref, _table_in,
     @pl.when(row < vocab)
     def _():
         _run(
-            _row_chunk_dmas(table_ref, row, row_buf, sems.at[0], chunks)
-            + _row_chunk_dmas(grads_ref, i, grad_buf, sems.at[1],
+            _row_chunk_dmas(table_ref, row, buf.at[0], sems.at[0],
+                            chunks)
+            + _row_chunk_dmas(grads_ref, i, buf.at[1], sems.at[1],
                               chunks)
         )
-        row_buf[...] = row_buf[...] - lr * grad_buf[...]
-        _run(_row_chunk_stores(table_ref, row, row_buf, sems.at[0],
+        buf[0] = buf[0] - lr * buf[1]
+        _run(_row_chunk_stores(table_ref, row, buf.at[0], sems.at[0],
                                chunks))
+
+
+
+def _inplace_row_update(kernel, unique_ids, row_grads, tables,
+                        scalars=None, interpret=False):
+    """Shared pallas_call plumbing for the in-place row-update kernels.
+
+    ``tables``: the (V, D) arrays updated in place (aliased outputs, in
+    kernel order). ``scalars``: optional extra scalar-prefetch array
+    (Adam's bias corrections). One definition of the grid/scratch/alias
+    layout so the four optimizer wrappers cannot drift."""
+    n, dim = row_grads.shape
+    chunks = dim // LANE
+    n_t = len(tables)
+    num_prefetch = 1 + (scalars is not None)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=num_prefetch,
+        grid=(n,),
+        # inputs after prefetch: grads, then each aliased table.
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * (1 + n_t),
+        out_specs=(
+            [pl.BlockSpec(memory_space=pl.ANY)] * n_t
+            if n_t > 1 else pl.BlockSpec(memory_space=pl.ANY)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((n_t + 1, chunks, LANE), jnp.float32),
+            pltpu.SemaphoreType.DMA((n_t + 1, chunks)),
+        ],
+    )
+    flat = tables[0].shape[0] * chunks
+    shapes = [jax.ShapeDtypeStruct((flat, LANE), jnp.float32)] * n_t
+    args = ([scalars] if scalars is not None else []) + [
+        unique_ids.astype(jnp.int32),
+        row_grads.astype(jnp.float32).reshape(-1, LANE),
+    ] + [t.astype(jnp.float32).reshape(-1, LANE) for t in tables]
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=shapes if n_t > 1 else shapes[0],
+        input_output_aliases={
+            num_prefetch + 1 + i: i for i in range(n_t)
+        },
+        interpret=interpret,
+    )(*args)
+    outs = out if n_t > 1 else [out]
+    return tuple(o.reshape(t.shape) for o, t in zip(outs, tables))
 
 
 def sparse_sgd_update(table, unique_ids, row_grads, lr: float,
@@ -331,37 +379,12 @@ def sparse_sgd_update(table, unique_ids, row_grads, lr: float,
     """In-place ``table[ids] -= lr * grads``. Pad ids with any value
     >= vocab (``unique_pad`` fill): out-of-range rows are skipped
     entirely — no DMA, no update."""
-    n, dim = row_grads.shape
-    chunks = dim // LANE
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,
-        grid=(n,),
-        in_specs=[
-            pl.BlockSpec(memory_space=pl.ANY),  # grads in HBM
-            pl.BlockSpec(memory_space=pl.ANY),  # table in HBM (aliased)
-        ],
-        out_specs=pl.BlockSpec(memory_space=pl.ANY),
-        scratch_shapes=[
-            pltpu.VMEM((chunks, LANE), jnp.float32),
-            pltpu.VMEM((chunks, LANE), jnp.float32),
-            pltpu.SemaphoreType.DMA((2, chunks)),
-        ],
-    )
-    out = pl.pallas_call(
+    chunks = row_grads.shape[1] // LANE
+    (new_table,) = _inplace_row_update(
         functools.partial(_sgd_kernel, lr, table.shape[0], chunks),
-        grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((table.shape[0] * chunks, LANE),
-                                       jnp.float32),
-        # inputs (after scalar prefetch): 1=grads, 2=table -> out 0
-        input_output_aliases={2: 0},
-        interpret=interpret,
-    )(
-        unique_ids.astype(jnp.int32),
-        row_grads.astype(jnp.float32).reshape(-1, LANE),
-        table.astype(jnp.float32).reshape(-1, LANE),
+        unique_ids, row_grads, [table], interpret=interpret,
     )
-    return out.reshape(table.shape)
-
+    return new_table
 
 def _adagrad_kernel(lr, eps, vocab, chunks, ids_ref, grads_ref,
                     _table_in, _accum_in, table_ref, accum_ref, buf,
@@ -396,43 +419,12 @@ def sparse_adagrad_update(table, accum, unique_ids, row_grads, lr: float,
                           interpret: bool = False):
     """In-place Adagrad on (table, accum). Same pad contract as SGD:
     out-of-range ids are skipped (no DMA, no update)."""
-    n, dim = row_grads.shape
-    chunks = dim // LANE
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,
-        grid=(n,),
-        in_specs=[
-            pl.BlockSpec(memory_space=pl.ANY),  # grads
-            pl.BlockSpec(memory_space=pl.ANY),  # table (aliased)
-            pl.BlockSpec(memory_space=pl.ANY),  # accum (aliased)
-        ],
-        out_specs=[
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((3, chunks, LANE), jnp.float32),
-            pltpu.SemaphoreType.DMA((3, chunks)),
-        ],
-    )
-    flat = table.shape[0] * chunks
-    new_table, new_accum = pl.pallas_call(
+    chunks = row_grads.shape[1] // LANE
+    return _inplace_row_update(
         functools.partial(_adagrad_kernel, lr, epsilon, table.shape[0],
                           chunks),
-        grid_spec=grid_spec,
-        out_shape=[
-            jax.ShapeDtypeStruct((flat, LANE), jnp.float32),
-            jax.ShapeDtypeStruct((flat, LANE), jnp.float32),
-        ],
-        input_output_aliases={2: 0, 3: 1},
-        interpret=interpret,
-    )(
-        unique_ids.astype(jnp.int32),
-        row_grads.astype(jnp.float32).reshape(-1, LANE),
-        table.astype(jnp.float32).reshape(-1, LANE),
-        accum.astype(jnp.float32).reshape(-1, LANE),
+        unique_ids, row_grads, [table, accum], interpret=interpret,
     )
-    return new_table.reshape(table.shape), new_accum.reshape(accum.shape)
 
 
 def _adam_kernel(lr, beta1, beta2, eps, vocab, chunks, bc_ref, ids_ref,
@@ -483,54 +475,62 @@ def sparse_adam_update(table, m, v, unique_ids, row_grads, lr: float,
     count for bias correction (may be traced). Same pad contract as
     SGD/Adagrad: out-of-range ids are skipped. amsgrad is not kernelized
     (use the XLA path)."""
-    n, dim = row_grads.shape
-    chunks = dim // LANE
+    chunks = row_grads.shape[1] // LANE
     step_f = jnp.asarray(step, jnp.float32)
     bias_corr = jnp.stack([
         1.0 - jnp.float32(beta1) ** step_f,
         1.0 - jnp.float32(beta2) ** step_f,
     ])
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,  # bias corrections, ids
-        grid=(n,),
-        in_specs=[
-            pl.BlockSpec(memory_space=pl.ANY),  # grads
-            pl.BlockSpec(memory_space=pl.ANY),  # table (aliased)
-            pl.BlockSpec(memory_space=pl.ANY),  # m (aliased)
-            pl.BlockSpec(memory_space=pl.ANY),  # v (aliased)
-        ],
-        out_specs=[
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((4, chunks, LANE), jnp.float32),
-            pltpu.SemaphoreType.DMA((4, chunks)),
-        ],
-    )
-    flat = table.shape[0] * chunks
-    new_t, new_m, new_v = pl.pallas_call(
-        functools.partial(
-            _adam_kernel, lr, beta1, beta2, epsilon, table.shape[0],
-            chunks,
-        ),
-        grid_spec=grid_spec,
-        out_shape=[
-            jax.ShapeDtypeStruct((flat, LANE), jnp.float32),
-            jax.ShapeDtypeStruct((flat, LANE), jnp.float32),
-            jax.ShapeDtypeStruct((flat, LANE), jnp.float32),
-        ],
-        # inputs after scalar prefetch: 2=grads, 3=table, 4=m, 5=v
-        input_output_aliases={3: 0, 4: 1, 5: 2},
+    return _inplace_row_update(
+        functools.partial(_adam_kernel, lr, beta1, beta2, epsilon,
+                          table.shape[0], chunks),
+        unique_ids, row_grads, [table, m, v], scalars=bias_corr,
         interpret=interpret,
-    )(
-        bias_corr,
-        unique_ids.astype(jnp.int32),
-        row_grads.astype(jnp.float32).reshape(-1, LANE),
-        table.astype(jnp.float32).reshape(-1, LANE),
-        m.astype(jnp.float32).reshape(-1, LANE),
-        v.astype(jnp.float32).reshape(-1, LANE),
     )
-    return (new_t.reshape(table.shape), new_m.reshape(m.shape),
-            new_v.reshape(v.shape))
+
+
+def _momentum_kernel(lr, momentum, nesterov, vocab, chunks, ids_ref,
+                     grads_ref, _t, _v, table_ref, vel_ref, buf, sems):
+    """Momentum (+Nesterov) row update — completes parity with the
+    reference's C++ kernel family (kernel_api.cc:16-38)."""
+    i = pl.program_id(0)
+    row = ids_ref[i]
+
+    @pl.when(row < vocab)  # out-of-range = padding: skip
+    def _():
+        _run(
+            _row_chunk_dmas(table_ref, row, buf.at[0], sems.at[0],
+                            chunks)
+            + _row_chunk_dmas(vel_ref, row, buf.at[1], sems.at[1],
+                              chunks)
+            + _row_chunk_dmas(grads_ref, i, buf.at[2], sems.at[2],
+                              chunks)
+        )
+        g = buf[2]
+        vel = momentum * buf[1] + g
+        buf[1] = vel
+        if nesterov:
+            update = momentum * vel + g
+        else:
+            update = vel
+        buf[0] = buf[0] - lr * update
+        _run(
+            _row_chunk_stores(table_ref, row, buf.at[0], sems.at[0],
+                              chunks)
+            + _row_chunk_stores(vel_ref, row, buf.at[1], sems.at[1],
+                                chunks)
+        )
+
+
+def sparse_momentum_update(table, velocity, unique_ids, row_grads,
+                           lr: float, momentum: float = 0.9,
+                           nesterov: bool = False,
+                           interpret: bool = False):
+    """In-place momentum SGD on (table, velocity). Same pad contract as
+    the other update kernels: out-of-range ids are skipped."""
+    chunks = row_grads.shape[1] // LANE
+    return _inplace_row_update(
+        functools.partial(_momentum_kernel, lr, momentum, nesterov,
+                          table.shape[0], chunks),
+        unique_ids, row_grads, [table, velocity], interpret=interpret,
+    )

@@ -228,3 +228,35 @@ def test_lookup_auto_dispatch_by_dim(monkeypatch):
     with pytest.raises(ValueError):
         pe.lookup_combine(narrow, ids, w, "sum",
                           force_pallas=True, force_xla=True)
+
+
+@pytest.mark.parametrize("nesterov", [False, True])
+def test_sparse_momentum_update_matches_row_optimizer(nesterov):
+    from elasticdl_tpu.embedding.optimizer import Momentum
+    from elasticdl_tpu.ops.pallas_embedding import sparse_momentum_update
+
+    rng = np.random.RandomState(6)
+    table = rng.randn(V, D).astype(np.float32)
+    vel = rng.randn(V, D).astype(np.float32) * 0.1
+    ids = np.array([4, 9, V], np.int32)  # one OOR pad
+    grads = rng.randn(3, D).astype(np.float32)
+    opt = Momentum(lr=0.05, momentum=0.9, nesterov=nesterov)
+
+    new_t, new_v = sparse_momentum_update(
+        jnp.asarray(table), jnp.asarray(vel), jnp.asarray(ids),
+        jnp.asarray(grads), lr=0.05, momentum=0.9, nesterov=nesterov,
+        interpret=True,
+    )
+    real = ids[:2]
+    want_rows, want_slots = opt.apply_rows(
+        table[real], grads[:2], {"momentum": vel[real]}, step=1
+    )
+    np.testing.assert_allclose(np.asarray(new_t)[real], want_rows,
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(new_v)[real],
+                               want_slots["momentum"],
+                               rtol=1e-5, atol=1e-6)
+    mask = np.ones(V, bool)
+    mask[real] = False
+    np.testing.assert_array_equal(np.asarray(new_t)[mask], table[mask])
+    np.testing.assert_array_equal(np.asarray(new_v)[mask], vel[mask])

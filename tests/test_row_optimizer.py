@@ -239,7 +239,7 @@ class TestSparseApplyKernelDispatch:
         )
         return table, jnp.asarray(padded), grads, vocab, dim
 
-    @pytest.mark.parametrize("opt_name", ["SGD", "Adagrad", "Adam"])
+    @pytest.mark.parametrize("opt_name", ["SGD", "Momentum", "Adagrad", "Adam"])
     def test_kernel_path_matches_xla(self, opt_name):
         from elasticdl_tpu.embedding.optimizer import (
             init_slot_tables,
@@ -279,8 +279,9 @@ class TestSparseApplyKernelDispatch:
 
         assert kernelizable(SGD(), 128)
         assert kernelizable(Adagrad(), 256)
+        assert kernelizable(Momentum(), 128)
+        assert kernelizable(Momentum(nesterov=True), 256)
         assert not kernelizable(SGD(), 100)        # lane-misaligned
-        assert not kernelizable(Momentum(), 128)   # not kernelized
         assert not kernelizable(
             AdamAmsgrad(slot_names=("m", "v", "max_v")), 128
-        )
+        )  # amsgrad is the one XLA-only variant

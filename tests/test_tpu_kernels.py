@@ -293,3 +293,34 @@ class TestEmbeddingKernelsOnChip:
         mask[ids] = False
         np.testing.assert_array_equal(np.asarray(got_t)[mask],
                                       table[mask])
+
+    def test_sparse_momentum_matches_reference(self, tpu):
+        import jax
+        import jax.numpy as jnp
+
+        from elasticdl_tpu.embedding.optimizer import Momentum
+        from elasticdl_tpu.ops.pallas_embedding import (
+            sparse_momentum_update,
+        )
+
+        table = self._table()
+        vel = self._table(seed=11) * 0.1
+        rng = np.random.RandomState(12)
+        ids = np.unique(rng.randint(0, 1024, 24)).astype(np.int32)
+        grads = rng.randn(len(ids), 128).astype(np.float32)
+        opt = Momentum(lr=0.05, momentum=0.9, nesterov=True)
+
+        got_t, got_v = jax.jit(
+            lambda t, v, i, g: sparse_momentum_update(
+                t, v, i, g, 0.05, momentum=0.9, nesterov=True
+            )
+        )(jnp.asarray(table), jnp.asarray(vel), jnp.asarray(ids),
+          jnp.asarray(grads))
+        want_rows, want_slots = opt.apply_rows(
+            table[ids], grads, {"momentum": vel[ids]}, step=1
+        )
+        np.testing.assert_allclose(np.asarray(got_t)[ids], want_rows,
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(got_v)[ids],
+                                   want_slots["momentum"],
+                                   rtol=1e-5, atol=1e-6)
