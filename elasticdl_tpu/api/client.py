@@ -98,7 +98,10 @@ def _submit_job(args, mode: str) -> int:
     if getattr(args, "wait", False):
         from elasticdl_tpu.platform.job_monitor import JobMonitor
 
-        ok = JobMonitor(client, args.job_name).wait()
+        ok = JobMonitor(
+            client, args.job_name,
+            unknown_ok=getattr(args, "wait_unknown_ok", False),
+        ).wait()
         return 0 if ok else 1
     return 0
 

@@ -138,6 +138,10 @@ def add_common_params(parser):
                    "After submitting to k8s, poll the job to completion "
                    "(exit 0 on master Succeeded) — reference "
                    "k8s_job_monitor semantics")
+    add_bool_param(parser, "--wait_unknown_ok", False,
+                   "With --wait: treat a master pod that vanishes while "
+                   "Running as completed (clusters that GC finished pods "
+                   "between polls); default treats it as not-success")
 
 
 def add_train_params(parser):

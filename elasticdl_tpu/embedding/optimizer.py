@@ -228,7 +228,23 @@ def sparse_apply(opt: RowOptimizer, table, slot_tables: Dict[str, "jnp.ndarray"]
         raise ValueError(f"use_pallas={use_pallas!r}")
     import jax
 
+    from elasticdl_tpu.ops import pallas_embedding as pe
+
     dim = int(table.shape[1])
+    # "always" must fail with a clear message up front, not deep inside
+    # pallas_call with an opaque input_output_aliases shape error
+    # (mirrors lookup_combine's force_pallas validation).
+    if use_pallas == "always":
+        if not pe.dim_supported(dim):
+            raise ValueError(
+                f"use_pallas='always' needs dim % {pe.LANE} == 0, "
+                f"got dim={dim}"
+            )
+        if not kernelizable(opt, dim):
+            raise ValueError(
+                f"use_pallas='always': no Pallas kernel for "
+                f"{type(opt).__name__} (kernelizable() is False)"
+            )
     # Auto only engages where the Mosaic kernels actually lower: the
     # TPU backend (or the interpreter, which tests use on CPU).
     kernel_ok = kernelizable(opt, dim) and (

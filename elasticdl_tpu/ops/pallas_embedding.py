@@ -249,11 +249,16 @@ def lookup_combine(table, ids, weights, combiner: str,
     if force_pallas and force_xla:
         raise ValueError("force_pallas and force_xla are exclusive")
     # Auto engages only where Mosaic lowers (TPU backend or the
-    # interpreter); CPU/GPU hosts keep the XLA path by default.
+    # interpreter); CPU/GPU hosts keep the XLA path by default. The
+    # single-device guard lives HERE, not just in the Embedding layer:
+    # under a sharded mesh the kernel would force GSPMD to materialize
+    # the full table per shard, so auto never takes it there (use
+    # shard_map + force_pallas for an explicit per-shard kernel).
     backend_ok = interpret or jax.default_backend() == "tpu"
     use_kernel = force_pallas or (
         not force_xla
         and backend_ok
+        and jax.device_count() == 1
         and use_pallas_lookup(table.shape[1], ids.shape[1])
     )
     if use_kernel:

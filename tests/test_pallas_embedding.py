@@ -196,8 +196,11 @@ def test_lookup_auto_dispatch_by_dim(monkeypatch):
     monkeypatch.setattr(pe, "lookup_combine_pallas",
                         lambda t, i, w, c, interpret=False: spy(t, i, w, c))
     # Auto-dispatch is additionally gated on the TPU backend (Mosaic
-    # kernels don't lower on CPU); simulate it.
+    # kernels don't lower on CPU) AND a single device (under a sharded
+    # mesh the kernel would force per-shard full-table materialization);
+    # simulate both — the test env runs 8 virtual CPU devices.
     monkeypatch.setattr(pe.jax, "default_backend", lambda: "tpu")
+    monkeypatch.setattr(pe.jax, "device_count", lambda: 1)
     rng = np.random.RandomState(0)
     ids = jnp.asarray(rng.randint(0, 16, (4, 3)), jnp.int32)
     w = jnp.ones((4, 3), jnp.float32)
@@ -228,6 +231,13 @@ def test_lookup_auto_dispatch_by_dim(monkeypatch):
     with pytest.raises(ValueError):
         pe.lookup_combine(narrow, ids, w, "sum",
                           force_pallas=True, force_xla=True)
+
+    # ADVICE round 2: the single-device guard lives at op level — a
+    # direct lookup_combine caller on a multi-device process must not
+    # silently take the kernel (per-shard full-table materialization).
+    monkeypatch.setattr(pe.jax, "device_count", lambda: 8)
+    pe.lookup_combine(wide, ids, w, "sum")
+    assert calls["pallas"] == 2  # unchanged: XLA path taken
 
 
 @pytest.mark.parametrize("nesterov", [False, True])

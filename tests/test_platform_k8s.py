@@ -617,7 +617,11 @@ class TestJobMonitor:
 
 
 class TestJobMonitorGone:
-    """ADVICE round 1: seen-then-gone must not read as failure.
+    """ADVICE round 1 + round 2: seen-then-gone is neither failure nor
+    success — it is a distinct UNKNOWN outcome (pod GC after a fast
+    completion, or an eviction/external kill; the monitor can't tell).
+    ``wait()`` maps UNKNOWN to False by default (--wait must not exit 0
+    for a possibly-killed job) and to True under ``unknown_ok=True``.
 
     Plain class (NOT a TestJobMonitor subclass — inheriting would
     re-collect every base test); helpers referenced directly.
@@ -634,14 +638,26 @@ class TestJobMonitorGone:
                 return None
             return TestJobMonitor._Pod(name, phase, rtype="master")
 
-    def test_job_monitor_seen_then_gone_is_success(self):
-        from elasticdl_tpu.platform.job_monitor import JobMonitor
+    def test_job_monitor_running_then_gone_is_unknown(self):
+        from elasticdl_tpu.platform.job_monitor import (
+            OUTCOME_UNKNOWN, JobMonitor,
+        )
 
-        # Master observed Running, then GC-deleted before the next poll
-        # ever sees Succeeded: report completed, not failed.
+        # Master observed Running, then gone for good, Succeeded never
+        # seen: could be pod GC after completion OR an eviction — the
+        # outcome is unknown and wait() must not report success.
         client = self._GoneClient(["Running"])
         mon = JobMonitor(client, "j", poll_secs=0.01)
-        assert mon.wait(not_found_retries=2) is True
+        assert mon.wait_outcome(not_found_retries=2) == OUTCOME_UNKNOWN
+        client = self._GoneClient(["Running"])
+        assert JobMonitor(client, "j", poll_secs=0.01).wait(
+            not_found_retries=2
+        ) is False
+        # Fast-GC clusters can opt back into the round-1 behavior.
+        client = self._GoneClient(["Running"])
+        assert JobMonitor(
+            client, "j", poll_secs=0.01, unknown_ok=True
+        ).wait(not_found_retries=2) is True
 
     def test_job_monitor_never_seen_is_failure(self):
         from elasticdl_tpu.platform.job_monitor import JobMonitor
@@ -650,10 +666,29 @@ class TestJobMonitorGone:
         mon = JobMonitor(client, "j", poll_secs=0.01)
         assert mon.wait(not_found_retries=2) is False
 
-    def test_pod_monitor_seen_then_gone_is_success(self):
-        from elasticdl_tpu.platform.job_monitor import PodMonitor
+    def test_pod_monitor_running_then_gone_is_unknown(self):
+        from elasticdl_tpu.platform.job_monitor import (
+            OUTCOME_UNKNOWN, PodMonitor,
+        )
 
         client = self._GoneClient(["Running"])
+        mon = PodMonitor(client, "p", poll_secs=0.01, not_found_retries=2)
+        assert mon.wait_outcome() == OUTCOME_UNKNOWN
+        client = self._GoneClient(["Running"])
+        assert PodMonitor(
+            client, "p", poll_secs=0.01, not_found_retries=2
+        ).wait() is False
+        client = self._GoneClient(["Running"])
+        assert PodMonitor(
+            client, "p", poll_secs=0.01, not_found_retries=2,
+            unknown_ok=True,
+        ).wait() is True
+
+    def test_succeeded_observed_then_gone_is_success(self):
+        from elasticdl_tpu.platform.job_monitor import PodMonitor
+
+        # An actually-observed Succeeded phase proves success outright.
+        client = self._GoneClient(["Running", "Succeeded"])
         mon = PodMonitor(client, "p", poll_secs=0.01, not_found_retries=2)
         assert mon.wait() is True
 

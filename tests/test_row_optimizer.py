@@ -268,6 +268,25 @@ class TestSparseApplyKernelDispatch:
                 rtol=1e-5, atol=1e-6, err_msg=f"slot {name}",
             )
 
+    def test_always_validates_up_front(self):
+        # ADVICE round 2: use_pallas="always" with an unkernelizable
+        # (opt, dim) must raise a clear ValueError, not an opaque
+        # pallas_call shape error.
+        from elasticdl_tpu.embedding.optimizer import (
+            init_slot_tables,
+            make_row_optimizer,
+            sparse_apply,
+        )
+
+        opt = make_row_optimizer("SGD", lr=0.05)
+        table, ids, grads, vocab, _ = self._fixture(dim=100)
+        slots = init_slot_tables(opt, vocab, 100)
+        with pytest.raises(ValueError, match="dim % 128"):
+            sparse_apply(
+                opt, table, slots, ids, grads, step=1,
+                use_pallas="always", interpret=True,
+            )
+
     def test_auto_respects_coverage(self):
         from elasticdl_tpu.embedding.optimizer import (
             AdamAmsgrad,
