@@ -77,6 +77,8 @@ def main():
         configs[name] = {
             "rate": rec["value"], "unit": rec["unit"],
             "vs_floor": rec["vs_baseline"], "mfu": rec.get("mfu"),
+            "rate_device": rec.get("rate_device"),
+            "gate": rec.get("gate"),
             "platform": platform,
         }
 

@@ -3,13 +3,51 @@
 One implementation of batch synthesis, the warmup/median measurement loop,
 and floor-file bookkeeping so the driver bench (bench.py) and the breadth
 suite (bench_suite.py) can't drift apart.
+
+Measurement-integrity design (round 3): the chip is reached through a
+device tunnel whose per-dispatch latency swings run to run (observed
+±12% back-to-back on sub-ms-step configs — BASELINE.md "Floor
+re-baseline"). Three defenses, all applied:
+
+1. **Device-time rate**: one measuring round runs under
+   ``jax.profiler`` and the per-program device execution time is read
+   off the trace's "XLA Modules" lane (``module_device_times``). Device
+   time is what the framework controls — tunnel weather cannot touch
+   it — so it is the regression-gating metric on TPU; wall rate is
+   recorded alongside (production jobs don't run through an HTTP
+   tunnel, so wall there tracks device time).
+2. **Big fused programs**: dispatch-bound configs fuse 128 steps per
+   XLA program (bench_suite CONFIGS), putting per-program wall at
+   ~300ms against ~10-15ms dispatch (<5%), where round 2's 32-step
+   programs sat at ~15-20%.
+3. **Min-of-rounds wall estimator**: tunnel noise is one-sided
+   (contention only ever adds time), so the minimum over
+   ``measure_rounds`` timed rounds estimates the true sustained rate;
+   the spread across rounds is recorded as evidence.
 """
 
+import glob
+import gzip
 import json
 import os
+import tempfile
 import time
 
 import numpy as np
+
+
+def enable_bench_compile_cache():
+    """Persistent XLA compile cache for bench processes (verified to
+    work through the axon remote-compile tunnel: second-process compile
+    of the probe program dropped 2.4s -> 0.9s). Makes fresh-process
+    isolated floor readings cheap. Cache dir is machine-local."""
+    import jax
+
+    cache_dir = os.environ.get(
+        "ELASTICDL_BENCH_CACHE", "/tmp/elasticdl_xla_bench_cache"
+    )
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 
 def make_mnist_batch(batch, rng, flat=False):
@@ -70,14 +108,96 @@ def program_flops(spec, batch):
     return float((cost or {}).get("flops", 0.0))
 
 
+def module_device_times(trace_dir, name_filter="multi_step"):
+    """Per-program device execution times (ms) from the newest
+    ``jax.profiler`` trace under ``trace_dir``.
+
+    Reads the Perfetto JSON the profiler writes and returns the
+    durations of complete events on the device process's "XLA Modules"
+    lane — one event per executed XLA program, timed ON the device, so
+    host/dispatch/tunnel time is excluded by construction.
+    ``name_filter`` keeps only the measured program (e.g. the
+    ``jit_multi_step`` task program), dropping incidental transfers or
+    helper programs that executed inside the trace window; if nothing
+    matches, all module events are returned (program naming is backend
+    -dependent). Empty list when the trace has no device lane (CPU).
+    """
+    paths = sorted(glob.glob(os.path.join(
+        trace_dir, "plugins/profile/*/*.trace.json.gz"
+    )))
+    if not paths:
+        return []
+    with gzip.open(paths[-1]) as f:
+        trace = json.load(f)
+    events = trace.get("traceEvents", [])
+    dev_pids = set()
+    module_lanes = set()
+    for e in events:
+        if e.get("ph") != "M":
+            continue
+        args = e.get("args") or {}
+        if e.get("name") == "process_name" and "/device:" in (
+            args.get("name") or ""
+        ):
+            dev_pids.add(e.get("pid"))
+        if e.get("name") == "thread_name" and args.get("name") == "XLA Modules":
+            module_lanes.add((e.get("pid"), e.get("tid")))
+    lanes = {(p, t) for (p, t) in module_lanes if p in dev_pids}
+    mods = [
+        e for e in events
+        if e.get("ph") == "X" and (e.get("pid"), e.get("tid")) in lanes
+    ]
+    named = [e for e in mods if name_filter in (e.get("name") or "")]
+    return [e["dur"] / 1e3 for e in (named or mods)]
+
+
+def _measure_device_time(multi_step, state, task, sync, measure_tasks):
+    """Run ``measure_tasks`` programs under a profiler trace; return
+    (state, median per-program device ms) — 0.0 if the backend's trace
+    has no device lane."""
+    import jax
+
+    with tempfile.TemporaryDirectory(prefix="bench_trace_") as td:
+        jax.profiler.start_trace(td)
+        try:
+            for _ in range(measure_tasks):
+                state, metrics = multi_step(state, task)
+            sync(metrics)
+        finally:
+            jax.profiler.stop_trace()
+        times = module_device_times(td)
+    if not times:
+        return state, 0.0
+    # Median over programs: device time is already near-constant
+    # (<2% observed spread); the median shrugs off a stray partial
+    # event at the trace boundary.
+    return state, float(np.median(times))
+
+
 def measure_multi_step(spec, task, batch, steps_per_task, measure_tasks,
-                       warmup_tasks=2, measure_rounds=3,
-                       compute_mfu=False):
-    """Time the fused task-granular step (core/step.build_multi_step) on a
-    device-resident task; returns examples/sec (median over rounds — the
-    device tunnel's throughput varies run to run). With ``compute_mfu``,
-    returns ``(examples_per_sec, mfu, tflops_per_sec)`` where MFU is
-    achieved FLOPs/sec over the chip's bf16 peak (program_flops)."""
+                       warmup_tasks=2, measure_rounds=5,
+                       compute_mfu=False, device_time=True):
+    """Time the fused task-granular step (core/step.build_multi_step) on
+    a device-resident task.
+
+    Returns a dict:
+      ``eps``                examples/sec from the MIN wall time over
+                             ``measure_rounds`` rounds (tunnel noise is
+                             one-sided — see module docstring)
+      ``eps_median``         median-of-rounds wall rate
+      ``wall_spread``        (max-min)/min over the timed rounds — the
+                             recorded variance evidence
+      ``device_ms_per_task`` median per-program device time off the
+                             profiler trace (0.0 where no device lane)
+      ``eps_device``         examples/sec over device time alone — the
+                             tunnel-immune regression-gating rate
+      ``mfu`` / ``tflops_per_sec``  (with ``compute_mfu``) achieved
+                             FLOPs/sec over bf16 peak, computed on
+                             device time when available (wall
+                             otherwise) — MFU is a device-efficiency
+                             statement, so device time is its honest
+                             denominator
+    """
     import jax
 
     from elasticdl_tpu.core.step import build_multi_step
@@ -106,16 +226,40 @@ def measure_multi_step(spec, task, batch, steps_per_task, measure_tasks,
             state, metrics = multi_step(state, task)
         final_loss = sync(metrics)
         rounds.append(time.perf_counter() - start)
-    elapsed = float(np.median(rounds))
     assert np.isfinite(final_loss), f"bench diverged: loss={final_loss}"
-    eps = batch * steps_per_task * measure_tasks / elapsed
-    if not compute_mfu:
-        return eps
-    flops_step = program_flops(spec, jax.tree.map(lambda x: x[0], task))
-    achieved = flops_step * steps_per_task * measure_tasks / elapsed
-    peak = peak_flops(jax.devices()[0])
-    mfu = achieved / peak if peak else 0.0
-    return eps, mfu, achieved / 1e12
+
+    examples = batch * steps_per_task * measure_tasks
+    best = float(np.min(rounds))
+    result = {
+        "eps": examples / best,
+        "eps_median": examples / float(np.median(rounds)),
+        "wall_spread": float((np.max(rounds) - np.min(rounds))
+                             / np.min(rounds)),
+        "rounds_sec": [round(r, 5) for r in rounds],
+    }
+
+    device_ms = 0.0
+    if device_time:
+        state, device_ms = _measure_device_time(
+            multi_step, state, task, sync, measure_tasks
+        )
+    result["device_ms_per_task"] = round(device_ms, 3)
+    result["eps_device"] = (
+        batch * steps_per_task / (device_ms / 1e3) if device_ms else 0.0
+    )
+
+    if compute_mfu:
+        flops_step = program_flops(
+            spec, jax.tree.map(lambda x: x[0], task)
+        )
+        if device_ms:
+            achieved = flops_step * steps_per_task / (device_ms / 1e3)
+        else:
+            achieved = flops_step * steps_per_task * measure_tasks / best
+        peak = peak_flops(jax.devices()[0])
+        result["mfu"] = achieved / peak if peak else 0.0
+        result["tflops_per_sec"] = achieved / 1e12
+    return result
 
 
 def load_json(path, default):
