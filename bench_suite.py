@@ -78,6 +78,12 @@ CONFIGS = {
     # real LM training. Fewer steps/task: each step is ~6x the d512
     # cost, so dispatch amortization needs less fusing.
     "transformer_l": ("transformer.transformer_lm.custom_model", 8, 8, 2),
+    # Large-recsys flagship: 1M x 256 table trained sparsely in HBM —
+    # the Pallas lookup + in-place row-update kernels' production
+    # config (the measured winning tier, EMBEDDING_SWEEP.json). The
+    # suite measures it twice (auto vs force_xla) and records the
+    # kernel speedup alongside the gated rate.
+    "recsys": ("recsys.recsys_sparse.custom_model", 512, 64, 2),
 }
 TRANSFORMER_SEQ = 1024
 TRANSFORMER_VOCAB = 32768
@@ -141,6 +147,15 @@ def _make_batch(name, batch, rng):
             ).astype(np.int32),
             "dense": rng.rand(batch, len(m.NUMERIC_KEYS)).astype(np.float32),
         }
+    elif name == "recsys":
+        from model_zoo.recsys import recsys_sparse as m
+
+        labels = rng.randint(0, 2, batch).astype(np.int32)
+        features = {
+            m.FEATURE_KEY: rng.randint(
+                0, m.VOCAB, (batch, m.INPUT_LENGTH)
+            ).astype(np.int64),
+        }
     else:
         raise ValueError(name)
     return {
@@ -150,9 +165,14 @@ def _make_batch(name, batch, rng):
     }
 
 
-def run_config(name):
+def run_config(name, use_pallas=None):
     """Measure one config; returns the benchlib.measure_multi_step dict
-    with transformer rates scaled to tokens/sec."""
+    with transformer rates scaled to tokens/sec. For the sparse recsys
+    config the result also carries the paired force-XLA measurement
+    (``rate_xla_device``/``kernel_speedup_device``) — the committed
+    evidence that the production Pallas path beats the XLA path."""
+    import functools
+
     import jax
 
     from elasticdl_tpu.core.model_spec import get_model_spec
@@ -163,6 +183,10 @@ def run_config(name):
     spec = get_model_spec(model_zoo_dir(), model_def)
     if name.startswith("transformer"):
         spec = _transformer_spec(spec, name)
+    if use_pallas is not None and spec.make_sparse_runner is not None:
+        spec.make_sparse_runner = functools.partial(
+            spec.make_sparse_runner, use_pallas=use_pallas
+        )
     rng = np.random.RandomState(0)
     task = jax.device_put(
         stack_batches([_make_batch(name, batch, rng) for _ in range(steps)])
@@ -173,6 +197,14 @@ def run_config(name):
     if name.startswith("transformer"):
         for key in ("eps", "eps_median", "eps_device"):
             measured[key] *= TRANSFORMER_SEQ  # examples/sec -> tokens/sec
+    if name == "recsys" and use_pallas is None:
+        xla = run_config(name, use_pallas="never")
+        measured["rate_xla"] = round(xla["eps"], 2)
+        measured["rate_xla_device"] = round(xla["eps_device"], 2)
+        if xla["eps_device"] and measured["eps_device"]:
+            measured["kernel_speedup_device"] = round(
+                measured["eps_device"] / xla["eps_device"], 4
+            )
     return measured
 
 
@@ -305,6 +337,10 @@ def main():
                 measured.get("tflops_per_sec", 0.0), 2
             ),
         }
+        for extra in ("rate_xla", "rate_xla_device",
+                      "kernel_speedup_device"):
+            if extra in measured:
+                results[name][extra] = measured[extra]
         print(json.dumps({
             "metric": f"{name}_train_{unit.split('/')[0]}_per_sec_per_chip"
                       f"[{platform}]",

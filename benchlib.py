@@ -203,11 +203,22 @@ def measure_multi_step(spec, task, batch, steps_per_task, measure_tasks,
     from elasticdl_tpu.core.step import build_multi_step
     from elasticdl_tpu.core.train_state import init_train_state
 
-    state = init_train_state(
-        spec.model, spec.make_optimizer(),
-        jax.tree.map(lambda x: x[0], task), seed=0,
-    )
-    multi_step = build_multi_step(spec.loss)
+    if getattr(spec, "make_sparse_runner", None):
+        # Device-tier sparse models (embedding/device_sparse.py): the
+        # runner owns state init and the fused multi-step — the Pallas
+        # lookup + row-kernel path this config exists to measure.
+        runner = spec.make_sparse_runner()
+        state = runner.init_state(
+            spec.model, spec.make_optimizer(),
+            jax.tree.map(lambda x: x[0], task), seed=0,
+        )
+        multi_step = runner.train_multi_step(spec.loss)
+    else:
+        state = init_train_state(
+            spec.model, spec.make_optimizer(),
+            jax.tree.map(lambda x: x[0], task), seed=0,
+        )
+        multi_step = build_multi_step(spec.loss)
 
     def sync(metrics):
         # Host transfer of the last step's loss: a hard sync even where
@@ -248,7 +259,13 @@ def measure_multi_step(spec, task, batch, steps_per_task, measure_tasks,
         batch * steps_per_task / (device_ms / 1e3) if device_ms else 0.0
     )
 
-    if compute_mfu:
+    if compute_mfu and getattr(spec, "make_sparse_runner", None):
+        # Embedding-bound by construction: MFU is structurally ~0 and
+        # the dense-step cost analysis doesn't apply to the sparse
+        # program. Rate is the metric (BASELINE.md round-2 notes).
+        result["mfu"] = 0.0
+        result["tflops_per_sec"] = 0.0
+    elif compute_mfu:
         flops_step = program_flops(
             spec, jax.tree.map(lambda x: x[0], task)
         )

@@ -72,7 +72,10 @@ class LocalExecutor:
         # row-block template needs an example batch).
         self._step_runner = (
             self._spec.make_host_runner()
-            if self._spec.make_host_runner else None
+            if self._spec.make_host_runner else (
+                self._spec.make_sparse_runner()
+                if self._spec.make_sparse_runner else None
+            )
         )
         if self._step_runner is None:
             self._train_step = build_train_step(self._spec.loss)

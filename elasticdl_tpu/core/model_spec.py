@@ -85,6 +85,10 @@ class ModelSpec:
     # returning a HostStepRunner. When present, the worker and local
     # executor drive the model through it automatically.
     make_host_runner: Optional[Callable] = None
+    # Device-tier sparse models (embedding/device_sparse.py): factory
+    # returning a DeviceSparseRunner — big HBM tables trained through
+    # the Pallas lookup + row-update kernels.
+    make_sparse_runner: Optional[Callable] = None
 
     def make_optimizer(self, **kwargs):
         return self.optimizer_fn(**kwargs)
@@ -146,4 +150,5 @@ def get_model_spec(
         batch_sharding_rule=_get_spec_value(module, "batch_sharding_rule"),
         model_fn=model_fn,
         make_host_runner=_get_spec_value(module, "make_host_runner"),
+        make_sparse_runner=_get_spec_value(module, "make_sparse_runner"),
     )

@@ -257,10 +257,27 @@ class CsvTableSource(TableSource):
 
 
 class OdpsTableSource(TableSource):
-    """MaxCompute table via pyodps (import-gated; reference odps_io.py)."""
+    """MaxCompute table via pyodps (import-gated; reference
+    ``odps_io.py:61-142`` ODPSReader: project/endpoint/table[,partition]
+    range reads over ``open_reader``).
+
+    The class body is exercised against a faked pyodps API in
+    tests/test_table_reader_and_tools.py (this image has no pyodps and
+    no egress); only the import itself is environment-gated.
+    """
+
+    # pyodps exception class names worth retrying (reference
+    # odps_io.py:243-265 retried everything; we classify — server-side
+    # and connection flakes retry, schema/auth errors surface).
+    _TRANSIENT_ERROR_NAMES = frozenset({
+        "ConnectTimeout", "ReadTimeout", "Timeout",
+        "InternalServerError", "ServiceUnavailable",
+        "RequestTimeTooSkewed", "StreamError",
+    })
 
     def __init__(self, project: str, table: str, access_id: str = "",
-                 access_key: str = "", endpoint: str = ""):
+                 access_key: str = "", endpoint: str = "",
+                 partition: str = ""):
         try:
             import odps  # noqa: F401
         except ImportError as e:
@@ -274,19 +291,31 @@ class OdpsTableSource(TableSource):
         self._odps = ODPS(access_id, access_key, project,
                           endpoint=endpoint)
         self._table = self._odps.get_table(table)
+        self._partition = partition or None
         self._columns = [c.name for c in self._table.schema.columns]
 
+    def _open_reader(self):
+        if self._partition:
+            return self._table.open_reader(partition=self._partition)
+        return self._table.open_reader()
+
     def count(self) -> int:
-        with self._table.open_reader() as reader:
+        with self._open_reader() as reader:
             return reader.count
 
     def column_names(self) -> List[str]:
         return list(self._columns)
 
     def read(self, start: int, end: int) -> Iterator[dict]:
-        with self._table.open_reader() as reader:
+        with self._open_reader() as reader:
             for record in reader.read(start=start, count=end - start):
                 yield dict(zip(self._columns, record.values))
+
+    def is_transient_error(self, exc: BaseException) -> bool:
+        for klass in type(exc).__mro__:
+            if klass.__name__ in self._TRANSIENT_ERROR_NAMES:
+                return True
+        return is_transient_error(exc)
 
 
 def open_table_source(data_origin: str) -> TableSource:
@@ -310,9 +339,21 @@ def open_table_source(data_origin: str) -> TableSource:
 
         return RemoteTableSource(parsed.netloc)
     if scheme == "odps":
+        import os
+
         parts = parsed.path.strip("/").split("/")
         table = parts[-1] if parts else ""
-        return OdpsTableSource(project=parsed.netloc, table=table)
+        q = parse_qs(parsed.query)
+        # Credentials come from the reference's MaxCompute env contract
+        # (common/constants.py:15-18: MAXCOMPUTE_AK/SK/ENDPOINT), never
+        # from the URL.
+        return OdpsTableSource(
+            project=parsed.netloc, table=table,
+            access_id=os.environ.get("MAXCOMPUTE_AK", ""),
+            access_key=os.environ.get("MAXCOMPUTE_SK", ""),
+            endpoint=os.environ.get("MAXCOMPUTE_ENDPOINT", ""),
+            partition=q.get("partition", [""])[0],
+        )
     raise ValueError(f"Unrecognized table origin {data_origin!r}")
 
 

@@ -40,6 +40,7 @@ from elasticdl_tpu.embedding.host_engine import (
     HostEmbedding,
     HostEmbeddingEngine,
     HostStepRunner,
+    PreparedBatch,
     build_host_eval_step,
     build_host_train_step,
     host_rows_template,
@@ -56,6 +57,7 @@ __all__ = [
     "HostRowService",
     "make_remote_engine",
     "HostStepRunner",
+    "PreparedBatch",
     "build_host_eval_step",
     "build_host_train_step",
     "host_rows_template",

@@ -1,0 +1,367 @@
+"""Device-tier sparse embedding training: the PS hot path, in HBM.
+
+The reference trains big embedding tables on parameter-server pods —
+pull rows, compute, push row grads, C++ kernels apply them
+(``pkg/ps/server.go:162-192``, ``pkg/kernel/capi/kernel_api.cc:6-96``).
+The TPU-native shape when the table FITS in HBM (the v5e has 16 GB —
+a 4M x 256 f32 table is 4 GB): keep the table next to the model and
+make the whole step one XLA program, with the sparse structure
+preserved —
+
+- **forward** reads the table through the measured Pallas row-streaming
+  lookup (``ops/pallas_embedding.lookup_combine`` auto-dispatch: each
+  touched row leaves HBM exactly once; the table never enters autodiff,
+  so no dense (V, D) gradient ever exists),
+- **backward** produces row gradients for only the batch's unique ids
+  (linear-transpose of the combiner — exact, no hand math),
+- **update** scatters through the in-place Pallas row kernels
+  (``embedding/optimizer.sparse_apply``: one HBM read+write per touched
+  row, slots included — the C++ kernel family this replaces).
+
+``tables/slots`` ride a ``SparseTrainState`` (a ``TrainState`` with
+extra pytree fields), so jit/donation/checkpoint treat them like any
+other state leaf. Models read per-batch embeddings through the
+``SparseEmbed`` module (collection ``sparse_emb``), mirroring the host
+tier's ``HostEmbedding``/``host_rows`` contract.
+"""
+
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+from flax import struct
+
+from elasticdl_tpu.core.train_state import TrainState
+from elasticdl_tpu.embedding.combiner import COMBINERS, RaggedIds, combine
+from elasticdl_tpu.embedding.optimizer import (
+    RowOptimizer,
+    init_slot_tables,
+    sparse_apply,
+)
+
+SPARSE_EMB_COLLECTION = "sparse_emb"
+
+
+@dataclass(frozen=True)
+class TableSpec:
+    """One device-resident sparse table: ``feature_key`` names the
+    batch feature carrying its RaggedIds (or dense (B, L) ids)."""
+
+    name: str
+    vocab: int
+    dim: int
+    combiner: str = "sum"
+    feature_key: str = "ids"
+
+    def __post_init__(self):
+        if self.combiner not in COMBINERS:
+            raise ValueError(f"combiner must be one of {COMBINERS}")
+
+
+class SparseEmbed(nn.Module):
+    """Read the runner-computed (B, dim) combined embedding for one
+    table (collection ``sparse_emb``). The model never touches the
+    (V, D) table — the sparse step owns lookup and update."""
+
+    table_name: str
+    output_dim: int
+
+    @nn.compact
+    def __call__(self):
+        return self.variable(
+            SPARSE_EMB_COLLECTION,
+            self.table_name,
+            lambda: jnp.zeros((1, self.output_dim), jnp.float32),
+        ).value
+
+
+class SparseTrainState(TrainState):
+    """TrainState + the sparse plane: {table: (V, D)} main tables,
+    their slot tables, and per-table apply counters (Adam bias
+    correction — reference kernel_api.cc:52-55 step semantics)."""
+
+    tables: Dict[str, jnp.ndarray] = struct.field(default_factory=dict)
+    slot_tables: Dict[str, Dict[str, jnp.ndarray]] = struct.field(
+        default_factory=dict
+    )
+    table_steps: Dict[str, jnp.ndarray] = struct.field(
+        default_factory=dict
+    )
+
+
+def _ragged(ids) -> RaggedIds:
+    if isinstance(ids, RaggedIds):
+        return ids
+    ids = jnp.asarray(ids)
+    return RaggedIds(
+        ids=ids.astype(jnp.int32),
+        weights=jnp.ones(ids.shape, jnp.float32),
+    )
+
+
+def _unique_pad_jit(ids_flat: jnp.ndarray, vocab: int):
+    """In-jit static-shape dedup: (uids, inverse) with uids padded to
+    ``ids_flat.size`` by the out-of-range sentinel ``vocab`` (the pad
+    contract every Pallas row kernel skips on)."""
+    uids, inverse = jnp.unique(
+        ids_flat, return_inverse=True, size=ids_flat.size,
+        fill_value=vocab,
+    )
+    return uids.astype(jnp.int32), inverse.astype(jnp.int32)
+
+
+def _row_grads(d_emb, uids, inverse, ragged, combiner):
+    """Exact row gradients via linear transpose of the combiner (it is
+    linear in the rows): (B, dim) cotangent -> (U, dim) row grads,
+    scatter-add over duplicate ids included. XLA's native strength —
+    the lookup kernel's VJP design note (ops/pallas_embedding.py)."""
+    n_unique = uids.shape[0]
+    dim = d_emb.shape[-1]
+    inv = inverse.reshape(ragged.ids.shape)
+
+    def lookup(rows):
+        return combine(
+            jnp.take(rows, inv, axis=0), ragged.weights, combiner
+        )
+
+    transpose = jax.linear_transpose(
+        lookup, jax.ShapeDtypeStruct((n_unique, dim), jnp.float32)
+    )
+    (rows_ct,) = transpose(d_emb.astype(jnp.float32))
+    return rows_ct
+
+
+def build_sparse_train_step(
+    loss_fn: Callable,
+    specs: Tuple[TableSpec, ...],
+    row_opt: RowOptimizer,
+    template,
+    use_pallas: str = "auto",
+    interpret: bool = False,
+) -> Callable:
+    """Build ``(SparseTrainState, batch) -> (state, metrics)`` — one
+    jittable program covering lookup, model fwd/bwd, dense apply, and
+    the sparse row-kernel apply. ``template`` is the model's
+    ``sparse_emb`` collection structure (``sparse_template``).
+    Composable with ``lax.scan`` for the fused multi-step task path
+    (``build_sparse_multi_step``)."""
+    from elasticdl_tpu.core.step import _call_loss
+    from elasticdl_tpu.embedding.host_engine import _nest_rows
+    from elasticdl_tpu.ops.pallas_embedding import lookup_combine
+
+    def train_step(state: SparseTrainState, batch):
+        state, rng = state.next_rng()
+        features = batch["features"]
+
+        embs, lookups = {}, {}
+        for spec in specs:
+            ragged = _ragged(features[spec.feature_key])
+            table = state.tables[spec.name]
+            # Forward from the LIVE table (Pallas auto-dispatch); the
+            # table is not differentiated — row grads come from the
+            # combiner transpose below.
+            embs[spec.name] = lookup_combine(
+                jax.lax.stop_gradient(table), ragged.ids,
+                ragged.weights, spec.combiner,
+                interpret=interpret,
+                force_pallas=(use_pallas == "always"),
+                force_xla=(use_pallas == "never"),
+            )
+            uids, inverse = _unique_pad_jit(
+                jnp.ravel(ragged.ids), spec.vocab
+            )
+            lookups[spec.name] = (ragged, uids, inverse)
+
+        def compute_loss(params, embs):
+            variables = {
+                "params": params,
+                SPARSE_EMB_COLLECTION: _nest_rows(template, embs),
+            }
+            preds = state.apply_fn(
+                variables, batch["features"], training=True,
+                rngs={"dropout": rng} if rng is not None else None,
+                mutable=False,
+            )
+            return _call_loss(
+                loss_fn, batch["labels"], preds, batch["mask"]
+            )
+
+        grad_fn = jax.value_and_grad(compute_loss, argnums=(0, 1))
+        loss, (param_grads, emb_grads) = grad_fn(state.params, embs)
+
+        new_tables = dict(state.tables)
+        new_slots = dict(state.slot_tables)
+        new_steps = dict(state.table_steps)
+        for spec in specs:
+            ragged, uids, inverse = lookups[spec.name]
+            rows_ct = _row_grads(
+                emb_grads[spec.name], uids, inverse, ragged,
+                spec.combiner,
+            )
+            step_count = state.table_steps[spec.name] + 1
+            table, slots = sparse_apply(
+                row_opt, state.tables[spec.name],
+                state.slot_tables[spec.name], uids, rows_ct,
+                step=step_count, use_pallas=use_pallas,
+                interpret=interpret,
+            )
+            new_tables[spec.name] = table
+            new_slots[spec.name] = slots
+            new_steps[spec.name] = step_count
+
+        state = state.apply_gradients(
+            grads=param_grads, tables=new_tables,
+            slot_tables=new_slots, table_steps=new_steps,
+        )
+        return state, {"loss": loss}
+
+    return train_step
+
+
+def build_sparse_multi_step(loss_fn, specs, row_opt, template,
+                            use_pallas: str = "auto",
+                            interpret: bool = False,
+                            unroll: int = 1) -> Callable:
+    """T fused sparse steps per XLA program (the task-granular mode —
+    core/step.build_multi_step for the sparse plane)."""
+    step = build_sparse_train_step(
+        loss_fn, specs, row_opt, template, use_pallas=use_pallas,
+        interpret=interpret,
+    )
+
+    def multi_step(state, batches):
+        def body(state, batch):
+            return step(state, batch)
+
+        num_steps = jax.tree.leaves(batches)[0].shape[0]
+        return jax.lax.scan(
+            body, state, batches, unroll=max(1, min(unroll, num_steps))
+        )
+
+    return jax.jit(multi_step, donate_argnums=(0,))
+
+
+def init_sparse_state(
+    model, tx, example_batch, specs: Tuple[TableSpec, ...],
+    row_opt: RowOptimizer, seed: int = 0,
+    table_dtype=jnp.float32,
+) -> Tuple[SparseTrainState, Any]:
+    """Trace the model (zero embeddings in the collection), attach
+    deterministic tables + zero slots; returns ``(state, template)``
+    where template is the model's sparse_emb collection structure
+    (pass to ``build_sparse_train_step``). Table init is seeded
+    uniform, so elastic relaunches reproduce."""
+    from elasticdl_tpu.embedding.host_engine import _iter_leaves
+
+    rng = jax.random.PRNGKey(seed)
+    variables = model.init(
+        {"params": rng, "dropout": rng}, example_batch["features"],
+        training=False,
+    )
+    template = variables.get(SPARSE_EMB_COLLECTION, {})
+    names = [k for k, _ in _iter_leaves(template)]
+    missing = {s.name for s in specs} - set(names)
+    if missing:
+        raise ValueError(
+            f"model declares no SparseEmbed for tables {missing}"
+        )
+
+    tables = {}
+    slot_tables = {}
+    table_steps = {}
+    for i, spec in enumerate(specs):
+        key = jax.random.fold_in(jax.random.PRNGKey(seed), i)
+        scale = 1.0 / np.sqrt(spec.dim)
+        tables[spec.name] = jax.random.uniform(
+            key, (spec.vocab, spec.dim), table_dtype, -scale, scale
+        )
+        slot_tables[spec.name] = init_slot_tables(
+            row_opt, spec.vocab, spec.dim, table_dtype
+        )
+        table_steps[spec.name] = jnp.zeros((), jnp.int32)
+
+    state = SparseTrainState(
+        step=jnp.zeros((), jnp.int32),
+        apply_fn=model.apply,
+        params=variables["params"],
+        batch_stats={},
+        tx=tx,
+        opt_state=tx.init(variables["params"]),
+        rng=jax.random.PRNGKey(seed),
+        tables=tables,
+        slot_tables=slot_tables,
+        table_steps=table_steps,
+    )
+    return state, template
+
+
+class DeviceSparseRunner:
+    """Worker-compatible step runner (init_state/train_step/eval_step +
+    train_multi_step) for device-tier sparse models — the deployment
+    adapter the host tier has in HostStepRunner."""
+
+    def __init__(self, specs: Tuple[TableSpec, ...],
+                 row_opt: RowOptimizer, use_pallas: str = "auto",
+                 interpret: Optional[bool] = None):
+        self.specs = tuple(specs)
+        self.row_opt = row_opt
+        self.use_pallas = use_pallas
+        # interpret=None: auto — real kernels on TPU, interpreter off
+        # TPU (CPU tests) only when a kernel path is forced.
+        if interpret is None:
+            interpret = (
+                use_pallas == "always"
+                and jax.default_backend() != "tpu"
+            )
+        self.interpret = interpret
+        self._template = None
+
+    def init_state(self, model, tx, batch, seed: int = 0):
+        state, self._template = init_sparse_state(
+            model, tx, batch, self.specs, self.row_opt, seed=seed
+        )
+        return state
+
+    def train_step(self, loss_fn):
+        step = build_sparse_train_step(
+            loss_fn, self.specs, self.row_opt, self._template,
+            use_pallas=self.use_pallas, interpret=self.interpret,
+        )
+        return jax.jit(step, donate_argnums=(0,))
+
+    def train_multi_step(self, loss_fn):
+        return build_sparse_multi_step(
+            loss_fn, self.specs, self.row_opt, self._template,
+            use_pallas=self.use_pallas, interpret=self.interpret,
+        )
+
+    def eval_step(self):
+        from elasticdl_tpu.embedding.host_engine import _nest_rows
+        from elasticdl_tpu.ops.pallas_embedding import lookup_combine
+
+        specs = self.specs
+        template = self._template
+
+        def step(state, batch):
+            embs = {}
+            for spec in specs:
+                ragged = _ragged(batch["features"][spec.feature_key])
+                embs[spec.name] = lookup_combine(
+                    state.tables[spec.name], ragged.ids, ragged.weights,
+                    spec.combiner, interpret=self.interpret,
+                    force_pallas=(self.use_pallas == "always"),
+                    force_xla=(self.use_pallas == "never"),
+                )
+            variables = {
+                "params": state.params,
+                SPARSE_EMB_COLLECTION: _nest_rows(template, embs),
+            }
+            return state.apply_fn(
+                variables, batch["features"], training=False,
+                mutable=False,
+            )
+
+        return jax.jit(step)

@@ -161,7 +161,17 @@ def _pallas_sparse_apply(opt: RowOptimizer, table, slot_tables,
     (ops/pallas_embedding in-place updates; same OOR pad contract)."""
     from elasticdl_tpu.ops import pallas_embedding as pe
 
-    if isinstance(opt, Adam) and not opt.amsgrad:
+    if isinstance(opt, Adam) and opt.amsgrad:
+        new_table, m, v, max_v = pe.sparse_adam_amsgrad_update(
+            table, slot_tables["m"], slot_tables["v"],
+            slot_tables["max_v"], unique_ids, row_grads, lr=opt.lr,
+            beta1=opt.beta1, beta2=opt.beta2, epsilon=opt.epsilon,
+            step=step, interpret=interpret,
+        )
+        return new_table, {
+            **slot_tables, "m": m, "v": v, "max_v": max_v
+        }
+    if isinstance(opt, Adam):
         new_table, m, v = pe.sparse_adam_update(
             table, slot_tables["m"], slot_tables["v"], unique_ids,
             row_grads, lr=opt.lr, beta1=opt.beta1, beta2=opt.beta2,
@@ -197,15 +207,13 @@ def _pallas_sparse_apply(opt: RowOptimizer, table, slot_tables,
 def kernelizable(opt: RowOptimizer, dim: int) -> bool:
     """Whether the Pallas in-place kernels cover (opt, dim): lane-aligned
     rows and one of SGD / Momentum(+Nesterov) / Adagrad /
-    Adam-without-amsgrad — the reference's full C++ kernel family
-    (kernel_api.cc); only amsgrad stays on XLA."""
+    Adam(+amsgrad) — the reference's full C++ kernel family
+    (kernel_api.cc), with nothing left on XLA-only."""
     from elasticdl_tpu.ops import pallas_embedding as pe
 
     if not pe.dim_supported(dim):
         return False
-    if isinstance(opt, Adam):
-        return not opt.amsgrad
-    return isinstance(opt, (SGD, Momentum, Adagrad))
+    return isinstance(opt, (SGD, Momentum, Adagrad, Adam))
 
 
 def sparse_apply(opt: RowOptimizer, table, slot_tables: Dict[str, "jnp.ndarray"],

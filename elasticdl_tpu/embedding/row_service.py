@@ -23,6 +23,7 @@ batch's unique rows, mirroring the reference worker's dedup before
 push (worker.py:487-599).
 """
 
+import itertools
 import threading
 import time
 from typing import Dict, Optional
@@ -302,7 +303,12 @@ def _call_with_retry(stub: RpcStub, method: str, retries: int,
 
 class _RemoteTable:
     """Table-like view pulling rows over RPC (get-only: writes happen
-    server-side via the optimizer push)."""
+    server-side via the optimizer push). ``concurrent_safe``: the stub
+    is thread-safe and the SERVER serializes row access, so the client
+    engine lets pulls overlap in-flight pushes (reference Go PS
+    concurrent serving, ps/server.go:162-192)."""
+
+    concurrent_safe = True
 
     def __init__(self, stub: RpcStub, name: str, dim: int,
                  retries: int = 12, backoff_secs: float = 0.5):
@@ -334,28 +340,48 @@ class _RemoteTable:
 
 class _RemoteOptimizer:
     """Optimizer-like view pushing row grads over RPC; the server
-    applies them (reference push_gradients semantics)."""
+    applies them (reference push_gradients semantics).
+
+    Concurrent-safe via PER-THREAD (client, seq) streams: the server's
+    exactly-once dedup drops any seq <= the client's last applied, so
+    two threads sharing one stream would lose whichever concurrent push
+    arrived second. Each pushing thread gets its own client id instead
+    (the server is multi-client by design); within a thread, seqs stay
+    monotone so lost-reply retries still dedup correctly."""
+
+    concurrent_safe = True
 
     def __init__(self, stub: RpcStub, retries: int = 12,
                  backoff_secs: float = 0.5):
+        import threading
         import uuid
 
         self._stub = stub
         self._retries = retries
         self._backoff = backoff_secs
-        self._client = uuid.uuid4().hex
-        self._seq = 0
+        self._client_base = uuid.uuid4().hex
+        self._local = threading.local()
+        # Fresh-counter client ids (NOT thread idents — idents are
+        # reused after a thread dies, which would resurrect a dead
+        # stream with a reset seq and get every push deduped away).
+        self._client_counter = itertools.count()
+        self._counter_lock = threading.Lock()
 
     def apply_gradients(self, table, ids, grads):
         # (client, seq) lets the server drop a retried push whose first
         # attempt applied but whose reply was lost.
-        self._seq += 1
+        if not hasattr(self._local, "client"):
+            with self._counter_lock:
+                n = next(self._client_counter)
+            self._local.client = f"{self._client_base}-{n}"
+            self._local.seq = 0
+        self._local.seq += 1
         _call_with_retry(
             self._stub, "push_row_grads", self._retries, self._backoff,
             table=table.name,
             ids=np.asarray(ids, np.int64),
             grads=np.asarray(grads, np.float32),
-            client=self._client, seq=self._seq,
+            client=self._local.client, seq=self._local.seq,
         )
         return table
 

@@ -278,6 +278,77 @@ def lookup_combine(table, ids, weights, combiner: str,
     return combine(rows, weights, combiner)
 
 
+def lookup_combine_sharded(table, ids, weights, combiner: str, mesh,
+                           axis: str, interpret: bool = False,
+                           force_pallas: bool = False,
+                           force_xla: bool = False):
+    """Per-shard kernel lookup over a ROW-SHARDED ``(V, D)`` table.
+
+    Lifts the single-device restriction the auto-dispatch enforces
+    (under plain GSPMD the kernel would force per-shard full-table
+    materialization): ``shard_map`` gives each device its own row range
+    [idx*V/n, (idx+1)*V/n); ids outside the local range keep the row
+    DMA but contribute weight 0, partial sums ``psum`` over ``axis``,
+    and mean/sqrtn renormalize with the replicated weights — exactly
+    ``combine``'s semantics. Differentiable (the per-shard path's
+    custom VJP composes with shard_map; d_table comes back sharded the
+    same way). ids/weights must be replicated over ``axis``.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    if combiner not in COMBINERS:
+        raise ValueError(f"combiner must be one of {COMBINERS}")
+    num_shards = mesh.shape[axis]
+    vocab = table.shape[0]
+    if vocab % num_shards:
+        raise ValueError(
+            f"vocab {vocab} not divisible by mesh axis {axis!r} size "
+            f"{num_shards}; pad the table"
+        )
+    shard_rows = vocab // num_shards
+    # Decide the path ONCE at the outer level (the inner call would
+    # otherwise hit the multi-device auto-dispatch guard), then pin it
+    # per shard.
+    backend_ok = interpret or jax.default_backend() == "tpu"
+    use_kernel = force_pallas or (
+        not force_xla
+        and backend_ok
+        and use_pallas_lookup(table.shape[1], ids.shape[1])
+    )
+
+    def per_shard(tbl, ids_, w_):
+        lo = (jax.lax.axis_index(axis) * shard_rows).astype(jnp.int32)
+        local = ids_.astype(jnp.int32) - lo
+        in_range = (local >= 0) & (local < shard_rows)
+        w_local = jnp.where(in_range, w_, 0.0)
+        local = jnp.clip(local, 0, shard_rows - 1)
+        part = lookup_combine(
+            tbl, local, w_local, "sum", interpret=interpret,
+            force_pallas=use_kernel, force_xla=not use_kernel,
+        )
+        return jax.lax.psum(part, axis)
+
+    # check_vma=False: pallas_call's out_shape carries no varying-mesh
+    # annotation, which the vma checker (jax >= 0.8) rejects inside
+    # shard_map; the psum above makes the output's replication explicit.
+    out = jax.shard_map(
+        per_shard, mesh=mesh,
+        in_specs=(P(axis, None), P(None, None), P(None, None)),
+        out_specs=P(None, None), check_vma=False,
+    )(table, jnp.asarray(ids), jnp.asarray(weights, jnp.float32))
+
+    if combiner == "sum":
+        return out
+    if combiner == "mean":
+        denom = jnp.sum(weights, axis=-1, keepdims=True)
+    else:  # sqrtn
+        denom = jnp.sqrt(jnp.sum(weights * weights, axis=-1,
+                                 keepdims=True))
+    return jnp.where(
+        denom > 0, out / jnp.where(denom > 0, denom, 1.0), 0.0
+    )
+
+
 # ---- in-place sparse optimizer updates -----------------------------------
 
 
@@ -478,8 +549,8 @@ def sparse_adam_update(table, m, v, unique_ids, row_grads, lr: float,
                        interpret: bool = False):
     """In-place Adam on (table, m, v); ``step`` is the 1-based apply
     count for bias correction (may be traced). Same pad contract as
-    SGD/Adagrad: out-of-range ids are skipped. amsgrad is not kernelized
-    (use the XLA path)."""
+    SGD/Adagrad: out-of-range ids are skipped. For amsgrad use
+    ``sparse_adam_amsgrad_update`` (adds the max_v table)."""
     chunks = row_grads.shape[1] // LANE
     step_f = jnp.asarray(step, jnp.float32)
     bias_corr = jnp.stack([
@@ -538,4 +609,72 @@ def sparse_momentum_update(table, velocity, unique_ids, row_grads,
         functools.partial(_momentum_kernel, lr, momentum, nesterov,
                           table.shape[0], chunks),
         unique_ids, row_grads, [table, velocity], interpret=interpret,
+    )
+
+
+def _adam_amsgrad_kernel(lr, beta1, beta2, eps, vocab, chunks, bc_ref,
+                         ids_ref, grads_ref, _t, _m, _v, _mv, table_ref,
+                         m_ref, v_ref, maxv_ref, buf, sems):
+    """amsgrad Adam row update — the last gap vs the reference's C++
+    Adam kernel (kernel_api.cc:40-77, which fuses the max_square slot).
+    Matches RowOptimizer.Adam.apply_rows(amsgrad=True) exactly: the max
+    is taken over the bias-CORRECTED v_hat and the maximized value is
+    what divides the step."""
+    i = pl.program_id(0)
+    row = ids_ref[i]
+
+    @pl.when(row < vocab)  # out-of-range = padding: skip
+    def _():
+        _run(
+            _row_chunk_dmas(table_ref, row, buf.at[0], sems.at[0],
+                            chunks)
+            + _row_chunk_dmas(m_ref, row, buf.at[1], sems.at[1],
+                              chunks)
+            + _row_chunk_dmas(v_ref, row, buf.at[2], sems.at[2],
+                              chunks)
+            + _row_chunk_dmas(maxv_ref, row, buf.at[3], sems.at[3],
+                              chunks)
+            + _row_chunk_dmas(grads_ref, i, buf.at[4], sems.at[4],
+                              chunks)
+        )
+        g = buf[4]
+        m = beta1 * buf[1] + (1.0 - beta1) * g
+        v = beta2 * buf[2] + (1.0 - beta2) * g * g
+        buf[1] = m
+        buf[2] = v
+        m_hat = m / bc_ref[0]
+        v_hat = v / bc_ref[1]
+        vmax = jnp.maximum(buf[3], v_hat)
+        buf[3] = vmax
+        buf[0] = buf[0] - lr * m_hat / (jnp.sqrt(vmax) + eps)
+        _run(
+            _row_chunk_stores(table_ref, row, buf.at[0], sems.at[0],
+                              chunks)
+            + _row_chunk_stores(m_ref, row, buf.at[1], sems.at[1],
+                                chunks)
+            + _row_chunk_stores(v_ref, row, buf.at[2], sems.at[2],
+                                chunks)
+            + _row_chunk_stores(maxv_ref, row, buf.at[3], sems.at[3],
+                                chunks)
+        )
+
+
+def sparse_adam_amsgrad_update(table, m, v, max_v, unique_ids, row_grads,
+                               lr: float, beta1: float = 0.9,
+                               beta2: float = 0.999,
+                               epsilon: float = 1e-8, step=1,
+                               interpret: bool = False):
+    """In-place amsgrad Adam on (table, m, v, max_v); same pad contract
+    and traced-``step`` bias correction as ``sparse_adam_update``."""
+    chunks = row_grads.shape[1] // LANE
+    step_f = jnp.asarray(step, jnp.float32)
+    bias_corr = jnp.stack([
+        1.0 - jnp.float32(beta1) ** step_f,
+        1.0 - jnp.float32(beta2) ** step_f,
+    ])
+    return _inplace_row_update(
+        functools.partial(_adam_amsgrad_kernel, lr, beta1, beta2,
+                          epsilon, table.shape[0], chunks),
+        unique_ids, row_grads, [table, m, v, max_v], scalars=bias_corr,
+        interpret=interpret,
     )

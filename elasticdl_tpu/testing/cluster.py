@@ -111,6 +111,13 @@ class MiniCluster:
             # a per-worker factory would silently fork the tables.
             shared_runner = self.spec.make_host_runner()
             step_runner_factory = lambda: shared_runner  # noqa: E731
+        elif step_runner_factory is None and self.spec.make_sparse_runner:
+            # Device-tier sparse models: tables ride the TrainState, so
+            # a per-worker runner is only step-builder config — but the
+            # single-device in-process cluster still shares one (the
+            # state itself is worker-owned).
+            sparse_runner = self.spec.make_sparse_runner()
+            step_runner_factory = lambda: sparse_runner  # noqa: E731
         task_reader = (
             self.train_reader or self.eval_reader or self.predict_reader
         )

@@ -1,0 +1,14 @@
+"""A minimal ``prepare(fileobj, filename)`` for distributed_gen tests
+(the user-module contract of tools/record_gen/distributed_gen.py — the
+reference's spark job loaded the same hook from a model-zoo module)."""
+
+import csv
+import io
+
+
+def prepare(fileobj, filename):
+    text = io.TextIOWrapper(fileobj, newline="")
+    reader = csv.reader(text)
+    columns = next(reader)
+    for row in reader:
+        yield {c: v for c, v in zip(columns, row)}

@@ -311,27 +311,80 @@ class Worker:
             if self._multi_step is not None and len(batch_list) > 1:
                 return self._process_train_task_fused(batch_list)
             batches = iter(batch_list)
+        # Host-tier runners: pull rows for upcoming minibatches on a
+        # prefetch thread while the current one trains (the reference's
+        # Go PS served pulls concurrently by design). Init needs a raw
+        # first batch, so peek it before wrapping. Multi-host sync keeps
+        # raw batches (dummy participation uses them directly).
+        batches = iter(batches)
+        prepared_iter = None
+        if (
+            self._step_runner is not None
+            and getattr(self._step_runner, "pull_ahead", False)
+            and not self._multihost_sync
+        ):
+            first = next(batches, None)
+            if first is None:
+                return 0
+            self._maybe_init(first)
+            import itertools
+
+            from elasticdl_tpu.embedding.host_engine import PreparedBatch
+
+            prepared_iter = self._step_runner.iter_prepared(
+                itertools.chain([first], batches)
+            )
+            batches = prepared_iter
+        else:
+            PreparedBatch = ()  # isinstance() no-match sentinel
         count = 0
-        for batch in batches:
-            self._maybe_init(batch)
-            self.last_batch = batch
-            if self._profiler is not None:
-                # Pre-step so the window [start, start+num) captures the
-                # steps it names.
-                self._profiler.observe_step(int(self.state.step))
-            with self._timing.record("batch_process"):
+        try:
+            for batch in batches:
+                raw = (
+                    batch.raw if isinstance(batch, PreparedBatch)
+                    else batch
+                )
+                self._maybe_init(raw)
+                self.last_batch = raw
                 if self._profiler is not None:
-                    with self._profiler.annotation("train_step"):
+                    # Pre-step so the window [start, start+num) captures
+                    # the steps it names.
+                    self._profiler.observe_step(int(self.state.step))
+                with self._timing.record("batch_process"):
+                    if self._profiler is not None:
+                        with self._profiler.annotation("train_step"):
+                            self._process_train_batch(batch)
+                    else:
                         self._process_train_batch(batch)
-                else:
-                    self._process_train_batch(batch)
-            count += 1
-            version = int(self.state.step)
-            if version % self._version_report_steps == 0:
-                with self._timing.record("report_version"):
-                    self._master.report_version(version)
-            with self._timing.record("checkpoint"):
-                self._checkpoint.maybe_save(self.state)
+                count += 1
+                version = int(self.state.step)
+                if version % self._version_report_steps == 0:
+                    with self._timing.record("report_version"):
+                        self._master.report_version(version)
+                with self._timing.record("checkpoint"):
+                    self._checkpoint.maybe_save(self.state)
+        finally:
+            if prepared_iter is not None:
+                prepared_iter.close()
+            # Drain the runner's async row applier at task granularity:
+            # a row-service push failure must fail THIS task (and a
+            # task-complete report must cover its last step's pushes —
+            # nothing may ride a daemon thread past process exit).
+            flush = getattr(self._step_runner, "flush", None)
+            if flush is not None:
+                import sys as _sys
+
+                try:
+                    flush()
+                except Exception:
+                    # Don't mask an in-flight exception with the
+                    # flush's own.
+                    if _sys.exc_info()[0] is None:
+                        raise
+                    logger.warning(
+                        "row applier flush failed during task "
+                        "unwind:\n%s", traceback.format_exc(),
+                    )
         return count
 
     def _process_train_task_fused(self, batch_list) -> int:

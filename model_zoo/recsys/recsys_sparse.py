@@ -1,0 +1,102 @@
+"""Large-vocabulary recsys ranker on the device-tier sparse plane.
+
+The production shape of the reference's PS-backed ``deepfm_edl_embedding``
+at real ad/recsys scale (``model_zoo/deepfm_edl_embedding``): a
+million-row embedding table trained sparsely — but TPU-native, the table
+lives in HBM and the whole step is one XLA program:
+
+- forward: Pallas row-streaming lookup (the measured winning tier —
+  D=256, <=64 ids/example: 1.44-3.12x over XLA gather+combine,
+  EMBEDDING_SWEEP.json),
+- update: in-place Pallas row kernels via ``sparse_apply`` (the
+  reference's C++ kernel family, kernel_api.cc) — no dense (V, D)
+  gradient, no optimizer traffic over untouched rows.
+
+``custom_model`` follows the zoo contract; ``make_sparse_runner`` is
+the step-runner factory (``elasticdl_tpu.embedding.device_sparse``),
+mirroring ``deepfm_host.make_host_runner`` for the host tier.
+"""
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from elasticdl_tpu.common import tensor_utils
+from elasticdl_tpu.common.constants import Mode
+from elasticdl_tpu.embedding.device_sparse import (
+    DeviceSparseRunner,
+    SparseEmbed,
+    TableSpec,
+)
+from elasticdl_tpu.embedding.optimizer import Adagrad
+from elasticdl_tpu.ops import masked_sigmoid_cross_entropy
+
+VOCAB = 1_000_000
+DIM = 256
+INPUT_LENGTH = 32  # ids per example — inside the kernel's winning tier
+TABLE_NAME = "item_emb"
+FEATURE_KEY = "ids"
+
+TABLE_SPECS = (
+    TableSpec(
+        name=TABLE_NAME, vocab=VOCAB, dim=DIM, combiner="sum",
+        feature_key=FEATURE_KEY,
+    ),
+)
+
+
+class RecsysRanker(nn.Module):
+    """Combined item embedding -> MLP -> click logit."""
+
+    hidden: tuple = (256, 128)
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, features, training=False):
+        emb = SparseEmbed(TABLE_NAME, DIM)()  # (B, DIM) from the runner
+        x = emb.astype(self.compute_dtype)
+        for width in self.hidden:
+            x = nn.relu(nn.Dense(width, dtype=self.compute_dtype)(x))
+        return nn.Dense(1, dtype=jnp.float32)(x)[..., 0]
+
+
+def custom_model():
+    return RecsysRanker()
+
+
+def loss(labels, predictions, mask):
+    return masked_sigmoid_cross_entropy(labels, predictions, mask)
+
+
+def optimizer(lr=0.001):
+    return optax.adam(lr)
+
+
+def make_sparse_runner(use_pallas: str = "auto") -> DeviceSparseRunner:
+    """Step-runner factory (the sparse-tier analogue of
+    deepfm_host.make_host_runner). Adagrad rows — the reference PS's
+    canonical sparse optimizer (optimizer_wrapper.py slot tables)."""
+    return DeviceSparseRunner(
+        TABLE_SPECS, Adagrad(lr=0.05), use_pallas=use_pallas
+    )
+
+
+def dataset_fn(records, mode, metadata):
+    ids, labels = [], []
+    for payload in records:
+        rec = tensor_utils.loads(payload)
+        ids.append(np.asarray(rec["feature_ids"], np.int64))
+        labels.append(int(rec.get("label", 0)))
+    features = {FEATURE_KEY: np.stack(ids)}
+    labels = np.asarray(labels, np.int32)
+    if mode == Mode.PREDICTION:
+        return features, np.zeros_like(labels)
+    return features, labels
+
+
+def eval_metrics_fn():
+    def accuracy(labels, outputs):
+        return float(np.mean((outputs > 0).astype(np.int32) == labels))
+
+    return {"accuracy": accuracy}

@@ -1,0 +1,303 @@
+"""Device-tier sparse embedding training (embedding/device_sparse.py).
+
+The in-HBM PS hot path: Pallas lookup forward, combiner-transpose row
+grads, in-place Pallas row-kernel updates — reference parity target is
+the Go PS + C++ kernels (pkg/ps/server.go, kernel_api.cc), restructured
+as one XLA program. CPU tests pin kernels through the interpreter;
+use_pallas='never' is the XLA reference the kernel path must match.
+"""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from elasticdl_tpu.embedding.device_sparse import (
+    DeviceSparseRunner,
+    SparseEmbed,
+    TableSpec,
+)
+from elasticdl_tpu.embedding.optimizer import Adagrad, make_row_optimizer
+
+VOCAB = 512
+DIM = 128  # lane-aligned so the interpreter kernels engage
+FIELDS = 6
+
+
+class TinySparseModel(nn.Module):
+    @nn.compact
+    def __call__(self, features, training=False):
+        emb = SparseEmbed("items", DIM)()
+        x = nn.relu(nn.Dense(32)(emb))
+        return nn.Dense(1, dtype=jnp.float32)(x)[..., 0]
+
+
+SPECS = (
+    TableSpec(name="items", vocab=VOCAB, dim=DIM, combiner="sum",
+              feature_key="ids"),
+)
+
+
+def loss_fn(labels, preds, mask):
+    per = optax.sigmoid_binary_cross_entropy(
+        preds, labels.astype(np.float32)
+    )
+    return (per * mask).sum() / jnp.maximum(mask.sum(), 1)
+
+
+def make_batch(rng, batch=16):
+    ids = rng.randint(0, VOCAB, (batch, FIELDS)).astype(np.int64)
+    # Learnable signal: slot 0 is one of two marker ids, and the label
+    # is which one — linearly separable from the summed embedding.
+    marker = rng.randint(0, 2, batch)
+    ids[:, 0] = np.where(marker == 1, 3, VOCAB - 5)
+    labels = marker.astype(np.int32)
+    return {
+        "features": {"ids": ids},
+        "labels": labels,
+        "mask": np.ones((batch,), np.float32),
+    }
+
+
+def _runner(use_pallas, opt=None):
+    return DeviceSparseRunner(
+        SPECS, opt or Adagrad(lr=0.05), use_pallas=use_pallas,
+    )
+
+
+def _train(runner, batches, seed=0):
+    state = runner.init_state(
+        TinySparseModel(), optax.sgd(0.1), batches[0], seed=seed
+    )
+    step = runner.train_step(loss_fn)
+    losses = []
+    for b in batches:
+        state, metrics = step(state, b)
+        losses.append(float(metrics["loss"]))
+    return state, losses
+
+
+def test_kernel_path_matches_xla_reference():
+    """The whole sparse step (lookup fwd + row grads + row-kernel
+    apply) on the interpreter must match the pure-XLA step."""
+    batches = [make_batch(np.random.RandomState(s)) for s in range(4)]
+    state_k, losses_k = _train(_runner("always"), batches)
+    state_x, losses_x = _train(_runner("never"), batches)
+    np.testing.assert_allclose(losses_k, losses_x, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(state_k.tables["items"]),
+        np.asarray(state_x.tables["items"]), rtol=1e-4, atol=1e-5,
+    )
+    np.testing.assert_allclose(
+        np.asarray(state_k.slot_tables["items"]["accumulator"]),
+        np.asarray(state_x.slot_tables["items"]["accumulator"]),
+        rtol=1e-4, atol=1e-5,
+    )
+
+
+def test_untouched_rows_and_slots_stay_put():
+    rng = np.random.RandomState(7)
+    batch = make_batch(rng)
+    runner = _runner("never")
+    state = runner.init_state(
+        TinySparseModel(), optax.sgd(0.1), batch, seed=0
+    )
+    before = np.asarray(state.tables["items"]).copy()
+    slots_before = np.asarray(
+        state.slot_tables["items"]["accumulator"]
+    ).copy()
+    step = runner.train_step(loss_fn)
+    state, _ = step(state, batch)
+    touched = np.unique(batch["features"]["ids"])
+    mask = np.ones(VOCAB, bool)
+    mask[touched] = False
+    np.testing.assert_array_equal(
+        np.asarray(state.tables["items"])[mask], before[mask]
+    )
+    np.testing.assert_array_equal(
+        np.asarray(state.slot_tables["items"]["accumulator"])[mask],
+        slots_before[mask],
+    )
+    # Touched rows actually moved.
+    assert not np.allclose(
+        np.asarray(state.tables["items"])[touched], before[touched]
+    )
+
+
+def test_training_learns():
+    rng = np.random.RandomState(0)
+    batches = [make_batch(rng, batch=32) for _ in range(30)]
+    _, losses = _train(_runner("never"), batches)
+    assert np.mean(losses[-5:]) < np.mean(losses[:5]) * 0.8
+
+
+def test_duplicate_ids_accumulate_row_grads():
+    """Two occurrences of one id in a batch must contribute BOTH
+    gradients (the combiner transpose scatter-adds duplicates)."""
+    runner = _runner("never")
+    base = make_batch(np.random.RandomState(3), batch=4)
+    ids = np.full((4, FIELDS), 7, np.int64)  # every slot = id 7
+    batch = dict(base, features={"ids": ids})
+    state = runner.init_state(TinySparseModel(), optax.sgd(0.1), batch)
+    before = np.asarray(state.tables["items"])[7].copy()
+    step = runner.train_step(loss_fn)
+    state, _ = step(state, batch)
+    moved_all = np.abs(
+        np.asarray(state.tables["items"])[7] - before
+    ).max()
+    # Single-occurrence control: same batch but only one slot = 7.
+    ids1 = np.asarray(
+        np.random.RandomState(3).randint(VOCAB // 2, VOCAB, (4, FIELDS))
+    )
+    ids1[0, 0] = 7
+    state2 = runner.init_state(
+        TinySparseModel(), optax.sgd(0.1),
+        dict(base, features={"ids": ids1}),
+    )
+    before2 = np.asarray(state2.tables["items"])[7].copy()
+    step2 = runner.train_step(loss_fn)
+    state2, _ = step2(state2, dict(base, features={"ids": ids1}))
+    moved_one = np.abs(
+        np.asarray(state2.tables["items"])[7] - before2
+    ).max()
+    assert moved_all > moved_one  # duplicates accumulated
+
+
+def test_multi_step_scan_matches_per_step():
+    from elasticdl_tpu.core.step import stack_batches
+
+    batches = [make_batch(np.random.RandomState(s)) for s in range(3)]
+    runner = _runner("never")
+    state = runner.init_state(
+        TinySparseModel(), optax.sgd(0.1), batches[0], seed=0
+    )
+    multi = runner.train_multi_step(loss_fn)
+    m_state, metrics = multi(state, stack_batches(batches))
+    state2, losses = _train(_runner("never"), batches)
+    np.testing.assert_allclose(
+        np.asarray(metrics["loss"]), losses, rtol=1e-4, atol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(m_state.tables["items"]),
+        np.asarray(state2.tables["items"]), rtol=1e-4, atol=1e-5,
+    )
+
+
+def test_eval_step_serves_live_rows():
+    batch = make_batch(np.random.RandomState(1))
+    runner = _runner("never")
+    state = runner.init_state(TinySparseModel(), optax.sgd(0.1), batch)
+    preds = runner.eval_step()(state, batch)
+    assert np.asarray(preds).shape == (16,)
+    assert np.all(np.isfinite(np.asarray(preds)))
+
+
+@pytest.mark.parametrize("opt_name", ["SGD", "Adam", "Adagrad"])
+def test_row_optimizers_through_the_step(opt_name):
+    opt = make_row_optimizer(opt_name, lr=0.05)
+    batches = [make_batch(np.random.RandomState(s)) for s in range(3)]
+    state_k, losses_k = _train(_runner("always", opt=opt), batches)
+    state_x, losses_x = _train(_runner("never", opt=opt), batches)
+    np.testing.assert_allclose(losses_k, losses_x, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(state_k.tables["items"]),
+        np.asarray(state_x.tables["items"]), rtol=1e-4, atol=1e-5,
+    )
+
+
+def test_recsys_zoo_contract_resolves():
+    """The zoo module exposes the sparse-runner contract (the full-size
+    table is bench/TPU territory — contract only here)."""
+    from elasticdl_tpu.core.model_spec import get_model_spec
+    from elasticdl_tpu.testing.data import model_zoo_dir
+
+    spec = get_model_spec(
+        model_zoo_dir(), "recsys.recsys_sparse.custom_model"
+    )
+    assert spec.make_sparse_runner is not None
+    runner = spec.make_sparse_runner(use_pallas="never")
+    assert isinstance(runner, DeviceSparseRunner)
+    assert runner.specs[0].vocab == 1_000_000
+    assert runner.specs[0].dim == 256
+
+
+class TestShardedKernelLookup:
+    """shard_map per-shard kernel lookup over a row-sharded table
+    (VERDICT r2 #2: lift the single-device restriction). Runs on the
+    8-device virtual CPU mesh; the kernel path goes through the
+    interpreter."""
+
+    def _mesh(self, n=4):
+        from elasticdl_tpu.parallel.mesh import make_mesh
+
+        devices = jax.devices("cpu")
+        if len(devices) < n:
+            pytest.skip(f"need {n} cpu devices")
+        return make_mesh((n,), ("tp",), devices=devices[:n])
+
+    @pytest.mark.parametrize("combiner", ["sum", "mean", "sqrtn"])
+    def test_matches_dense_reference(self, combiner):
+        from elasticdl_tpu.ops.pallas_embedding import (
+            lookup_combine,
+            lookup_combine_sharded,
+        )
+
+        mesh = self._mesh()
+        rng = np.random.RandomState(0)
+        table = jnp.asarray(rng.randn(64, 256), jnp.float32)
+        ids = jnp.asarray(rng.randint(0, 64, (8, 5)), jnp.int32)
+        w = jnp.asarray(rng.rand(8, 5), jnp.float32)
+        w = w.at[2, 3:].set(0.0)  # padding slots
+        got = lookup_combine_sharded(
+            table, ids, w, combiner, mesh, "tp",
+            interpret=True, force_pallas=True,
+        )
+        want = lookup_combine(table, ids, w, combiner, force_xla=True)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5
+        )
+
+    def test_gradients_match_dense_reference(self):
+        from elasticdl_tpu.ops.pallas_embedding import (
+            lookup_combine,
+            lookup_combine_sharded,
+        )
+
+        mesh = self._mesh()
+        rng = np.random.RandomState(1)
+        table = jnp.asarray(rng.randn(32, 256), jnp.float32)
+        ids = jnp.asarray(rng.randint(0, 32, (4, 3)), jnp.int32)
+        w = jnp.asarray(rng.rand(4, 3), jnp.float32)
+
+        def f_sharded(t):
+            return jnp.sum(lookup_combine_sharded(
+                t, ids, w, "mean", mesh, "tp",
+                interpret=True, force_pallas=True,
+            ) ** 2)
+
+        def f_dense(t):
+            return jnp.sum(
+                lookup_combine(t, ids, w, "mean", force_xla=True) ** 2
+            )
+
+        g_sharded = jax.grad(f_sharded)(table)
+        g_dense = jax.grad(f_dense)(table)
+        np.testing.assert_allclose(
+            np.asarray(g_sharded), np.asarray(g_dense),
+            rtol=1e-4, atol=1e-5,
+        )
+
+    def test_indivisible_vocab_rejected(self):
+        from elasticdl_tpu.ops.pallas_embedding import (
+            lookup_combine_sharded,
+        )
+
+        mesh = self._mesh()
+        table = jnp.zeros((63, 256), jnp.float32)
+        with pytest.raises(ValueError, match="not divisible"):
+            lookup_combine_sharded(
+                table, jnp.zeros((2, 2), jnp.int32),
+                jnp.ones((2, 2), jnp.float32), "sum", mesh, "tp",
+            )

@@ -239,7 +239,10 @@ class TestSparseApplyKernelDispatch:
         )
         return table, jnp.asarray(padded), grads, vocab, dim
 
-    @pytest.mark.parametrize("opt_name", ["SGD", "Momentum", "Adagrad", "Adam"])
+    @pytest.mark.parametrize(
+        "opt_name",
+        ["SGD", "Momentum", "Adagrad", "Adam", "AdamAmsgrad"],
+    )
     def test_kernel_path_matches_xla(self, opt_name):
         from elasticdl_tpu.embedding.optimizer import (
             init_slot_tables,
@@ -247,7 +250,10 @@ class TestSparseApplyKernelDispatch:
             sparse_apply,
         )
 
-        opt = make_row_optimizer(opt_name, lr=0.05)
+        if opt_name == "AdamAmsgrad":
+            opt = make_row_optimizer("Adam", lr=0.05, amsgrad=True)
+        else:
+            opt = make_row_optimizer(opt_name, lr=0.05)
         table, ids, grads, vocab, dim = self._fixture()
         slots = init_slot_tables(opt, vocab, dim)
 
@@ -301,6 +307,7 @@ class TestSparseApplyKernelDispatch:
         assert kernelizable(Momentum(), 128)
         assert kernelizable(Momentum(nesterov=True), 256)
         assert not kernelizable(SGD(), 100)        # lane-misaligned
-        assert not kernelizable(
+        # Round 3 closed the last gap vs kernel_api.cc: amsgrad too.
+        assert kernelizable(
             AdamAmsgrad(slot_names=("m", "v", "max_v")), 128
-        )  # amsgrad is the one XLA-only variant
+        )

@@ -304,8 +304,9 @@ def test_retried_push_after_relaunch_is_deduplicated(tmp_path):
 
     svc2 = fresh(port)
     try:
-        # Client (unaware the reply made it) retries the SAME seq.
-        opt._seq -= 1
+        # Client (unaware the reply made it) retries the SAME seq
+        # (seq streams are per-thread now; this thread owns one).
+        opt._local.seq -= 1
         opt.apply_gradients(table, ids, np.ones((1, DIM), np.float32))
         after = table.get(ids)
         # One application only: -lr * 1.0 = -0.5, not -1.0.

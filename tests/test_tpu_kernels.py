@@ -294,6 +294,46 @@ class TestEmbeddingKernelsOnChip:
         np.testing.assert_array_equal(np.asarray(got_t)[mask],
                                       table[mask])
 
+    def test_sparse_adam_amsgrad_matches_reference(self, tpu):
+        import jax
+        import jax.numpy as jnp
+
+        from elasticdl_tpu.embedding.optimizer import AdamAmsgrad
+        from elasticdl_tpu.ops.pallas_embedding import (
+            sparse_adam_amsgrad_update,
+        )
+
+        table = self._table()
+        m = self._table(seed=17) * 0.01
+        v = np.abs(self._table(seed=18)) * 0.01
+        max_v = np.abs(self._table(seed=19)) * 0.01
+        rng = np.random.RandomState(16)
+        ids = np.unique(rng.randint(0, 1024, 32)).astype(np.int32)
+        padded = np.concatenate([ids, [1024, 1024]]).astype(np.int32)
+        grads = rng.randn(len(padded), 128).astype(np.float32)
+        opt = AdamAmsgrad(lr=0.01)
+
+        got_t, got_m, got_v, got_mv = jax.jit(
+            lambda t, m_, v_, mv, i, g: sparse_adam_amsgrad_update(
+                t, m_, v_, mv, i, g, lr=0.01, step=5
+            )
+        )(jnp.asarray(table), jnp.asarray(m), jnp.asarray(v),
+          jnp.asarray(max_v), jnp.asarray(padded), jnp.asarray(grads))
+        want_rows, want_slots = opt.apply_rows(
+            table[ids], grads[:len(ids)],
+            {"m": m[ids], "v": v[ids], "max_v": max_v[ids]}, step=5,
+        )
+        np.testing.assert_allclose(np.asarray(got_t)[ids], want_rows,
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(got_mv)[ids],
+                                   want_slots["max_v"],
+                                   rtol=1e-5, atol=1e-6)
+        mask = np.ones(1024, bool)
+        mask[ids] = False
+        np.testing.assert_array_equal(np.asarray(got_t)[mask],
+                                      table[mask])
+        np.testing.assert_array_equal(np.asarray(got_mv)[mask], max_v[mask])
+
     def test_sparse_momentum_matches_reference(self, tpu):
         import jax
         import jax.numpy as jnp

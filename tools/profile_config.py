@@ -1,0 +1,164 @@
+"""Op-level device-time profile of a bench_suite config.
+
+Runs one measured round of the config's fused task program under
+``jax.profiler`` and aggregates the trace's device "XLA Ops" lane by op
+bucket — the committed evidence for per-config MFU claims (VERDICT
+round 2 asked for profile breakdowns, not inferences).
+
+Usage:
+    python tools/profile_config.py resnet50
+    python tools/profile_config.py transformer --top 25
+
+Prints one JSON line per bucket (device ms per task program, share of
+device time) plus a summary line, and appends the summary to
+PROFILES.json keyed by config.
+"""
+
+import argparse
+import collections
+import glob
+import gzip
+import json
+import os
+import re
+import sys
+import tempfile
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, HERE)
+
+from benchlib import enable_bench_compile_cache, load_json  # noqa: E402
+
+PROFILES_FILE = os.path.join(HERE, "PROFILES.json")
+
+
+def bucket(op_name: str) -> str:
+    """Collapse XLA op names into readable buckets: fusion kinds keep
+    their leading fused-op hint (e.g. 'convolution_tanh_fusion' ->
+    'convolution'), numbered clones collapse (fusion.123 -> fusion)."""
+    name = op_name.split("(")[0]
+    name = re.sub(r"\.\d+$", "", name)
+    for key in ("convolution", "dot", "scatter", "gather", "reduce",
+                "transpose", "copy", "all-reduce", "dynamic-slice",
+                "dynamic-update-slice", "custom-call", "select-and-scatter"):
+        if key in name:
+            return key
+    if "fusion" in name:
+        return "fusion(elementwise)"
+    return name
+
+
+def ops_profile(trace_dir):
+    """{bucket: total_ms} + n_programs from the newest trace."""
+    paths = sorted(glob.glob(os.path.join(
+        trace_dir, "plugins/profile/*/*.trace.json.gz"
+    )))
+    if not paths:
+        return {}, 0
+    with gzip.open(paths[-1]) as f:
+        trace = json.load(f)
+    events = trace.get("traceEvents", [])
+    dev_pids, lanes = set(), {}
+    for e in events:
+        if e.get("ph") != "M":
+            continue
+        args = e.get("args") or {}
+        if e.get("name") == "process_name" and "/device:" in (
+            args.get("name") or ""
+        ):
+            dev_pids.add(e.get("pid"))
+        if e.get("name") == "thread_name":
+            lanes[(e.get("pid"), e.get("tid"))] = args.get("name")
+    totals = collections.Counter()
+    modules = []
+    for e in events:
+        if e.get("ph") != "X" or e.get("pid") not in dev_pids:
+            continue
+        lane = lanes.get((e.get("pid"), e.get("tid")))
+        if lane == "XLA Modules":
+            modules.append(e.get("name") or "")
+        elif lane == "XLA Ops":
+            totals[bucket(e.get("name") or "?")] += e.get("dur", 0) / 1e3
+    # Only the measured task program counts — the trace window also
+    # catches trivial helper programs (convert_element_type of the loss
+    # readback etc.) which must not dilute the per-program average.
+    n_programs = sum("multi_step" in m for m in modules) or len(modules)
+    return dict(totals), n_programs
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("config")
+    ap.add_argument("--top", type=int, default=15)
+    args = ap.parse_args()
+
+    enable_bench_compile_cache()
+    import jax
+
+    import bench_suite
+    from elasticdl_tpu.core.model_spec import get_model_spec
+    from elasticdl_tpu.core.step import build_multi_step, stack_batches
+    from elasticdl_tpu.core.train_state import init_train_state
+    from elasticdl_tpu.testing.data import model_zoo_dir
+
+    name = args.config
+    model_def, batch, steps, measure_tasks = bench_suite.CONFIGS[name]
+    spec = get_model_spec(model_zoo_dir(), model_def)
+    if name.startswith("transformer"):
+        spec = bench_suite._transformer_spec(spec, name)
+    rng = np.random.RandomState(0)
+    task = jax.device_put(stack_batches(
+        [bench_suite._make_batch(name, batch, rng) for _ in range(steps)]
+    ))
+    state = init_train_state(
+        spec.model, spec.make_optimizer(),
+        jax.tree.map(lambda x: x[0], task), seed=0,
+    )
+    multi_step = build_multi_step(spec.loss)
+    for _ in range(2):  # warmup/compile
+        state, metrics = multi_step(state, task)
+    float(np.asarray(metrics["loss"][-1]))
+
+    with tempfile.TemporaryDirectory(prefix="profile_cfg_") as td:
+        jax.profiler.start_trace(td)
+        for _ in range(measure_tasks):
+            state, metrics = multi_step(state, task)
+        float(np.asarray(metrics["loss"][-1]))
+        jax.profiler.stop_trace()
+        totals, n_programs = ops_profile(td)
+
+    if not totals:
+        raise SystemExit("no device ops in trace (CPU backend?)")
+    n_programs = max(n_programs, 1)
+    device_ms = sum(totals.values())
+    rows = sorted(totals.items(), key=lambda kv: -kv[1])
+    out_rows = []
+    for op, ms in rows[:args.top]:
+        row = {
+            "op": op,
+            "ms_per_task": round(ms / n_programs, 3),
+            "share": round(ms / device_ms, 4),
+        }
+        out_rows.append(row)
+        print(json.dumps(row))
+    summary = {
+        "config": name,
+        "batch": batch, "steps_per_task": steps,
+        "device_ms_per_task": round(device_ms / n_programs, 2),
+        "device_ms_per_step": round(device_ms / n_programs / steps, 3),
+        "n_programs": n_programs,
+        "top_ops": out_rows,
+    }
+    print(json.dumps({k: v for k, v in summary.items()
+                      if k != "top_ops"}))
+    profiles = load_json(PROFILES_FILE, {})
+    profiles[name] = summary
+    with open(PROFILES_FILE, "w") as f:
+        json.dump(profiles, f, indent=1)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
