@@ -78,11 +78,11 @@ CONFIGS = {
     # real LM training. Fewer steps/task: each step is ~6x the d512
     # cost, so dispatch amortization needs less fusing.
     "transformer_l": ("transformer.transformer_lm.custom_model", 8, 8, 2),
-    # Large-recsys flagship: 1M x 256 table trained sparsely in HBM —
-    # the Pallas lookup + in-place row-update kernels' production
-    # config (the measured winning tier, EMBEDDING_SWEEP.json). The
-    # suite measures it twice (auto vs force_xla) and records the
-    # kernel speedup alongside the gated rate.
+    # Large-recsys flagship: 1M x 256 table trained through the
+    # device-tier sparse plane (embedding/device_sparse.py) — row grads
+    # for only the touched ids, scatter-apply, no dense (V, D) gradient.
+    # The suite also measures the dense-embedding control (same model,
+    # flax Embed + dense optimizer) and records the sparse/dense ratio.
     "recsys": ("recsys.recsys_sparse.custom_model", 512, 64, 2),
 }
 TRANSFORMER_SEQ = 1024
@@ -165,14 +165,13 @@ def _make_batch(name, batch, rng):
     }
 
 
-def run_config(name, use_pallas=None):
+def run_config(name):
     """Measure one config; returns the benchlib.measure_multi_step dict
-    with transformer rates scaled to tokens/sec. For the sparse recsys
-    config the result also carries the paired force-XLA measurement
-    (``rate_xla_device``/``kernel_speedup_device``) — the committed
-    evidence that the production Pallas path beats the XLA path."""
-    import functools
-
+    with transformer rates scaled to tokens/sec. The sparse recsys
+    config also carries its paired dense-embedding control
+    (``rate_dense``/``rate_dense_device``/``sparse_speedup_vs_dense``)
+    — the committed evidence for the sparse plane's architectural
+    win."""
     import jax
 
     from elasticdl_tpu.core.model_spec import get_model_spec
@@ -183,10 +182,6 @@ def run_config(name, use_pallas=None):
     spec = get_model_spec(model_zoo_dir(), model_def)
     if name.startswith("transformer"):
         spec = _transformer_spec(spec, name)
-    if use_pallas is not None and spec.make_sparse_runner is not None:
-        spec.make_sparse_runner = functools.partial(
-            spec.make_sparse_runner, use_pallas=use_pallas
-        )
     rng = np.random.RandomState(0)
     task = jax.device_put(
         stack_batches([_make_batch(name, batch, rng) for _ in range(steps)])
@@ -197,13 +192,29 @@ def run_config(name, use_pallas=None):
     if name.startswith("transformer"):
         for key in ("eps", "eps_median", "eps_device"):
             measured[key] *= TRANSFORMER_SEQ  # examples/sec -> tokens/sec
-    if name == "recsys" and use_pallas is None:
-        xla = run_config(name, use_pallas="never")
-        measured["rate_xla"] = round(xla["eps"], 2)
-        measured["rate_xla_device"] = round(xla["eps_device"], 2)
-        if xla["eps_device"] and measured["eps_device"]:
-            measured["kernel_speedup_device"] = round(
-                measured["eps_device"] / xla["eps_device"], 4
+    if name == "recsys":
+        # Paired dense-embedding control (same model, table as a flax
+        # Embed under the dense optimizer): the ratio is the sparse
+        # plane's architectural win — no dense (V, D) gradient, no
+        # full-table optimizer traffic. (The Pallas-vs-XLA kernel
+        # comparison lives in tools/bench_kernel_device_sweep.py /
+        # EMBEDDING_SWEEP.json; auto-dispatch takes XLA — see
+        # ops/pallas_embedding.py round-3 note.)
+        import dataclasses
+
+        dense_spec = dataclasses.replace(
+            spec, model=spec.module.dense_model(),
+            make_sparse_runner=None,
+        )
+        dense = measure_multi_step(
+            dense_spec, task, batch, steps, measure_tasks,
+            compute_mfu=False,
+        )
+        measured["rate_dense"] = round(dense["eps"], 2)
+        measured["rate_dense_device"] = round(dense["eps_device"], 2)
+        if dense["eps_device"] and measured["eps_device"]:
+            measured["sparse_speedup_vs_dense"] = round(
+                measured["eps_device"] / dense["eps_device"], 4
             )
     return measured
 
@@ -337,8 +348,8 @@ def main():
                 measured.get("tflops_per_sec", 0.0), 2
             ),
         }
-        for extra in ("rate_xla", "rate_xla_device",
-                      "kernel_speedup_device"):
+        for extra in ("rate_dense", "rate_dense_device",
+                      "sparse_speedup_vs_dense"):
             if extra in measured:
                 results[name][extra] = measured[extra]
         print(json.dumps({

@@ -51,12 +51,14 @@ class Embedding(nn.Module):
     output_dim: int
     combiner: Optional[str] = None
     param_dtype: jnp.dtype = jnp.float32
-    # Pallas row-streaming lookup for the ragged path: None = auto
-    # (kernel on the measured winning tier — ops/pallas_embedding
-    # use_pallas_lookup — but only on single-device runs: under a
-    # sharded mesh the kernel would force GSPMD to materialize the
-    # full table per shard, so mesh models keep the XLA gather that
-    # GSPMD partitions natively). True/False pin a path.
+    # Pallas row-streaming lookup for the ragged path: None = auto,
+    # which takes XLA — round-3 device-time measurement overturned the
+    # round-2 wall-clock kernel tiers (ops/pallas_embedding
+    # use_pallas_lookup, dispatch note there). True pins the kernel
+    # (single-device only: under a sharded mesh it would force GSPMD
+    # to materialize the full table per shard — use
+    # lookup_combine_sharded for an explicit per-shard kernel);
+    # False pins XLA.
     pallas: Optional[bool] = None
 
     def _use_pallas(self, table, ids):

@@ -228,21 +228,23 @@ def sparse_apply(opt: RowOptimizer, table, slot_tables: Dict[str, "jnp.ndarray"]
     race its duplicate scatter and, for Adam/Adagrad, corrupt slot state
     even with zero grad. The Pallas kernels skip OOR ids outright.
 
-    ``use_pallas``: "auto" routes supported (opt, dim) pairs through the
-    in-place Pallas kernels (one HBM read+write per touched row vs the
-    XLA gather/scatter's two of each); "never"/"always" pin a path.
+    ``use_pallas``: "auto" takes the XLA gather/scatter path — round-3
+    device-time measurement showed the per-row-DMA kernels lose to it
+    at every size (the flat-view retiling copy plus ~0.05us/row DMA
+    latency; see ops/pallas_embedding.py's dispatch note), overturning
+    round-2's wall-clock tiers. "always" pins the kernels (reference
+    -parity implementations, on-chip tested); "never" pins XLA
+    explicitly.
     """
     if use_pallas not in ("auto", "never", "always"):
         raise ValueError(f"use_pallas={use_pallas!r}")
-    import jax
-
     from elasticdl_tpu.ops import pallas_embedding as pe
 
     dim = int(table.shape[1])
-    # "always" must fail with a clear message up front, not deep inside
-    # pallas_call with an opaque input_output_aliases shape error
-    # (mirrors lookup_combine's force_pallas validation).
     if use_pallas == "always":
+        # Fail with a clear message up front, not deep inside
+        # pallas_call with an opaque input_output_aliases shape error
+        # (mirrors lookup_combine's force_pallas validation).
         if not pe.dim_supported(dim):
             raise ValueError(
                 f"use_pallas='always' needs dim % {pe.LANE} == 0, "
@@ -253,14 +255,6 @@ def sparse_apply(opt: RowOptimizer, table, slot_tables: Dict[str, "jnp.ndarray"]
                 f"use_pallas='always': no Pallas kernel for "
                 f"{type(opt).__name__} (kernelizable() is False)"
             )
-    # Auto only engages where the Mosaic kernels actually lower: the
-    # TPU backend (or the interpreter, which tests use on CPU).
-    kernel_ok = kernelizable(opt, dim) and (
-        interpret or jax.default_backend() == "tpu"
-    )
-    if use_pallas == "always" or (
-        use_pallas == "auto" and kernel_ok
-    ):
         return _pallas_sparse_apply(
             opt, table, slot_tables, unique_ids, row_grads, step,
             interpret=interpret,

@@ -185,25 +185,34 @@ def lookup_combine_pallas(table, ids, weights, combiner: str,
     return out.reshape(padded, dim)[:batch]
 
 
-# Auto-dispatch tier, measured on v5e over a 1M-row (>>VMEM) table
-# (tools/bench_embedding_sweep.py → EMBEDDING_SWEEP.json, two timing
-# harnesses agree): the kernel reads each touched row from HBM exactly
-# once while the XLA path materializes and re-reads the (B, L, D)
-# gather intermediate, so the kernel wins on WIDE rows — 1.44-3.12x at
-# D=256/512 with L<=64 — and loses where per-row DMA count dominates
-# (D=128 is a wash; L=128 at D=512 is 0.3x). Dispatch takes the kernel
-# for D >= 256 with L <= 64.
-PALLAS_MIN_DIM = 256
+# Auto-dispatch: NEVER take the row-DMA kernel — XLA's native gather
+# wins everywhere once timing is done on DEVICE time instead of wall
+# clock. Round-2's recorded 1.44-3.12x kernel wins (the old
+# EMBEDDING_SWEEP.json) came from a wall-clock harness whose numbers
+# (0.017 ms for 65k rows = an impossible 3.8 TB/s) were dominated by
+# host/dispatch artifacts; the round-3 trace-based re-measurement
+# (tools/bench_kernel_device_sweep.py, EMBEDDING_SWEEP.json) puts the
+# kernel at 0.01-0.10x of XLA across every tier — two structural
+# reasons, both visible in the traces:
+#  1. Mosaic only accepts (1, 128) HBM slices, so the (V, D) table must
+#     be viewed as (V·C, 128); that reshape is a full-table RETILING
+#     COPY per call (~2.5 ms/GB on v5e) which also severs the in-place
+#     aliasing chain.
+#  2. Even ignoring the copy, the per-row chunk-DMA chain sustains
+#     ~0.05 us/row (~19 GB/s effective) against XLA's coalesced gather.
+# The kernels remain available behind force_pallas (reference-parity
+# implementations, on-chip tested); production dispatch is XLA.
+PALLAS_MIN_DIM = 256   # kept: force_pallas callers still tier on these
 PALLAS_MAX_IDS = 64
 
 
 def use_pallas_lookup(dim: int, num_ids: int) -> bool:
-    """The measured auto-dispatch rule (see PALLAS_MIN_DIM/MAX_IDS)."""
-    return (
-        dim_supported(dim)
-        and dim >= PALLAS_MIN_DIM
-        and num_ids <= PALLAS_MAX_IDS
-    )
+    """Auto-dispatch rule: always False (see the measurement note
+    above — device-time profiling overturned the round-2 wall-clock
+    tiers). Kept as the single dispatch predicate so a future kernel
+    redesign changes one function."""
+    del dim, num_ids
+    return False
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))

@@ -374,13 +374,19 @@ class Worker:
             if flush is not None:
                 import sys as _sys
 
+                # Snapshot whether an exception is already propagating
+                # BEFORE calling flush — inside an except block
+                # exc_info() would report the flush's own error and the
+                # re-raise would be unreachable, silently downgrading a
+                # lost-push failure to a warning.
+                unwinding = _sys.exc_info()[0] is not None
                 try:
                     flush()
                 except Exception:
-                    # Don't mask an in-flight exception with the
-                    # flush's own.
-                    if _sys.exc_info()[0] is None:
+                    if not unwinding:
                         raise
+                    # Don't mask the in-flight exception with the
+                    # flush's own.
                     logger.warning(
                         "row applier flush failed during task "
                         "unwind:\n%s", traceback.format_exc(),

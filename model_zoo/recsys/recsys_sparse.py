@@ -5,12 +5,15 @@ at real ad/recsys scale (``model_zoo/deepfm_edl_embedding``): a
 million-row embedding table trained sparsely — but TPU-native, the table
 lives in HBM and the whole step is one XLA program:
 
-- forward: Pallas row-streaming lookup (the measured winning tier —
-  D=256, <=64 ids/example: 1.44-3.12x over XLA gather+combine,
-  EMBEDDING_SWEEP.json),
-- update: in-place Pallas row kernels via ``sparse_apply`` (the
-  reference's C++ kernel family, kernel_api.cc) — no dense (V, D)
-  gradient, no optimizer traffic over untouched rows.
+- forward reads only the looked-up rows (``lookup_combine``
+  auto-dispatch — XLA's coalesced gather per the round-3 device-time
+  measurement, EMBEDDING_SWEEP.json; the Pallas kernels sit behind
+  force flags),
+- backward produces row grads for only the batch's unique ids, and
+  ``sparse_apply`` scatter-updates just those rows — no dense (V, D)
+  gradient, no optimizer traffic over untouched rows. Measured 3.3x
+  over dense-embedding training of the same model on v5e (the
+  ``recsys`` bench config's recorded ``sparse_speedup_vs_dense``).
 
 ``custom_model`` follows the zoo contract; ``make_sparse_runner`` is
 the step-runner factory (``elasticdl_tpu.embedding.device_sparse``),
@@ -34,7 +37,7 @@ from elasticdl_tpu.ops import masked_sigmoid_cross_entropy
 
 VOCAB = 1_000_000
 DIM = 256
-INPUT_LENGTH = 32  # ids per example — inside the kernel's winning tier
+INPUT_LENGTH = 32  # ids per example (padded-ragged width)
 TABLE_NAME = "item_emb"
 FEATURE_KEY = "ids"
 
@@ -63,6 +66,31 @@ class RecsysRanker(nn.Module):
 
 def custom_model():
     return RecsysRanker()
+
+
+class RecsysRankerDense(nn.Module):
+    """Dense-embedding control: the SAME ranker with the table as an
+    ordinary flax Embed trained by the dense optimizer — what training
+    this model WITHOUT the sparse plane costs (a dense (V, D) gradient
+    plus full-table optimizer traffic every step). The bench measures
+    both; the ratio is the sparse plane's architectural win."""
+
+    hidden: tuple = (256, 128)
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, features, training=False):
+        ids = jnp.asarray(features[FEATURE_KEY], jnp.int32)
+        table = nn.Embed(VOCAB, DIM, name="item_emb")
+        emb = table(ids).sum(axis=1)  # (B, L, D) -> (B, D) sum combine
+        x = emb.astype(self.compute_dtype)
+        for width in self.hidden:
+            x = nn.relu(nn.Dense(width, dtype=self.compute_dtype)(x))
+        return nn.Dense(1, dtype=jnp.float32)(x)[..., 0]
+
+
+def dense_model():
+    return RecsysRankerDense()
 
 
 def loss(labels, predictions, mask):

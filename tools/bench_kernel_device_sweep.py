@@ -1,0 +1,141 @@
+"""DEVICE-TIME kernel-vs-XLA sweep for the embedding ops.
+
+Round-3 replacement for the retired wall-clock sweep
+(tools/bench_embedding_sweep.py): every number here is per-program
+device execution time read off the profiler trace
+(benchlib.module_device_times), so host dispatch and tunnel weather
+cannot contaminate the comparison — the flaw that made the round-2
+sweep report physically impossible rates (0.017 ms for 65k x 1 KB row
+reads = 3.8 TB/s) and a phantom 1.44-3.12x kernel win.
+
+Measures, at production-like sizes over a 1M-row table:
+  - lookup_combine: force_pallas vs force_xla,
+  - sparse_apply (Adagrad): use_pallas always vs never, with the table
+    state DONATED and threaded between calls (without donation both
+    paths degrade to full-table copies and the comparison is
+    meaningless — the round-2 harness also missed this).
+
+Writes EMBEDDING_SWEEP.json. Run on the TPU, nothing else on the host.
+"""
+
+import json
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, HERE)
+
+from benchlib import enable_bench_compile_cache, module_device_times  # noqa: E402
+
+OUT_FILE = os.path.join(HERE, "EMBEDDING_SWEEP.json")
+VOCAB = 1_000_000
+
+
+def device_ms(run, args, reps=10, donate_state=False):
+    """Median per-program device ms over ``reps`` traced calls."""
+    import jax
+
+    out = None
+    state = args
+    for _ in range(3):
+        out = run(*state)
+        if donate_state:
+            state = (*out, *args[len(out):])
+    jax.block_until_ready(out)
+    td = tempfile.mkdtemp(prefix="sweep_")
+    jax.profiler.start_trace(td)
+    for _ in range(reps):
+        out = run(*state)
+        if donate_state:
+            state = (*out, *args[len(out):])
+    jax.block_until_ready(out)
+    jax.profiler.stop_trace()
+    times = module_device_times(td, name_filter="jit_")
+    return float(np.median(times)) if times else float("nan")
+
+
+def sweep():
+    import jax
+    import jax.numpy as jnp
+
+    from elasticdl_tpu.embedding.optimizer import (
+        Adagrad,
+        init_slot_tables,
+        sparse_apply,
+    )
+    from elasticdl_tpu.ops import pallas_embedding as pe
+
+    rng = np.random.RandomState(0)
+    results = {"platform": jax.devices()[0].platform,
+               "device_kind": getattr(jax.devices()[0], "device_kind", ""),
+               "method": "per-program device time off the profiler "
+                         "trace (benchlib.module_device_times); update "
+                         "path donated+threaded",
+               "lookup": [], "sparse_update": []}
+
+    for dim, L, B in [(256, 32, 64), (256, 32, 512), (256, 64, 1024),
+                      (512, 64, 1024)]:
+        table = jnp.asarray(rng.randn(VOCAB, dim).astype(np.float32))
+        ids = jnp.asarray(rng.randint(0, VOCAB, (B, L)), jnp.int32)
+        w = jnp.ones((B, L), jnp.float32)
+
+        def mk(fp):
+            def f(t, i, ww):
+                return pe.lookup_combine(
+                    t, i, ww, "sum", force_pallas=fp, force_xla=not fp
+                )
+            return jax.jit(f)
+
+        k = device_ms(mk(True), (table, ids, w))
+        x = device_ms(mk(False), (table, ids, w))
+        row = {"dim": dim, "L": L, "batch": B, "vocab": VOCAB,
+               "pallas_ms": round(k, 4), "xla_ms": round(x, 4),
+               "pallas_speedup": round(x / k, 4) if k else None}
+        results["lookup"].append(row)
+        print(json.dumps(row), flush=True)
+        del table
+
+    dim = 256
+    opt = Adagrad(lr=0.05)
+    for n in [256, 4096, 16384]:
+        table = jnp.asarray(rng.randn(VOCAB, dim).astype(np.float32))
+        slots = init_slot_tables(opt, VOCAB, dim)["accumulator"]
+        ids = np.unique(rng.randint(0, VOCAB, n)).astype(np.int32)
+        padded = jnp.asarray(np.concatenate([ids, [VOCAB]], 0), jnp.int32)
+        grads = jnp.asarray(
+            rng.randn(len(ids) + 1, dim).astype(np.float32)
+        )
+
+        def mk(mode):
+            def f(t, s, i, g):
+                t2, s2 = sparse_apply(
+                    opt, t, {"accumulator": s}, i, g, step=1,
+                    use_pallas=mode,
+                )
+                return t2, s2["accumulator"]
+            return jax.jit(f, donate_argnums=(0, 1))
+
+        k = device_ms(mk("always"), (table, slots, padded, grads),
+                      donate_state=True)
+        table = jnp.asarray(rng.randn(VOCAB, dim).astype(np.float32))
+        slots = init_slot_tables(opt, VOCAB, dim)["accumulator"]
+        x = device_ms(mk("never"), (table, slots, padded, grads),
+                      donate_state=True)
+        row = {"dim": dim, "rows": int(len(ids)), "vocab": VOCAB,
+               "pallas_ms": round(k, 4), "xla_ms": round(x, 4),
+               "pallas_speedup": round(x / k, 4) if k else None}
+        results["sparse_update"].append(row)
+        print(json.dumps(row), flush=True)
+        del table
+
+    with open(OUT_FILE, "w") as f:
+        json.dump(results, f, indent=1)
+    return 0
+
+
+if __name__ == "__main__":
+    enable_bench_compile_cache()
+    sys.exit(sweep())

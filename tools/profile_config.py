@@ -112,11 +112,21 @@ def main():
     task = jax.device_put(stack_batches(
         [bench_suite._make_batch(name, batch, rng) for _ in range(steps)]
     ))
-    state = init_train_state(
-        spec.model, spec.make_optimizer(),
-        jax.tree.map(lambda x: x[0], task), seed=0,
-    )
-    multi_step = build_multi_step(spec.loss)
+    if getattr(spec, "make_sparse_runner", None):
+        # Sparse-plane configs (recsys) need their runner's step —
+        # mirrors benchlib.measure_multi_step's branch.
+        runner = spec.make_sparse_runner()
+        state = runner.init_state(
+            spec.model, spec.make_optimizer(),
+            jax.tree.map(lambda x: x[0], task), seed=0,
+        )
+        multi_step = runner.train_multi_step(spec.loss)
+    else:
+        state = init_train_state(
+            spec.model, spec.make_optimizer(),
+            jax.tree.map(lambda x: x[0], task), seed=0,
+        )
+        multi_step = build_multi_step(spec.loss)
     for _ in range(2):  # warmup/compile
         state, metrics = multi_step(state, task)
     float(np.asarray(metrics["loss"][-1]))
