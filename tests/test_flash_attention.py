@@ -83,11 +83,31 @@ def test_flash_bf16_inputs():
 
 
 def test_supports_gate():
+    # Default path: S needs a LANE-ALIGNED (x128) tiling block — the
+    # shapes the on-chip lane actually compiles (round-3 block sweep).
     assert supports((2, 256, 4, 16))
-    assert supports((2, 32, 4, 16))      # small aligned S: blocks clamp
-    assert supports((2, 200, 4, 16))     # <= one clamped block
+    assert supports((2, 1024, 4, 16))
+    assert supports((2, 1536, 4, 16))   # 768-blocks tile it
+    assert supports((2, 3584, 4, 16))   # 512-blocks tile it
     assert not supports((2, 100, 4, 16))  # not sublane-aligned
-    assert not supports((2, 520, 4, 16))  # doesn't tile by the block
+    assert not supports((2, 520, 4, 16))  # no x128 divisor block
+    assert not supports((2, 200, 4, 16))
+    # Small-S models take dense attention (flash has nothing to save).
+    assert not supports((2, 32, 4, 16))
+    # Explicit blocks keep the raw divisibility rule (interpret tests).
+    assert supports((2, 32, 4, 16), block_q=32, block_k=32)
+    assert not supports((2, 520, 4, 16), block_q=256, block_k=256)
+
+
+def test_auto_block_picks_lane_aligned_divisors():
+    from elasticdl_tpu.ops.flash_attention import _auto_block
+
+    assert _auto_block(1024, 1024) == 1024
+    assert _auto_block(1536, 1024) == 768
+    assert _auto_block(3584, 1024) == 896  # largest x128 divisor <= cap
+    assert _auto_block(512, 1024) == 512
+    assert _auto_block(520, 1024) == 0
+    assert _auto_block(32, 1024) == 0
 
 
 def test_unaligned_seq_raises():

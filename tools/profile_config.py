@@ -34,12 +34,23 @@ from benchlib import enable_bench_compile_cache, load_json  # noqa: E402
 PROFILES_FILE = os.path.join(HERE, "PROFILES.json")
 
 
-def bucket(op_name: str) -> str:
-    """Collapse XLA op names into readable buckets: fusion kinds keep
-    their leading fused-op hint (e.g. 'convolution_tanh_fusion' ->
-    'convolution'), numbered clones collapse (fusion.123 -> fusion)."""
-    name = op_name.split("(")[0]
-    name = re.sub(r"\.\d+$", "", name)
+# Container ops whose children are ALSO on the ops lane — counting both
+# would double every scan body (the fused task program is a lax.scan).
+_CONTAINER_OPS = ("while", "conditional", "call")
+
+
+def bucket(op_name: str, category: str = "") -> str:
+    """Collapse XLA op names into readable buckets; "" for container
+    ops (while/conditional/call) whose children are ALSO on the ops
+    lane — counting both would double every lax.scan body. The trace's
+    ``hlo_category`` arg (e.g. 'convolution fusion', 'loop fusion') is
+    the authoritative kind — generic 'fusion.N' names say nothing about
+    the fused root; fall back to name keywords without it."""
+    name = re.sub(r"\.\d+$", "", op_name.split("(")[0])
+    if name in _CONTAINER_OPS:
+        return ""
+    if category:
+        return category
     for key in ("convolution", "dot", "scatter", "gather", "reduce",
                 "transpose", "copy", "all-reduce", "dynamic-slice",
                 "dynamic-update-slice", "custom-call", "select-and-scatter"):
@@ -80,7 +91,12 @@ def ops_profile(trace_dir):
         if lane == "XLA Modules":
             modules.append(e.get("name") or "")
         elif lane == "XLA Ops":
-            totals[bucket(e.get("name") or "?")] += e.get("dur", 0) / 1e3
+            key = bucket(
+                e.get("name") or "?",
+                (e.get("args") or {}).get("hlo_category", ""),
+            )
+            if key:  # "" = container op; children counted individually
+                totals[key] += e.get("dur", 0) / 1e3
     # Only the measured task program counts — the trace window also
     # catches trivial helper programs (convert_element_type of the loss
     # readback etc.) which must not dilute the per-program average.
