@@ -101,15 +101,23 @@ class OrbaxSaver:
 
 
 def save_state(saver: OrbaxSaver, state) -> str:
-    """Save a TrainState's array leaves (apply_fn/tx are static)."""
+    """Save a TrainState's array leaves (apply_fn/tx are static).
+
+    Fields come from the dataclass via ``state_io._state_trees`` — the
+    same discovery the native backend uses — so TrainState SUBCLASS
+    state (SparseTrainState's tables/slot_tables/table_steps) rides the
+    checkpoint instead of silently dropping out of a hardcoded list.
+    Each field stores as its leaves list (optax states and custom
+    pytrees aren't orbax-serializable as structure; the restore side
+    unflattens against the live state's treedef).
+    """
     import jax
 
+    from elasticdl_tpu.checkpoint.state_io import _state_trees
+
     tree = {
-        "step": state.step,
-        "params": state.params,
-        "batch_stats": state.batch_stats,
-        "opt_state": jax.tree.leaves(state.opt_state),
-        "rng": state.rng,
+        name: jax.tree.leaves(field_tree)
+        for name, field_tree in _state_trees(state)
     }
     return saver.save(int(state.step), tree)
 
@@ -121,6 +129,8 @@ def restore_state(saver: OrbaxSaver, state,
     on one mesh restores re-placed onto another (mesh-resize path)."""
     import jax
 
+    from elasticdl_tpu.checkpoint.state_io import _state_trees
+
     def abstract(tree):
         return jax.tree.map(
             lambda leaf: jax.ShapeDtypeStruct(
@@ -131,21 +141,15 @@ def restore_state(saver: OrbaxSaver, state,
             tree,
         )
 
+    fields = list(_state_trees(state))
     target = {
-        "step": state.step,
-        "params": state.params,
-        "batch_stats": state.batch_stats,
-        "opt_state": jax.tree.leaves(state.opt_state),
-        "rng": state.rng,
+        name: jax.tree.leaves(field_tree) for name, field_tree in fields
     }
     restored = saver.restore_tree(abstract(target), version=version)
-    opt_state = jax.tree.unflatten(
-        jax.tree.structure(state.opt_state), restored["opt_state"]
-    )
-    return state.replace(
-        step=restored["step"],
-        params=restored["params"],
-        batch_stats=restored["batch_stats"],
-        opt_state=opt_state,
-        rng=restored["rng"],
-    )
+    new_fields = {
+        name: jax.tree.unflatten(
+            jax.tree.structure(field_tree), restored[name]
+        )
+        for name, field_tree in fields
+    }
+    return state.replace(**new_fields)

@@ -9,10 +9,24 @@ freshly initialized state's leaves by path, which also revalidates
 structure compatibility.
 """
 
+import dataclasses
 from typing import Dict
 
 import jax
 import numpy as np
+
+# Non-pytree callables the state carries (struct.field(pytree_node=
+# False)) — everything else a TrainState SUBCLASS adds (e.g.
+# SparseTrainState's tables/slot_tables/table_steps) must checkpoint,
+# so the field list is discovered from the dataclass, not hardcoded: a
+# fixed list silently DROPPED subclass state from every checkpoint.
+
+
+def _state_trees(state):
+    for field in dataclasses.fields(state):
+        if not field.metadata.get("pytree_node", True):
+            continue  # apply_fn / tx: code, not state
+        yield field.name, getattr(state, field.name)
 
 
 def _leaf_name(prefix: str, path) -> str:
@@ -22,13 +36,7 @@ def _leaf_name(prefix: str, path) -> str:
 def named_leaves_from_state(state) -> Dict[str, np.ndarray]:
     """Flatten state into {path_name: host ndarray}."""
     out = {}
-    for prefix, tree in (
-        ("step", state.step),
-        ("params", state.params),
-        ("batch_stats", state.batch_stats),
-        ("opt_state", state.opt_state),
-        ("rng", state.rng),
-    ):
+    for prefix, tree in _state_trees(state):
         leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
         for path, leaf in leaves:
             out[_leaf_name(prefix, path)] = np.asarray(leaf)
@@ -45,13 +53,7 @@ def restore_state_from_named_leaves(state, named: Dict[str, np.ndarray],
     asserts variable presence, save_utils.py:230-247).
     """
     new_fields = {}
-    for prefix, tree in (
-        ("step", state.step),
-        ("params", state.params),
-        ("batch_stats", state.batch_stats),
-        ("opt_state", state.opt_state),
-        ("rng", state.rng),
-    ):
+    for prefix, tree in _state_trees(state):
         paths, treedef = jax.tree_util.tree_flatten_with_path(tree)
         new_leaves = []
         for path, leaf in paths:
@@ -71,10 +73,4 @@ def restore_state_from_named_leaves(state, named: Dict[str, np.ndarray],
         new_fields[prefix] = jax.tree_util.tree_unflatten(
             treedef, new_leaves
         )
-    return state.replace(
-        step=new_fields["step"],
-        params=new_fields["params"],
-        batch_stats=new_fields["batch_stats"],
-        opt_state=new_fields["opt_state"],
-        rng=new_fields["rng"],
-    )
+    return state.replace(**new_fields)
