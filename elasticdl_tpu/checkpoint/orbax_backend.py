@@ -145,7 +145,44 @@ def restore_state(saver: OrbaxSaver, state,
     target = {
         name: jax.tree.leaves(field_tree) for name, field_tree in fields
     }
-    restored = saver.restore_tree(abstract(target), version=version)
+    try:
+        restored = saver.restore_tree(abstract(target), version=version)
+    except FileNotFoundError:
+        raise
+    except Exception:
+        # Legacy layout (pre field-discovery): step/params/batch_stats/
+        # rng stored as native structures, opt_state as a leaves list.
+        # Only the classic five fields exist there — a state carrying
+        # MORE (SparseTrainState tables) must not silently restore
+        # partially.
+        classic = ("step", "params", "batch_stats", "opt_state", "rng")
+        extra = [name for name, _ in fields if name not in classic]
+        if extra:
+            raise ValueError(
+                f"orbax checkpoint predates the field-discovery layout "
+                f"and carries no state for {extra}; restoring would "
+                "silently reinitialize that state"
+            )
+        legacy_target = {
+            "step": state.step,
+            "params": state.params,
+            "batch_stats": state.batch_stats,
+            "opt_state": jax.tree.leaves(state.opt_state),
+            "rng": state.rng,
+        }
+        restored = saver.restore_tree(
+            abstract(legacy_target), version=version
+        )
+        return state.replace(
+            step=restored["step"],
+            params=restored["params"],
+            batch_stats=restored["batch_stats"],
+            opt_state=jax.tree.unflatten(
+                jax.tree.structure(state.opt_state),
+                restored["opt_state"],
+            ),
+            rng=restored["rng"],
+        )
     new_fields = {
         name: jax.tree.unflatten(
             jax.tree.structure(field_tree), restored[name]

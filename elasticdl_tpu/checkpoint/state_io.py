@@ -23,10 +23,16 @@ import numpy as np
 
 
 def _state_trees(state):
-    for field in dataclasses.fields(state):
-        if not field.metadata.get("pytree_node", True):
-            continue  # apply_fn / tx: code, not state
-        yield field.name, getattr(state, field.name)
+    if dataclasses.is_dataclass(state):
+        for field in dataclasses.fields(state):
+            if not field.metadata.get("pytree_node", True):
+                continue  # apply_fn / tx: code, not state
+            yield field.name, getattr(state, field.name)
+        return
+    # Duck-typed states (row-service checkpoint adapters, tests):
+    # the classic TrainState surface.
+    for name in ("step", "params", "batch_stats", "opt_state", "rng"):
+        yield name, getattr(state, name)
 
 
 def _leaf_name(prefix: str, path) -> str:
