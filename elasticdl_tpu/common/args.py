@@ -187,9 +187,19 @@ def add_train_params(parser):
                         help="Background batch-decode queue depth "
                              "(0 disables prefetching)")
     parser.add_argument("--row_service_addr", default="",
-                        help="Address of a shared host-tier row service "
-                             "(embedding/row_service.py) — required for "
-                             "host-tier models with num_workers > 1")
+                        help="Address(es) of the shared host-tier row "
+                             "service (embedding/row_service.py) — "
+                             "required for host-tier models with "
+                             "num_workers > 1. A comma list means N "
+                             "shards: rows scatter client-side by "
+                             "id %% N (the reference's N parameter "
+                             "servers, worker.py:404-414)")
+    parser.add_argument("--num_row_service_shards", type=pos_int,
+                        default=1,
+                        help="Row-service shard pods (reference "
+                             "--num_ps_pods): rows live by id %% N, one "
+                             "stable Service + pod per shard, each with "
+                             "its own checkpoint subdir (max 16)")
     parser.add_argument("--row_service_resource_request",
                         default="cpu=1,memory=4096Mi",
                         help="Resources for the row-service pod (the "

@@ -26,13 +26,14 @@ tier's ``HostEmbedding``/``host_rows`` contract.
 """
 
 from dataclasses import dataclass
-from typing import Any, Callable, Dict, Optional, Tuple
+from typing import Any, Callable, Dict, FrozenSet, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from flax import linen as nn
 from flax import struct
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from elasticdl_tpu.core.train_state import TrainState
 from elasticdl_tpu.embedding.combiner import COMBINERS, RaggedIds, combine
@@ -40,6 +41,9 @@ from elasticdl_tpu.embedding.optimizer import (
     RowOptimizer,
     init_slot_tables,
     sparse_apply,
+)
+from elasticdl_tpu.embedding.partition import (
+    DEFAULT_PARTITION_THRESHOLD_BYTES,
 )
 
 SPARSE_EMB_COLLECTION = "sparse_emb"
@@ -134,6 +138,56 @@ def _row_grads(d_emb, uids, inverse, ragged, combiner):
     return rows_ct
 
 
+def sparse_apply_sharded(opt: RowOptimizer, table, slot_tables, unique_ids,
+                         row_grads, step, mesh, axis: str,
+                         use_pallas: str = "auto",
+                         interpret: bool = False):
+    """``sparse_apply`` over a ROW-SHARDED ``(V, D)`` table: each device
+    owns rows [idx*V/n, (idx+1)*V/n) and applies only the updates whose
+    (globally unique) id lands in its range — the TPU-native analogue of
+    the reference's id%N scatter to parameter-server pods
+    (``worker/worker.py:570-580``, ``common/hash_utils.py:4-49``), with
+    contiguous row ranges instead of modulo so each shard stays one
+    dense slice (the placement ``checkpoint/saver.py`` repartitions).
+
+    Ids out of the local range (including the global pad sentinel
+    ``vocab``) map to the LOCAL pad sentinel ``shard_rows``, which
+    ``sparse_apply`` drops (XLA path ``mode="drop"``; kernels skip) —
+    so pads and remote ids cost nothing locally. ``unique_ids`` must be
+    globally deduplicated (``_unique_pad_jit``): each real id then
+    updates exactly one shard exactly once. Slot tables co-shard with
+    their main table; ``step`` is the replicated apply counter."""
+    num_shards = mesh.shape[axis]
+    vocab = table.shape[0]
+    if vocab % num_shards:
+        raise ValueError(
+            f"vocab {vocab} not divisible by mesh axis {axis!r} size "
+            f"{num_shards}; pad the table"
+        )
+    shard_rows = vocab // num_shards
+
+    def per_shard(tbl, slots, uids, grads, step_):
+        lo = (jax.lax.axis_index(axis) * shard_rows).astype(jnp.int32)
+        local = uids.astype(jnp.int32) - lo
+        in_range = (local >= 0) & (local < shard_rows)
+        local = jnp.where(in_range, local, shard_rows)
+        return sparse_apply(
+            opt, tbl, slots, local, grads, step_,
+            use_pallas=use_pallas, interpret=interpret,
+        )
+
+    # check_vma=False for the same reason as lookup_combine_sharded:
+    # the forced-kernel path's pallas_call outputs carry no varying-mesh
+    # annotation; the out_specs make the row sharding explicit.
+    return jax.shard_map(
+        per_shard, mesh=mesh,
+        in_specs=(P(axis, None), P(axis, None), P(None), P(None, None),
+                  P()),
+        out_specs=(P(axis, None), P(axis, None)), check_vma=False,
+    )(table, slot_tables, jnp.asarray(unique_ids),
+      jnp.asarray(row_grads), jnp.asarray(step))
+
+
 def build_sparse_train_step(
     loss_fn: Callable,
     specs: Tuple[TableSpec, ...],
@@ -141,16 +195,32 @@ def build_sparse_train_step(
     template,
     use_pallas: str = "auto",
     interpret: bool = False,
+    mesh=None,
+    axis: str = "dp",
+    sharded_tables: FrozenSet[str] = frozenset(),
 ) -> Callable:
     """Build ``(SparseTrainState, batch) -> (state, metrics)`` — one
     jittable program covering lookup, model fwd/bwd, dense apply, and
     the sparse row-kernel apply. ``template`` is the model's
     ``sparse_emb`` collection structure (``sparse_template``).
     Composable with ``lax.scan`` for the fused multi-step task path
-    (``build_sparse_multi_step``)."""
+    (``build_sparse_multi_step``).
+
+    With ``mesh``, tables named in ``sharded_tables`` are row-sharded
+    over ``axis``: lookup goes through
+    ``lookup_combine_sharded``'s shard_map path and the row update
+    through ``sparse_apply_sharded`` — same math, partitioned by row
+    range, so the dp-N trajectory equals dp-1 exactly (dryrun case 5).
+    Everything else (dedup, model fwd/bwd, dense apply) stays in the
+    global view and GSPMD partitions it over the batch sharding."""
     from elasticdl_tpu.core.step import _call_loss
     from elasticdl_tpu.embedding.host_engine import _nest_rows
-    from elasticdl_tpu.ops.pallas_embedding import lookup_combine
+    from elasticdl_tpu.ops.pallas_embedding import (
+        lookup_combine,
+        lookup_combine_sharded,
+    )
+    if sharded_tables and mesh is None:
+        raise ValueError("sharded_tables requires a mesh")
 
     def train_step(state: SparseTrainState, batch):
         state, rng = state.next_rng()
@@ -163,13 +233,22 @@ def build_sparse_train_step(
             # Forward from the LIVE table (Pallas auto-dispatch); the
             # table is not differentiated — row grads come from the
             # combiner transpose below.
-            embs[spec.name] = lookup_combine(
-                jax.lax.stop_gradient(table), ragged.ids,
-                ragged.weights, spec.combiner,
-                interpret=interpret,
-                force_pallas=(use_pallas == "always"),
-                force_xla=(use_pallas == "never"),
-            )
+            if spec.name in sharded_tables:
+                embs[spec.name] = lookup_combine_sharded(
+                    jax.lax.stop_gradient(table), ragged.ids,
+                    ragged.weights, spec.combiner, mesh, axis,
+                    interpret=interpret,
+                    force_pallas=(use_pallas == "always"),
+                    force_xla=(use_pallas == "never"),
+                )
+            else:
+                embs[spec.name] = lookup_combine(
+                    jax.lax.stop_gradient(table), ragged.ids,
+                    ragged.weights, spec.combiner,
+                    interpret=interpret,
+                    force_pallas=(use_pallas == "always"),
+                    force_xla=(use_pallas == "never"),
+                )
             uids, inverse = _unique_pad_jit(
                 jnp.ravel(ragged.ids), spec.vocab
             )
@@ -202,12 +281,20 @@ def build_sparse_train_step(
                 spec.combiner,
             )
             step_count = state.table_steps[spec.name] + 1
-            table, slots = sparse_apply(
-                row_opt, state.tables[spec.name],
-                state.slot_tables[spec.name], uids, rows_ct,
-                step=step_count, use_pallas=use_pallas,
-                interpret=interpret,
-            )
+            if spec.name in sharded_tables:
+                table, slots = sparse_apply_sharded(
+                    row_opt, state.tables[spec.name],
+                    state.slot_tables[spec.name], uids, rows_ct,
+                    step_count, mesh, axis, use_pallas=use_pallas,
+                    interpret=interpret,
+                )
+            else:
+                table, slots = sparse_apply(
+                    row_opt, state.tables[spec.name],
+                    state.slot_tables[spec.name], uids, rows_ct,
+                    step=step_count, use_pallas=use_pallas,
+                    interpret=interpret,
+                )
             new_tables[spec.name] = table
             new_slots[spec.name] = slots
             new_steps[spec.name] = step_count
@@ -224,12 +311,16 @@ def build_sparse_train_step(
 def build_sparse_multi_step(loss_fn, specs, row_opt, template,
                             use_pallas: str = "auto",
                             interpret: bool = False,
-                            unroll: int = 1) -> Callable:
+                            unroll: int = 1,
+                            mesh=None, axis: str = "dp",
+                            sharded_tables: FrozenSet[str] = frozenset(),
+                            state_shardings=None) -> Callable:
     """T fused sparse steps per XLA program (the task-granular mode —
     core/step.build_multi_step for the sparse plane)."""
     step = build_sparse_train_step(
         loss_fn, specs, row_opt, template, use_pallas=use_pallas,
-        interpret=interpret,
+        interpret=interpret, mesh=mesh, axis=axis,
+        sharded_tables=sharded_tables,
     )
 
     def multi_step(state, batches):
@@ -241,7 +332,13 @@ def build_sparse_multi_step(loss_fn, specs, row_opt, template,
             body, state, batches, unroll=max(1, min(unroll, num_steps))
         )
 
-    return jax.jit(multi_step, donate_argnums=(0,))
+    kwargs = {}
+    if state_shardings is not None:
+        kwargs = dict(
+            in_shardings=(state_shardings, None),
+            out_shardings=(state_shardings, None),
+        )
+    return jax.jit(multi_step, donate_argnums=(0,), **kwargs)
 
 
 def init_sparse_state(
@@ -301,11 +398,25 @@ def init_sparse_state(
 class DeviceSparseRunner:
     """Worker-compatible step runner (init_state/train_step/eval_step +
     train_multi_step) for device-tier sparse models — the deployment
-    adapter the host tier has in HostStepRunner."""
+    adapter the host tier has in HostStepRunner.
+
+    With ``mesh``, every TableSpec table over ``partition_threshold_bytes``
+    whose vocab divides the ``axis`` size is ROW-SHARDED over the mesh
+    (+its slot tables, co-sharded — reference slot co-location,
+    ``ps/parameters.py:156``); the batch shards over the same ``axis``
+    (data parallel), dense params replicate, and the step is jitted with
+    explicit in/out shardings. This is the multi-chip form of the
+    reference's N-parameter-server sparse plane
+    (``docs/designs/parameter_server.md`` "Model Parameter Partition"):
+    row ranges instead of id%N, XLA collectives over ICI instead of
+    gRPC pull/push."""
 
     def __init__(self, specs: Tuple[TableSpec, ...],
                  row_opt: RowOptimizer, use_pallas: str = "auto",
-                 interpret: Optional[bool] = None):
+                 interpret: Optional[bool] = None,
+                 mesh=None, axis: str = "dp",
+                 partition_threshold_bytes: int =
+                 DEFAULT_PARTITION_THRESHOLD_BYTES):
         self.specs = tuple(specs)
         self.row_opt = row_opt
         self.use_pallas = use_pallas
@@ -317,30 +428,123 @@ class DeviceSparseRunner:
                 and jax.default_backend() != "tpu"
             )
         self.interpret = interpret
+        self.mesh = mesh
+        self.axis = axis
+        if mesh is not None:
+            n = mesh.shape[axis]
+            self.sharded_tables = frozenset(
+                s.name for s in self.specs
+                if s.vocab % n == 0
+                and s.vocab * s.dim * 4 > partition_threshold_bytes
+            )
+        else:
+            self.sharded_tables = frozenset()
         self._template = None
+        self._state_shardings = None
+        self._batch_shardings = None
+
+    def _table_sharding(self, name):
+        spec = P(self.axis, None) if name in self.sharded_tables else P()
+        return NamedSharding(self.mesh, spec)
+
+    def state_shardings(self, state):
+        """Pytree of NamedShardings for a (possibly abstract)
+        SparseTrainState: sharded tables/slots on P(axis, None),
+        everything else replicated."""
+        rep = NamedSharding(self.mesh, P())
+        sh = jax.tree.map(lambda _: rep, state)
+        return sh.replace(
+            tables={
+                k: self._table_sharding(k) for k in state.tables
+            },
+            slot_tables={
+                k: jax.tree.map(
+                    lambda _, s=self._table_sharding(k): s, v
+                )
+                for k, v in state.slot_tables.items()
+            },
+        )
 
     def init_state(self, model, tx, batch, seed: int = 0):
-        state, self._template = init_sparse_state(
-            model, tx, batch, self.specs, self.row_opt, seed=seed
+        if self.mesh is None:
+            state, self._template = init_sparse_state(
+                model, tx, batch, self.specs, self.row_opt, seed=seed
+            )
+            return state
+
+        # Build under jit with explicit out_shardings so a table sized
+        # for the whole mesh never materializes on one device
+        # (MeshRunner.init_state's pattern).
+        def make_state():
+            state, template = init_sparse_state(
+                model, tx, batch, self.specs, self.row_opt, seed=seed
+            )
+            return state, template
+
+        abstract_state, abstract_template = jax.eval_shape(make_state)
+        shardings = self.state_shardings(abstract_state)
+        self._state_shardings = shardings
+        rep = NamedSharding(self.mesh, P())
+        state, template = jax.jit(
+            make_state,
+            out_shardings=(
+                shardings,
+                jax.tree.map(lambda _: rep, abstract_template),
+            ),
+        )()
+        self._template = template
+        self._batch_shardings = jax.tree.map(
+            lambda leaf: NamedSharding(
+                self.mesh,
+                P(self.axis) if np.ndim(leaf) >= 1 else P(),
+            ),
+            batch,
         )
         return state
+
+    def place_state(self, state):
+        """Re-place restored host arrays with the runner's shardings
+        (checkpoint restore would otherwise land a mesh-sized table on
+        one device) — MeshRunner.place_state's contract."""
+        if self.mesh is None:
+            return state
+        shardings = self._state_shardings or self.state_shardings(state)
+        return jax.device_put(state, shardings)
+
+    def _jit_step(self, step):
+        if self.mesh is None:
+            return jax.jit(step, donate_argnums=(0,))
+        return jax.jit(
+            step, donate_argnums=(0,),
+            in_shardings=(self._state_shardings,
+                          self._batch_shardings),
+            out_shardings=(self._state_shardings, None),
+        )
 
     def train_step(self, loss_fn):
         step = build_sparse_train_step(
             loss_fn, self.specs, self.row_opt, self._template,
             use_pallas=self.use_pallas, interpret=self.interpret,
+            mesh=self.mesh, axis=self.axis,
+            sharded_tables=self.sharded_tables,
         )
-        return jax.jit(step, donate_argnums=(0,))
+        return self._jit_step(step)
 
     def train_multi_step(self, loss_fn):
         return build_sparse_multi_step(
             loss_fn, self.specs, self.row_opt, self._template,
             use_pallas=self.use_pallas, interpret=self.interpret,
+            mesh=self.mesh, axis=self.axis,
+            sharded_tables=self.sharded_tables,
+            state_shardings=self._state_shardings,
         )
 
     def eval_step(self):
         from elasticdl_tpu.embedding.host_engine import _nest_rows
-        from elasticdl_tpu.ops.pallas_embedding import lookup_combine
+        from elasticdl_tpu.ops.pallas_embedding import (
+            lookup_combine,
+            lookup_combine_sharded,
+        )
 
         specs = self.specs
         template = self._template
@@ -349,12 +553,22 @@ class DeviceSparseRunner:
             embs = {}
             for spec in specs:
                 ragged = _ragged(batch["features"][spec.feature_key])
-                embs[spec.name] = lookup_combine(
-                    state.tables[spec.name], ragged.ids, ragged.weights,
-                    spec.combiner, interpret=self.interpret,
-                    force_pallas=(self.use_pallas == "always"),
-                    force_xla=(self.use_pallas == "never"),
-                )
+                if spec.name in self.sharded_tables:
+                    embs[spec.name] = lookup_combine_sharded(
+                        state.tables[spec.name], ragged.ids,
+                        ragged.weights, spec.combiner, self.mesh,
+                        self.axis, interpret=self.interpret,
+                        force_pallas=(self.use_pallas == "always"),
+                        force_xla=(self.use_pallas == "never"),
+                    )
+                else:
+                    embs[spec.name] = lookup_combine(
+                        state.tables[spec.name], ragged.ids,
+                        ragged.weights, spec.combiner,
+                        interpret=self.interpret,
+                        force_pallas=(self.use_pallas == "always"),
+                        force_xla=(self.use_pallas == "never"),
+                    )
             variables = {
                 "params": state.params,
                 SPARSE_EMB_COLLECTION: _nest_rows(template, embs),

@@ -131,19 +131,26 @@ class HostRowService:
         return {"rows": np.asarray(rows, np.float32)}
 
     def _export_rows(self, request: dict) -> dict:
-        """Dense [lo, hi) rows for serving export WITHOUT inflating the
-        live table: trained rows overlay a throwaway table's
-        deterministic lazy init (serving/export.py materialization,
-        server side)."""
+        """Dense rows ``lo+offset, lo+offset+stride, ... < hi`` for
+        serving export WITHOUT inflating the live table: trained rows
+        overlay a throwaway table's deterministic lazy init
+        (serving/export.py materialization, server side).
+        ``stride``/``offset`` let a sharded client pull only the rows
+        this shard owns (id % N == shard) instead of the whole range."""
         table = self._tables[request["table"]]
         lo, hi = int(request["lo"]), int(request["hi"])
+        stride = int(request.get("stride", 1))
+        offset = int(request.get("offset", 0))
+        want = np.arange(lo + offset, hi, stride)
         with self._lock:
             ids, rows = table.to_arrays()
         from elasticdl_tpu.serving.export import _clone_empty
 
-        dense = np.asarray(_clone_empty(table).get(np.arange(lo, hi)))
-        keep = (ids >= lo) & (ids < hi)
-        dense[ids[keep] - lo] = rows[keep]
+        dense = np.asarray(_clone_empty(table).get(want))
+        keep = (ids >= lo + offset) & (ids < hi)
+        if stride != 1:
+            keep &= (ids - lo - offset) % stride == 0
+        dense[(ids[keep] - lo - offset) // stride] = rows[keep]
         return {"rows": dense.astype(np.float32)}
 
     def _push_row_grads(self, request: dict) -> dict:
@@ -325,14 +332,21 @@ class _RemoteTable:
         )
         return np.asarray(resp["rows"], np.float32)
 
+    def export_range(self, lo: int, hi: int, stride: int = 1,
+                     offset: int = 0) -> np.ndarray:
+        """Dense rows ``lo+offset, +stride, ... < hi`` (trained rows
+        over deterministic lazy init; see _export_rows)."""
+        return np.asarray(_call_with_retry(
+            self._stub, "export_rows", self._retries, self._backoff,
+            table=self.name, lo=int(lo), hi=int(hi),
+            stride=int(stride), offset=int(offset),
+        )["rows"], np.float32)
+
     def export_dense(self, vocab: int, chunk: int = 65536) -> np.ndarray:
         """Serving-export materialization, served chunk-wise by the
         service (no live-table inflation; see _export_rows)."""
         parts = [
-            np.asarray(_call_with_retry(
-                self._stub, "export_rows", self._retries, self._backoff,
-                table=self.name, lo=lo, hi=min(lo + chunk, vocab),
-            )["rows"], np.float32)
+            self.export_range(lo, min(lo + chunk, vocab))
             for lo in range(0, int(vocab), chunk)
         ]
         return np.concatenate(parts, axis=0)
@@ -386,37 +400,228 @@ class _RemoteOptimizer:
         return table
 
 
+def _scatter_by_home(pool, n: int, ids: np.ndarray, per_shard):
+    """Run ``per_shard(shard_idx, mask)`` concurrently for every shard
+    owning at least one of ``ids`` (home shard = id % n), and join.
+    The one fan-out loop both the pull and push scatters share."""
+    home = ids % n
+    futures = []
+    for s in range(n):
+        mask = home == s
+        if mask.any():
+            futures.append(pool.submit(per_shard, s, mask))
+    for f in futures:
+        f.result()
+
+
+class _ShardedTable:
+    """Client-side scatter/gather over N row-service shards: row id
+    lives on shard ``int_to_id(id, N)`` (= ``id % N`` — the same
+    placement ``checkpoint/saver.py`` uses for row file shards, so a
+    table checkpointed under either layout repartitions onto the
+    other). The TPU-native shape of the reference worker's pull scatter
+    over N PS pods (``worker/worker.py:362-391``,
+    ``common/hash_utils.py:4-49``); per-shard pulls fan out on the
+    engine's shard pool so N servers' line rates aggregate."""
+
+    concurrent_safe = True
+
+    def __init__(self, shards, pool):
+        self._shards = list(shards)
+        self._pool = pool
+        self.name = self._shards[0].name
+        self.dim = self._shards[0].dim
+
+    def get(self, ids) -> np.ndarray:
+        ids = np.asarray(ids, np.int64)
+        out = np.empty((ids.size, self.dim), np.float32)
+
+        def pull(s, mask):
+            # Disjoint row slices: concurrent writes never overlap.
+            out[mask] = self._shards[s].get(ids[mask])
+
+        _scatter_by_home(self._pool, len(self._shards), ids, pull)
+        return out
+
+    def export_dense(self, vocab: int, chunk: int = 65536) -> np.ndarray:
+        """Each shard exports ONLY its owned rows (strided
+        ``export_range``: ids ≡ s mod N), interleaved client-side — the
+        total transfer is one table, not N (untrained rows fall back to
+        the home shard's deterministic lazy init)."""
+        n = len(self._shards)
+        parts = []
+        for lo in range(0, int(vocab), chunk):
+            hi = min(lo + chunk, vocab)
+            out = np.empty((hi - lo, self.dim), np.float32)
+
+            def fill(s, lo=lo, hi=hi, out=out):
+                offset = (s - lo) % n
+                rows = self._shards[s].export_range(
+                    lo, hi, stride=n, offset=offset
+                )
+                out[np.arange(lo + offset, hi, n) - lo] = rows
+
+            futures = [
+                self._pool.submit(fill, s)
+                for s in range(n) if lo + (s - lo) % n < hi
+            ]
+            for f in futures:
+                f.result()
+            parts.append(out)
+        return np.concatenate(parts, axis=0)
+
+
+class _ShardedOptimizer:
+    """Push scatter over N shards (reference ``worker.py:570-580``):
+    each shard receives only the row grads it owns, applied by its own
+    ``_RemoteOptimizer`` (whose per-thread (client, seq) streams keep
+    the exactly-once dedup intact per shard)."""
+
+    concurrent_safe = True
+
+    def __init__(self, optimizers, pool):
+        self._optimizers = list(optimizers)
+        self._pool = pool
+
+    def apply_gradients(self, table, ids, grads):
+        ids = np.asarray(ids, np.int64)
+        grads = np.asarray(grads, np.float32)
+
+        def push(s, mask):
+            self._optimizers[s].apply_gradients(
+                table, ids[mask], grads[mask]
+            )
+
+        _scatter_by_home(
+            self._pool, len(self._optimizers), ids, push
+        )
+        return table
+
+
 def make_remote_engine(
     addr: str, id_keys: Dict[str, str],
     retries: int = 12, backoff_secs: float = 0.5,
 ) -> HostEmbeddingEngine:
-    """Client-side engine over a running `HostRowService`. Table names
-    and dims come from the service itself; pulls/pushes retry with
-    bounded backoff across a service relaunch. The default budget
+    """Client-side engine over running `HostRowService` shard(s).
+
+    ``addr`` is one address or a comma list of N shard addresses —
+    the reference's N parameter servers (``--ps_pods``); rows scatter
+    by ``id % N`` client-side (``_ShardedTable``/``_ShardedOptimizer``)
+    and each shard process runs the UNCHANGED single-server
+    ``HostRowService`` (its lazy tables only ever materialize the rows
+    hashed to it). Table names and dims come from the services
+    themselves (verified consistent across shards); pulls/pushes retry
+    with bounded backoff across a shard relaunch. The default budget
     (0.5s doubling, capped 30s, 12 retries ≈ 4 minutes) spans a real
     pod relaunch — scheduling + image pull + checkpoint restore — like
     the reference workers' 3x300s channel waits."""
-    stub = RpcStub(addr, SERVICE_NAME)
-    info = _call_with_retry(stub, "table_info", retries, backoff_secs)[
-        "tables"
+    addrs = [a.strip() for a in addr.split(",") if a.strip()]
+    if not addrs:
+        raise ValueError("empty row-service address")
+    stubs = [RpcStub(a, SERVICE_NAME) for a in addrs]
+    infos = [
+        _call_with_retry(stub, "table_info", retries, backoff_secs)[
+            "tables"
+        ]
+        for stub in stubs
     ]
-    tables = {
-        name: _RemoteTable(stub, name, meta["dim"], retries, backoff_secs)
-        for name, meta in info.items()
-    }
-    engine = HostEmbeddingEngine(
-        tables, _RemoteOptimizer(stub, retries, backoff_secs),
-        id_keys=id_keys,
-    )
+    for a, info in zip(addrs[1:], infos[1:]):
+        if info != infos[0]:
+            raise ValueError(
+                f"row-service shard {a} serves different tables "
+                f"({sorted(info)}) than shard {addrs[0]} "
+                f"({sorted(infos[0])}); all shards must run the same "
+                "model module"
+            )
+    if len(addrs) == 1:
+        stub = stubs[0]
+        tables = {
+            name: _RemoteTable(
+                stub, name, meta["dim"], retries, backoff_secs
+            )
+            for name, meta in infos[0].items()
+        }
+        optimizer = _RemoteOptimizer(stub, retries, backoff_secs)
+    else:
+        from concurrent.futures import ThreadPoolExecutor
+
+        pool = ThreadPoolExecutor(
+            max_workers=2 * len(addrs),
+            thread_name_prefix="row-shard",
+        )
+        tables = {
+            name: _ShardedTable(
+                [
+                    _RemoteTable(
+                        stub, name, meta["dim"], retries, backoff_secs
+                    )
+                    for stub in stubs
+                ],
+                pool,
+            )
+            for name, meta in infos[0].items()
+        }
+        optimizer = _ShardedOptimizer(
+            [_RemoteOptimizer(s, retries, backoff_secs) for s in stubs],
+            pool,
+        )
+    engine = HostEmbeddingEngine(tables, optimizer, id_keys=id_keys)
     engine.remote = True  # server owns checkpointing (see HostStepRunner)
     return engine
+
+
+def validate_shard_layout(checkpoint_dir: str, shard: int,
+                          num_shards: int):
+    """Refuse to restore a checkpoint written under a DIFFERENT shard
+    layout: rows live by id % N client-side, so restoring an N-shard
+    checkpoint into an M-shard job would silently re-lazy-init every
+    row whose home moved (trained embeddings reset with no error). A
+    ``shard_layout.json`` marker records the layout; a checkpoint dir
+    holding versions but no marker is treated as num_shards=1 (the
+    pre-shard layout)."""
+    import json
+    import os
+
+    marker = os.path.join(checkpoint_dir, "shard_layout.json")
+    if os.path.exists(marker):
+        with open(marker) as fh:
+            recorded = json.load(fh)
+    else:
+        from elasticdl_tpu.checkpoint.saver import CheckpointSaver
+
+        has_versions = bool(
+            os.path.isdir(checkpoint_dir)
+            and CheckpointSaver(checkpoint_dir).list_versions()
+        )
+        if not has_versions:
+            os.makedirs(checkpoint_dir, exist_ok=True)
+            with open(marker, "w") as fh:
+                json.dump({"shard": shard, "num_shards": num_shards}, fh)
+            return
+        recorded = {"shard": 0, "num_shards": 1}  # pre-shard layout
+    if (
+        int(recorded.get("num_shards", 1)) != num_shards
+        or int(recorded.get("shard", 0)) != shard
+    ):
+        raise SystemExit(
+            f"checkpoint {checkpoint_dir} was written as shard "
+            f"{recorded.get('shard', 0)}/{recorded.get('num_shards', 1)}"
+            f" but this process is shard {shard}/{num_shards}; "
+            "changing --num_row_service_shards across a restore would "
+            "silently lose the rows whose id%N home moved. Start a "
+            "fresh checkpoint dir (or repartition offline via "
+            "checkpoint.saver, which uses the same id%N placement)."
+        )
 
 
 def main(argv=None):
     """Process entry: ``python -m elasticdl_tpu.embedding.row_service
     --model_zoo ... --model_def ... [--addr :6100] [--checkpoint_dir ...]``
     — the zoo module supplies ``make_row_service()`` (the deployment
-    unit the reference's PS pod mapped to)."""
+    unit the reference's PS pod mapped to). ``--shard_id/--num_shards``
+    record the shard layout so a relaunch with a different
+    --num_row_service_shards fails loudly instead of silently losing
+    rows (see validate_shard_layout)."""
     import argparse
 
     from elasticdl_tpu.core.model_spec import load_model_zoo_module
@@ -428,6 +633,8 @@ def main(argv=None):
     parser.add_argument("--checkpoint_dir", default="")
     parser.add_argument("--checkpoint_steps", type=int, default=0)
     parser.add_argument("--keep_checkpoint_max", type=int, default=3)
+    parser.add_argument("--shard_id", type=int, default=0)
+    parser.add_argument("--num_shards", type=int, default=1)
     args = parser.parse_args(argv)
 
     module, _ = load_model_zoo_module(args.model_zoo, args.model_def)
@@ -438,6 +645,9 @@ def main(argv=None):
         )
     service = factory()
     if args.checkpoint_dir:
+        validate_shard_layout(
+            args.checkpoint_dir, args.shard_id, args.num_shards
+        )
         service.configure_checkpoint(
             args.checkpoint_dir, args.checkpoint_steps,
             args.keep_checkpoint_max,

@@ -87,9 +87,10 @@ class InstanceManager:
         # for the life of the job; task retries are capped instead)
         on_worker_relaunch: Optional[Callable[[int, int], None]] = None,
         multihost: bool = False,
-        row_service_command: Optional[Callable[[], List[str]]] = None,
+        row_service_command: Optional[Callable[[int], List[str]]] = None,
         row_service_resource_request: str = "cpu=1,memory=4096Mi",
         row_service_resource_limit: str = "",
+        num_row_service_shards: int = 1,
     ):
         self._task_d = task_dispatcher
         self._client = k8s_client
@@ -123,18 +124,22 @@ class InstanceManager:
         self._multihost = multihost
         self._generation = 0
         # Host-tier row service (reference PS pod lifecycle: fixed
-        # service name, relaunch on death — k8s_instance_manager.py
-        # :303-308). One replica; its state survives via its own
-        # checkpoint (row_service.py), which the reference PS also
-        # relied on when re-init from workers wasn't possible.
+        # per-shard service names, relaunch on death —
+        # k8s_instance_manager.py:303-308). One pod per shard (rows by
+        # id % N client-side, row_service._ShardedTable); each shard's
+        # state survives via its own checkpoint (row_service.py), which
+        # the reference PS also relied on when re-init from workers
+        # wasn't possible. ``row_service_command(shard)`` builds the
+        # per-shard process command.
         self._row_service_command = row_service_command
-        # Dedicated sizing: the CPU-only row pod must not inherit the
+        self._num_rs_shards = max(1, int(num_row_service_shards))
+        # Dedicated sizing: the CPU-only row pods must not inherit the
         # workers' accelerator-sized resources (reference had its own
         # --ps_resource_* knobs).
         self._rs_resource_request = row_service_resource_request
         self._rs_resource_limit = row_service_resource_limit
-        self._row_service_pod: Optional[str] = None
-        self._rs_generation = 0
+        self._row_service_pods: Dict[int, str] = {}  # shard -> pod name
+        self._rs_generation: Dict[int, int] = {}
         self._rs_relaunch_count = 0
 
     # ---- pod creation ---------------------------------------------------
@@ -170,15 +175,19 @@ class InstanceManager:
     # ---- row service (PS-pod lifecycle) --------------------------------
 
     def start_row_service(self):
-        """Create the stable Service + the serving pod."""
+        """Create the per-shard stable Services + serving pods."""
         if self._row_service_command is None:
             return
-        self._client.create_service(build_row_service_service_manifest(
-            self._job_name, namespace=self._namespace
-        ))
-        self._start_row_service_pod()
+        for shard in range(self._num_rs_shards):
+            self._client.create_service(
+                build_row_service_service_manifest(
+                    self._job_name, namespace=self._namespace,
+                    shard=shard,
+                )
+            )
+            self._start_row_service_pod(shard)
 
-    def _start_row_service_pod(self):
+    def _start_row_service_pod(self, shard: int):
         with self._lock:
             if self._stopped:
                 # A death event racing stop() must not recreate a pod
@@ -186,15 +195,16 @@ class InstanceManager:
                 # relaunch path does).
                 return
             name = get_row_service_pod_name(
-                self._job_name, self._rs_generation
+                self._job_name, self._rs_generation.get(shard, 0),
+                shard=shard,
             )
         manifest = build_pod_manifest(
             name=name,
             job_name=self._job_name,
             replica_type="rowservice",
-            replica_index=0,
+            replica_index=shard,
             image=self._image,
-            command=self._row_service_command(),
+            command=self._row_service_command(shard),
             namespace=self._namespace,
             resource_request=self._rs_resource_request,
             resource_limit=self._rs_resource_limit,
@@ -205,17 +215,18 @@ class InstanceManager:
         )
         self._client.create_pod(manifest)
         with self._lock:
-            self._row_service_pod = name
-        logger.info("Started row service pod %s", name)
+            self._row_service_pods[shard] = name
+        logger.info("Started row service pod %s (shard %d)", name, shard)
 
-    def _handle_dead_row_service(self):
-        """Same stable service name, fresh pod generation; workers ride
-        the outage on their RPC retry/backoff (generous default budget,
-        row_service.make_remote_engine) and the relaunched pod restores
-        from its checkpoint (row_service.py). Unlike workers, ANY
-        failure relaunches: the singleton service runs no user code, so
-        the crash-loop concern behind the workers' exit-137-only policy
-        does not apply; max_relaunches (when set) still bounds it."""
+    def _handle_dead_row_service(self, shard: int):
+        """Same stable per-shard service name, fresh pod generation;
+        workers ride the outage on their RPC retry/backoff (generous
+        default budget, row_service.make_remote_engine) and the
+        relaunched pod restores from its own checkpoint
+        (row_service.py). Unlike workers, ANY failure relaunches: the
+        service runs no user code, so the crash-loop concern behind the
+        workers' exit-137-only policy does not apply; max_relaunches
+        (when set) still bounds it (budget shared across shards)."""
         with self._lock:
             if self._stopped:
                 return
@@ -228,12 +239,15 @@ class InstanceManager:
                 )
                 return
             self._rs_relaunch_count += 1
-            self._rs_generation += 1
+            self._rs_generation[shard] = (
+                self._rs_generation.get(shard, 0) + 1
+            )
+            generation = self._rs_generation[shard]
         logger.warning(
-            "Row service pod died; relaunching (generation %d)",
-            self._rs_generation,
+            "Row service shard %d pod died; relaunching "
+            "(generation %d)", shard, generation,
         )
-        self._start_row_service_pod()
+        self._start_row_service_pod(shard)
 
     # ---- event handling -------------------------------------------------
 
@@ -245,9 +259,18 @@ class InstanceManager:
         if info["replica_type"] == "rowservice":
             dead = info["type"] == "DELETED" or info["phase"] == "Failed"
             with self._lock:
-                current = self._row_service_pod
-            if dead and info["name"] == current:
-                self._handle_dead_row_service()
+                # Map the event back to its shard by tracked pod name
+                # (stale generations mismatch and are ignored, same as
+                # the worker path).
+                shard = next(
+                    (
+                        s for s, pod in self._row_service_pods.items()
+                        if pod == info["name"]
+                    ),
+                    None,
+                )
+            if dead and shard is not None:
+                self._handle_dead_row_service(shard)
             return
         if info["replica_type"] != "worker":
             return
@@ -380,9 +403,8 @@ class InstanceManager:
             self._stopped = True
             pods = list(self._worker_pods.values())
             self._worker_pods.clear()
-            if self._row_service_pod is not None:
-                pods.append(self._row_service_pod)
-                self._row_service_pod = None
+            pods.extend(self._row_service_pods.values())
+            self._row_service_pods.clear()
         for name in pods:
             self._client.delete_pod(name)
 

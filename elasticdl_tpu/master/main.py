@@ -180,18 +180,40 @@ class Master:
             is not None
         )
 
+    def _num_row_service_shards(self) -> int:
+        n = max(
+            1, int(getattr(self._args, "num_row_service_shards", 1) or 1)
+        )
+        if n > 16:
+            # `clean` sweeps per-shard Services over a fixed 0..15
+            # range (k8s_client.delete_job_resources) — more shards
+            # would leak Services on cleanup.
+            raise ValueError(
+                f"--num_row_service_shards={n} exceeds the supported "
+                "maximum of 16"
+            )
+        return n
+
     def _row_service_addr(self) -> str:
+        """Comma list of per-shard addresses: the workers scatter rows
+        by id % N client-side (row_service._ShardedTable — the
+        reference's N PS pods, worker.py:404-414)."""
         from elasticdl_tpu.platform.k8s_client import (
             ROW_SERVICE_PORT,
             get_row_service_service_name,
         )
 
-        return "%s:%d" % (
-            get_row_service_service_name(self._args.job_name),
-            ROW_SERVICE_PORT,
+        return ",".join(
+            "%s:%d" % (
+                get_row_service_service_name(
+                    self._args.job_name, shard
+                ),
+                ROW_SERVICE_PORT,
+            )
+            for shard in range(self._num_row_service_shards())
         )
 
-    def _row_service_command(self):
+    def _row_service_command(self, shard: int = 0):
         from elasticdl_tpu.platform.k8s_client import ROW_SERVICE_PORT
 
         cmd = [sys.executable, "-m", "elasticdl_tpu.embedding.row_service",
@@ -212,10 +234,24 @@ class Master:
                 steps = int(getattr(self._args, "checkpoint_steps", 0)) * max(
                     1, int(getattr(self._args, "num_workers", 1))
                 )
-            cmd += ["--checkpoint_dir", f"{ckpt}/row_service",
+            # Per-shard subdir: each shard owns exactly its id%N rows
+            # (client-side scatter), so checkpoints must not collide.
+            # Shard 0 keeps the legacy path (single-shard jobs resume
+            # pre-shard checkpoints unchanged).
+            subdir = (
+                "row_service" if shard == 0 else f"row_service/s{shard}"
+            )
+            cmd += ["--checkpoint_dir", f"{ckpt}/{subdir}",
                     "--checkpoint_steps", str(steps),
                     "--keep_checkpoint_max",
-                    str(getattr(self._args, "keep_checkpoint_max", 3))]
+                    str(getattr(self._args, "keep_checkpoint_max", 3)),
+                    # Layout guard: a relaunch with a different
+                    # --num_row_service_shards must fail loudly, not
+                    # silently lose the rows whose id%N home moved
+                    # (row_service.validate_shard_layout).
+                    "--shard_id", str(shard),
+                    "--num_shards",
+                    str(self._num_row_service_shards())]
         return cmd
 
     def _master_addr_for_workers(self) -> str:
@@ -293,6 +329,7 @@ class Master:
                 row_service_resource_limit=getattr(
                     self._args, "row_service_resource_limit", ""
                 ),
+                num_row_service_shards=self._num_row_service_shards(),
             )
             self.instance_manager.start_watch()
             # Row service first (reference Master.prepare starts PS pods

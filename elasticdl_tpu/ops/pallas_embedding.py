@@ -1,11 +1,18 @@
 """Pallas TPU kernels for the sparse embedding engine.
 
 TPU-native counterpart of the reference's native embedding hot path (Go
-row map + C++/Eigen kernels, pkg/kernel/capi/kernel_api.cc): for tables
-living in HBM, these kernels stream only the touched rows through VMEM —
-the jnp fallback (``jnp.take``) materializes a (B, L, D) gather that XLA
-stages through HBM, while the kernel overlaps per-row DMA with the
-combine (double-buffered) and never forms the intermediate.
+row map + C++/Eigen kernels, pkg/kernel/capi/kernel_api.cc).
+
+**Measured verdict (round-3 device-time sweep, EMBEDDING_SWEEP.json):
+the row-DMA kernels in this file LOSE to XLA's native gather/scatter by
+10-100x at every realistic size, so production dispatch takes XLA
+everywhere** — ``use_pallas_lookup`` always returns False and the
+kernels live behind ``force_pallas`` / ``use_pallas='always'`` as
+reference-parity implementations (on-chip tested). Two structural
+causes, both visible in the traces (see the dispatch note above
+``use_pallas_lookup``): the (V·C, 128) flat-view retiling copy Mosaic's
+(1, 128)-slice rule forces, and the ~19 GB/s effective rate of the
+per-row chunk-DMA chain vs XLA's coalesced gather.
 
 - ``lookup_combine``: fused gather + sum/mean/sqrtn combine over a padded
   ragged batch (embedding/combiner.py RaggedIds semantics).

@@ -138,34 +138,45 @@ TENSORBOARD_PORT = 6006
 ROW_SERVICE_PORT = 6100
 
 
-def get_row_service_pod_name(job_name: str, generation: int = 0) -> str:
+def get_row_service_pod_name(job_name: str, generation: int = 0,
+                             shard: int = 0) -> str:
     """Reference PS pods relaunch with the SAME id behind a fixed
     service name (k8s_instance_manager.py:303-308); pod deletion is
     async, so each relaunch generation gets a fresh pod name while the
-    stable Service keeps routing."""
+    stable Service keeps routing. ``shard``: one pod per row-service
+    shard (the reference's N PS pods, `elasticdl-{job}-ps-{id}`);
+    shard 0 keeps the legacy unsuffixed name."""
     base = f"elasticdl-tpu-{job_name}-rowservice"
+    if shard:
+        base += f"-s{shard}"
     return base if generation == 0 else f"{base}-r{generation}"
 
 
-def get_row_service_service_name(job_name: str) -> str:
+def get_row_service_service_name(job_name: str, shard: int = 0) -> str:
     """Stable DNS name workers dial (reference fixed service names
-    `elasticdl-{job}-ps-{id}` port 2222, k8s_client.py:19-22)."""
-    return f"elasticdl-tpu-{job_name}-rowservice"
+    `elasticdl-{job}-ps-{id}` port 2222, k8s_client.py:19-22); one
+    Service per shard (client-side id%N routing needs a stable
+    per-shard address, never round-robin across shards)."""
+    base = f"elasticdl-tpu-{job_name}-rowservice"
+    return base if shard == 0 else f"{base}-s{shard}"
 
 
 def build_row_service_service_manifest(
-    job_name: str, namespace: str = "default", port: int = ROW_SERVICE_PORT
+    job_name: str, namespace: str = "default",
+    port: int = ROW_SERVICE_PORT, shard: int = 0,
 ) -> dict:
     return {
         "apiVersion": "v1",
         "kind": "Service",
         "metadata": {
-            "name": get_row_service_service_name(job_name),
+            "name": get_row_service_service_name(job_name, shard),
             "namespace": namespace,
-            "labels": _labels(job_name, "rowservice"),
+            "labels": _labels(job_name, "rowservice", shard),
         },
         "spec": {
-            "selector": _labels(job_name, "rowservice"),
+            # Selector pins the shard index: each shard Service must
+            # route to exactly its own pod (rows live by id % N).
+            "selector": _labels(job_name, "rowservice", shard),
             "ports": [{"port": port, "targetPort": port}],
             "clusterIP": "None",
         },
@@ -354,9 +365,15 @@ class Client:
             errors.append(f"service: {exc}")
         for optional_service in (
             # Exist only for some job shapes (--tensorboard_log_dir /
-            # host-tier models); delete_service no-ops on 404.
+            # host-tier models); delete_service no-ops on 404. Row
+            # services are per-shard (shard 0 = legacy unsuffixed
+            # name); sweeping a fixed shard range keeps `clean`
+            # argument-free.
             get_tensorboard_service_name(job_name),
-            get_row_service_service_name(job_name),
+            *(
+                get_row_service_service_name(job_name, shard)
+                for shard in range(16)
+            ),
         ):
             try:
                 self.delete_service(optional_service)

@@ -99,22 +99,62 @@ def build_worker(args, master_client=None) -> Worker:
 
         shape, axes = parse_mesh_args(args.mesh_shape, args.mesh_axes)
         mesh = make_mesh(shape, axes)
-        # Mesh-aware models (e.g. the transformer flagship) rebuild with
-        # the mesh so ring attention / sharding constraints activate; the
-        # zoo module's sharding rules drive param & batch layout.
-        spec.model = spec.make_model(mesh)
-        step_runner = make_runner_for_spec(
-            spec,
-            mesh,
-            # grads_to_wait maps onto gradient accumulation before the
-            # sync apply (SURVEY.md §7.4); async staleness LR modulation
-            # becomes per-microbatch 1/staleness weighting.
-            accum_steps=getattr(args, "grads_to_wait", 1),
-            staleness_modulation=(
+        if spec.make_sparse_runner is not None:
+            # Device-tier sparse plane over the mesh: TableSpec tables
+            # (+slots) row-shard over the first mesh axis, the batch
+            # shards over it too, dense params replicate — the
+            # multi-chip form of the reference's N-parameter-server
+            # sparse plane (docs/designs/parameter_server.md).
+            import inspect
+
+            params = inspect.signature(
+                spec.make_sparse_runner
+            ).parameters
+            accepts_mesh = "mesh" in params or any(
+                p.kind is inspect.Parameter.VAR_KEYWORD
+                for p in params.values()
+            )
+            if not accepts_mesh:
+                raise ValueError(
+                    f"{args.model_def}: make_sparse_runner must accept "
+                    "mesh=... to run under MeshStrategy"
+                )
+            # The dense mesh path maps --grads_to_wait onto gradient
+            # accumulation and async onto staleness LR modulation;
+            # the sparse step has no accumulation mode — fail loudly
+            # rather than silently change effective batch semantics.
+            if getattr(args, "grads_to_wait", 1) > 1 or (
                 getattr(args, "use_async", False)
                 and getattr(args, "lr_staleness_modulation", False)
-            ),
-        )
+            ):
+                raise ValueError(
+                    "device-tier sparse models do not support "
+                    "--grads_to_wait > 1 or async staleness LR "
+                    "modulation under MeshStrategy; the sparse step "
+                    "applies each batch's row grads directly"
+                )
+            step_runner = spec.make_sparse_runner(
+                mesh=mesh, axis=axes[0]
+            )
+        else:
+            # Mesh-aware models (e.g. the transformer flagship) rebuild
+            # with the mesh so ring attention / sharding constraints
+            # activate; the zoo module's sharding rules drive param &
+            # batch layout.
+            spec.model = spec.make_model(mesh)
+            step_runner = make_runner_for_spec(
+                spec,
+                mesh,
+                # grads_to_wait maps onto gradient accumulation before
+                # the sync apply (SURVEY.md §7.4); async staleness LR
+                # modulation becomes per-microbatch 1/staleness
+                # weighting.
+                accum_steps=getattr(args, "grads_to_wait", 1),
+                staleness_modulation=(
+                    getattr(args, "use_async", False)
+                    and getattr(args, "lr_staleness_modulation", False)
+                ),
+            )
     if spec.make_host_runner is not None:
         # Host-tier model (>HBM tables, embedding/host_engine.py): the
         # zoo module supplies the runner holding its row stores.
@@ -153,6 +193,11 @@ def build_worker(args, master_client=None) -> Worker:
                     "--row_service_addr"
                 )
             step_runner = spec.make_host_runner()
+    if step_runner is None and spec.make_sparse_runner is not None:
+        # Device-tier sparse model under the default strategy: the
+        # plain single-device runner (tables in HBM next to the model)
+        # — same wiring LocalExecutor uses.
+        step_runner = spec.make_sparse_runner()
     if master_client is None:
         master_client = MasterClient(
             args.master_addr, worker_id=args.worker_id

@@ -50,14 +50,19 @@ TABLE_SPECS = (
 
 
 class RecsysRanker(nn.Module):
-    """Combined item embedding -> MLP -> click logit."""
+    """Combined item embedding -> MLP -> click logit. ``table_name`` /
+    ``emb_dim`` are attributes so small-shape harnesses (the multichip
+    dryrun) can instantiate the same module against a tiny TableSpec."""
 
     hidden: tuple = (256, 128)
     compute_dtype: jnp.dtype = jnp.bfloat16
+    table_name: str = TABLE_NAME
+    emb_dim: int = DIM
 
     @nn.compact
     def __call__(self, features, training=False):
-        emb = SparseEmbed(TABLE_NAME, DIM)()  # (B, DIM) from the runner
+        # (B, emb_dim) from the runner
+        emb = SparseEmbed(self.table_name, self.emb_dim)()
         x = emb.astype(self.compute_dtype)
         for width in self.hidden:
             x = nn.relu(nn.Dense(width, dtype=self.compute_dtype)(x))
@@ -101,12 +106,16 @@ def optimizer(lr=0.001):
     return optax.adam(lr)
 
 
-def make_sparse_runner(use_pallas: str = "auto") -> DeviceSparseRunner:
+def make_sparse_runner(use_pallas: str = "auto",
+                       mesh=None, axis: str = "dp") -> DeviceSparseRunner:
     """Step-runner factory (the sparse-tier analogue of
     deepfm_host.make_host_runner). Adagrad rows — the reference PS's
-    canonical sparse optimizer (optimizer_wrapper.py slot tables)."""
+    canonical sparse optimizer (optimizer_wrapper.py slot tables).
+    With ``mesh``, the 1M x 256 table row-shards over ``axis`` (it is
+    far over the 2MB partition threshold)."""
     return DeviceSparseRunner(
-        TABLE_SPECS, Adagrad(lr=0.05), use_pallas=use_pallas
+        TABLE_SPECS, Adagrad(lr=0.05), use_pallas=use_pallas,
+        mesh=mesh, axis=axis,
     )
 
 

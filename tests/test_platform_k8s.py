@@ -397,7 +397,7 @@ class TestRowServicePods:
             _dispatcher(), client, job_name="j", image_name="img",
             worker_command=lambda wid: ["run", str(wid)],
             num_workers=1,
-            row_service_command=lambda: ["serve-rows"],
+            row_service_command=lambda shard: ["serve-rows", str(shard)],
             **kw,
         )
         return mgr, client
@@ -431,7 +431,9 @@ class TestRowServicePods:
         assert svc["metadata"]["name"] == get_row_service_service_name("j")
         pod = next(m for m in client.created if m.get("kind") != "Service")
         assert pod["metadata"]["name"] == get_row_service_pod_name("j")
-        assert pod["spec"]["containers"][0]["command"] == ["serve-rows"]
+        assert pod["spec"]["containers"][0]["command"] == [
+            "serve-rows", "0",
+        ]
 
     def test_death_relaunches_fresh_pod_same_service(self):
         from elasticdl_tpu.platform.k8s_client import (
@@ -468,6 +470,52 @@ class TestRowServicePods:
         assert len(
             [m for m in client.created if m.get("kind") != "Service"]
         ) == n_pods
+
+    def test_sharded_row_service_pods_and_relaunch(self):
+        """N shards: one stable Service + pod per shard (the
+        reference's N PS pods); a dead shard relaunches under ITS
+        generation suffix while the other shard is untouched."""
+        from elasticdl_tpu.platform.k8s_client import (
+            get_row_service_pod_name,
+            get_row_service_service_name,
+        )
+
+        mgr, client = self._manager(num_row_service_shards=2)
+        mgr.start_row_service()
+        services = [
+            m for m in client.created if m.get("kind") == "Service"
+        ]
+        assert [s["metadata"]["name"] for s in services] == [
+            get_row_service_service_name("j", 0),
+            get_row_service_service_name("j", 1),
+        ]
+        # Per-shard selectors: shard routing must never round-robin.
+        assert (
+            services[0]["spec"]["selector"]
+            != services[1]["spec"]["selector"]
+        )
+        pods = [m for m in client.created if m.get("kind") != "Service"]
+        assert [p["metadata"]["name"] for p in pods] == [
+            get_row_service_pod_name("j", shard=0),
+            get_row_service_pod_name("j", shard=1),
+        ]
+        assert pods[1]["spec"]["containers"][0]["command"] == [
+            "serve-rows", "1",
+        ]
+
+        # Kill shard 1: only it relaunches, with its own generation.
+        event = self._rs_dead_event(
+            get_row_service_pod_name("j", shard=1)
+        )
+        event["object"]["metadata"]["labels"][
+            "elasticdl-tpu-replica-index"
+        ] = "1"
+        mgr._event_cb(event)
+        pods = [m for m in client.created if m.get("kind") != "Service"]
+        assert pods[-1]["metadata"]["name"] == get_row_service_pod_name(
+            "j", generation=1, shard=1
+        )
+        assert len(pods) == 3
 
     def test_no_row_service_without_command(self):
         client = FakeK8sClient()
@@ -513,6 +561,29 @@ def test_master_wires_row_service_for_host_models(tmp_path):
     assert rcmd[rcmd.index("--checkpoint_dir") + 1].endswith(
         "/row_service"
     )
+    # 2 shards: comma addr list + per-shard checkpoint subdirs.
+    args_sharded = parse_master_args([
+        "--model_zoo", model_zoo_dir(),
+        "--model_def", "deepfm.deepfm_host.custom_model",
+        "--training_data", train,
+        "--minibatch_size", "16",
+        "--num_workers", "2",
+        "--num_row_service_shards", "2",
+        "--job_name", "hostjob",
+        "--checkpoint_dir", str(tmp_path / "ckpt"),
+        "--checkpoint_steps", "4",
+    ])
+    sharded = Master(args_sharded)
+    wcmd = sharded._worker_command(0)
+    assert wcmd[wcmd.index("--row_service_addr") + 1] == (
+        "elasticdl-tpu-hostjob-rowservice:6100,"
+        "elasticdl-tpu-hostjob-rowservice-s1:6100"
+    )
+    rcmd1 = sharded._row_service_command(1)
+    assert rcmd1[rcmd1.index("--checkpoint_dir") + 1].endswith(
+        "/row_service/s1"
+    )
+
     # Non-host model: no row service.
     args2 = parse_master_args([
         "--model_zoo", model_zoo_dir(),

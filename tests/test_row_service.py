@@ -510,3 +510,181 @@ def test_failed_apply_does_not_burn_seq():
     # And a genuine duplicate is still dropped.
     resp = svc._push_row_grads(dict(push))
     assert resp.get("duplicate") is True
+
+
+# ---- sharded row service (N servers, id % N client-side scatter) --------
+
+
+def _start_shard(port=0, lr=0.5, ckpt=""):
+    return HostRowService(
+        {"items": EmbeddingTable("items", DIM)},
+        HostOptimizerWrapper(SGD(lr=lr)),
+        checkpoint_dir=ckpt, checkpoint_steps=1 if ckpt else 0,
+    ).start(f"localhost:{port}")
+
+
+def test_sharded_engine_routes_by_id_mod_n():
+    """2-shard engine: pulls/pushes scatter by id % 2 (the reference
+    worker's PS scatter, worker.py:362-391/570-580) — each server only
+    ever materializes its own rows, values match the single-table
+    reference exactly."""
+    shards = [_start_shard(), _start_shard()]
+    try:
+        addr = ",".join(f"localhost:{s.port}" for s in shards)
+        engine = make_remote_engine(addr, id_keys={"items": "ids"})
+        table = engine.tables["items"]
+        assert table.dim == DIM
+
+        ids = np.array([3, 8, 13, 20, 7])
+        rows = table.get(ids)
+        ref = EmbeddingTable("items", DIM)
+        np.testing.assert_array_equal(rows, ref.get(ids))
+
+        grads = np.arange(5 * DIM, dtype=np.float32).reshape(5, DIM)
+        engine.optimizer.apply_gradients(table, ids, grads)
+        after = table.get(ids)
+        np.testing.assert_allclose(after, rows - 0.5 * grads, rtol=1e-6)
+
+        # Placement: every materialized row sits on its id%2 home shard
+        # (the same placement checkpoint/saver.py uses for row file
+        # shards).
+        for s, svc in enumerate(shards):
+            got_ids, _ = svc._tables["items"].to_arrays()
+            assert got_ids.size > 0
+            assert all(int(i) % 2 == s for i in got_ids), (s, got_ids)
+    finally:
+        for s in shards:
+            s.stop(0)
+
+
+def test_sharded_engine_rejects_mismatched_shards():
+    a = HostRowService(
+        {"items": EmbeddingTable("items", DIM)},
+        HostOptimizerWrapper(SGD(lr=0.5)),
+    ).start()
+    b = HostRowService(
+        {"other": EmbeddingTable("other", DIM)},
+        HostOptimizerWrapper(SGD(lr=0.5)),
+    ).start()
+    try:
+        with pytest.raises(ValueError, match="different tables"):
+            make_remote_engine(
+                f"localhost:{a.port},localhost:{b.port}",
+                id_keys={"items": "ids"},
+            )
+    finally:
+        a.stop(0)
+        b.stop(0)
+
+
+def test_sharded_export_dense_merges_home_shards():
+    shards = [_start_shard(), _start_shard()]
+    try:
+        addr = ",".join(f"localhost:{s.port}" for s in shards)
+        engine = make_remote_engine(addr, id_keys={"items": "ids"})
+        table = engine.tables["items"]
+        ids = np.array([1, 2, 6])
+        engine.optimizer.apply_gradients(
+            table, ids, np.ones((3, DIM), np.float32)
+        )
+        dense = table.export_dense(10, chunk=4)
+        assert dense.shape == (10, DIM)
+        ref = EmbeddingTable("items", DIM)
+        want = np.asarray(ref.get(np.arange(10)), np.float32)
+        want[ids] -= 0.5
+        np.testing.assert_allclose(dense, want, rtol=1e-6)
+    finally:
+        for s in shards:
+            s.stop(0)
+
+
+@pytest.mark.slow
+def test_two_shard_job_with_shard_restart(tmp_path):
+    """The reference PS-restart shape at N=2 (VERDICT r3 #2): a 2-worker
+    deepfm job over a 2-shard row service; shard 1 is killed after the
+    first completed task and relaunched on the same port from its own
+    checkpoint. Workers ride the outage on RPC retries; the job drains
+    and every shard holds exactly its id%2 rows."""
+    import threading
+    import time as _time
+
+    from model_zoo.deepfm import deepfm_host
+
+    train = create_frappe_record_file(str(tmp_path / "t.rec"), 192, seed=11)
+
+    def shard_service(port=0, ckpt=""):
+        svc = deepfm_host.make_row_service()
+        if ckpt:
+            svc.configure_checkpoint(ckpt, checkpoint_steps=1)
+        return svc.start(f"localhost:{port}")
+
+    ckpt1 = str(tmp_path / "shard1_ckpt")
+    shards = [shard_service(), shard_service(ckpt=ckpt1)]
+    addr = ",".join(f"localhost:{s.port}" for s in shards)
+    port1 = shards[1].port
+
+    state = {"killed": False, "relaunched": None}
+
+    def kill_once(_request):
+        if state["killed"]:
+            return
+        state["killed"] = True
+        shards[1].stop(0)
+
+        def relaunch():
+            _time.sleep(1.0)
+            for _ in range(20):
+                try:
+                    state["relaunched"] = shard_service(
+                        port=port1, ckpt=ckpt1
+                    )
+                    return
+                except Exception:
+                    _time.sleep(0.5)
+
+        threading.Thread(target=relaunch, daemon=True).start()
+
+    cluster = MiniCluster(
+        model_zoo=model_zoo_dir(),
+        model_def="deepfm.deepfm_host.custom_model",
+        training_data=train,
+        minibatch_size=16,
+        num_minibatches_per_task=1,
+        num_workers=2,
+        step_runner_factory=lambda: deepfm_host.make_host_runner(
+            remote_addr=addr
+        ),
+        worker_callbacks={"report_task_result": kill_once},
+    )
+    cluster.run()
+    assert cluster.finished
+    assert state["killed"] and state["relaunched"] is not None
+    live = [shards[0], state["relaunched"]]
+    try:
+        for s, svc in enumerate(live):
+            ids, _ = svc._tables[deepfm_host.TABLE_NAME].to_arrays()
+            assert ids.size > 0
+            assert all(int(i) % 2 == s for i in ids)
+    finally:
+        for svc in live:
+            svc.stop(0)
+
+
+def test_shard_layout_guard(tmp_path):
+    """Relaunching with a different --num_row_service_shards against an
+    existing checkpoint must fail loudly (silent row loss otherwise);
+    a version-holding dir without a marker is the pre-shard layout."""
+    from elasticdl_tpu.embedding.row_service import validate_shard_layout
+
+    ckpt = str(tmp_path / "ck")
+    validate_shard_layout(ckpt, shard=1, num_shards=2)  # fresh: records
+    validate_shard_layout(ckpt, shard=1, num_shards=2)  # same: ok
+    with pytest.raises(SystemExit, match="shard 1/2"):
+        validate_shard_layout(ckpt, shard=1, num_shards=4)
+
+    # Legacy dir: versions but no marker -> treated as 1-shard layout.
+    legacy = str(tmp_path / "legacy")
+    CheckpointSaver(legacy).save(1, {"w": np.zeros((2,), np.float32)})
+    with pytest.raises(SystemExit, match="shard 0/1"):
+        validate_shard_layout(legacy, shard=0, num_shards=2)
+    validate_shard_layout(legacy, shard=0, num_shards=1)  # unchanged: ok

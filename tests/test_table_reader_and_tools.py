@@ -989,3 +989,153 @@ class TestPerDatasetConverters:
             scanner = RecordFileScanner(str(tmp_path / s))
             total += scanner.num_records
         assert total == 90
+
+
+class _FakeEntry:
+    """Duck-typed ODPS entry for the in-warehouse kv transform driver
+    (tools/table_tools/transform_kv_table.py): records every resource /
+    function / SQL interaction so the test can assert the full
+    register -> CTAS -> cleanup lifecycle without pyodps."""
+
+    class _Obj:
+        def __init__(self, owner, kind, name):
+            self._owner, self._kind, self._name = owner, kind, name
+
+        def drop(self):
+            self._owner.dropped.append((self._kind, self._name))
+
+    class _Instance:
+        def __init__(self, owner):
+            self._owner = owner
+
+        def wait_for_success(self):
+            self._owner.waited = True
+
+    class _Record(dict):
+        pass
+
+    class _Table:
+        def __init__(self, rows):
+            self._rows = rows
+
+        def head(self, n, partition=None):
+            return self._rows[:n]
+
+    def __init__(self, rows):
+        self._rows = rows
+        self.resources = {}
+        self.functions = {}
+        self.dropped = []
+        self.deleted_tables = []
+        self.sql = []
+        self.waited = False
+
+    def get_table(self, name):
+        return self._Table(self._rows)
+
+    def create_resource(self, name, type, file_obj):
+        self.resources[name] = file_obj.read()
+        return self._Obj(self, "resource", name)
+
+    def create_function(self, name, class_type, resources):
+        self.functions[name] = class_type
+        return self._Obj(self, "function", name)
+
+    def get_resource(self, name):
+        if name not in self.resources:
+            raise KeyError(name)
+        return self._Obj(self, "resource", name)
+
+    def get_function(self, name):
+        if name not in self.functions:
+            raise KeyError(name)
+        return self._Obj(self, "function", name)
+
+    def delete_table(self, name, if_exists=False):
+        self.deleted_tables.append(name)
+
+    def run_sql(self, sql):
+        self.sql.append(sql)
+        return self._Instance(self)
+
+
+class TestKvTransformTools:
+    """In-warehouse kv flatten (reference tools/odps_table_tools):
+    UDTF parse semantics + the SQL-transform driver lifecycle."""
+
+    def _tools(self):
+        sys.path.insert(0, os.path.join(REPO, "tools", "table_tools"))
+        try:
+            import kv_udtf
+            import transform_kv_table
+        finally:
+            sys.path.pop(0)
+        return kv_udtf, transform_kv_table
+
+    def test_udtf_flattens_and_appends(self):
+        kv_udtf, _ = self._tools()
+        rows = []
+
+        class Collect(kv_udtf.KVFlatten):
+            def forward(self, *values):
+                rows.append(values)
+
+        udtf = Collect()
+        udtf.process("age:32,hours:40", 7, 1, "age,hours,zip", ",", ":")
+        # missing key -> "", append columns stringified after features
+        assert rows == [("32", "40", "", "7", "1")]
+        with pytest.raises(ValueError, match="KVFlatten needs"):
+            udtf.process("a:1", "a")
+
+    def test_udtf_skips_malformed_items(self):
+        kv_udtf, _ = self._tools()
+        got = kv_udtf.parse_kv_values(
+            "a:1,,broken, b :2", ["a", "b", "c"]
+        )
+        assert got == ["1", "2", ""]
+
+    def test_transform_lifecycle_and_sql(self):
+        kv_udtf, tkt = self._tools()
+        rows = [
+            _FakeEntry._Record({"kv": "age:32,hours:40", "label": 1}),
+            _FakeEntry._Record({"kv": "zip:94110", "label": 0}),
+        ]
+        entry = _FakeEntry(rows)
+        sql = tkt.run_transform(
+            entry, "census_kv", "kv", "census_wide",
+            append_columns=("label",), tag="t0", log=lambda *_: None,
+        )
+        # schema discovered from the sampled head, sorted + stable
+        assert 'AS (age, hours, zip, label)' in sql
+        assert "CREATE TABLE IF NOT EXISTS census_wide" in sql
+        assert "FROM census_kv" in sql
+        assert entry.sql == [sql] and entry.waited
+        assert entry.deleted_tables == ["census_wide"]
+        # the uploaded resource is the self-contained UDTF source
+        assert "class KVFlatten" in entry.resources[
+            "elasticdl_kv_udtf_t0.py"
+        ]
+        assert entry.functions["elasticdl_kv_flatten_t0"] == (
+            "elasticdl_kv_udtf_t0.KVFlatten"
+        )
+        # both temporaries dropped afterwards
+        assert ("function", "elasticdl_kv_flatten_t0") in entry.dropped
+        assert ("resource", "elasticdl_kv_udtf_t0.py") in entry.dropped
+
+    def test_partition_and_empty_sample_guard(self):
+        _, tkt = self._tools()
+        entry = _FakeEntry([_FakeEntry._Record({"kv": ""})])
+        with pytest.raises(ValueError, match="no kv keys"):
+            tkt.discover_feature_names(entry, "t", "kv")
+        sql = tkt.generate_transform_sql(
+            "t_in", "t_out", "fn", "kv", ["a"], partition="dt='20260731'"
+        )
+        assert sql.endswith("WHERE dt='20260731'")
+
+    def test_discover_rejects_non_identifier_keys(self):
+        _, tkt = self._tools()
+        entry = _FakeEntry([
+            _FakeEntry._Record({"kv": 'age:32,click-rate:0.5'}),
+        ])
+        with pytest.raises(ValueError, match="not valid SQL"):
+            tkt.discover_feature_names(entry, "t", "kv")

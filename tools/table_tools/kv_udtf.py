@@ -1,0 +1,62 @@
+"""MaxCompute UDTF that flattens a key-value column inside SQL.
+
+In-warehouse counterpart of the reference's ``KVFlatter`` UDTF
+(``tools/odps_table_tools/normalize_kv_udf.py:1-52``): the driver
+(``transform_kv_table.py``) uploads this file as an ODPS python
+resource and registers :class:`KVFlatten` as a UDTF; each input row's
+kv string ("k1:v1,k2:v2") expands into one output column per requested
+feature name, with any append columns (ids, labels) passed through.
+
+This file must stay SELF-CONTAINED (no repo imports): it executes
+inside the MaxCompute runtime, uploaded as a single .py resource. The
+parse helper is pure so the class body is unit-testable without the
+``odps`` runtime (the ``BaseUDTF`` import is gated).
+
+Argument contract (mirrored by ``transform_kv_table.generate_udtf_call``):
+``process(kv_value, *append_values, feature_names_csv, pair_sep,
+kv_sep)`` — the last three args are constants baked into the generated
+SQL, everything before them is per-row column data.
+"""
+
+try:  # pragma: no cover - only importable inside the ODPS runtime
+    from odps.udf import BaseUDTF
+except ImportError:  # unit tests / local tooling
+    class BaseUDTF(object):
+        def forward(self, *values):  # collected by tests
+            raise NotImplementedError
+
+
+def parse_kv_values(kv_string, feature_names, pair_sep=",", kv_sep=":"):
+    """"k1:v1,k2:v2" -> [value-or-"" for each name in feature_names].
+
+    Malformed items (no separator, empty) are skipped; missing keys
+    yield "" so the output column count is always ``len(feature_names)``.
+    """
+    table = {}
+    for item in (kv_string or "").split(pair_sep):
+        key, sep, value = item.strip().partition(kv_sep)
+        if sep and key:
+            table[key.strip()] = value
+    return [table.get(name, "") for name in feature_names]
+
+
+class KVFlatten(BaseUDTF):
+    """Expand one kv column into wide feature columns + append columns.
+
+    ``args[0]``: the kv string column; ``args[1:-3]``: append column
+    values (forwarded as strings after the features); ``args[-3]``:
+    comma-joined feature names; ``args[-2]``: pair separator;
+    ``args[-1]``: key-value separator.
+    """
+
+    def process(self, *args):
+        if len(args) < 4:
+            raise ValueError(
+                "KVFlatten needs (kv, [append...], names, pair_sep, "
+                "kv_sep); got %d args" % len(args)
+            )
+        feature_names = args[-3].split(",")
+        pair_sep, kv_sep = args[-2], args[-1]
+        values = parse_kv_values(args[0], feature_names, pair_sep, kv_sep)
+        values.extend(str(v) for v in args[1:-3])
+        self.forward(*values)

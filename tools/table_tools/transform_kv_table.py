@@ -1,0 +1,222 @@
+"""Flatten a key-value ODPS table column into a wide table, in-warehouse.
+
+Counterpart of the reference's SQL-transform driver
+(``tools/odps_table_tools/transform_kv_table.py:1-318``): sample the
+head of the input table to discover the union of feature names,
+register ``kv_udtf.py`` as a python resource + UDTF function, run one
+``CREATE TABLE ... AS SELECT udtf(...)`` over the input, and drop the
+temporaries — so terabyte kv tables flatten inside the warehouse
+instead of streaming through the client (the local/CSV pipeline for
+that is ``flatten_kv.py``).
+
+Everything except the three entry-touching helpers
+(``discover_feature_names`` / ``register_udtf`` / ``run_transform``) is
+pure string work, unit-tested against a duck-typed fake entry
+(tests/test_table_reader_and_tools.py); real egress needs pyodps
+credentials via flags or ODPS_* env vars.
+"""
+
+import argparse
+import os
+import re
+import sys
+import time
+
+PAIR_SEP = ","
+KV_SEP = ":"
+UDTF_CLASS = "KVFlatten"
+SAMPLE_ROWS = 100
+
+# Discovered kv keys become SQL column identifiers AND ride inside a
+# double-quoted literal in the generated CTAS — restrict them to plain
+# identifiers so data can never inject into the SQL (or, via a comma,
+# corrupt KVFlatten's names_csv split).
+_IDENTIFIER_RE = re.compile(r"^[A-Za-z_][A-Za-z0-9_]*$")
+
+SQL_TEMPLATE = (
+    "CREATE TABLE IF NOT EXISTS {output_table} LIFECYCLE 7 AS\n"
+    "SELECT\n    {udtf_call}\nFROM {input_table}"
+)
+
+
+def parse_kv_keys(kv_string, pair_sep=PAIR_SEP, kv_sep=KV_SEP):
+    """Key names present in one kv cell (malformed items skipped)."""
+    keys = []
+    for item in (kv_string or "").split(pair_sep):
+        key, sep, _ = item.strip().partition(kv_sep)
+        if sep and key:
+            keys.append(key.strip())
+    return keys
+
+
+def discover_feature_names(entry, table_name, kv_column, partition=None,
+                           sample_rows=SAMPLE_ROWS, pair_sep=PAIR_SEP,
+                           kv_sep=KV_SEP):
+    """Union of kv keys over the first ``sample_rows`` records — the
+    output schema. Sorted so reruns produce a stable column order."""
+    table = entry.get_table(table_name)
+    names = set()
+    for record in table.head(sample_rows, partition=partition):
+        names.update(parse_kv_keys(record[kv_column], pair_sep, kv_sep))
+    if not names:
+        raise ValueError(
+            f"no kv keys found in the first {sample_rows} rows of "
+            f"{table_name}.{kv_column}"
+        )
+    bad = sorted(n for n in names if not _IDENTIFIER_RE.match(n))
+    if bad:
+        raise ValueError(
+            f"kv keys {bad} are not valid SQL identifiers "
+            "([A-Za-z_][A-Za-z0-9_]*); clean the source column before "
+            "transforming (keys become output column names)"
+        )
+    return sorted(names)
+
+
+def generate_udtf_call(function, kv_column, feature_names,
+                       append_columns=(), pair_sep=PAIR_SEP,
+                       kv_sep=KV_SEP):
+    """The SELECT expression: matches KVFlatten's argument contract
+    (kv, *append, names_csv, pair_sep, kv_sep) AS (features..., append...)."""
+    in_cols = ", ".join([kv_column, *append_columns])
+    out_cols = ", ".join([*feature_names, *append_columns])
+    names_csv = ",".join(feature_names)
+    return (
+        f'{function}({in_cols}, "{names_csv}", "{pair_sep}", '
+        f'"{kv_sep}") AS ({out_cols})'
+    )
+
+
+def generate_transform_sql(input_table, output_table, function,
+                           kv_column, feature_names, append_columns=(),
+                           partition=None, pair_sep=PAIR_SEP,
+                           kv_sep=KV_SEP):
+    sql = SQL_TEMPLATE.format(
+        output_table=output_table,
+        udtf_call=generate_udtf_call(
+            function, kv_column, feature_names, append_columns,
+            pair_sep, kv_sep,
+        ),
+        input_table=input_table,
+    )
+    if partition:
+        sql += f"\nWHERE {partition}"
+    return sql
+
+
+def register_udtf(entry, udf_path=None, tag=None):
+    """Upload kv_udtf.py as a py resource and register the UDTF.
+    Returns (resource_name, function_name) for cleanup; pre-existing
+    same-named leftovers from a crashed run are dropped first."""
+    if udf_path is None:
+        udf_path = os.path.join(os.path.dirname(__file__), "kv_udtf.py")
+    tag = tag or str(int(time.time()))
+    resource_name = f"elasticdl_kv_udtf_{tag}.py"
+    function_name = f"elasticdl_kv_flatten_{tag}"
+    drop_udtf(entry, resource_name, function_name)
+    with open(udf_path) as fh:
+        resource = entry.create_resource(
+            resource_name, type="py", file_obj=fh
+        )
+    entry.create_function(
+        function_name,
+        class_type=f"{resource_name[:-3]}.{UDTF_CLASS}",
+        resources=[resource],
+    )
+    return resource_name, function_name
+
+
+def drop_udtf(entry, resource_name, function_name):
+    """Best-effort cleanup (missing objects are fine)."""
+    for getter, name in (
+        (entry.get_function, function_name),
+        (entry.get_resource, resource_name),
+    ):
+        try:
+            obj = getter(name)
+            if obj is not None:
+                obj.drop()
+        except Exception:  # noqa: BLE001 - NoSuchObject et al.
+            pass
+
+
+def run_transform(entry, input_table, kv_column, output_table,
+                  partition=None, append_columns=(), udf_path=None,
+                  tag=None, pair_sep=PAIR_SEP, kv_sep=KV_SEP,
+                  log=print):
+    """End-to-end: discover schema, register UDTF, run the CTAS, clean
+    up. Returns the generated SQL (the audit artifact)."""
+    resource_name, function_name = register_udtf(
+        entry, udf_path=udf_path, tag=tag
+    )
+    try:
+        feature_names = discover_feature_names(
+            entry, input_table, kv_column, partition=partition,
+            pair_sep=pair_sep, kv_sep=kv_sep,
+        )
+        entry.delete_table(output_table, if_exists=True)
+        sql = generate_transform_sql(
+            input_table, output_table, function_name, kv_column,
+            feature_names, append_columns, partition=partition,
+            pair_sep=pair_sep, kv_sep=kv_sep,
+        )
+        log(f"transform sql:\n{sql}")
+        instance = entry.run_sql(sql)
+        instance.wait_for_success()
+    finally:
+        drop_udtf(entry, resource_name, function_name)
+    return sql
+
+
+def _build_entry(args):
+    try:
+        from odps import ODPS
+    except ImportError as exc:  # pragma: no cover - env without pyodps
+        raise SystemExit(
+            "pyodps is not installed; transform_kv_table needs the "
+            "odps package for real table access"
+        ) from exc
+
+    def flag_or_env(value, env):
+        return value or os.environ.get(env) or ""
+
+    return ODPS(
+        access_id=flag_or_env(args.access_id, "ODPS_ACCESS_ID"),
+        secret_access_key=flag_or_env(args.access_key, "ODPS_ACCESS_KEY"),
+        project=flag_or_env(args.project, "ODPS_PROJECT"),
+        endpoint=flag_or_env(args.endpoint, "ODPS_ENDPOINT"),
+    )
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--input_table", required=True)
+    parser.add_argument("--output_table", required=True)
+    parser.add_argument("--kv_column", required=True)
+    parser.add_argument("--input_table_partition", default=None)
+    parser.add_argument(
+        "--append_columns", default="",
+        help="comma list of pass-through columns, e.g. 'id,label'",
+    )
+    parser.add_argument("--pair_separator", default=PAIR_SEP)
+    parser.add_argument("--kv_separator", default=KV_SEP)
+    parser.add_argument("--access_id", default="")
+    parser.add_argument("--access_key", default="")
+    parser.add_argument("--project", default="")
+    parser.add_argument("--endpoint", default="")
+    args = parser.parse_args(argv)
+
+    append = tuple(
+        c.strip() for c in args.append_columns.split(",") if c.strip()
+    )
+    run_transform(
+        _build_entry(args), args.input_table, args.kv_column,
+        args.output_table, partition=args.input_table_partition,
+        append_columns=append, pair_sep=args.pair_separator,
+        kv_sep=args.kv_separator,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
