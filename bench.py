@@ -84,13 +84,17 @@ def main():
 
     elasticity = {}
     elastic = _run("bench_elasticity.py")
-    for rec in _parse_metric_lines(elastic.stdout):
-        name = rec["metric"].split("[")[0]
-        if name.startswith("elastic_"):
-            elasticity[name[len("elastic_"):]] = {
-                "value": rec["value"], "unit": rec["unit"],
-                "vs_baseline": rec["vs_baseline"],
-            }
+    # Mesh-resize under load (dp4 -> dp2 -> dp4 on a virtual CPU mesh;
+    # sets its own JAX_PLATFORMS=cpu so it never contends for the chip).
+    resize = _run("bench_elasticity.py", "--scenario", "resize")
+    for proc in (elastic, resize):
+        for rec in _parse_metric_lines(proc.stdout):
+            name = rec["metric"].split("[")[0]
+            if name.startswith("elastic_"):
+                elasticity[name[len("elastic_"):]] = {
+                    "value": rec["value"], "unit": rec["unit"],
+                    "vs_baseline": rec["vs_baseline"],
+                }
 
     worst = min(
         (c["vs_floor"] for c in configs.values()), default=0.0
@@ -105,7 +109,8 @@ def main():
     }))
     # Floor regressions and crashed sub-benches fail the bench loudly.
     return (
-        0 if suite.returncode == 0 and elastic.returncode == 0 else 1
+        0 if suite.returncode == 0 and elastic.returncode == 0
+        and resize.returncode == 0 else 1
     )
 
 

@@ -70,7 +70,232 @@ def _make_cluster(train, ckpt_dir, kill_after_tasks=None):
     )
 
 
+def run_resize_scenario():
+    """Mesh-resize under load: dp4 -> dp2 -> dp4 on a virtual CPU mesh.
+
+    The reference's pitch is utilization under elasticity — a worker
+    leaves, the job keeps most of its throughput, the worker returns,
+    throughput recovers. On TPU a membership change is a NEW Mesh
+    (tests/test_elastic_mesh_resize.py proves correctness); this
+    scenario makes it quantitative: a task-completion timeline across
+    two live resizes, per-phase records/sec, and the recovery seconds
+    each transition costs (kill -> first task completed on the resized
+    mesh). Runs on 8 virtual CPU devices — the timeline SHAPE (not
+    absolute chip rates) is the artifact, same spirit as the
+    reference's minikube bench. Results merge into BENCH_SUITE.json
+    under "elastic_resize" and gate on a hard floor: every phase must
+    finish and worst-phase retention vs phase-1 must stay >= FLOOR.
+    """
+    import jax
+    import numpy as np
+
+    from elasticdl_tpu.checkpoint import CheckpointHook
+    from elasticdl_tpu.core.model_spec import get_model_spec
+    from elasticdl_tpu.parallel.mesh import make_mesh
+    from elasticdl_tpu.parallel.mesh_runner import make_runner_for_spec
+    from elasticdl_tpu.testing.data import (
+        create_mnist_record_file,
+        model_zoo_dir,
+    )
+    from elasticdl_tpu.testing.in_process_master import InProcessMaster
+    from elasticdl_tpu.worker.worker import Worker
+
+    RESIZE_FLOOR = 0.25          # worst-phase retention vs phase 1
+    # Smaller job than the preempt scenario: CPU-mesh steps are ~100x
+    # the chip's and the artifact is the timeline SHAPE — 16 tasks give
+    # ~5 per phase at ~2s each on an idle host.
+    resize_records = 4096
+    mb_per_task = 4
+    records_per_task = MINIBATCH * mb_per_task
+    total_tasks = resize_records // records_per_task
+    kill_points = (total_tasks // 3, 2 * total_tasks // 3)
+
+    tmp = tempfile.mkdtemp(prefix="bench_resize_")
+    train = create_mnist_record_file(
+        os.path.join(tmp, "train.rec"), resize_records, seed=11
+    )
+    ckpt_dir = os.path.join(tmp, "ckpt")
+
+    devices = jax.devices()
+    if len(devices) < 4:
+        raise SystemExit(
+            "resize scenario needs >=4 devices "
+            "(run under xla_force_host_platform_device_count)"
+        )
+    mesh_of = {4: lambda: make_mesh((4,), ("dp",), devices=devices[:4]),
+               2: lambda: make_mesh((2,), ("dp",), devices=devices[:2])}
+    phase_sizes = (4, 2, 4)      # dp4 -> shrink -> regrow
+
+    timeline = []                # (t_rel, phase_idx) per completed task
+    t0 = time.perf_counter()
+
+    def make_worker(worker_id, phase_idx, servicer, spec, reader,
+                    kill_at_total):
+        """A worker on the phase's mesh; raises _Preempted once the
+        job-wide completed-task count reaches ``kill_at_total``."""
+        mesh = mesh_of[phase_sizes[phase_idx]]()
+        spec.model = spec.make_model(mesh)
+        runner = make_runner_for_spec(spec, mesh)
+
+        def on_report(request):
+            # The callback fires BEFORE the servicer records the result:
+            # raising here leaves the trained-but-unreported task in
+            # `doing` (the genuine preemption shape), so it must NOT be
+            # counted — the resized mesh re-trains and re-reports it.
+            if (kill_at_total is not None
+                    and len(timeline) + 1 > kill_at_total):
+                raise _Preempted(f"resize point {kill_at_total}")
+            timeline.append((time.perf_counter() - t0, phase_idx))
+
+        return Worker(
+            worker_id=worker_id,
+            master_client=InProcessMaster(
+                servicer, worker_id=worker_id,
+                callbacks={"report_task_result": on_report},
+            ),
+            model_spec=spec,
+            data_reader=reader,
+            minibatch_size=MINIBATCH,
+            step_runner=runner,
+            checkpoint_hook=CheckpointHook(
+                checkpoint_dir=ckpt_dir,
+                checkpoint_steps=mb_per_task,
+            ),
+            checkpoint_dir_for_init=ckpt_dir if worker_id else "",
+            fuse_task_steps=True,
+        )
+
+    from elasticdl_tpu.testing.cluster import MiniCluster
+
+    cluster = MiniCluster(
+        model_zoo=model_zoo_dir(),
+        model_def="mnist.mnist_functional.custom_model",
+        training_data=train,
+        minibatch_size=MINIBATCH,
+        num_minibatches_per_task=mb_per_task,
+        checkpoint_dir=ckpt_dir,
+        checkpoint_steps=mb_per_task,
+        fuse_task_steps=True,
+    )
+    servicer, dispatcher = cluster.servicer, cluster.dispatcher
+    transitions = []
+    phase_idx = 0
+    worker_id = 0
+    while True:
+        kill_at = (kill_points[phase_idx]
+                   if phase_idx < len(kill_points) else None)
+        spec = get_model_spec(
+            model_zoo_dir(), "mnist.mnist_functional.custom_model"
+        )
+        worker = make_worker(
+            worker_id, phase_idx, servicer, spec, cluster.train_reader,
+            kill_at,
+        )
+        try:
+            worker.run()
+        except _Preempted:
+            # The in-flight task dies with the worker; the master's
+            # watch-event path re-queues it for the resized mesh.
+            if dispatcher.doing_tasks_of(worker_id):
+                dispatcher.recover_tasks(worker_id)
+            transitions.append({
+                "after_tasks": len(timeline),
+                "killed_at": time.perf_counter() - t0,
+            })
+            phase_idx += 1
+            worker_id += 1
+            continue
+        break
+    if not cluster.finished:
+        raise SystemExit("resize scenario did not drain the job")
+
+    # Per-phase throughput from the timeline; recovery = kill -> first
+    # completed task on the new mesh (includes restore + recompile —
+    # the real downtime a resize costs).
+    phases = []
+    for p in range(len(phase_sizes)):
+        stamps = [t for t, ph in timeline if ph == p]
+        if not stamps:
+            phases.append({"dp": phase_sizes[p], "tasks": 0, "rate": 0.0})
+            continue
+        start = 0.0 if p == 0 else transitions[p - 1]["killed_at"]
+        span = max(stamps[-1] - start, 1e-9)
+        phases.append({
+            "dp": phase_sizes[p],
+            "tasks": len(stamps),
+            "rate": round(len(stamps) * records_per_task / span, 2),
+        })
+    recoveries = []
+    for p, tr in enumerate(transitions):
+        nxt = [t for t, ph in timeline if ph == p + 1]
+        recoveries.append(
+            round(nxt[0] - tr["killed_at"], 3) if nxt else None
+        )
+
+    base_rate = phases[0]["rate"] or 1e-9
+    worst_retention = min(ph["rate"] / base_rate for ph in phases)
+    for metric, value, unit, vs in (
+        ("elastic_resize_shrunk_records_per_sec", phases[1]["rate"],
+         "records/sec", phases[1]["rate"] / base_rate),
+        ("elastic_resize_regrown_records_per_sec", phases[2]["rate"],
+         "records/sec", phases[2]["rate"] / base_rate),
+        ("elastic_resize_shrink_recovery_seconds", recoveries[0] or -1.0,
+         "seconds", 0.0),
+        ("elastic_resize_grow_recovery_seconds", recoveries[1] or -1.0,
+         "seconds", 0.0),
+        ("elastic_resize_worst_phase_retention", round(worst_retention, 4),
+         "ratio", round(worst_retention, 4)),
+    ):
+        print(json.dumps({
+            "metric": f"{metric}[cpu-mesh]", "value": round(value, 2),
+            "unit": unit, "vs_baseline": round(vs, 4),
+        }))
+
+    from benchlib import load_json
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    out_path = os.path.join(here, "BENCH_SUITE.json")
+    suite = load_json(out_path, {})
+    suite["elastic_resize"] = {
+        "phases": phases,
+        "recovery_seconds": recoveries,
+        "timeline": [
+            {"t": round(t, 3), "phase": ph} for t, ph in timeline
+        ],
+        "floor": RESIZE_FLOOR,
+        "worst_phase_retention": round(worst_retention, 4),
+    }
+    with open(out_path, "w") as f:
+        json.dump(suite, f, indent=1)
+    if worst_retention < RESIZE_FLOOR:
+        raise SystemExit(
+            f"resize retention {worst_retention:.3f} < floor {RESIZE_FLOOR}"
+        )
+
+
 def main():
+    import argparse as _argparse
+
+    ap = _argparse.ArgumentParser()
+    ap.add_argument("--scenario", choices=("preempt", "resize"),
+                    default="preempt")
+    scenario = ap.parse_args().scenario
+    if scenario == "resize":
+        # Resizes need a multi-device CPU mesh and must not contend for
+        # the bench chip. The site hook registers the TPU plugin and
+        # sets jax_platforms in CONFIG (env vars are too late — same
+        # note as tests/conftest.py), so override the config before the
+        # first backend init; the XLA flag must precede it too.
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        return run_resize_scenario()
+
     import argparse
 
     import jax

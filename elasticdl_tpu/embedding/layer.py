@@ -51,6 +51,10 @@ class Embedding(nn.Module):
     output_dim: int
     combiner: Optional[str] = None
     param_dtype: jnp.dtype = jnp.float32
+    # Table initializer override ((key, shape, dtype) -> array); None =
+    # the Keras/reference uniform(-0.05, 0.05). Used by the
+    # feature-column surface's ``embedding_column(initializer=...)``.
+    initializer: Optional[callable] = None
     # Pallas row-streaming lookup for the ragged path: None = auto,
     # which takes XLA — round-3 device-time measurement overturned the
     # round-2 wall-clock kernel tiers (ops/pallas_embedding
@@ -76,7 +80,7 @@ class Embedding(nn.Module):
     def __call__(self, ids):
         table = self.param(
             EMBEDDING_PARAM_NAME,
-            embedding_init,
+            self.initializer or embedding_init,
             (self.input_dim, self.output_dim),
             self.param_dtype,
         )

@@ -14,6 +14,18 @@ into two planes:
 (reference ``elasticdl_preprocessing/feature_column/feature_column.py``).
 """
 
+from elasticdl_tpu.preprocessing.feature_column import (  # noqa: F401
+    DenseFeatures,
+    apply_host_transforms,
+    bucketized_column,
+    categorical_column_with_hash_bucket,
+    categorical_column_with_identity,
+    categorical_column_with_vocabulary_list,
+    concatenated_categorical_column,
+    embedding_column,
+    indicator_column,
+    numeric_column,
+)
 from elasticdl_tpu.preprocessing.feature_group import (  # noqa: F401
     FeatureGroup,
     concat_feature_ids,

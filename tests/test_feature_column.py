@@ -1,0 +1,225 @@
+"""Feature-column surface (preprocessing/feature_column.py).
+
+Mirrors the reference's two test files:
+- ``elasticdl_preprocessing/tests/feature_column_test.py`` (name /
+  num_buckets / offset arithmetic of concatenated_categorical_column,
+  DenseFeatures call),
+- ``elasticdl/python/tests/feature_column_test.py`` (embedding_column
+  validation + lookup semantics).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from elasticdl_tpu.preprocessing import (
+    DenseFeatures,
+    apply_host_transforms,
+    bucketized_column,
+    categorical_column_with_hash_bucket,
+    categorical_column_with_identity,
+    categorical_column_with_vocabulary_list,
+    concatenated_categorical_column,
+    embedding_column,
+    indicator_column,
+    numeric_column,
+)
+
+
+def _apply(columns, features):
+    mod = DenseFeatures(columns=columns)
+    feats = {k: jnp.asarray(v) for k, v in features.items()}
+    params = mod.init(jax.random.PRNGKey(0), feats)
+    return mod.apply(params, feats), params
+
+
+def test_numeric_column_shapes_and_normalizer():
+    col = numeric_column("x", shape=2, normalizer_fn=lambda v: v * 0.5)
+    out, _ = _apply([col], {"x": np.array([[2.0, 4.0], [6.0, 8.0]],
+                                          np.float32)})
+    np.testing.assert_allclose(out, [[1.0, 2.0], [3.0, 4.0]])
+
+
+def test_numeric_column_host_parses_strings():
+    col = numeric_column("x", default_value=-1.0)
+    rec = apply_host_transforms([col], {"x": np.array(["3.5", "oops"])})
+    np.testing.assert_allclose(rec["x"], [3.5, -1.0])
+
+
+def test_bucketized_column_ids():
+    col = bucketized_column(numeric_column("age"), [18, 35, 60])
+    ids = col.device_ids(jnp.array([[10.0], [20.0], [40.0], [70.0]]))
+    np.testing.assert_array_equal(np.asarray(ids).ravel(), [0, 1, 2, 3])
+    assert col.num_buckets == 4
+
+
+def test_identity_column_clips_and_defaults():
+    col = categorical_column_with_identity("c", 10, default_value=0)
+    ids = col.device_ids(jnp.array([[3], [-2], [12]]))
+    np.testing.assert_array_equal(np.asarray(ids).ravel(), [3, 0, 0])
+
+
+def test_hash_bucket_column_strings_on_host():
+    col = categorical_column_with_hash_bucket("h", 16)
+    rec = apply_host_transforms(
+        [col], {"h": np.array(["a", "b", "a"], object)}
+    )
+    assert rec["h"].dtype.kind == "i"
+    assert rec["h"][0] == rec["h"][2]  # stable
+    ids = col.device_ids(jnp.asarray(rec["h"]))
+    assert np.asarray(ids).max() < 16 and np.asarray(ids).min() >= 0
+
+
+def test_vocabulary_column_lookup_and_oov():
+    col = categorical_column_with_vocabulary_list(
+        "v", ["red", "green", "blue"]
+    )
+    rec = apply_host_transforms(
+        [col], {"v": np.array(["green", "??", "blue"], object)}
+    )
+    assert rec["v"][0] == 1 and rec["v"][2] == 2
+    assert rec["v"][1] == 3  # reserved OOV bucket after the vocab
+    assert col.num_buckets == 4
+
+
+def test_concatenated_column_offsets_and_num_buckets():
+    # The reference's headline case: hash(1024) + identity(32) -> 1056
+    # (elasticdl_preprocessing feature_column_test.test_num_buckets).
+    a = categorical_column_with_hash_bucket("aaa", 1024)
+    b = categorical_column_with_identity("bbb", 32)
+    concat = concatenated_categorical_column([a, b])
+    assert concat.num_buckets == 1056
+    assert concat.offsets == (0, 1024)
+    assert concat.key == "aaa_bbb"
+    ids = concat.device_ids({
+        "aaa": jnp.array([[5]]), "bbb": jnp.array([[7]]),
+    })
+    out = np.asarray(ids)
+    assert out.shape == (1, 2)
+    assert out[0, 1] == 1024 + 7          # offset applied
+    assert 0 <= out[0, 0] < 1024          # hashed into first range
+
+
+def test_host_transforms_recurse_through_wrappers():
+    """embedding_column over a concatenated union of STRING columns must
+    host-transform each member (review finding: the joined synthetic key
+    crashed and skipped the string work)."""
+    col = embedding_column(
+        concatenated_categorical_column([
+            categorical_column_with_hash_bucket("aaa", 1024),
+            categorical_column_with_identity("bbb", 32),
+        ]),
+        8,
+    )
+    rec = apply_host_transforms(
+        [col],
+        {"aaa": np.array(["x", "y"], object), "bbb": np.array([3, 4])},
+    )
+    assert rec["aaa"].dtype.kind == "i"          # strings hashed on host
+    np.testing.assert_array_equal(rec["bbb"], [3, 4])
+
+
+def test_vocabulary_default_value_honored():
+    col = categorical_column_with_vocabulary_list(
+        "v", ["a", "b"], num_oov_buckets=0, default_value=0
+    )
+    rec = apply_host_transforms(
+        [col], {"v": np.array(["b", "??"], object)}
+    )
+    np.testing.assert_array_equal(rec["v"], [1, 0])  # OOV -> default 0
+    assert col.num_buckets == 2                      # no reserved slot
+
+
+def test_embedding_column_validation():
+    cat = categorical_column_with_identity("c", 4)
+    with pytest.raises(ValueError):
+        embedding_column(cat, 0)
+    with pytest.raises(ValueError):
+        embedding_column(cat, 8, initializer=5)
+    with pytest.raises(ValueError):
+        embedding_column(cat, 8, combiner="max")
+    with pytest.raises(ValueError):
+        embedding_column(numeric_column("x"), 8)
+
+
+def test_embedding_column_mean_combiner():
+    cat = categorical_column_with_identity("c", 6)
+    col = embedding_column(cat, dimension=3, combiner="mean")
+    out, params = _apply([col], {"c": np.array([[1, 3], [2, 2]])})
+    table = np.asarray(
+        params["params"]["c_embedding"]["embedding"]
+    )
+    assert table.shape == (6, 3)
+    np.testing.assert_allclose(
+        np.asarray(out)[0], (table[1] + table[3]) / 2, rtol=1e-6
+    )
+    np.testing.assert_allclose(np.asarray(out)[1], table[2], rtol=1e-6)
+
+
+def test_embedding_over_concatenated_shares_one_table():
+    a = categorical_column_with_identity("a", 4)
+    b = categorical_column_with_identity("b", 8)
+    col = embedding_column(
+        concatenated_categorical_column([a, b]), 5, combiner="sum"
+    )
+    out, params = _apply(
+        [col], {"a": np.array([[1]]), "b": np.array([[2]])}
+    )
+    table = np.asarray(params["params"]["a_b_embedding"]["embedding"])
+    assert table.shape == (12, 5)  # ONE table over the union id space
+    np.testing.assert_allclose(
+        np.asarray(out)[0], table[1] + table[4 + 2], rtol=1e-6
+    )
+
+
+def test_indicator_column_multi_hot():
+    cat = categorical_column_with_identity("c", 4)
+    out, _ = _apply([indicator_column(cat)],
+                    {"c": np.array([[0, 2, 2]])})
+    np.testing.assert_allclose(np.asarray(out), [[1.0, 0.0, 2.0, 0.0]])
+
+
+def test_dense_features_concat_order_and_mixed_columns():
+    cols = [
+        numeric_column("x"),
+        embedding_column(categorical_column_with_identity("c", 4), 2),
+    ]
+    out, _ = _apply(cols, {
+        "x": np.array([[1.5], [2.5]], np.float32),
+        "c": np.array([[0], [3]]),
+    })
+    assert np.asarray(out).shape == (2, 3)
+    np.testing.assert_allclose(np.asarray(out)[:, 0], [1.5, 2.5])
+
+
+def test_bare_categorical_rejected_by_dense_features():
+    with pytest.raises(ValueError, match="bare categorical"):
+        _apply([categorical_column_with_identity("c", 4)],
+               {"c": np.array([[1]])})
+
+
+def test_embedding_table_is_auto_partition_eligible():
+    """The table must land under the 2MB auto-partition rule exactly
+    like hand-built Embedding layers: param path ends in a param whose
+    first dim is the vocab (embedding/partition.py matches by size)."""
+    from elasticdl_tpu.embedding.partition import embedding_partition_rule
+
+    cat = categorical_column_with_identity("c", 1 << 16)
+    col = embedding_column(cat, 16)
+    mod = DenseFeatures(columns=[col])
+    feats = {"c": jnp.zeros((2, 1), jnp.int32)}
+    params = mod.init(jax.random.PRNGKey(0), feats)
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    rule = embedding_partition_rule(axis="dp", axis_size=4)
+    specs = {
+        tuple(getattr(k, "key", str(k)) for k, _ in [(p, None)
+                                                     for p in path]):
+        rule(path, leaf)
+        for path, leaf in flat
+    }
+    (table_path, table_spec), = [
+        (p, s) for p, s in specs.items() if p[-1] == "embedding"
+    ]
+    assert table_spec[0] == "dp", (table_path, table_spec)

@@ -61,8 +61,12 @@ def bucket(op_name: str, category: str = "") -> str:
     return name
 
 
-def ops_profile(trace_dir):
-    """{bucket: total_ms} + n_programs from the newest trace."""
+def ops_profile(trace_dir, raw=False):
+    """{bucket: total_ms} + n_programs from the newest trace.
+
+    ``raw=True`` keys by individual op name (category prefix kept) so a
+    hot bucket can be attributed to the actual HLO — e.g. which fusion
+    is the BN-stats reduce vs the conv stem vs a layout transpose."""
     paths = sorted(glob.glob(os.path.join(
         trace_dir, "plugins/profile/*/*.trace.json.gz"
     )))
@@ -91,12 +95,14 @@ def ops_profile(trace_dir):
         if lane == "XLA Modules":
             modules.append(e.get("name") or "")
         elif lane == "XLA Ops":
-            key = bucket(
-                e.get("name") or "?",
-                (e.get("args") or {}).get("hlo_category", ""),
-            )
-            if key:  # "" = container op; children counted individually
-                totals[key] += e.get("dur", 0) / 1e3
+            name = e.get("name") or "?"
+            cat = (e.get("args") or {}).get("hlo_category", "")
+            key = bucket(name, cat)
+            if not key:  # container op; children counted individually
+                continue
+            if raw:
+                key = "%s [%s]" % (name.split("(")[0], cat or key)
+            totals[key] += e.get("dur", 0) / 1e3
     # Only the measured task program counts — the trace window also
     # catches trivial helper programs (convert_element_type of the loss
     # readback etc.) which must not dilute the per-program average.
@@ -108,6 +114,9 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("config")
     ap.add_argument("--top", type=int, default=15)
+    ap.add_argument("--raw", action="store_true",
+                    help="aggregate by individual op name (diagnostic; "
+                         "not written to PROFILES.json)")
     args = ap.parse_args()
 
     enable_bench_compile_cache()
@@ -153,7 +162,7 @@ def main():
             state, metrics = multi_step(state, task)
         float(np.asarray(metrics["loss"][-1]))
         jax.profiler.stop_trace()
-        totals, n_programs = ops_profile(td)
+        totals, n_programs = ops_profile(td, raw=args.raw)
 
     if not totals:
         raise SystemExit("no device ops in trace (CPU backend?)")
@@ -179,6 +188,8 @@ def main():
     }
     print(json.dumps({k: v for k, v in summary.items()
                       if k != "top_ops"}))
+    if args.raw:  # diagnostic breakdown; keep PROFILES.json bucketed
+        return 0
     profiles = load_json(PROFILES_FILE, {})
     profiles[name] = summary
     with open(PROFILES_FILE, "w") as f:
