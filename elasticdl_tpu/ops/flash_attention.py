@@ -43,6 +43,36 @@ DEFAULT_BLOCK_Q = 1024
 DEFAULT_BLOCK_K = 1024
 
 
+def _cost(bh, sq, sk, d, n_matmuls, causal, byte_tensors):
+    """pl.CostEstimate for one attention kernel, MODEL-FLOPs convention:
+    count the algorithmically required matmuls (fwd: QK+PV = 2; dq
+    kernel: dP+dQ = 2; dkv kernel: dK+dV = 2) and NOT the in-kernel
+    score recomputes (those are rematerialization — the same convention
+    under which benchlib.program_flops excludes jax.checkpoint
+    recompute). Causal discounts by 1/2 (the exact useful fraction is
+    (S+1)/2S; 1/2 is the conservative side, and ring chunks fully below
+    the diagonal are also undercounted, never overcounted). XLA's cost
+    analysis folds these into the program totals, so Pallas-kernel
+    FLOPs stop reading as zero in the bench's MFU numerator
+    (tools/measure_config.py, BASELINE.md round-4 note).
+
+    ``byte_tensors``: (count, seq_len, dtype_size) triples of
+    (BH, seq_len, D)-shaped operands/outputs for bytes_accessed."""
+    frac = 0.5 if causal else 1.0
+    flops = int(2 * n_matmuls * bh * sq * sk * d * frac)
+    # One exp per score element per kernel (fwd online-softmax; each
+    # bwd kernel recomputes P once).
+    transcendentals = int(bh * sq * sk * frac)
+    nbytes = int(sum(
+        count * bh * s * d * size
+        for count, s, size in byte_tensors
+    ))
+    return pl.CostEstimate(
+        flops=flops, transcendentals=transcendentals,
+        bytes_accessed=nbytes,
+    )
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, l_ref, m_acc, l_acc, o_acc,
                 *, block_k: int, causal: bool, scale: float):
     """One (batch*head, q-block, k-block) grid step.
@@ -161,6 +191,11 @@ def _flash_forward(q, k, v, causal: bool, scale: float, block_q: int,
         ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        cost_estimate=_cost(
+            bh, s_len, s_len, d, n_matmuls=2, causal=causal,
+            byte_tensors=[(2, s_len, q.dtype.itemsize),
+                          (2, s_len, q.dtype.itemsize)],
         ),
         interpret=interpret,
     )(q, k, v)
@@ -306,6 +341,11 @@ def flash_chunk_update(
         ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        cost_estimate=_cost(
+            bh, sq, sk, d, n_matmuls=2, causal=causal,
+            byte_tensors=[(1, sq, q.dtype.itemsize),
+                          (2, sk, q.dtype.itemsize), (2, sq, 4)],
         ),
         interpret=interpret,
     )(qoff, koff, q, k_chunk, v_chunk, m, l, acc)
@@ -477,6 +517,11 @@ def flash_chunk_grads(
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
+        cost_estimate=_cost(
+            bh, sq, sk, d, n_matmuls=2, causal=causal,
+            byte_tensors=[(2, sq, q.dtype.itemsize),
+                          (2, sk, q.dtype.itemsize), (1, sq, 4)],
+        ),
         interpret=interpret,
     )(qoff, koff, q, k_chunk, v_chunk, do, lse, delta)
 
@@ -516,6 +561,11 @@ def flash_chunk_grads(
         ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        cost_estimate=_cost(
+            bh, sq, sk, d, n_matmuls=2, causal=causal,
+            byte_tensors=[(2, sq, q.dtype.itemsize),
+                          (2, sk, q.dtype.itemsize), (2, sk, 4)],
         ),
         interpret=interpret,
     )(qoff, koff, q, k_chunk, v_chunk, do, lse, delta)

@@ -128,6 +128,14 @@ def categorical_column_with_identity(key, num_buckets, default_value=None):
     return IdentityCategoricalColumn(key, int(num_buckets), default_value)
 
 
+# Host-side string pre-hash range: strings map to a stable int32 in
+# [0, 2^31) WITHOUT bucketing; the device mixer then buckets exactly
+# once. (Pre-bucketing on the host and mixing again on device would
+# double-hash — the bucket would no longer be the CategoryHash id,
+# desyncing any consumer that reads host-transformed ids directly.)
+_HASH_PRERANGE = np.int32(2**31 - 1)
+
+
 @dataclass(frozen=True)
 class HashedCategoricalColumn(CategoricalColumn):
     key: str
@@ -136,16 +144,17 @@ class HashedCategoricalColumn(CategoricalColumn):
     def host(self, values):
         arr = np.asarray(values)
         if arr.dtype.kind in ("U", "S", "O"):
-            # Strings hash on the host (device has no string ops).
-            return CategoryHash(self.num_buckets)(arr)
+            # Strings hash to a stable wide int on the host (device has
+            # no string ops); bucketing happens once, on device.
+            return CategoryHash(int(_HASH_PRERANGE))(arr).astype(
+                np.int32
+            )
         return arr
 
     def device_ids(self, ids):
         ids = jnp.asarray(ids)
         if ids.dtype.kind == "f":
             ids = ids.astype(jnp.int32)
-        # Already-host-hashed values land in range and pass through the
-        # mixer unharmed (Hashing is a pure [0, bins) projection).
         return Hashing(self.num_buckets)(ids)
 
 
@@ -275,6 +284,14 @@ def concatenated_categorical_column(categorical_columns):
         if not isinstance(c, CategoricalColumn):
             raise ValueError(
                 f"{c!r} is not a categorical column"
+            )
+        if isinstance(c, ConcatenatedCategoricalColumn):
+            # device_ids indexes the feature dict by each member's key;
+            # a nested concat has a synthetic key that matches nothing.
+            # Flatten at the call site instead (offsets compose).
+            raise ValueError(
+                "nested concatenated_categorical_column is not "
+                "supported — pass the flat list of member columns"
             )
     return ConcatenatedCategoricalColumn(cols)
 

@@ -59,17 +59,47 @@ class BottleneckBlock(nn.Module):
         return nn.relu((y + shortcut).astype(self.compute_dtype))
 
 
+def _space_to_depth(x, block=2):
+    """(B, H, W, C) -> (B, H/b, W/b, b*b*C): each b x b spatial patch
+    folds into channels. Free-ish on TPU (one relayout) and it turns
+    the stem's C_in=3 — which starves the MXU's 128-wide contraction
+    and forces XLA into degenerate f01b/i01o conv layouts (see the
+    round-4 trace note in BASELINE.md) — into C_in=12."""
+    b, h, w, c = x.shape
+    x = x.reshape(b, h // block, block, w // block, block, c)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(b, h // block, w // block, block * block * c)
+
+
 class ResNet50(nn.Module):
     num_classes: int = 1000
     stage_sizes: Sequence[int] = (3, 4, 6, 3)
     compute_dtype: jnp.dtype = jnp.bfloat16
     norm_dtype: jnp.dtype = jnp.bfloat16  # see BottleneckBlock
+    # Space-to-depth stem (the MLPerf TPU ResNet trick): 2x2 s2d then a
+    # 4x4/s1 conv on (H/2, W/2, 12) replaces the 7x7/s2 conv on
+    # (H, W, 3). Receptive field 8x8 strictly contains the 7x7, stride
+    # semantics identical; C_in=12 feeds the MXU where C_in=3 cannot.
+    # False restores the exact reference stem (checkpoints differ).
+    space_to_depth: bool = True
 
     @nn.compact
     def __call__(self, features, training=False):
         x = features.astype(self.compute_dtype)
-        x = nn.Conv(64, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],
-                    use_bias=False, dtype=self.compute_dtype)(x)
+        if self.space_to_depth and x.shape[1] % 2 == 0 \
+                and x.shape[2] % 2 == 0:
+            x = _space_to_depth(x, 2)
+            # Explicit (2, 1) padding: output pixel i then sees original
+            # rows 2i-4..2i+3, which CONTAINS the reference 7x7/s2
+            # window 2i-3..2i+3 (SAME would pad (1, 2) and lose row
+            # 2i-3 — the containment claim needs the left-heavy pad).
+            x = nn.Conv(64, (4, 4), strides=(1, 1),
+                        padding=[(2, 1), (2, 1)],
+                        use_bias=False, dtype=self.compute_dtype)(x)
+        else:
+            x = nn.Conv(64, (7, 7), strides=(2, 2),
+                        padding=[(3, 3), (3, 3)],
+                        use_bias=False, dtype=self.compute_dtype)(x)
         x = nn.BatchNorm(use_running_average=not training, momentum=0.9,
                          epsilon=1e-5, dtype=self.norm_dtype)(x)
         x = nn.relu(x).astype(self.compute_dtype)

@@ -132,6 +132,16 @@ def test_vocabulary_default_value_honored():
     assert col.num_buckets == 2                      # no reserved slot
 
 
+def test_nested_concatenated_rejected():
+    a = categorical_column_with_identity("a", 4)
+    b = categorical_column_with_identity("b", 8)
+    inner = concatenated_categorical_column([a, b])
+    with pytest.raises(ValueError, match="nested"):
+        concatenated_categorical_column(
+            [inner, categorical_column_with_identity("c", 2)]
+        )
+
+
 def test_embedding_column_validation():
     cat = categorical_column_with_identity("c", 4)
     with pytest.raises(ValueError):

@@ -40,6 +40,31 @@ def test_lookup_combine_matches_jnp(combiner):
     assert not np.isnan(np.asarray(got)).any()
 
 
+@pytest.mark.parametrize("combiner", ["sum", "mean", "sqrtn"])
+def test_lookup_aligned_matches_jnp(combiner):
+    from elasticdl_tpu.ops.pallas_embedding import lookup_combine_aligned
+
+    table, ids, weights = _fixtures()
+    got = lookup_combine_aligned(
+        table, ids, weights, combiner, interpret=True
+    )
+    want = combine(jnp.take(table, ids, axis=0), weights, combiner)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5
+    )
+    assert not np.isnan(np.asarray(got)).any()
+
+
+def test_lookup_aligned_rejects_unaligned_vocab():
+    from elasticdl_tpu.ops.pallas_embedding import lookup_combine_aligned
+
+    table, ids, weights = _fixtures()
+    with pytest.raises(ValueError, match="vocab"):
+        lookup_combine_aligned(
+            table[:-3], ids, weights, "sum", interpret=True
+        )
+
+
 def test_lookup_wrapper_defaults_to_xla_and_validates_dim():
     rng = np.random.RandomState(1)
     table = jnp.asarray(rng.randn(V, 48).astype(np.float32))  # 48 % 128 != 0

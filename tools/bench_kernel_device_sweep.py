@@ -89,11 +89,17 @@ def sweep():
                 )
             return jax.jit(f)
 
+        def aligned(t, i, ww):
+            return pe.lookup_combine_aligned(t, i, ww, "sum")
+
         k = device_ms(mk(True), (table, ids, w))
         x = device_ms(mk(False), (table, ids, w))
+        a = device_ms(jax.jit(aligned), (table, ids, w))
         row = {"dim": dim, "L": L, "batch": B, "vocab": VOCAB,
                "pallas_ms": round(k, 4), "xla_ms": round(x, 4),
-               "pallas_speedup": round(x / k, 4) if k else None}
+               "aligned_ms": round(a, 4),
+               "pallas_speedup": round(x / k, 4) if k else None,
+               "aligned_speedup": round(x / a, 4) if a else None}
         results["lookup"].append(row)
         print(json.dumps(row), flush=True)
         del table

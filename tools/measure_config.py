@@ -1,0 +1,54 @@
+"""One-line device-time measurement of a bench config (no floor I/O).
+
+Usage: python tools/measure_config.py transformer [transformer_l ...]
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, HERE)
+
+from benchlib import enable_bench_compile_cache, measure_multi_step  # noqa: E402
+
+
+def main():
+    names = sys.argv[1:] or ["transformer"]
+    enable_bench_compile_cache()
+    import jax
+
+    import bench_suite
+    from elasticdl_tpu.core.model_spec import get_model_spec
+    from elasticdl_tpu.core.step import stack_batches
+    from elasticdl_tpu.testing.data import model_zoo_dir
+
+    for name in names:
+        model_def, batch, steps, measure_tasks = bench_suite.CONFIGS[name]
+        spec = get_model_spec(model_zoo_dir(), model_def)
+        if name.startswith("transformer"):
+            spec = bench_suite._transformer_spec(spec, name)
+        rng = np.random.RandomState(0)
+        task = jax.device_put(stack_batches(
+            [bench_suite._make_batch(name, batch, rng)
+             for _ in range(steps)]
+        ))
+        m = measure_multi_step(
+            spec, task, batch, steps, measure_tasks, compute_mfu=True
+        )
+        print(json.dumps({
+            "config": name,
+            "device_ms_per_step": round(
+                (m["device_ms_per_task"] or 0.0) / steps, 3
+            ),
+            "eps_device": round(m["eps_device"] or 0.0, 1),
+            "eps_wall": round(m["eps"], 1),
+            "mfu": round(m.get("mfu") or 0.0, 4),
+        }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
