@@ -122,6 +122,15 @@ def module_device_times(trace_dir, name_filter="multi_step"):
     matches, all module events are returned (program naming is backend
     -dependent). Empty list when the trace has no device lane (CPU).
     """
+    return [d for _, d in module_device_events(trace_dir, name_filter)]
+
+
+def module_device_events(trace_dir, name_filter="multi_step"):
+    """(start_ms, dur_ms) per device execution of the measured program,
+    sorted by start — same lane/name-filter/fallback semantics as
+    ``module_device_times`` (which is now a view over this); the starts
+    let callers measure inter-program host gaps
+    (tools/measure_dispatch_gap.py)."""
     paths = sorted(glob.glob(os.path.join(
         trace_dir, "plugins/profile/*/*.trace.json.gz"
     )))
@@ -148,7 +157,10 @@ def module_device_times(trace_dir, name_filter="multi_step"):
         if e.get("ph") == "X" and (e.get("pid"), e.get("tid")) in lanes
     ]
     named = [e for e in mods if name_filter in (e.get("name") or "")]
-    return [e["dur"] / 1e3 for e in (named or mods)]
+    return sorted(
+        (e.get("ts", 0) / 1e3, e.get("dur", 0) / 1e3)
+        for e in (named or mods)
+    )
 
 
 def _measure_device_time(multi_step, state, task, sync, measure_tasks):

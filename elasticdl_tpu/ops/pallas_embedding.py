@@ -346,7 +346,20 @@ def use_pallas_lookup(dim: int, num_ids: int) -> bool:
     """Auto-dispatch rule: always False (see the measurement note
     above — device-time profiling overturned the round-2 wall-clock
     tiers). Kept as the single dispatch predicate so a future kernel
-    redesign changes one function."""
+    redesign changes one function.
+
+    Round-4 update: the aligned-tile redesign (``lookup_combine_aligned``
+    — 8-row-aligned (8, D) single-DMA fetches + in-register sublane
+    select, the VERDICT r3 #5 design) was built and device-measured
+    (EMBEDDING_SWEEP.json ``aligned_ms``): it recovers 2.2-33x over the
+    row-chunk kernel — the per-DMA issue cost drops from C tiny copies
+    to one wide copy and the flat-view retiling copy disappears — but
+    still loses to XLA 2.5-4.5x at every tier. The residual loss is
+    structural: Mosaic's sublane alignment floor forces 8x fetch
+    amplification (raw DMA rate measured ~340 GB/s at dim 512 ≈ 42% of
+    peak, /8 => ~43 GB/s useful, vs XLA's ~108 GB/s coalesced gather).
+    A <8-row aligned read does not exist on this hardware generation,
+    so dispatch stays XLA everywhere."""
     del dim, num_ids
     return False
 

@@ -21,6 +21,7 @@ from elasticdl_tpu.data.decoders import (
     argmax_accuracy_metrics,
     image_classification_dataset_fn,
 )
+from elasticdl_tpu.models.batch_norm import TpuBatchNorm
 from elasticdl_tpu.ops import masked_softmax_cross_entropy
 
 
@@ -37,11 +38,17 @@ class BottleneckBlock(nn.Module):
     # (1.1 GB accessed per stage-1 BN at batch 128; PROFILES.json).
     norm_dtype: jnp.dtype = jnp.bfloat16
 
+    # bf16-folded normalize (models/batch_norm.TpuBatchNorm) vs flax's
+    # f32-promoted chain; False restores nn.BatchNorm (same variable
+    # collections either way — checkpoints are interchangeable).
+    tpu_norm: bool = False
+
     @nn.compact
     def __call__(self, x, training=False):
         conv = partial(nn.Conv, use_bias=False, dtype=self.compute_dtype)
         norm = partial(
-            nn.BatchNorm, use_running_average=not training, momentum=0.9,
+            TpuBatchNorm if self.tpu_norm else nn.BatchNorm,
+            use_running_average=not training, momentum=0.9,
             epsilon=1e-5, dtype=self.norm_dtype,
         )
         shortcut = x
@@ -82,6 +89,7 @@ class ResNet50(nn.Module):
     # semantics identical; C_in=12 feeds the MXU where C_in=3 cannot.
     # False restores the exact reference stem (checkpoints differ).
     space_to_depth: bool = True
+    tpu_norm: bool = False  # see BottleneckBlock
 
     @nn.compact
     def __call__(self, features, training=False):
@@ -100,8 +108,9 @@ class ResNet50(nn.Module):
             x = nn.Conv(64, (7, 7), strides=(2, 2),
                         padding=[(3, 3), (3, 3)],
                         use_bias=False, dtype=self.compute_dtype)(x)
-        x = nn.BatchNorm(use_running_average=not training, momentum=0.9,
-                         epsilon=1e-5, dtype=self.norm_dtype)(x)
+        stem_norm = TpuBatchNorm if self.tpu_norm else nn.BatchNorm
+        x = stem_norm(use_running_average=not training, momentum=0.9,
+                      epsilon=1e-5, dtype=self.norm_dtype)(x)
         x = nn.relu(x).astype(self.compute_dtype)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
         for stage, num_blocks in enumerate(self.stage_sizes):
@@ -112,6 +121,7 @@ class ResNet50(nn.Module):
                     filters=filters, strides=strides, projection=(block == 0),
                     compute_dtype=self.compute_dtype,
                     norm_dtype=self.norm_dtype,
+                    tpu_norm=self.tpu_norm,
                 )(x, training=training)
         x = jnp.mean(x, axis=(1, 2))
         return nn.Dense(self.num_classes, dtype=jnp.float32)(x)

@@ -1,0 +1,78 @@
+"""TpuBatchNorm (models/batch_norm.py) vs flax nn.BatchNorm."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from elasticdl_tpu.models.batch_norm import TpuBatchNorm
+
+
+def _pair(training, x, momentum=0.9):
+    tpu = TpuBatchNorm(use_running_average=not training,
+                       momentum=momentum, dtype=jnp.float32)
+    ref = nn.BatchNorm(use_running_average=not training,
+                       momentum=momentum, epsilon=1e-5,
+                       dtype=jnp.float32)
+    vt = tpu.init(jax.random.PRNGKey(0), x)
+    vr = ref.init(jax.random.PRNGKey(0), x)
+    return tpu, ref, vt, vr
+
+
+def test_matches_flax_training_and_stats():
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(4, 6, 6, 8).astype(np.float32)) * 3 + 1
+    tpu, ref, vt, vr = _pair(training=True, x=x)
+    yt, mt = tpu.apply(vt, x, mutable=["batch_stats"])
+    yr, mr = ref.apply(vr, x, mutable=["batch_stats"])
+    np.testing.assert_allclose(np.asarray(yt), np.asarray(yr),
+                               rtol=2e-3, atol=2e-3)
+    for k in ("mean", "var"):
+        np.testing.assert_allclose(
+            np.asarray(mt["batch_stats"][k]),
+            np.asarray(mr["batch_stats"][k]), rtol=1e-4, atol=1e-4,
+        )
+
+
+def test_matches_flax_inference():
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(4, 5, 5, 8).astype(np.float32))
+    tpu, ref, vt, vr = _pair(training=False, x=x)
+    # Same non-trivial stats on both sides.
+    stats = {"mean": jnp.asarray(rng.randn(8).astype(np.float32)),
+             "var": jnp.asarray(rng.rand(8).astype(np.float32) + 0.5)}
+    vt = {"params": vt["params"], "batch_stats": stats}
+    vr = {"params": vr["params"], "batch_stats": stats}
+    np.testing.assert_allclose(
+        np.asarray(tpu.apply(vt, x)), np.asarray(ref.apply(vr, x)),
+        rtol=2e-3, atol=2e-3,
+    )
+
+
+def test_gradients_match_flax():
+    rng = np.random.RandomState(2)
+    x = jnp.asarray(rng.randn(4, 4, 4, 8).astype(np.float32))
+    tpu, ref, vt, vr = _pair(training=True, x=x)
+
+    def loss(mod, variables, xx):
+        y, _ = mod.apply(variables, xx, mutable=["batch_stats"])
+        return jnp.sum(jnp.square(y.astype(jnp.float32)))
+
+    gt = jax.grad(lambda xx: loss(tpu, vt, xx))(x)
+    gr = jax.grad(lambda xx: loss(ref, vr, xx))(x)
+    np.testing.assert_allclose(np.asarray(gt), np.asarray(gr),
+                               rtol=5e-3, atol=5e-3)
+
+
+def test_bf16_output_dtype_and_finite():
+    rng = np.random.RandomState(3)
+    x = jnp.asarray(rng.randn(2, 4, 4, 8).astype(np.float32))
+    tpu = TpuBatchNorm(use_running_average=False, dtype=jnp.bfloat16)
+    v = tpu.init(jax.random.PRNGKey(0), x)
+    y, _ = tpu.apply(v, x, mutable=["batch_stats"])
+    assert y.dtype == jnp.bfloat16
+    assert np.isfinite(np.asarray(y, np.float32)).all()
+    # Collections mirror flax exactly (checkpoint compatibility).
+    assert set(v) == {"params", "batch_stats"}
+    assert set(v["params"]) == {"scale", "bias"}
+    assert set(v["batch_stats"]) == {"mean", "var"}

@@ -37,6 +37,7 @@ ZOO = [
     ("cifar10.cifar10_subclass.custom_model", "cifar", {}),
     ("census.census_wide_deep.custom_model", "census", {}),
     ("census.census_dnn.custom_model", "census", {}),
+    ("census.census_feature_columns.custom_model", "census", {}),
     ("census.census_sqlflow.custom_model", "census", {}),
     ("heart.heart.custom_model", "heart", {}),
     ("iris.iris_dnn.custom_model", "iris", {}),

@@ -57,7 +57,7 @@ def device_ms(run, args, reps=10, donate_state=False):
     return float(np.median(times)) if times else float("nan")
 
 
-def sweep():
+def sweep(lookup_only=False):
     import jax
     import jax.numpy as jnp
 
@@ -104,6 +104,24 @@ def sweep():
         print(json.dumps(row), flush=True)
         del table
 
+    if lookup_only:
+        # Merge over the previous full run so sparse_update rows
+        # survive a lookup-only re-measure (single-section runs fit the
+        # session command timeout).
+        try:
+            with open(OUT_FILE) as f:
+                prev = json.load(f)
+            results["sparse_update"] = prev.get("sparse_update", [])
+        except (OSError, ValueError) as exc:
+            # Refuse to clobber the only copy of the expensive sparse
+            # measurements without saying so.
+            print(f"WARNING: previous {OUT_FILE} unreadable ({exc}); "
+                  "sparse_update section will be EMPTY — re-run the "
+                  "full sweep to restore it", file=sys.stderr)
+        with open(OUT_FILE, "w") as f:
+            json.dump(results, f, indent=1)
+        return 0
+
     dim = 256
     opt = Adagrad(lr=0.05)
     for n in [256, 4096, 16384]:
@@ -144,4 +162,4 @@ def sweep():
 
 if __name__ == "__main__":
     enable_bench_compile_cache()
-    sys.exit(sweep())
+    sys.exit(sweep(lookup_only="--lookup-only" in sys.argv))
