@@ -20,6 +20,7 @@ path → PartitionSpec pairs consumed by ``parallel/rules.py``; the same
 module runs unsharded on one chip (mesh=None) for the single-chip entry.
 """
 
+import math
 from dataclasses import dataclass
 from typing import Optional, Tuple
 
@@ -47,6 +48,12 @@ class TransformerConfig:
     moe_experts: int = 0        # 0 = dense MLP in every block
     moe_top_k: int = 1          # experts combined per token (renormed)
     moe_every: int = 2          # MoE replaces the MLP in every k-th block
+    # "dense": exact one-hot einsum dispatch (FLOPs scale with E);
+    # "scatter": capacity-based Switch/GShard dispatch (FLOPs ~constant
+    # in E, tokens over capacity dropped, all-to-all under ep) — see
+    # the MoE module docstring.
+    moe_dispatch: str = "dense"
+    moe_capacity_factor: float = 1.25
     # Rematerialize each block on backward (jax.checkpoint): trades
     # ~1/3 more FLOPs for O(n_layers) less activation HBM — the lever
     # for deep/long-context configs (HBM is the usual TPU bottleneck).
@@ -204,13 +211,29 @@ class Mlp(nn.Module):
 
 
 class MoE(nn.Module):
-    """Top-1 routed mixture-of-experts with dense one-hot dispatch.
+    """Routed mixture-of-experts: dense one-hot OR capacity dispatch.
 
-    The expert einsum carries the expert dim so GSPMD partitions it over
-    ``ep`` — each device computes its local experts for all tokens and the
-    weighted combine psums over ``ep``. (A capacity-based all-to-all
-    dispatch is the follow-on optimization; this layout is exact and
-    collective-correct.)
+    ``cfg.moe_dispatch``:
+
+    - ``"dense"`` — the expert einsum carries the expert dim so GSPMD
+      partitions it over ``ep``: every device computes its local
+      experts for ALL tokens and the weighted combine psums over
+      ``ep``. Exact (no token ever dropped) and collective-light, but
+      expert FLOPs scale with E — the right choice for few experts or
+      correctness baselines (the dryrun's ep4 == ep1 equivalence runs
+      this path).
+    - ``"scatter"`` — capacity-based dispatch (Switch/GShard shape):
+      each token-choice gets a rank among the tokens routed to its
+      expert (one-hot cumsum); tokens with rank < capacity
+      C = ceil(k·T/E · capacity_factor) scatter into an (E, C, D)
+      buffer, the expert FFN runs batched over (E, C) — FLOPs
+      ~constant in E — and results gather back gate-weighted.
+      Overflowing tokens are DROPPED (contribute zero), the standard
+      capacity trade; with C >= T it is drop-free and numerically
+      equals dense dispatch (tested). Under an ``ep`` mesh axis the
+      (E, C, D) buffer shards over ``ep`` while tokens shard over
+      ``dp``, so GSPMD lowers the scatter/gather to the all-to-all
+      exchange this mode exists for.
     """
 
     cfg: TransformerConfig
@@ -235,11 +258,6 @@ class MoE(nn.Module):
             top_vals = top_vals / jnp.maximum(
                 top_vals.sum(axis=-1, keepdims=True), 1e-9
             )
-        combine = (
-            jax.nn.one_hot(top_idx, e, dtype=gates.dtype)
-            * top_vals[..., None]
-        ).sum(axis=-2)                                     # (B,S,E)
-        combine = wsc(combine, "dp", "sp", "ep")
 
         wi = self.param(
             "wi", nn.initializers.lecun_normal(), (e, dm, dff), jnp.float32
@@ -248,6 +266,23 @@ class MoE(nn.Module):
             "wo", nn.initializers.lecun_normal(), (e, dff, dm), jnp.float32
         )
         xc = x.astype(cfg.compute_dtype)
+        if cfg.moe_dispatch == "scatter":
+            return self._scatter_dispatch(
+                xc, top_idx, top_vals, wi, wo, wsc
+            )
+        if cfg.moe_dispatch != "dense":
+            # A typo must not silently buy the E-times-more-expensive
+            # dense einsum.
+            raise ValueError(
+                f"moe_dispatch must be 'dense' or 'scatter', got "
+                f"{cfg.moe_dispatch!r}"
+            )
+
+        combine = (
+            jax.nn.one_hot(top_idx, e, dtype=gates.dtype)
+            * top_vals[..., None]
+        ).sum(axis=-2)                                     # (B,S,E)
+        combine = wsc(combine, "dp", "sp", "ep")
         h = jnp.einsum(
             "bsd,edf->besf", xc, wi.astype(cfg.compute_dtype)
         )
@@ -257,6 +292,53 @@ class MoE(nn.Module):
         )
         y = wsc(y, "dp", "ep", "sp", None)
         out = jnp.einsum("besd,bse->bsd", y, combine.astype(y.dtype))
+        return wsc(out, "dp", "sp", None)
+
+    def _scatter_dispatch(self, xc, top_idx, top_vals, wi, wo, wsc):
+        cfg = self.cfg
+        e = cfg.moe_experts
+        b, s, dm = xc.shape
+        k = top_idx.shape[-1]
+        t = b * s
+        cap = int(math.ceil(t * k / e * cfg.moe_capacity_factor))
+        cap = max(min(cap, t), 1)
+
+        tokens = xc.reshape(t, dm)
+        idx = top_idx.reshape(t, k)                 # expert per choice
+        vals = top_vals.reshape(t, k).astype(xc.dtype)
+        # Rank of each (token, choice) within its expert, counted in
+        # token-major order across all k choices: one-hot cumsum — the
+        # standard XLA-friendly position_in_expert (no sort, static
+        # shapes throughout).
+        onehot = jax.nn.one_hot(idx, e, dtype=jnp.int32)  # (T,k,E)
+        flat_oh = onehot.reshape(t * k, e)
+        ranks = jnp.cumsum(flat_oh, axis=0) - 1           # (T*k,E)
+        pos = (ranks * flat_oh).sum(-1).reshape(t, k)     # (T,k)
+        keep = (pos < cap)                                # (T,k)
+        safe_pos = jnp.where(keep, pos, 0)
+
+        # Dispatch: (E, C, D) buffer; dropped choices scatter a zero
+        # row at slot 0 of their expert via add-of-zero (scatter-add
+        # keeps the op deterministic under duplicates).
+        buf = jnp.zeros((e, cap, dm), xc.dtype)
+        contrib = tokens[:, None, :] * keep[..., None].astype(xc.dtype)
+        buf = buf.at[idx, safe_pos].add(contrib)
+        buf = wsc(buf, "ep", None, None)
+
+        h = jnp.einsum(
+            "ecd,edf->ecf", buf, wi.astype(xc.dtype)
+        )
+        h = wsc(nn.gelu(h), "ep", None, "tp")
+        y = jnp.einsum(
+            "ecf,efd->ecd", h, wo.astype(xc.dtype)
+        )
+        y = wsc(y, "ep", None, None)
+
+        # Combine: gather each choice's row back, gate-weight, zero the
+        # dropped ones.
+        rows = y[idx, safe_pos]                           # (T,k,D)
+        rows = rows * (vals * keep.astype(xc.dtype))[..., None]
+        out = rows.sum(axis=1).reshape(b, s, dm)
         return wsc(out, "dp", "sp", None)
 
 

@@ -250,3 +250,108 @@ def test_training_learns_on_dp_sp_tp():
             first = float(metrics["loss"])
     last = float(metrics["loss"])
     assert last < first * 0.7, (first, last)
+
+
+def test_moe_scatter_matches_dense_when_dropfree():
+    """Capacity dispatch with C >= T*k is drop-free and must equal the
+    dense one-hot dispatch exactly (same params, same routing)."""
+    import dataclasses
+
+    from elasticdl_tpu.models.transformer import MoE
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(2, 8, 32), jnp.float32)
+    for k in (1, 2):
+        cfg_d = TransformerConfig(
+            vocab_size=32, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+            max_len=32, moe_experts=4, moe_top_k=k,
+            compute_dtype=jnp.float32, moe_dispatch="dense",
+        )
+        cfg_s = dataclasses.replace(
+            cfg_d, moe_dispatch="scatter", moe_capacity_factor=100.0
+        )
+        variables = MoE(cfg_d).init({"params": jax.random.PRNGKey(0)}, x)
+        out_d = MoE(cfg_d).apply(variables, x)
+        out_s = MoE(cfg_s).apply(variables, x)
+        np.testing.assert_allclose(
+            np.asarray(out_s), np.asarray(out_d), rtol=1e-5, atol=1e-5
+        )
+
+
+def test_moe_scatter_drops_over_capacity():
+    """A tiny capacity factor drops tokens (they contribute zero)
+    without NaNs or shape surprises."""
+    from elasticdl_tpu.models.transformer import MoE
+
+    cfg = TransformerConfig(
+        vocab_size=32, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+        max_len=32, moe_experts=4, compute_dtype=jnp.float32,
+        moe_dispatch="scatter", moe_capacity_factor=0.25,
+    )
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(2, 8, 32), jnp.float32)
+    variables = MoE(cfg).init({"params": jax.random.PRNGKey(0)}, x)
+    out = MoE(cfg).apply(variables, x)
+    assert out.shape == x.shape
+    assert np.isfinite(np.asarray(out)).all()
+    # With C = ceil(16/4 * 0.25) = 1 per expert, most tokens drop -> the
+    # output has genuinely zero rows (dropped tokens).
+    row_norms = np.linalg.norm(np.asarray(out).reshape(-1, 32), axis=1)
+    assert (row_norms == 0.0).any()
+
+
+def test_moe_scatter_expert_parallel():
+    """Scatter dispatch under a dp x ep mesh: experts shard over ep,
+    training learns, and the mesh forward equals the single-device
+    forward (the all-to-all exchange is exact)."""
+    cfg = TransformerConfig(
+        vocab_size=32, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+        max_len=32, moe_experts=4, moe_every=2,
+        compute_dtype=jnp.float32, moe_dispatch="scatter",
+        moe_capacity_factor=100.0,
+    )
+    mesh = make_mesh((2, 4), ("dp", "ep"), devices=jax.devices()[:8])
+    model = TransformerLM(cfg, mesh=mesh)
+    runner = _runner(mesh, model)
+    state = runner.init_state(model, optax.adam(1e-2), _batch(), seed=0)
+    wi = state.params["block_1"]["moe"]["wi"]
+    assert wi.sharding.spec == P("ep", None, None)
+    step = runner.train_step(_lm_loss())
+    losses = []
+    for i in range(8):
+        state, metrics = step(state, _batch(seed=i % 2))
+        losses.append(float(metrics["loss"]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+
+    # Forward equivalence mesh vs single device on identical params.
+    single = TransformerLM(cfg, mesh=None)
+    params_host = jax.device_get(state.params)
+    batch = _batch()
+    tokens = jnp.asarray(batch["features"], jnp.int32)
+    out_mesh = jax.jit(
+        lambda p, t: model.apply({"params": p}, t)
+    )(state.params, tokens)
+    out_single = jax.jit(
+        lambda p, t: single.apply({"params": p}, t)
+    )(params_host, tokens)
+    np.testing.assert_allclose(
+        np.asarray(out_mesh), np.asarray(out_single),
+        rtol=2e-4, atol=2e-4,
+    )
+
+
+def test_moe_dispatch_validated():
+    import pytest
+    import dataclasses
+
+    from elasticdl_tpu.models.transformer import MoE
+
+    cfg = TransformerConfig(
+        vocab_size=32, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+        max_len=32, moe_experts=4, compute_dtype=jnp.float32,
+        moe_dispatch="gshard",
+    )
+    x = jnp.zeros((2, 8, 32), jnp.float32)
+    with pytest.raises(ValueError, match="moe_dispatch"):
+        MoE(cfg).init({"params": jax.random.PRNGKey(0)}, x)
