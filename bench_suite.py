@@ -66,12 +66,13 @@ CONFIGS = {
     # Flagship LM (net-new vs the reference): GPT-style blocks at a
     # realistic small-LM size; seq 1024 engages the Pallas flash
     # attention kernels (fwd + bwd). Reported in tokens/sec
-    # (= examples x seq). 32 steps/task (~1s programs): the fused-task
-    # program amortizes host->device dispatch, measured +17% at 16
-    # steps / +26% at 32 over 4-step tasks through the device tunnel
-    # (per-dispatch overhead is real in production too — the reference
-    # tunes the same knob as num_minibatches_per_task).
-    "transformer": ("transformer.transformer_lm.custom_model", 8, 32, 2),
+    # (= examples x seq). Fused-task programs amortize host->device
+    # dispatch (measured +17%/+26% at 16/32 steps over 4-step tasks
+    # through the tunnel — the reference tunes the same knob as
+    # num_minibatches_per_task). batch 16: best of the round-4 device
+    # sweep (B8 42.4% / B16 43.1% / B32 39.7% MFU); steps halved so
+    # tokens/task stays 262k.
+    "transformer": ("transformer.transformer_lm.custom_model", 16, 16, 2),
     # Large-LM edition (d1024/H16/L12/ff4096): bigger matmuls stretch
     # the MXU where the d512 flagship is dispatch/HBM-shaped — the
     # config that shows the framework's MFU headroom at sizes closer to

@@ -70,7 +70,11 @@ class RecsysRanker(nn.Module):
 
 
 def custom_model():
-    return RecsysRanker()
+    # Read the module globals at CALL time: dataclass field defaults
+    # bind at class definition, which silently ignores test/harness
+    # monkeypatches of VOCAB/DIM (the tiny-shape override in
+    # tests/test_bench_suite.py broke exactly this way).
+    return RecsysRanker(table_name=TABLE_NAME, emb_dim=DIM)
 
 
 class RecsysRankerDense(nn.Module):
