@@ -88,6 +88,45 @@ def peak_flops(device) -> float:
     return 0.0
 
 
+def load_config_spec(name):
+    """(spec, batch, steps, measure_tasks) for a bench_suite config:
+    zoo spec with the transformer size fixup applied. Cheap — tools
+    that re-measure model variants rebuild just this per variant."""
+    import bench_suite
+    from elasticdl_tpu.core.model_spec import get_model_spec
+    from elasticdl_tpu.testing.data import model_zoo_dir
+
+    model_def, batch, steps, measure_tasks = bench_suite.CONFIGS[name]
+    spec = get_model_spec(model_zoo_dir(), model_def)
+    if name.startswith("transformer"):
+        spec = bench_suite._transformer_spec(spec, name)
+    return spec, batch, steps, measure_tasks
+
+
+def load_config_harness(name, seed=0, spec_parts=None):
+    """(spec, task, batch, steps, measure_tasks) for a bench_suite
+    config: ``load_config_spec`` plus a device-resident stacked task of
+    ``steps`` deterministic batches — the prologue every measurement
+    tool shares (profile_config, measure_config, duel_fused_head,
+    dump_config_hlo, measure_dispatch_gap). ``spec_parts`` reuses an
+    existing ``load_config_spec(name)`` result instead of rebuilding
+    the zoo spec (tools that sweep model variants)."""
+    import jax
+    import numpy as np
+
+    import bench_suite
+    from elasticdl_tpu.core.step import stack_batches
+
+    spec, batch, steps, measure_tasks = (
+        spec_parts if spec_parts is not None else load_config_spec(name)
+    )
+    rng = np.random.RandomState(seed)
+    task = jax.device_put(stack_batches(
+        [bench_suite._make_batch(name, batch, rng) for _ in range(steps)]
+    ))
+    return spec, task, batch, steps, measure_tasks
+
+
 def program_flops(spec, batch):
     """FLOPs of ONE optimizer step (forward+backward+apply) from XLA's
     cost analysis of the compiled single-step program. The bench configs

@@ -31,7 +31,7 @@ Column objects are frozen dataclasses: hashable, reusable across models,
 and trivially serializable into model-spec modules.
 """
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Callable, Optional, Sequence, Tuple
 
 import numpy as np
@@ -133,7 +133,7 @@ def categorical_column_with_identity(key, num_buckets, default_value=None):
 # once. (Pre-bucketing on the host and mixing again on device would
 # double-hash — the bucket would no longer be the CategoryHash id,
 # desyncing any consumer that reads host-transformed ids directly.)
-_HASH_PRERANGE = np.int32(2**31 - 1)
+_HASH_PRERANGE = 2**31 - 1
 
 
 @dataclass(frozen=True)
@@ -146,7 +146,7 @@ class HashedCategoricalColumn(CategoricalColumn):
         if arr.dtype.kind in ("U", "S", "O"):
             # Strings hash to a stable wide int on the host (device has
             # no string ops); bucketing happens once, on device.
-            return CategoryHash(int(_HASH_PRERANGE))(arr).astype(
+            return CategoryHash(_HASH_PRERANGE)(arr).astype(
                 np.int32
             )
         return arr

@@ -16,7 +16,6 @@ import json
 import os
 import sys
 
-import numpy as np
 
 HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, HERE)
@@ -27,23 +26,16 @@ from benchlib import enable_bench_compile_cache, measure_multi_step  # noqa: E40
 def main():
     name = sys.argv[1] if len(sys.argv) > 1 else "transformer"
     enable_bench_compile_cache()
-    import jax
+    from benchlib import load_config_harness, load_config_spec
 
-    import bench_suite
-    from elasticdl_tpu.core.model_spec import get_model_spec
-    from elasticdl_tpu.core.step import stack_batches
-    from elasticdl_tpu.testing.data import model_zoo_dir
-
-    model_def, batch, steps, measure_tasks = bench_suite.CONFIGS[name]
-    rng = np.random.RandomState(0)
-    task = jax.device_put(stack_batches(
-        [bench_suite._make_batch(name, batch, rng) for _ in range(steps)]
-    ))
+    parts = load_config_spec(name)
+    spec, task, batch, steps, measure_tasks = load_config_harness(
+        name, spec_parts=parts
+    )
+    base_cfg = spec.model.cfg
     results = {}
     for fused in (False, True):
-        spec = get_model_spec(model_zoo_dir(), model_def)
-        spec = bench_suite._transformer_spec(spec, name)
-        cfg = dataclasses.replace(spec.model.cfg, fused_head=fused)
+        cfg = dataclasses.replace(base_cfg, fused_head=fused)
         spec.model = spec.module.custom_model(config=cfg)
         m = measure_multi_step(
             spec, task, batch, steps, measure_tasks, compute_mfu=True

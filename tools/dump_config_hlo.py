@@ -14,7 +14,6 @@ import os
 import re
 import sys
 
-import numpy as np
 
 HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, HERE)
@@ -36,21 +35,11 @@ def main():
     enable_bench_compile_cache()
     import jax
 
-    import bench_suite
-    from elasticdl_tpu.core.model_spec import get_model_spec
-    from elasticdl_tpu.core.step import build_multi_step, stack_batches
+    from benchlib import load_config_harness
+    from elasticdl_tpu.core.step import build_multi_step
     from elasticdl_tpu.core.train_state import init_train_state
-    from elasticdl_tpu.testing.data import model_zoo_dir
 
-    name = args.config
-    model_def, batch, steps, _ = bench_suite.CONFIGS[name]
-    spec = get_model_spec(model_zoo_dir(), model_def)
-    if name.startswith("transformer"):
-        spec = bench_suite._transformer_spec(spec, name)
-    rng = np.random.RandomState(0)
-    task = jax.device_put(stack_batches(
-        [bench_suite._make_batch(name, batch, rng) for _ in range(steps)]
-    ))
+    spec, task, batch, steps, _ = load_config_harness(args.config)
     state = init_train_state(
         spec.model, spec.make_optimizer(),
         jax.tree.map(lambda x: x[0], task), seed=0,

@@ -7,34 +7,24 @@ import json
 import os
 import sys
 
-import numpy as np
 
 HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, HERE)
 
-from benchlib import enable_bench_compile_cache, measure_multi_step  # noqa: E402
+from benchlib import (  # noqa: E402
+    enable_bench_compile_cache,
+    load_config_harness,
+    measure_multi_step,
+)
 
 
 def main():
     names = sys.argv[1:] or ["transformer"]
     enable_bench_compile_cache()
-    import jax
-
-    import bench_suite
-    from elasticdl_tpu.core.model_spec import get_model_spec
-    from elasticdl_tpu.core.step import stack_batches
-    from elasticdl_tpu.testing.data import model_zoo_dir
-
     for name in names:
-        model_def, batch, steps, measure_tasks = bench_suite.CONFIGS[name]
-        spec = get_model_spec(model_zoo_dir(), model_def)
-        if name.startswith("transformer"):
-            spec = bench_suite._transformer_spec(spec, name)
-        rng = np.random.RandomState(0)
-        task = jax.device_put(stack_batches(
-            [bench_suite._make_batch(name, batch, rng)
-             for _ in range(steps)]
-        ))
+        spec, task, batch, steps, measure_tasks = load_config_harness(
+            name
+        )
         m = measure_multi_step(
             spec, task, batch, steps, measure_tasks, compute_mfu=True
         )

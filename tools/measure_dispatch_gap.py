@@ -40,11 +40,9 @@ def main():
     import jax
     import jax.numpy as jnp
 
-    import bench_suite
-    from elasticdl_tpu.core.model_spec import get_model_spec
-    from elasticdl_tpu.core.step import build_multi_step, stack_batches
+    from benchlib import load_config_harness
+    from elasticdl_tpu.core.step import build_multi_step
     from elasticdl_tpu.core.train_state import init_train_state
-    from elasticdl_tpu.testing.data import model_zoo_dir
 
     # Empty-dispatch RTT floor.
     noop = jax.jit(lambda x: x + 1)
@@ -61,15 +59,7 @@ def main():
     print(json.dumps({"noop_dispatch_rtt_ms": round(rtt, 3)}))
 
     for name in names:
-        model_def, batch, steps, _ = bench_suite.CONFIGS[name]
-        spec = get_model_spec(model_zoo_dir(), model_def)
-        if name.startswith("transformer"):
-            spec = bench_suite._transformer_spec(spec, name)
-        rng = np.random.RandomState(0)
-        task = jax.device_put(stack_batches(
-            [bench_suite._make_batch(name, batch, rng)
-             for _ in range(steps)]
-        ))
+        spec, task, batch, steps, _ = load_config_harness(name)
         state = init_train_state(
             spec.model, spec.make_optimizer(),
             jax.tree.map(lambda t: t[0], task), seed=0,

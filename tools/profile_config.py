@@ -122,21 +122,12 @@ def main():
     enable_bench_compile_cache()
     import jax
 
-    import bench_suite
-    from elasticdl_tpu.core.model_spec import get_model_spec
-    from elasticdl_tpu.core.step import build_multi_step, stack_batches
+    from benchlib import load_config_harness
+    from elasticdl_tpu.core.step import build_multi_step
     from elasticdl_tpu.core.train_state import init_train_state
-    from elasticdl_tpu.testing.data import model_zoo_dir
 
     name = args.config
-    model_def, batch, steps, measure_tasks = bench_suite.CONFIGS[name]
-    spec = get_model_spec(model_zoo_dir(), model_def)
-    if name.startswith("transformer"):
-        spec = bench_suite._transformer_spec(spec, name)
-    rng = np.random.RandomState(0)
-    task = jax.device_put(stack_batches(
-        [bench_suite._make_batch(name, batch, rng) for _ in range(steps)]
-    ))
+    spec, task, batch, steps, measure_tasks = load_config_harness(name)
     if getattr(spec, "make_sparse_runner", None):
         # Sparse-plane configs (recsys) need their runner's step —
         # mirrors benchlib.measure_multi_step's branch.
