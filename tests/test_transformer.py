@@ -355,3 +355,25 @@ def test_moe_dispatch_validated():
     x = jnp.zeros((2, 8, 32), jnp.float32)
     with pytest.raises(ValueError, match="moe_dispatch"):
         MoE(cfg).init({"params": jax.random.PRNGKey(0)}, x)
+
+
+def test_generate_with_scatter_moe():
+    """KV-cache decoding through a scatter-dispatch MoE block: the
+    capacity math must hold at t = B*1 tokens per decode step."""
+    from elasticdl_tpu.models.transformer import TransformerLM, generate
+
+    cfg = TransformerConfig(
+        vocab_size=32, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+        max_len=32, moe_experts=4, moe_every=2,
+        compute_dtype=jnp.float32, moe_dispatch="scatter",
+    )
+    model = TransformerLM(cfg)
+    prompt = jnp.asarray(
+        np.random.RandomState(0).randint(0, 32, (2, 4)), jnp.int32
+    )
+    variables = model.init(
+        {"params": jax.random.PRNGKey(0)}, prompt, training=False
+    )
+    toks = generate(cfg, variables["params"], prompt, max_new_tokens=5)
+    assert toks.shape == (2, 5)
+    assert ((np.asarray(toks) >= 0) & (np.asarray(toks) < 32)).all()

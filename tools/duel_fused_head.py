@@ -26,12 +26,9 @@ from benchlib import enable_bench_compile_cache, measure_multi_step  # noqa: E40
 def main():
     name = sys.argv[1] if len(sys.argv) > 1 else "transformer"
     enable_bench_compile_cache()
-    from benchlib import load_config_harness, load_config_spec
+    from benchlib import load_config_harness
 
-    parts = load_config_spec(name)
-    spec, task, batch, steps, measure_tasks = load_config_harness(
-        name, spec_parts=parts
-    )
+    spec, task, batch, steps, measure_tasks = load_config_harness(name)
     base_cfg = spec.model.cfg
     results = {}
     for fused in (False, True):
