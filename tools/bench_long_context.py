@@ -37,6 +37,13 @@ SWEEP = [
     (4096, 2, True),
     (8192, 1, True),
 ]
+# The d1024/L12 model at the longest shapes (python tools/
+# bench_long_context.py --large): params+opt ~2.1 GB f32, so remat
+# everywhere past S=2048.
+SWEEP_LARGE = [
+    (2048, 4, True),
+    (8192, 1, True),
+]
 STEPS_PER_TASK = 8
 MEASURE_TASKS = 2
 
@@ -45,23 +52,27 @@ def main():
     enable_bench_compile_cache()
     import jax
 
-    import bench_suite
     from elasticdl_tpu.core.model_spec import get_model_spec
     from elasticdl_tpu.core.step import stack_batches
     from elasticdl_tpu.models.transformer import TransformerConfig
     from elasticdl_tpu.testing.data import model_zoo_dir
 
+    large = "--large" in sys.argv
+    sweep = SWEEP_LARGE if large else SWEEP
+    size = (dict(d_model=1024, n_heads=16, n_layers=12, d_ff=4096)
+            if large else dict(d_model=512, n_heads=8, n_layers=8,
+                               d_ff=2048))
     dev = jax.devices()[0]
     results = {
         "platform": dev.platform,
         "device_kind": getattr(dev, "device_kind", ""),
-        "tokens_per_step": SWEEP[0][0] * SWEEP[0][1],
+        "model": "d1024/L12" if large else "d512/L8",
+        "tokens_per_step": sweep[0][0] * sweep[0][1],
         "rows": [],
     }
-    for seq, batch, remat in SWEEP:
+    for seq, batch, remat in sweep:
         cfg = TransformerConfig(
-            vocab_size=32768, d_model=512, n_heads=8, n_layers=8,
-            d_ff=2048, max_len=seq, remat=remat,
+            vocab_size=32768, max_len=seq, remat=remat, **size,
         )
         spec = get_model_spec(
             model_zoo_dir(), "transformer.transformer_lm.custom_model"
@@ -107,8 +118,18 @@ def main():
         results["rows"].append(row)
         print(json.dumps(row), flush=True)
 
+    # Keyed by model so --large merges beside the default sweep
+    # (migrating the round-4 flat layout if present).
+    try:
+        with open(OUT_FILE) as f:
+            existing = json.load(f)
+        if "rows" in existing:
+            existing = {existing.get("model", "d512/L8"): existing}
+    except (OSError, ValueError):
+        existing = {}
+    existing[results["model"]] = results
     with open(OUT_FILE, "w") as f:
-        json.dump(results, f, indent=1)
+        json.dump(existing, f, indent=1)
     return 0
 
 
