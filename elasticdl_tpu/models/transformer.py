@@ -238,6 +238,7 @@ class MoE(nn.Module):
 
     cfg: TransformerConfig
     mesh: Optional[Mesh] = None
+    decode: bool = False
 
     @nn.compact
     def __call__(self, x, training=False):
@@ -266,16 +267,25 @@ class MoE(nn.Module):
             "wo", nn.initializers.lecun_normal(), (e, dff, dm), jnp.float32
         )
         xc = x.astype(cfg.compute_dtype)
-        if cfg.moe_dispatch == "scatter":
-            return self._scatter_dispatch(
-                xc, top_idx, top_vals, wi, wo, wsc
-            )
-        if cfg.moe_dispatch != "dense":
+        if cfg.moe_dispatch not in ("dense", "scatter"):
             # A typo must not silently buy the E-times-more-expensive
             # dense einsum.
             raise ValueError(
                 f"moe_dispatch must be 'dense' or 'scatter', got "
                 f"{cfg.moe_dispatch!r}"
+            )
+        # KV-cache decode steps see t = B*1 tokens, so the scatter
+        # capacity ceil(B*k/E*cf) is ~1 and any routing collision would
+        # silently zero a token's expert output at inference. The dense
+        # einsum at t=B is cheap and drop-free, so single-token decode
+        # steps take it; the gate is the STATIC sequence length, so the
+        # prefill pass (S = prompt length, ample capacity) keeps the
+        # scatter path's E-independent FLOPs. Param tree is identical
+        # either way.
+        decode_step = self.decode and x.shape[1] == 1
+        if cfg.moe_dispatch == "scatter" and not decode_step:
+            return self._scatter_dispatch(
+                xc, top_idx, top_vals, wi, wo, wsc
             )
 
         combine = (
@@ -360,7 +370,9 @@ class Block(nn.Module):
         x = x + h
         h = nn.LayerNorm(dtype=cfg.compute_dtype, name="ln2")(x)
         if self.use_moe:
-            h = MoE(cfg, self.mesh, name="moe")(h, training)
+            h = MoE(cfg, self.mesh, decode=self.decode, name="moe")(
+                h, training
+            )
         else:
             h = Mlp(cfg, self.mesh, name="mlp")(h, training)
         if cfg.dropout_rate and training:

@@ -111,6 +111,20 @@ class IdentityCategoricalColumn(CategoricalColumn):
     key: str
     num_buckets: int
     default_value: Optional[int] = None
+    validate: bool = False
+
+    def host(self, values):
+        arr = np.asarray(values)
+        if self.validate and self.default_value is None:
+            bad = (arr < 0) | (arr >= self.num_buckets)
+            if bad.any():
+                sample = np.asarray(arr[bad]).ravel()[:5].tolist()
+                raise ValueError(
+                    f"identity column {self.key!r}: "
+                    f"{int(bad.sum())} id(s) outside "
+                    f"[0, {self.num_buckets}), e.g. {sample}"
+                )
+        return arr
 
     def device_ids(self, ids):
         ids = jnp.asarray(ids, jnp.int32)
@@ -122,10 +136,21 @@ class IdentityCategoricalColumn(CategoricalColumn):
         return jnp.clip(ids, 0, self.num_buckets - 1)
 
 
-def categorical_column_with_identity(key, num_buckets, default_value=None):
+def categorical_column_with_identity(
+    key, num_buckets, default_value=None, validate=False
+):
+    """TF-surface deviation: with ``default_value=None`` the TF column
+    raises on out-of-range ids, but inside jit there is no data-dependent
+    raise — so the device plane CLIPS out-of-range ids to the boundary
+    buckets [0, num_buckets-1]. Bad input data would then train the edge
+    embeddings instead of failing; pass ``validate=True`` to get the TF
+    behavior back as a host-side check in ``host()`` (runs in
+    ``dataset_fn`` on the worker, before ids reach the device)."""
     if num_buckets <= 0:
         raise ValueError(f"num_buckets must be positive, got {num_buckets}")
-    return IdentityCategoricalColumn(key, int(num_buckets), default_value)
+    return IdentityCategoricalColumn(
+        key, int(num_buckets), default_value, bool(validate)
+    )
 
 
 # Host-side string pre-hash range: strings map to a stable int32 in

@@ -87,15 +87,25 @@ class ResNet50(nn.Module):
     # 4x4/s1 conv on (H/2, W/2, 12) replaces the 7x7/s2 conv on
     # (H, W, 3). Receptive field 8x8 strictly contains the 7x7, stride
     # semantics identical; C_in=12 feeds the MXU where C_in=3 cannot.
-    # False restores the exact reference stem (checkpoints differ).
-    space_to_depth: bool = True
+    # Opt-in (the zoo's custom_model() opts in): the stem kernel shape
+    # differs (4,4,12,64 vs 7,7,3,64), so the two settings' checkpoints
+    # are incompatible and the default preserves the reference
+    # architecture. The choice is static config only — odd input sizes
+    # raise rather than silently switching stems (a checkpoint must
+    # never depend on input spatial parity).
+    space_to_depth: bool = False
     tpu_norm: bool = False  # see BottleneckBlock
 
     @nn.compact
     def __call__(self, features, training=False):
         x = features.astype(self.compute_dtype)
-        if self.space_to_depth and x.shape[1] % 2 == 0 \
-                and x.shape[2] % 2 == 0:
+        if self.space_to_depth:
+            if x.shape[1] % 2 or x.shape[2] % 2:
+                raise ValueError(
+                    "space_to_depth=True needs even spatial dims, got "
+                    f"{x.shape[1]}x{x.shape[2]}; pad the input or set "
+                    "space_to_depth=False"
+                )
             x = _space_to_depth(x, 2)
             # Explicit (2, 1) padding: output pixel i then sees original
             # rows 2i-4..2i+3, which CONTAINS the reference 7x7/s2
@@ -129,8 +139,11 @@ class ResNet50(nn.Module):
 
 def custom_model():
     # 10-way head so the synthetic cifar-shaped corpus drives it; a user
-    # points the same module at ImageNet by changing num_classes.
-    return ResNet50(num_classes=10)
+    # points the same module at ImageNet by changing num_classes. The
+    # zoo entry opts into the s2d stem (+0.3% measured, BASELINE.md) —
+    # its checkpoints are self-consistent but not interchangeable with
+    # space_to_depth=False runs.
+    return ResNet50(num_classes=10, space_to_depth=True)
 
 
 def loss(labels, predictions, mask):

@@ -81,3 +81,32 @@ def test_zoo_model_trains_end_to_end(tmp_path, model_def, fixture, opts):
     import math
     assert math.isfinite(result["final_loss"])
     assert result["eval_metrics"]  # metrics computed for every family
+
+
+def test_resnet_stem_is_static_config():
+    """The stem is decided by config alone: default preserves the
+    reference 7x7/s2 kernel; s2d opt-in changes it; odd spatial sizes
+    raise under s2d instead of silently switching architectures (the
+    param tree must never depend on input parity)."""
+    import jax
+    import jax.numpy as jnp
+
+    from model_zoo.resnet50.resnet50 import ResNet50
+
+    rng = {"params": jax.random.PRNGKey(0)}
+    ref = ResNet50(num_classes=10)
+    v = jax.eval_shape(
+        lambda: ref.init(rng, jnp.zeros((1, 32, 32, 3), jnp.float32))
+    )
+    stem = v["params"]["Conv_0"]["kernel"]
+    assert stem.shape == (7, 7, 3, 64), stem.shape
+
+    s2d = ResNet50(num_classes=10, space_to_depth=True)
+    v2 = jax.eval_shape(
+        lambda: s2d.init(rng, jnp.zeros((1, 32, 32, 3), jnp.float32))
+    )
+    stem2 = v2["params"]["Conv_0"]["kernel"]
+    assert stem2.shape == (4, 4, 12, 64), stem2.shape
+
+    with pytest.raises(ValueError, match="even spatial"):
+        s2d.init(rng, jnp.zeros((1, 33, 33, 3), jnp.float32))

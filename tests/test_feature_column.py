@@ -282,3 +282,24 @@ def test_dense_features_table_shards_on_mesh():
     step = runner.train_step(loss)
     state, metrics = step(state, batch)
     assert np.isfinite(float(metrics["loss"]))
+
+
+def test_identity_validate_raises_on_out_of_range():
+    """validate=True restores the TF fail-fast: out-of-range ids raise
+    in host() instead of training the boundary embeddings."""
+    import pytest
+
+    col = categorical_column_with_identity("c", 10, validate=True)
+    with pytest.raises(ValueError, match="outside"):
+        col.host(np.array([0, 3, 12]))
+    np.testing.assert_array_equal(
+        col.host(np.array([0, 3, 9])), np.array([0, 3, 9])
+    )
+    # With a default_value, out-of-range is defined behavior — no raise.
+    col2 = categorical_column_with_identity(
+        "c", 10, default_value=0, validate=True
+    )
+    np.testing.assert_array_equal(
+        np.asarray(col2.device_ids(col2.host(np.array([12, 3])))),
+        np.array([0, 3]),
+    )

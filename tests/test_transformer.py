@@ -377,3 +377,23 @@ def test_generate_with_scatter_moe():
     toks = generate(cfg, variables["params"], prompt, max_new_tokens=5)
     assert toks.shape == (2, 5)
     assert ((np.asarray(toks) >= 0) & (np.asarray(toks) < 32)).all()
+
+    # Single-token decode steps force dense dispatch (capacity ~1 at
+    # t=B would silently drop colliding tokens); the prefill keeps
+    # scatter, which with capacity >= T is drop-free and numerically
+    # equals dense. So with a drop-free capacity factor and identical
+    # params, scatter and dense configs must generate IDENTICAL tokens
+    # — not just finite ones.
+    import dataclasses
+
+    cfg_safe = dataclasses.replace(cfg, moe_capacity_factor=8.0)
+    cfg_dense = dataclasses.replace(cfg, moe_dispatch="dense")
+    toks_safe = generate(
+        cfg_safe, variables["params"], prompt, max_new_tokens=5
+    )
+    toks_dense = generate(
+        cfg_dense, variables["params"], prompt, max_new_tokens=5
+    )
+    np.testing.assert_array_equal(
+        np.asarray(toks_safe), np.asarray(toks_dense)
+    )
