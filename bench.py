@@ -87,14 +87,23 @@ def main():
     # Mesh-resize under load (dp4 -> dp2 -> dp4 on a virtual CPU mesh;
     # sets its own JAX_PLATFORMS=cpu so it never contends for the chip).
     resize = _run("bench_elasticity.py", "--scenario", "resize")
-    for proc in (elastic, resize):
+    # Same resizes with the row-sharded device-sparse recsys model LIVE
+    # through every transition — the sparse × elasticity composition.
+    resize_sparse = _run(
+        "bench_elasticity.py", "--scenario", "resize", "--model", "sparse"
+    )
+    for proc in (elastic, resize, resize_sparse):
         for rec in _parse_metric_lines(proc.stdout):
-            name = rec["metric"].split("[")[0]
-            if name.startswith("elastic_"):
-                elasticity[name[len("elastic_"):]] = {
-                    "value": rec["value"], "unit": rec["unit"],
-                    "vs_baseline": rec["vs_baseline"],
-                }
+            name, _, tag = rec["metric"].partition("[")
+            if not name.startswith("elastic_"):
+                continue
+            key = name[len("elastic_"):]
+            if "sparse" in tag:
+                key += "_sparse"
+            elasticity[key] = {
+                "value": rec["value"], "unit": rec["unit"],
+                "vs_baseline": rec["vs_baseline"],
+            }
 
     worst = min(
         (c["vs_floor"] for c in configs.values()), default=0.0
@@ -110,7 +119,8 @@ def main():
     # Floor regressions and crashed sub-benches fail the bench loudly.
     return (
         0 if suite.returncode == 0 and elastic.returncode == 0
-        and resize.returncode == 0 else 1
+        and resize.returncode == 0 and resize_sparse.returncode == 0
+        else 1
     )
 
 

@@ -70,7 +70,7 @@ def _make_cluster(train, ckpt_dir, kill_after_tasks=None):
     )
 
 
-def run_resize_scenario():
+def run_resize_scenario(model: str = "mnist"):
     """Mesh-resize under load: dp4 -> dp2 -> dp4 on a virtual CPU mesh.
 
     The reference's pitch is utilization under elasticity — a worker
@@ -83,8 +83,17 @@ def run_resize_scenario():
     mesh). Runs on 8 virtual CPU devices — the timeline SHAPE (not
     absolute chip rates) is the artifact, same spirit as the
     reference's minikube bench. Results merge into BENCH_SUITE.json
-    under "elastic_resize" and gate on a hard floor: every phase must
-    finish and worst-phase retention vs phase-1 must stay >= FLOOR.
+    under "elastic_resize" (/"elastic_resize_sparse") and gate on a hard
+    floor: every phase must finish and worst-phase retention vs phase-1
+    must stay >= FLOOR.
+
+    ``model="sparse"`` runs the recsys device-sparse model instead of
+    mnist: the table (+Adagrad slots) is LIVE row-sharded over dp
+    through every resize, so each transition exercises the cross-N
+    repartition restore (every device's row range changes) — the
+    reference's defining recsys-elasticity composition
+    (save_utils.py:206-259 under a mid-training PS-count change).
+    Tiny-vocab shapes: the artifact is the timeline, not chip rates.
     """
     import jax
     import numpy as np
@@ -94,6 +103,7 @@ def run_resize_scenario():
     from elasticdl_tpu.parallel.mesh import make_mesh
     from elasticdl_tpu.parallel.mesh_runner import make_runner_for_spec
     from elasticdl_tpu.testing.data import (
+        create_frappe_record_file,
         create_mnist_record_file,
         model_zoo_dir,
     )
@@ -111,100 +121,131 @@ def run_resize_scenario():
     kill_points = (total_tasks // 3, 2 * total_tasks // 3)
 
     tmp = tempfile.mkdtemp(prefix="bench_resize_")
-    train = create_mnist_record_file(
-        os.path.join(tmp, "train.rec"), resize_records, seed=11
-    )
-    ckpt_dir = os.path.join(tmp, "ckpt")
+    from contextlib import ExitStack
 
-    devices = jax.devices()
-    if len(devices) < 4:
-        raise SystemExit(
-            "resize scenario needs >=4 devices "
-            "(run under xla_force_host_platform_device_count)"
-        )
-    mesh_of = {4: lambda: make_mesh((4,), ("dp",), devices=devices[:4]),
-               2: lambda: make_mesh((2,), ("dp",), devices=devices[:2])}
-    phase_sizes = (4, 2, 4)      # dp4 -> shrink -> regrow
+    stack = ExitStack()
+    try:
+        if model == "sparse":
+            # Tiny-shape recsys on the device-sparse plane (shared
+            # testing.tiny_zoo override — no 1M x 256 table on the CPU
+            # mesh); threshold 0 keeps the tiny table row-sharded.
+            from elasticdl_tpu.embedding.device_sparse import (
+                DeviceSparseRunner,
+            )
+            from elasticdl_tpu.embedding.optimizer import Adagrad
+            from elasticdl_tpu.testing.tiny_zoo import tiny_recsys_zoo
 
-    timeline = []                # (t_rel, phase_idx) per completed task
-    t0 = time.perf_counter()
+            zoo = stack.enter_context(tiny_recsys_zoo(vocab=4096, dim=16))
+            model_def = "recsys.recsys_sparse.custom_model"
+            train = create_frappe_record_file(
+                os.path.join(tmp, "train.rec"), resize_records, seed=11,
+                input_length=8, max_id=zoo.VOCAB,
+            )
 
-    def make_worker(worker_id, phase_idx, servicer, spec, reader,
-                    kill_at_total):
-        """A worker on the phase's mesh; raises _Preempted once the
-        job-wide completed-task count reaches ``kill_at_total``."""
-        mesh = mesh_of[phase_sizes[phase_idx]]()
-        spec.model = spec.make_model(mesh)
-        runner = make_runner_for_spec(spec, mesh)
+            def runner_for(spec, mesh):
+                return DeviceSparseRunner(
+                    zoo.TABLE_SPECS, Adagrad(lr=0.05), use_pallas="never",
+                    mesh=mesh, partition_threshold_bytes=0,
+                )
+        else:
+            model_def = "mnist.mnist_functional.custom_model"
+            train = create_mnist_record_file(
+                os.path.join(tmp, "train.rec"), resize_records, seed=11
+            )
 
-        def on_report(request):
-            # The callback fires BEFORE the servicer records the result:
-            # raising here leaves the trained-but-unreported task in
-            # `doing` (the genuine preemption shape), so it must NOT be
-            # counted — the resized mesh re-trains and re-reports it.
-            if (kill_at_total is not None
-                    and len(timeline) + 1 > kill_at_total):
-                raise _Preempted(f"resize point {kill_at_total}")
-            timeline.append((time.perf_counter() - t0, phase_idx))
+            def runner_for(spec, mesh):
+                spec.model = spec.make_model(mesh)
+                return make_runner_for_spec(spec, mesh)
+        ckpt_dir = os.path.join(tmp, "ckpt")
 
-        return Worker(
-            worker_id=worker_id,
-            master_client=InProcessMaster(
-                servicer, worker_id=worker_id,
-                callbacks={"report_task_result": on_report},
-            ),
-            model_spec=spec,
-            data_reader=reader,
+        devices = jax.devices()
+        if len(devices) < 4:
+            raise SystemExit(
+                "resize scenario needs >=4 devices "
+                "(run under xla_force_host_platform_device_count)"
+            )
+        mesh_of = {4: lambda: make_mesh((4,), ("dp",), devices=devices[:4]),
+                   2: lambda: make_mesh((2,), ("dp",), devices=devices[:2])}
+        phase_sizes = (4, 2, 4)      # dp4 -> shrink -> regrow
+
+        timeline = []                # (t_rel, phase_idx) per completed task
+        t0 = time.perf_counter()
+
+        def make_worker(worker_id, phase_idx, servicer, spec, reader,
+                        kill_at_total):
+            """A worker on the phase's mesh; raises _Preempted once the
+            job-wide completed-task count reaches ``kill_at_total``."""
+            mesh = mesh_of[phase_sizes[phase_idx]]()
+            runner = runner_for(spec, mesh)
+
+            def on_report(request):
+                # The callback fires BEFORE the servicer records the result:
+                # raising here leaves the trained-but-unreported task in
+                # `doing` (the genuine preemption shape), so it must NOT be
+                # counted — the resized mesh re-trains and re-reports it.
+                if (kill_at_total is not None
+                        and len(timeline) + 1 > kill_at_total):
+                    raise _Preempted(f"resize point {kill_at_total}")
+                timeline.append((time.perf_counter() - t0, phase_idx))
+
+            return Worker(
+                worker_id=worker_id,
+                master_client=InProcessMaster(
+                    servicer, worker_id=worker_id,
+                    callbacks={"report_task_result": on_report},
+                ),
+                model_spec=spec,
+                data_reader=reader,
+                minibatch_size=MINIBATCH,
+                step_runner=runner,
+                checkpoint_hook=CheckpointHook(
+                    checkpoint_dir=ckpt_dir,
+                    checkpoint_steps=mb_per_task,
+                ),
+                checkpoint_dir_for_init=ckpt_dir if worker_id else "",
+                fuse_task_steps=True,
+            )
+
+        from elasticdl_tpu.testing.cluster import MiniCluster
+
+        cluster = MiniCluster(
+            model_zoo=model_zoo_dir(),
+            model_def=model_def,
+            training_data=train,
             minibatch_size=MINIBATCH,
-            step_runner=runner,
-            checkpoint_hook=CheckpointHook(
-                checkpoint_dir=ckpt_dir,
-                checkpoint_steps=mb_per_task,
-            ),
-            checkpoint_dir_for_init=ckpt_dir if worker_id else "",
+            num_minibatches_per_task=mb_per_task,
+            checkpoint_dir=ckpt_dir,
+            checkpoint_steps=mb_per_task,
             fuse_task_steps=True,
         )
-
-    from elasticdl_tpu.testing.cluster import MiniCluster
-
-    cluster = MiniCluster(
-        model_zoo=model_zoo_dir(),
-        model_def="mnist.mnist_functional.custom_model",
-        training_data=train,
-        minibatch_size=MINIBATCH,
-        num_minibatches_per_task=mb_per_task,
-        checkpoint_dir=ckpt_dir,
-        checkpoint_steps=mb_per_task,
-        fuse_task_steps=True,
-    )
-    servicer, dispatcher = cluster.servicer, cluster.dispatcher
-    transitions = []
-    phase_idx = 0
-    worker_id = 0
-    while True:
-        kill_at = (kill_points[phase_idx]
-                   if phase_idx < len(kill_points) else None)
-        spec = get_model_spec(
-            model_zoo_dir(), "mnist.mnist_functional.custom_model"
-        )
-        worker = make_worker(
-            worker_id, phase_idx, servicer, spec, cluster.train_reader,
-            kill_at,
-        )
-        try:
-            worker.run()
-        except _Preempted:
-            # The in-flight task dies with the worker; the master's
-            # watch-event path re-queues it for the resized mesh.
-            if dispatcher.doing_tasks_of(worker_id):
-                dispatcher.recover_tasks(worker_id)
-            transitions.append(
-                {"killed_at": time.perf_counter() - t0}
+        servicer, dispatcher = cluster.servicer, cluster.dispatcher
+        transitions = []
+        phase_idx = 0
+        worker_id = 0
+        while True:
+            kill_at = (kill_points[phase_idx]
+                       if phase_idx < len(kill_points) else None)
+            spec = get_model_spec(model_zoo_dir(), model_def)
+            worker = make_worker(
+                worker_id, phase_idx, servicer, spec,
+                cluster.train_reader, kill_at,
             )
-            phase_idx += 1
-            worker_id += 1
-            continue
-        break
+            try:
+                worker.run()
+            except _Preempted:
+                # The in-flight task dies with the worker; the master's
+                # watch-event path re-queues it for the resized mesh.
+                if dispatcher.doing_tasks_of(worker_id):
+                    dispatcher.recover_tasks(worker_id)
+                transitions.append(
+                    {"killed_at": time.perf_counter() - t0}
+                )
+                phase_idx += 1
+                worker_id += 1
+                continue
+            break
+    finally:
+        stack.close()  # un-shrink the zoo for any in-process caller
     if not cluster.finished:
         raise SystemExit("resize scenario did not drain the job")
 
@@ -245,8 +286,9 @@ def run_resize_scenario():
         ("elastic_resize_worst_phase_retention", round(worst_retention, 4),
          "ratio", round(worst_retention, 4)),
     ):
+        tag = "cpu-mesh-sparse" if model == "sparse" else "cpu-mesh"
         print(json.dumps({
-            "metric": f"{metric}[cpu-mesh]", "value": round(value, 2),
+            "metric": f"{metric}[{tag}]", "value": round(value, 2),
             "unit": unit, "vs_baseline": round(vs, 4),
         }))
 
@@ -255,7 +297,9 @@ def run_resize_scenario():
     here = os.path.dirname(os.path.abspath(__file__))
     out_path = os.path.join(here, "BENCH_SUITE.json")
     suite = load_json(out_path, {})
-    suite["elastic_resize"] = {
+    key = "elastic_resize_sparse" if model == "sparse" else \
+        "elastic_resize"
+    suite[key] = {
         "phases": phases,
         "recovery_seconds": recoveries,
         "timeline": [
@@ -278,7 +322,12 @@ def main():
     ap = _argparse.ArgumentParser()
     ap.add_argument("--scenario", choices=("preempt", "resize"),
                     default="preempt")
-    scenario = ap.parse_args().scenario
+    ap.add_argument("--model", choices=("mnist", "sparse"),
+                    default="mnist",
+                    help="resize scenario's workload: mnist (dense) or "
+                         "the row-sharded device-sparse recsys model")
+    args = ap.parse_args()
+    scenario = args.scenario
     if scenario == "resize":
         # Resizes need a multi-device CPU mesh and must not contend for
         # the bench chip. The site hook registers the TPU plugin and
@@ -293,7 +342,7 @@ def main():
         import jax
 
         jax.config.update("jax_platforms", "cpu")
-        return run_resize_scenario()
+        return run_resize_scenario(model=args.model)
 
     import argparse
 

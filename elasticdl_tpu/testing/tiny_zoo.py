@@ -1,0 +1,39 @@
+"""Shrink zoo models to test/bench shapes, reversibly.
+
+The recsys zoo's production table is 1M x 256 (1 GB f32) — CPU smoke
+tests, the multichip dryrun, and the resize elasticity bench all need
+the same model at toy vocab. The override has three coupled parts
+(module globals read at ``custom_model()`` call time, the TABLE_SPECS
+tuple, and a ``model_spec.load_module`` route so ``get_model_spec``'s
+by-path re-import resolves to the patched module instance); keeping
+them in one context manager stops the recipes drifting apart across
+call sites and guarantees restoration — a bench that leaves the zoo
+shrunk would silently poison any later in-process job.
+"""
+
+from contextlib import contextmanager
+
+
+@contextmanager
+def tiny_recsys_zoo(vocab: int = 64, dim: int = 16):
+    """Patch the recsys zoo to ``vocab`` x ``dim`` and route
+    ``model_spec.load_module`` at it; yields the patched module and
+    restores everything on exit."""
+    import elasticdl_tpu.core.model_spec as ms
+    from elasticdl_tpu.embedding.device_sparse import TableSpec
+    from model_zoo.recsys import recsys_sparse as zoo
+
+    saved = (zoo.VOCAB, zoo.DIM, zoo.TABLE_SPECS, ms.load_module)
+    real_load = ms.load_module
+    zoo.VOCAB, zoo.DIM = int(vocab), int(dim)
+    zoo.TABLE_SPECS = (TableSpec(
+        name=zoo.TABLE_NAME, vocab=zoo.VOCAB, dim=zoo.DIM,
+        combiner="sum", feature_key=zoo.FEATURE_KEY,
+    ),)
+    ms.load_module = lambda path: (
+        zoo if path.endswith("recsys_sparse.py") else real_load(path)
+    )
+    try:
+        yield zoo
+    finally:
+        zoo.VOCAB, zoo.DIM, zoo.TABLE_SPECS, ms.load_module = saved

@@ -58,31 +58,14 @@ def test_recsys_config_runs_tiny(monkeypatch):
     """The sparse recsys measure path (runner branch + dense control)
     stays runnable — tiny-vocab override so CPU smoke never allocates
     the 1M x 256 production table."""
-    import elasticdl_tpu.core.model_spec as ms
-    from model_zoo.recsys import recsys_sparse as m
-    from elasticdl_tpu.embedding.device_sparse import TableSpec
+    from elasticdl_tpu.testing.tiny_zoo import tiny_recsys_zoo
 
-    monkeypatch.setattr(m, "VOCAB", 64)
-    monkeypatch.setattr(m, "DIM", 8)
-    monkeypatch.setattr(m, "TABLE_SPECS", (
-        TableSpec(name=m.TABLE_NAME, vocab=64, dim=8, combiner="sum",
-                  feature_key=m.FEATURE_KEY),
-    ))
-    # get_model_spec re-imports zoo files by path; route the recsys
-    # module to the patched package import so the tiny overrides hold.
-    real_load = ms.load_module
-
-    def fake_load(path):
-        if path.endswith("recsys_sparse.py"):
-            return m
-        return real_load(path)
-
-    monkeypatch.setattr(ms, "load_module", fake_load)
     monkeypatch.setitem(
         bench_suite.CONFIGS, "recsys",
         ("recsys.recsys_sparse.custom_model", 8, 2, 1),
     )
-    result = bench_suite.run_config("recsys")
+    with tiny_recsys_zoo(vocab=64, dim=8):
+        result = bench_suite.run_config("recsys")
     assert np.isfinite(result["eps"]) and result["eps"] > 0
     # The paired dense-embedding control rode along.
     assert result["rate_dense"] > 0

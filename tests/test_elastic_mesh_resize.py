@@ -141,3 +141,276 @@ def test_mesh_regrow_reshards_tp(tmp_path):
         ),
         rtol=1e-6, atol=1e-6,
     )
+
+# --------------------------------------------------------------- sparse
+
+# The reference's single most ElasticDL-defining recsys scenario:
+# checkpoint a job whose embedding table is partitioned across N
+# parameter servers, restore it across a DIFFERENT N
+# (save_utils.py:206-259, pkg/ps/checkpoint.go:47-119, exercised by
+# worker_ps_interaction_test.py:337's mid-training PS restart). The
+# TPU form: the row-sharded device-sparse table (+ co-sharded slot
+# tables) lives on a mesh; a resize means each device's row range
+# changes (dp4 -> dp2 doubles every shard), and restore must re-place
+# rows under the new mesh with the training math unchanged.
+
+SPARSE_VOCAB = 64
+SPARSE_DIM = 16
+
+
+def _TinySparse():
+    import flax.linen as nn
+
+    from elasticdl_tpu.embedding.device_sparse import SparseEmbed
+
+    class TinySparse(nn.Module):
+        @nn.compact
+        def __call__(self, features, training=False):
+            emb = SparseEmbed("items", SPARSE_DIM)()
+            x = nn.relu(nn.Dense(8)(emb))
+            return nn.Dense(1, dtype=np.float32)(x)[..., 0]
+
+    return TinySparse()
+
+
+def _sparse_loss(labels, preds, mask):
+    import jax.numpy as jnp
+    import optax
+
+    per = optax.sigmoid_binary_cross_entropy(
+        preds, labels.astype(np.float32)
+    )
+    return (per * mask).sum() / jnp.maximum(mask.sum(), 1)
+
+
+def _sparse_runner(mesh):
+    from elasticdl_tpu.embedding.device_sparse import (
+        DeviceSparseRunner,
+        TableSpec,
+    )
+    from elasticdl_tpu.embedding.optimizer import Adagrad
+
+    specs = (TableSpec(name="items", vocab=SPARSE_VOCAB, dim=SPARSE_DIM,
+                       combiner="sum", feature_key="ids"),)
+    return DeviceSparseRunner(
+        specs, Adagrad(lr=0.05), use_pallas="never", mesh=mesh,
+        partition_threshold_bytes=0,
+    )
+
+
+def _sparse_batches(n, batch=8):
+    out = []
+    for s in range(n):
+        rng = np.random.RandomState(100 + s)
+        out.append({
+            "features": {
+                "ids": rng.randint(
+                    0, SPARSE_VOCAB, (batch, 4)
+                ).astype(np.int32),
+            },
+            "labels": rng.randint(0, 2, batch).astype(np.int32),
+            "mask": np.ones((batch,), np.float32),
+        })
+    return out
+
+
+def _assert_table_on(state, mesh_shape, table="items"):
+    from jax.sharding import PartitionSpec as P
+
+    sh = state.tables[table].sharding
+    assert dict(sh.mesh.shape) == mesh_shape, sh.mesh.shape
+    assert sh.spec == P("dp", None), sh.spec
+    acc = state.slot_tables[table]["accumulator"].sharding
+    assert acc.spec == P("dp", None), acc.spec
+
+
+def test_sparse_resize_trajectory_equivalence(tmp_path):
+    """dp4 -> checkpoint -> dp2 -> checkpoint -> dp4: per-step losses
+    and the final table/slots must equal an unresized dp4 run — the
+    repartition leaves no trace on the training math."""
+    import optax
+
+    from elasticdl_tpu.checkpoint import CheckpointHook, restore_from_dir
+
+    batches = _sparse_batches(6)
+    ckpt = str(tmp_path / "ckpt")
+
+    # Control: unresized dp4, all 6 steps.
+    mesh4 = make_mesh((4,), ("dp",), devices=jax.devices()[:4])
+    runner = _sparse_runner(mesh4)
+    state = runner.init_state(
+        _TinySparse(), optax.sgd(0.1), batches[0], seed=0
+    )
+    step = runner.train_step(_sparse_loss)
+    control_losses = []
+    for b in batches:
+        state, m = step(state, b)
+        control_losses.append(float(m["loss"]))
+    control_table = np.asarray(state.tables["items"])
+    control_acc = np.asarray(state.slot_tables["items"]["accumulator"])
+
+    # Resized run, phase 1: dp4 for steps 1-2, checkpoint.
+    hook = CheckpointHook(checkpoint_dir=ckpt, checkpoint_steps=1,
+                          async_save=False)
+    runner_a = _sparse_runner(mesh4)
+    state_a = runner_a.init_state(
+        _TinySparse(), optax.sgd(0.1), batches[0], seed=0
+    )
+    step_a = runner_a.train_step(_sparse_loss)
+    losses = []
+    for b in batches[:2]:
+        state_a, m = step_a(state_a, b)
+        losses.append(float(m["loss"]))
+    assert hook.maybe_save(state_a)
+
+    # Phase 2: the cluster shrank — dp2. Each device's table shard
+    # DOUBLES (32 rows/device vs 16); seed 7 proves values come from
+    # the checkpoint, not re-init.
+    mesh2 = make_mesh((2,), ("dp",), devices=jax.devices()[:2])
+    runner_b = _sparse_runner(mesh2)
+    state_b = runner_b.init_state(
+        _TinySparse(), optax.sgd(0.1), batches[0], seed=7
+    )
+    state_b = restore_from_dir(state_b, ckpt, required=True)
+    state_b = runner_b.place_state(state_b)
+    _assert_table_on(state_b, {"dp": 2})
+    assert int(state_b.step) == 2
+    hook2 = CheckpointHook(checkpoint_dir=ckpt, checkpoint_steps=1,
+                           async_save=False)
+    hook2.note_version(int(state_b.step))
+    step_b = runner_b.train_step(_sparse_loss)
+    for b in batches[2:4]:
+        state_b, m = step_b(state_b, b)
+        losses.append(float(m["loss"]))
+    assert hook2.maybe_save(state_b)
+
+    # Phase 3: regrow to dp4 and finish.
+    runner_c = _sparse_runner(mesh4)
+    state_c = runner_c.init_state(
+        _TinySparse(), optax.sgd(0.1), batches[0], seed=11
+    )
+    state_c = restore_from_dir(state_c, ckpt, required=True)
+    state_c = runner_c.place_state(state_c)
+    _assert_table_on(state_c, {"dp": 4})
+    assert int(state_c.step) == 4
+    step_c = runner_c.train_step(_sparse_loss)
+    for b in batches[4:]:
+        state_c, m = step_c(state_c, b)
+        losses.append(float(m["loss"]))
+
+    np.testing.assert_allclose(losses, control_losses,
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(state_c.tables["items"]), control_table,
+        rtol=1e-4, atol=1e-5,
+    )
+    np.testing.assert_allclose(
+        np.asarray(state_c.slot_tables["items"]["accumulator"]),
+        control_acc, rtol=1e-4, atol=1e-5,
+    )
+
+
+@pytest.fixture
+def tiny_recsys():
+    from elasticdl_tpu.testing.tiny_zoo import tiny_recsys_zoo
+
+    with tiny_recsys_zoo(vocab=SPARSE_VOCAB, dim=SPARSE_DIM) as zoo:
+        yield zoo
+
+
+def test_mesh_resize_sparse_job(tmp_path, tiny_recsys):
+    """Full job seam: a recsys job with a LIVE row-sharded sparse table
+    dies on dp4, a replacement worker resumes on dp2 from the sharded
+    checkpoint and drains the job, and the final state regrows onto dp4
+    with values intact — the mid-training PS-restart scenario
+    (worker_ps_interaction_test.py:337) on a resizing mesh."""
+    from elasticdl_tpu.checkpoint import restore_from_dir
+    from elasticdl_tpu.embedding.device_sparse import DeviceSparseRunner
+    from elasticdl_tpu.embedding.optimizer import Adagrad
+    from elasticdl_tpu.testing.data import create_frappe_record_file
+
+    m = tiny_recsys
+
+    def sparse_runner_on(mesh):
+        return DeviceSparseRunner(
+            m.TABLE_SPECS, Adagrad(lr=0.05), use_pallas="never",
+            mesh=mesh, partition_threshold_bytes=0,
+        )
+
+    train = create_frappe_record_file(
+        str(tmp_path / "t.rec"), 192, seed=1, input_length=4,
+        max_id=SPARSE_VOCAB,
+    )
+    ckpt_dir = str(tmp_path / "ckpt")
+
+    # Phase 1: dp4, dies after 3 tasks with the table live-sharded.
+    mesh4 = make_mesh((4,), ("dp",), devices=jax.devices()[:4])
+    calls = {"n": 0}
+
+    def die_after_three(request):
+        calls["n"] += 1
+        if calls["n"] > 3:
+            raise WorkerKilled("simulated TPU-VM preemption")
+
+    cluster = MiniCluster(
+        model_zoo=model_zoo_dir(),
+        model_def="recsys.recsys_sparse.custom_model",
+        training_data=train,
+        minibatch_size=16,
+        num_minibatches_per_task=2,
+        checkpoint_dir=ckpt_dir,
+        checkpoint_steps=2,
+        step_runner_factory=lambda: sparse_runner_on(mesh4),
+        worker_callbacks={"get_task": die_after_three},
+    )
+    with pytest.raises(WorkerKilled):
+        cluster.workers[0].run()
+    assert not cluster.finished
+    _assert_table_on(cluster.workers[0].state, {"dp": 4},
+                     table=m.TABLE_NAME)
+    cluster.dispatcher.recover_tasks(0)
+    version = CheckpointSaver(ckpt_dir).get_valid_latest_version()
+    assert version is not None and version >= 2
+
+    # Phase 2: replacement drains the job on dp2 — every device's row
+    # range doubled; restore re-places rows under the new mesh.
+    from elasticdl_tpu.checkpoint import CheckpointHook
+
+    mesh2 = make_mesh((2,), ("dp",), devices=jax.devices()[:2])
+    spec2 = get_model_spec(model_zoo_dir(), "recsys.recsys_sparse.custom_model")
+    replacement = Worker(
+        worker_id=1,
+        master_client=InProcessMaster(cluster.servicer, worker_id=1),
+        model_spec=spec2,
+        data_reader=cluster.train_reader,
+        minibatch_size=16,
+        step_runner=sparse_runner_on(mesh2),
+        checkpoint_dir_for_init=ckpt_dir,
+        checkpoint_hook=CheckpointHook(
+            checkpoint_dir=ckpt_dir, checkpoint_steps=2, async_save=False
+        ),
+    )
+    result = replacement.run()
+    assert cluster.finished
+    assert int(replacement.state.step) > version
+    assert np.isfinite(result["final_loss"])
+    _assert_table_on(replacement.state, {"dp": 2}, table=m.TABLE_NAME)
+
+    # Phase 3: regrow — restore the final checkpoint onto dp4; rows
+    # re-place under quartered ranges with values intact.
+    import optax
+
+    runner4 = sparse_runner_on(mesh4)
+    batch = replacement.last_batch
+    state4 = runner4.init_state(
+        m.custom_model(), optax.adam(1e-3), batch, seed=13
+    )
+    state4 = restore_from_dir(state4, ckpt_dir, required=True)
+    state4 = runner4.place_state(state4)
+    _assert_table_on(state4, {"dp": 4}, table=m.TABLE_NAME)
+    assert int(state4.step) == int(replacement.state.step)
+    np.testing.assert_allclose(
+        np.asarray(state4.tables[m.TABLE_NAME]),
+        np.asarray(replacement.state.tables[m.TABLE_NAME]),
+        rtol=1e-6, atol=1e-7,
+    )
