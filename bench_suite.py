@@ -85,6 +85,17 @@ CONFIGS = {
     # The suite also measures the dense-embedding control (same model,
     # flax Embed + dense optimizer) and records the sparse/dense ratio.
     "recsys": ("recsys.recsys_sparse.custom_model", 512, 64, 2),
+    # Switch-style MoE LM (net-new axis, VERDICT r4 #4): the d512
+    # flagship with every 2nd MLP replaced by an 8-expert top-1 routed
+    # layer under CAPACITY-SCATTER dispatch (models/transformer.py
+    # _scatter_dispatch — one-hot-cumsum ranking, (E, C, D) scatter,
+    # batched expert FFN, gather-combine). One chip = no ep all-to-all;
+    # what this config times is the dispatch machinery itself against
+    # the dense einsum the same model would otherwise run. B16/steps 16
+    # matches the d512 flagship tuple (sweep-confirmed there); capacity
+    # factor stays the model default (1.25), the standard Switch
+    # operating point.
+    "moe": ("transformer.transformer_lm.custom_model", 16, 16, 2),
 }
 TRANSFORMER_SEQ = 1024
 TRANSFORMER_VOCAB = 32768
@@ -93,7 +104,17 @@ _TRANSFORMER_SIZES = {
     "transformer": dict(d_model=512, n_heads=8, n_layers=8, d_ff=2048),
     "transformer_l": dict(d_model=1024, n_heads=16, n_layers=12,
                           d_ff=4096),
+    "moe": dict(d_model=512, n_heads=8, n_layers=8, d_ff=2048,
+                moe_experts=8, moe_every=2, moe_top_k=1,
+                moe_dispatch="scatter"),
 }
+
+
+def _is_lm(name: str) -> bool:
+    """Configs that run the transformer zoo model (token-rate units,
+    LM batch shape): the transformer/transformer_l flagships plus the
+    MoE variant."""
+    return name in _TRANSFORMER_SIZES
 
 
 def _transformer_spec(spec, name="transformer"):
@@ -130,7 +151,7 @@ def _make_batch(name, batch, rng):
         features = rng.randint(
             0, m.MAX_ID, (batch, m.INPUT_LENGTH)
         ).astype(np.int32)
-    elif name.startswith("transformer"):
+    elif _is_lm(name):
         start = rng.randint(0, TRANSFORMER_VOCAB, (batch, 1))
         seq = (
             start + np.arange(TRANSFORMER_SEQ + 1)[None, :]
@@ -166,6 +187,31 @@ def _make_batch(name, batch, rng):
     }
 
 
+def config_spec(name):
+    """(spec, batch, steps, measure_tasks) with every bench-side spec
+    fixup applied — the ONE place run_config and the measurement tools
+    (benchlib.load_config_spec) get their spec, so a tool can never
+    profile a different model than the suite measures."""
+    from elasticdl_tpu.core.model_spec import get_model_spec
+    from elasticdl_tpu.testing.data import model_zoo_dir
+
+    model_def, batch, steps, measure_tasks = CONFIGS[name]
+    spec = get_model_spec(model_zoo_dir(), model_def)
+    if _is_lm(name):
+        spec = _transformer_spec(spec, name)
+    if name == "recsys":
+        # Bench-side EXPLICIT opt-in to the packed-slot layout (+37%
+        # measured, BASELINE.md round-5) — the zoo factory defaults to
+        # the split layout so production checkpoints stay compatible
+        # with the row-sharded/elastic-relaunch runners.
+        import functools
+
+        spec.make_sparse_runner = functools.partial(
+            spec.make_sparse_runner, packed_slots=True
+        )
+    return spec, batch, steps, measure_tasks
+
+
 def run_config(name):
     """Measure one config; returns the benchlib.measure_multi_step dict
     with transformer rates scaled to tokens/sec. The sparse recsys
@@ -175,14 +221,9 @@ def run_config(name):
     win."""
     import jax
 
-    from elasticdl_tpu.core.model_spec import get_model_spec
     from elasticdl_tpu.core.step import stack_batches
-    from elasticdl_tpu.testing.data import model_zoo_dir
 
-    model_def, batch, steps, measure_tasks = CONFIGS[name]
-    spec = get_model_spec(model_zoo_dir(), model_def)
-    if name.startswith("transformer"):
-        spec = _transformer_spec(spec, name)
+    spec, batch, steps, measure_tasks = config_spec(name)
     rng = np.random.RandomState(0)
     task = jax.device_put(
         stack_batches([_make_batch(name, batch, rng) for _ in range(steps)])
@@ -190,7 +231,7 @@ def run_config(name):
     measured = measure_multi_step(
         spec, task, batch, steps, measure_tasks, compute_mfu=True
     )
-    if name.startswith("transformer"):
+    if _is_lm(name):
         for key in ("eps", "eps_median", "eps_device"):
             measured[key] *= TRANSFORMER_SEQ  # examples/sec -> tokens/sec
     if name == "recsys":
@@ -301,7 +342,7 @@ def main():
             }))
             continue
         unit = (
-            "tokens/sec/chip" if name.startswith("transformer")
+            "tokens/sec/chip" if _is_lm(name)
             else "examples/sec/chip"
         )
         vs, gate_kind = gate(name, measured)
@@ -348,6 +389,11 @@ def main():
             "tflops_per_sec": round(
                 measured.get("tflops_per_sec", 0.0), 2
             ),
+            # HBM roofline companion (benchlib.program_cost): the
+            # efficiency statement for embedding-bound configs.
+            "hbm_frac": round(measured.get("hbm_frac", 0.0), 4),
+            "hbm_gbps": round(measured.get("hbm_gbps", 0.0), 2),
+            "bytes_per_step": measured.get("bytes_per_step", 0.0),
         }
         for extra in ("rate_dense", "rate_dense_device",
                       "sparse_speedup_vs_dense"):
@@ -360,6 +406,7 @@ def main():
             "unit": unit,
             "vs_baseline": round(vs, 4),
             "mfu": round(measured.get("mfu", 0.0), 4),
+            "hbm_frac": round(measured.get("hbm_frac", 0.0), 4),
             "rate_device": round(measured["eps_device"], 2),
             "gate": gate_kind,
         }))

@@ -88,19 +88,36 @@ def peak_flops(device) -> float:
     return 0.0
 
 
-def load_config_spec(name):
-    """(spec, batch, steps, measure_tasks) for a bench_suite config:
-    zoo spec with the transformer size fixup applied. Cheap — tools
-    that re-measure model variants rebuild just this per variant."""
-    import bench_suite
-    from elasticdl_tpu.core.model_spec import get_model_spec
-    from elasticdl_tpu.testing.data import model_zoo_dir
+# Peak HBM bandwidth per chip (bytes/sec), for roofline accounting on
+# embedding-bound configs (MFU is meaningless there — the honest
+# efficiency metric is fraction of memory bandwidth). Public spec-sheet
+# numbers, same prefix scheme as PEAK_BF16_FLOPS.
+PEAK_HBM_BYTES_PER_SEC = (
+    ("TPU v5 lite", 819e9),    # v5e
+    ("TPU v5e", 819e9),
+    ("TPU v5p", 2765e9),
+    ("TPU v5", 2765e9),
+    ("TPU v4", 1228e9),
+    ("TPU v6", 1640e9),        # Trillium
+)
 
-    model_def, batch, steps, measure_tasks = bench_suite.CONFIGS[name]
-    spec = get_model_spec(model_zoo_dir(), model_def)
-    if name.startswith("transformer"):
-        spec = bench_suite._transformer_spec(spec, name)
-    return spec, batch, steps, measure_tasks
+
+def peak_hbm_bw(device) -> float:
+    kind = getattr(device, "device_kind", "") or ""
+    for prefix, peak in PEAK_HBM_BYTES_PER_SEC:
+        if kind.startswith(prefix):
+            return peak
+    return 0.0
+
+
+def load_config_spec(name):
+    """(spec, batch, steps, measure_tasks) for a bench_suite config —
+    delegates to bench_suite.config_spec so tools always measure the
+    exact spec (transformer sizes, recsys packed layout) the suite
+    gates on."""
+    import bench_suite
+
+    return bench_suite.config_spec(name)
 
 
 def load_config_harness(name, seed=0, spec_parts=None):
@@ -127,24 +144,111 @@ def load_config_harness(name, seed=0, spec_parts=None):
     return spec, task, batch, steps, measure_tasks
 
 
-def program_flops(spec, batch):
-    """FLOPs of ONE optimizer step (forward+backward+apply) from XLA's
-    cost analysis of the compiled single-step program. The bench configs
-    run without rematerialization, so this equals the model's analytic
-    FLOPs (no recompute inflation) — the numerator MFU is defined over."""
+def program_cost(spec, batch, state=None, step=None):
+    """XLA cost analysis of ONE compiled optimizer step (forward +
+    backward + apply): {"flops": ...}. The bench configs run without
+    rematerialization, so flops equals the model's analytic FLOPs (no
+    recompute inflation) — the numerator MFU is defined over.
+
+    Device-tier sparse specs (``make_sparse_runner``) are costed through
+    THEIR program — the runner's lookup + row-kernel step — not the
+    dense ``build_train_step``, which would never compile against a
+    SparseTrainState. Pass ``state``/``step`` to reuse a live state and
+    step function (measure_multi_step does — building a second sparse
+    state would transiently double the production table in HBM)."""
     import jax
 
     from elasticdl_tpu.core.step import build_train_step
     from elasticdl_tpu.core.train_state import init_train_state
 
-    state = init_train_state(
-        spec.model, spec.make_optimizer(), batch, seed=0
-    )
-    compiled = build_train_step(spec.loss).lower(state, batch).compile()
-    cost = compiled.cost_analysis()
+    if (state is None) != (step is None):
+        # A lone state would be silently discarded and rebuilt — for a
+        # sparse spec that transiently doubles the table in HBM, the
+        # exact hazard passing state exists to avoid.
+        raise ValueError("pass state and step together, or neither")
+    if state is None:
+        if getattr(spec, "make_sparse_runner", None):
+            runner = spec.make_sparse_runner()
+            state = runner.init_state(
+                spec.model, spec.make_optimizer(), batch, seed=0
+            )
+            step = runner.train_step(spec.loss)
+        else:
+            state = init_train_state(
+                spec.model, spec.make_optimizer(), batch, seed=0
+            )
+            step = build_train_step(spec.loss)
+    cost = step.lower(state, batch).compile().cost_analysis()
     if isinstance(cost, (list, tuple)):
         cost = cost[0] if cost else {}
-    return float((cost or {}).get("flops", 0.0))
+    return cost or {}
+
+
+def program_flops(spec, batch, state=None, step=None):
+    """FLOPs of one optimizer step (see ``program_cost``)."""
+    return float(
+        program_cost(spec, batch, state=state, step=step)
+        .get("flops", 0.0)
+    )
+
+
+def analytic_bytes_per_step(state, batch, table_specs=()) -> float:
+    """USEFUL HBM traffic of one optimizer step, in bytes — the
+    numerator ``hbm_frac`` is defined over.
+
+    Deliberately analytic, not XLA's "bytes accessed": the cost model
+    charges a gather/scatter the FULL operand (a 1M-row table per
+    lookup), which measured >1.0 "of peak" on deepfm — an estimator
+    that can exceed the roofline attributes nothing. The analytic count
+    is the traffic the training math REQUIRES; achieved/peak below 1.0
+    then honestly splits into "moving bytes slower than the pin limit"
+    vs "spending time on non-traffic work" (dispatch, sorts, compute).
+
+    Model (documented so the number is auditable):
+    - dense params ``p``: read at forward + read at backward + write at
+      apply (3p), gradient write + read (2p) -> 5 x param bytes;
+    - optimizer-state leaves: read + write at apply -> 2 x their bytes;
+    - device-sparse tables (``table_specs``, SparseTrainState): per id
+      in the batch (upper bound of unique rows) one row of traffic for
+      forward read, row-grad write+read, apply read+write, and
+      read+write per slot table -> (5 + 2*n_slots) x ids x row bytes;
+      untouched rows move nothing — that IS the sparse plane's claim.
+    - activations and the ids themselves are excluded (second-order at
+      these shapes; documented as such in BASELINE.md).
+    """
+    import jax
+
+    def nbytes(tree):
+        return float(sum(
+            np.size(leaf) * np.dtype(
+                getattr(leaf, "dtype", np.float32)
+            ).itemsize
+            for leaf in jax.tree.leaves(tree)
+        ))
+
+    total = 5.0 * nbytes(state.params) + 2.0 * nbytes(state.opt_state)
+    tables = getattr(state, "tables", None) or {}
+    slot_tables = getattr(state, "slot_tables", None) or {}
+    for spec in table_specs:
+        if spec.name not in tables:
+            continue
+        ids = batch["features"][spec.feature_key]
+        ids = getattr(ids, "ids", ids)          # RaggedIds -> ids
+        itemsize = np.dtype(tables[spec.name].dtype).itemsize
+        width = int(np.shape(tables[spec.name])[-1])
+        if width > spec.dim:
+            # packed_slots layout (optimizer.pack_table): forward reads
+            # the full packed row (1x), apply gathers + scatters it
+            # (2x), row grads write+read at model dim (2x).
+            total += np.size(ids) * itemsize * (
+                3.0 * width + 2.0 * spec.dim
+            )
+        else:
+            n_slots = len(slot_tables.get(spec.name, {}))
+            total += (
+                (5.0 + 2.0 * n_slots) * np.size(ids) * spec.dim * itemsize
+            )
+    return total
 
 
 def module_device_times(trace_dir, name_filter="multi_step"):
@@ -251,7 +355,7 @@ def measure_multi_step(spec, task, batch, steps_per_task, measure_tasks,
     """
     import jax
 
-    from elasticdl_tpu.core.step import build_multi_step
+    from elasticdl_tpu.core.step import build_multi_step, build_train_step
     from elasticdl_tpu.core.train_state import init_train_state
 
     if getattr(spec, "make_sparse_runner", None):
@@ -259,17 +363,21 @@ def measure_multi_step(spec, task, batch, steps_per_task, measure_tasks,
         # runner owns state init and the fused multi-step — the Pallas
         # lookup + row-kernel path this config exists to measure.
         runner = spec.make_sparse_runner()
+        sparse_specs = runner.specs
         state = runner.init_state(
             spec.model, spec.make_optimizer(),
             jax.tree.map(lambda x: x[0], task), seed=0,
         )
         multi_step = runner.train_multi_step(spec.loss)
+        cost_step = runner.train_step(spec.loss)
     else:
+        sparse_specs = ()
         state = init_train_state(
             spec.model, spec.make_optimizer(),
             jax.tree.map(lambda x: x[0], task), seed=0,
         )
         multi_step = build_multi_step(spec.loss)
+        cost_step = build_train_step(spec.loss)
 
     def sync(metrics):
         # Host transfer of the last step's loss: a hard sync even where
@@ -310,23 +418,36 @@ def measure_multi_step(spec, task, batch, steps_per_task, measure_tasks,
         batch * steps_per_task / (device_ms / 1e3) if device_ms else 0.0
     )
 
-    if compute_mfu and getattr(spec, "make_sparse_runner", None):
-        # Embedding-bound by construction: MFU is structurally ~0 and
-        # the dense-step cost analysis doesn't apply to the sparse
-        # program. Rate is the metric (BASELINE.md round-2 notes).
-        result["mfu"] = 0.0
-        result["tflops_per_sec"] = 0.0
-    elif compute_mfu:
+    if compute_mfu:
+        one_batch = jax.tree.map(lambda x: x[0], task)
         flops_step = program_flops(
-            spec, jax.tree.map(lambda x: x[0], task)
+            spec, one_batch, state=state, step=cost_step
+        )
+        bytes_step = analytic_bytes_per_step(
+            state, one_batch, table_specs=sparse_specs
         )
         if device_ms:
-            achieved = flops_step * steps_per_task / (device_ms / 1e3)
+            sec = device_ms / 1e3 / steps_per_task
         else:
-            achieved = flops_step * steps_per_task * measure_tasks / best
-        peak = peak_flops(jax.devices()[0])
-        result["mfu"] = achieved / peak if peak else 0.0
-        result["tflops_per_sec"] = achieved / 1e12
+            sec = best / (steps_per_task * measure_tasks)
+        device = jax.devices()[0]
+        peak = peak_flops(device)
+        result["mfu"] = flops_step / sec / peak if peak else 0.0
+        result["tflops_per_sec"] = flops_step / sec / 1e12
+        # Roofline companion: achieved USEFUL bandwidth as a fraction
+        # of the chip's peak (analytic_bytes_per_step) — the honest
+        # efficiency statement for embedding-bound configs
+        # (deepfm/census/recsys), where the step streams table rows and
+        # mfu is structurally ~0. Near-1.0 means the program is at the
+        # memory roofline and "faster" requires touching fewer bytes;
+        # far below it with mfu also ~0 means the time goes to
+        # non-traffic work — attribute before optimizing.
+        peak_bw = peak_hbm_bw(device)
+        result["bytes_per_step"] = bytes_step
+        result["hbm_gbps"] = bytes_step / sec / 1e9 if sec else 0.0
+        result["hbm_frac"] = (
+            bytes_step / sec / peak_bw if peak_bw and sec else 0.0
+        )
     return result
 
 

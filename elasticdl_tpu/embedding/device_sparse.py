@@ -40,7 +40,9 @@ from elasticdl_tpu.embedding.combiner import COMBINERS, RaggedIds, combine
 from elasticdl_tpu.embedding.optimizer import (
     RowOptimizer,
     init_slot_tables,
+    pack_table,
     sparse_apply,
+    sparse_apply_packed,
 )
 from elasticdl_tpu.embedding.partition import (
     DEFAULT_PARTITION_THRESHOLD_BYTES,
@@ -198,6 +200,7 @@ def build_sparse_train_step(
     mesh=None,
     axis: str = "dp",
     sharded_tables: FrozenSet[str] = frozenset(),
+    packed_slots: bool = False,
 ) -> Callable:
     """Build ``(SparseTrainState, batch) -> (state, metrics)`` — one
     jittable program covering lookup, model fwd/bwd, dense apply, and
@@ -212,7 +215,14 @@ def build_sparse_train_step(
     through ``sparse_apply_sharded`` — same math, partitioned by row
     range, so the dp-N trajectory equals dp-1 exactly (dryrun case 5).
     Everything else (dedup, model fwd/bwd, dense apply) stays in the
-    global view and GSPMD partitions it over the batch sharding."""
+    global view and GSPMD partitions it over the batch sharding.
+
+    ``packed_slots``: slot tables live INSIDE the main table rows
+    ((V, D*(1+n_slots)), optimizer.pack_table) so the apply is one
+    gather + one scatter instead of (1 + n_slots) of each — the
+    measured scatter-latency win (optimizer.sparse_apply_packed).
+    Single-mesh only; forward narrows gathered rows to the first D
+    columns."""
     from elasticdl_tpu.core.step import _call_loss
     from elasticdl_tpu.embedding.host_engine import _nest_rows
     from elasticdl_tpu.ops.pallas_embedding import (
@@ -221,6 +231,16 @@ def build_sparse_train_step(
     )
     if sharded_tables and mesh is None:
         raise ValueError("sharded_tables requires a mesh")
+    if packed_slots and (mesh is not None or sharded_tables):
+        raise ValueError(
+            "packed_slots is single-mesh only (the row-sharded path "
+            "keeps split tables)"
+        )
+    if packed_slots and use_pallas == "always":
+        raise ValueError(
+            "packed_slots uses the XLA gather/scatter path; the Pallas "
+            "row kernels operate on split tables"
+        )
 
     def train_step(state: SparseTrainState, batch):
         state, rng = state.next_rng()
@@ -233,7 +253,17 @@ def build_sparse_train_step(
             # Forward from the LIVE table (Pallas auto-dispatch); the
             # table is not differentiated — row grads come from the
             # combiner transpose below.
-            if spec.name in sharded_tables:
+            if packed_slots:
+                # Gather the packed rows, narrow to the live first-D
+                # columns, combine — the slot columns ride the same
+                # (coalesced, cheap) gather; see sparse_apply_packed.
+                rows = jnp.take(
+                    jax.lax.stop_gradient(table), ragged.ids, axis=0
+                )[..., :spec.dim]
+                embs[spec.name] = combine(
+                    rows, ragged.weights, spec.combiner
+                )
+            elif spec.name in sharded_tables:
                 embs[spec.name] = lookup_combine_sharded(
                     jax.lax.stop_gradient(table), ragged.ids,
                     ragged.weights, spec.combiner, mesh, axis,
@@ -281,7 +311,13 @@ def build_sparse_train_step(
                 spec.combiner,
             )
             step_count = state.table_steps[spec.name] + 1
-            if spec.name in sharded_tables:
+            if packed_slots:
+                table = sparse_apply_packed(
+                    row_opt, state.tables[spec.name], uids, rows_ct,
+                    step_count, spec.dim,
+                )
+                slots = state.slot_tables[spec.name]  # {} — in-row
+            elif spec.name in sharded_tables:
                 table, slots = sparse_apply_sharded(
                     row_opt, state.tables[spec.name],
                     state.slot_tables[spec.name], uids, rows_ct,
@@ -314,13 +350,14 @@ def build_sparse_multi_step(loss_fn, specs, row_opt, template,
                             unroll: int = 1,
                             mesh=None, axis: str = "dp",
                             sharded_tables: FrozenSet[str] = frozenset(),
-                            state_shardings=None) -> Callable:
+                            state_shardings=None,
+                            packed_slots: bool = False) -> Callable:
     """T fused sparse steps per XLA program (the task-granular mode —
     core/step.build_multi_step for the sparse plane)."""
     step = build_sparse_train_step(
         loss_fn, specs, row_opt, template, use_pallas=use_pallas,
         interpret=interpret, mesh=mesh, axis=axis,
-        sharded_tables=sharded_tables,
+        sharded_tables=sharded_tables, packed_slots=packed_slots,
     )
 
     def multi_step(state, batches):
@@ -344,13 +381,16 @@ def build_sparse_multi_step(loss_fn, specs, row_opt, template,
 def init_sparse_state(
     model, tx, example_batch, specs: Tuple[TableSpec, ...],
     row_opt: RowOptimizer, seed: int = 0,
-    table_dtype=jnp.float32,
+    table_dtype=jnp.float32, packed_slots: bool = False,
 ) -> Tuple[SparseTrainState, Any]:
     """Trace the model (zero embeddings in the collection), attach
     deterministic tables + zero slots; returns ``(state, template)``
     where template is the model's sparse_emb collection structure
     (pass to ``build_sparse_train_step``). Table init is seeded
-    uniform, so elastic relaunches reproduce."""
+    uniform, so elastic relaunches reproduce. With ``packed_slots``
+    each table leaf is the (V, D*(1+n_slots)) packed store (identical
+    main-table values — slots concatenate onto the same seeded init)
+    and ``slot_tables`` entries are empty."""
     from elasticdl_tpu.embedding.host_engine import _iter_leaves
 
     rng = jax.random.PRNGKey(seed)
@@ -372,12 +412,18 @@ def init_sparse_state(
     for i, spec in enumerate(specs):
         key = jax.random.fold_in(jax.random.PRNGKey(seed), i)
         scale = 1.0 / np.sqrt(spec.dim)
-        tables[spec.name] = jax.random.uniform(
+        main = jax.random.uniform(
             key, (spec.vocab, spec.dim), table_dtype, -scale, scale
         )
-        slot_tables[spec.name] = init_slot_tables(
+        slots = init_slot_tables(
             row_opt, spec.vocab, spec.dim, table_dtype
         )
+        if packed_slots:
+            tables[spec.name] = pack_table(main, slots, row_opt)
+            slot_tables[spec.name] = {}
+        else:
+            tables[spec.name] = main
+            slot_tables[spec.name] = slots
         table_steps[spec.name] = jnp.zeros((), jnp.int32)
 
     state = SparseTrainState(
@@ -416,7 +462,25 @@ class DeviceSparseRunner:
                  interpret: Optional[bool] = None,
                  mesh=None, axis: str = "dp",
                  partition_threshold_bytes: int =
-                 DEFAULT_PARTITION_THRESHOLD_BYTES):
+                 DEFAULT_PARTITION_THRESHOLD_BYTES,
+                 packed_slots: bool = False):
+        # packed_slots: slots live inside the table rows so the apply
+        # is one gather + one scatter (optimizer.sparse_apply_packed —
+        # the measured single-chip scatter-latency win). Single-mesh
+        # only; checkpoints are layout-specific (a packed checkpoint
+        # does not restore into a split-table runner or vice versa —
+        # same class of opt-in as resnet50's s2d stem).
+        if packed_slots and mesh is not None:
+            raise ValueError(
+                "packed_slots is single-mesh only (row-sharded tables "
+                "keep the split layout)"
+            )
+        if packed_slots and use_pallas == "always":
+            raise ValueError(
+                "packed_slots uses the XLA gather/scatter path; "
+                "use_pallas='always' pins the split-table kernels"
+            )
+        self.packed_slots = bool(packed_slots)
         self.specs = tuple(specs)
         self.row_opt = row_opt
         self.use_pallas = use_pallas
@@ -468,7 +532,8 @@ class DeviceSparseRunner:
     def init_state(self, model, tx, batch, seed: int = 0):
         if self.mesh is None:
             state, self._template = init_sparse_state(
-                model, tx, batch, self.specs, self.row_opt, seed=seed
+                model, tx, batch, self.specs, self.row_opt, seed=seed,
+                packed_slots=self.packed_slots,
             )
             return state
 
@@ -527,6 +592,7 @@ class DeviceSparseRunner:
             use_pallas=self.use_pallas, interpret=self.interpret,
             mesh=self.mesh, axis=self.axis,
             sharded_tables=self.sharded_tables,
+            packed_slots=self.packed_slots,
         )
         return self._jit_step(step)
 
@@ -537,6 +603,7 @@ class DeviceSparseRunner:
             mesh=self.mesh, axis=self.axis,
             sharded_tables=self.sharded_tables,
             state_shardings=self._state_shardings,
+            packed_slots=self.packed_slots,
         )
 
     def eval_step(self):
@@ -553,7 +620,14 @@ class DeviceSparseRunner:
             embs = {}
             for spec in specs:
                 ragged = _ragged(batch["features"][spec.feature_key])
-                if spec.name in self.sharded_tables:
+                if self.packed_slots:
+                    rows = jnp.take(
+                        state.tables[spec.name], ragged.ids, axis=0
+                    )[..., :spec.dim]
+                    embs[spec.name] = combine(
+                        rows, ragged.weights, spec.combiner
+                    )
+                elif spec.name in self.sharded_tables:
                     embs[spec.name] = lookup_combine_sharded(
                         state.tables[spec.name], ragged.ids,
                         ragged.weights, spec.combiner, self.mesh,

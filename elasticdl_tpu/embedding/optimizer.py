@@ -282,6 +282,55 @@ def init_slot_tables(opt: RowOptimizer, vocab: int, dim: int,
     }
 
 
+# ---- packed layout: slots interleaved into the main table rows ----------
+#
+# XLA's TPU scatter is per-INDEX-latency bound, not bytes bound
+# (measured v5e, BASELINE.md round-5: 16384-row scatter into (1M, 256)
+# 1.33 ms; into (1M, 512) 1.74 ms — 2x the bytes for 1.3x the time).
+# The unpacked apply pays one scatter per table PLUS one per slot table;
+# packing every slot next to its row turns (1 + n_slots) scatters (and
+# gathers) into ONE of a wider row. Same update math, same touched-row
+# contract; the trade is +n_slots x dim bytes per FORWARD lookup row
+# (gathers are coalesced and ~9x cheaper per row, so the swap wins by
+# ~35% of apply time for Adagrad and more for Adam's 3 tables).
+
+
+def packed_width(opt: RowOptimizer, dim: int) -> int:
+    return dim * (1 + len(opt.slot_names))
+
+
+def pack_table(table, slot_tables: Dict[str, "jnp.ndarray"],
+               opt: RowOptimizer):
+    """(V, D) main + per-slot (V, D) -> one (V, D*(1+n_slots)):
+    [row | slot0 | slot1 | ...] in ``slot_names`` order."""
+    return jnp.concatenate(
+        [table] + [slot_tables[n] for n in opt.slot_names], axis=1
+    )
+
+
+def unpack_table(packed, opt: RowOptimizer, dim: int):
+    """Inverse of ``pack_table`` (checkpoint interop, tests)."""
+    table = packed[:, :dim]
+    slots = {
+        n: packed[:, dim * (i + 1): dim * (i + 2)]
+        for i, n in enumerate(opt.slot_names)
+    }
+    return table, slots
+
+
+def sparse_apply_packed(opt: RowOptimizer, packed, unique_ids, row_grads,
+                        step, dim: int):
+    """``sparse_apply`` over a packed (V, D*(1+n_slots)) store: one
+    gather, the row update math, one scatter. Contract matches
+    ``sparse_apply`` (globally-unique ids, out-of-range pad sentinel
+    rows dropped)."""
+    rows_packed = packed.at[unique_ids].get(mode="clip")
+    rows, slots = unpack_table(rows_packed, opt, dim)
+    new_rows, new_slots = opt.apply_rows(rows, row_grads, slots, step)
+    new_packed = pack_table(new_rows, new_slots, opt).astype(packed.dtype)
+    return packed.at[unique_ids].set(new_packed, mode="drop")
+
+
 def unique_pad(ids, fill_id: int):
     """Static-shape dedup: ``jnp.unique`` padded to ``ids.size`` with
     ``fill_id`` (pass the vocab size — an out-of-range sentinel, see

@@ -111,15 +111,25 @@ def optimizer(lr=0.001):
 
 
 def make_sparse_runner(use_pallas: str = "auto",
-                       mesh=None, axis: str = "dp") -> DeviceSparseRunner:
+                       mesh=None, axis: str = "dp",
+                       packed_slots: bool = False) -> DeviceSparseRunner:
     """Step-runner factory (the sparse-tier analogue of
     deepfm_host.make_host_runner). Adagrad rows — the reference PS's
     canonical sparse optimizer (optimizer_wrapper.py slot tables).
     With ``mesh``, the 1M x 256 table row-shards over ``axis`` (it is
-    far over the 2MB partition threshold)."""
+    far over the 2MB partition threshold).
+
+    ``packed_slots=True`` (single-mesh only) packs the Adagrad
+    accumulator into the table rows — one gather + one scatter per
+    apply instead of two of each, measured +37% on v5e (BASELINE.md
+    round-5; the bench opts in). EXPLICIT opt-in because checkpoints
+    are layout-specific: a packed (V, 2D) checkpoint does not restore
+    into the split layout every mesh/elastic-relaunch runner uses, so
+    defaulting it on would break the single-device -> row-sharded
+    resume seam."""
     return DeviceSparseRunner(
         TABLE_SPECS, Adagrad(lr=0.05), use_pallas=use_pallas,
-        mesh=mesh, axis=axis,
+        mesh=mesh, axis=axis, packed_slots=packed_slots,
     )
 
 

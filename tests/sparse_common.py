@@ -1,0 +1,65 @@
+"""Shared tiny device-sparse scaffolding for trajectory-equality
+tests (test_multihost_2proc.py, test_elastic_mesh_resize.py): every
+side of an equivalence assertion must build the SAME model, runner,
+and deterministic batch stream, or the test exercises the scaffolding
+instead of the sparse plane."""
+
+import numpy as np
+
+SPARSE_VOCAB = 64
+SPARSE_DIM = 16
+
+
+def make_model():
+    import flax.linen as nn
+
+    from elasticdl_tpu.embedding.device_sparse import SparseEmbed
+
+    class TinySparse(nn.Module):
+        @nn.compact
+        def __call__(self, features, training=False):
+            emb = SparseEmbed("items", SPARSE_DIM)()
+            x = nn.relu(nn.Dense(8)(emb))
+            return nn.Dense(1, dtype=np.float32)(x)[..., 0]
+
+    return TinySparse()
+
+
+def make_runner(mesh):
+    from elasticdl_tpu.embedding.device_sparse import (
+        DeviceSparseRunner,
+        TableSpec,
+    )
+    from elasticdl_tpu.embedding.optimizer import Adagrad
+
+    specs = (TableSpec(name="items", vocab=SPARSE_VOCAB, dim=SPARSE_DIM,
+                       combiner="sum", feature_key="ids"),)
+    return DeviceSparseRunner(
+        specs, Adagrad(lr=0.05), use_pallas="never", mesh=mesh,
+        partition_threshold_bytes=0,
+    )
+
+
+def sparse_loss(labels, preds, mask):
+    import jax.numpy as jnp
+    import optax
+
+    per = optax.sigmoid_binary_cross_entropy(
+        preds, labels.astype(np.float32)
+    )
+    return (per * mask).sum() / jnp.maximum(mask.sum(), 1)
+
+
+def global_batch(step: int, batch: int = 8, length: int = 4):
+    """Deterministic global batch for ``step`` — identical in every
+    process; each process slices its local rows."""
+    rng = np.random.RandomState(1000 + step)
+    return {
+        "features": {
+            "ids": rng.randint(
+                0, SPARSE_VOCAB, (batch, length)
+            ).astype(np.int32),
+        },
+        "labels": rng.randint(0, 2, batch).astype(np.int32),
+        "mask": np.ones((batch,), np.float32),
+    }

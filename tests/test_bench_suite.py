@@ -25,6 +25,7 @@ def tiny_configs(monkeypatch):
         "deepfm": ("deepfm.deepfm_functional.custom_model", 8, 2, 1),
         "census": ("census.census_wide_deep.custom_model", 8, 2, 1),
         "transformer": ("transformer.transformer_lm.custom_model", 2, 2, 1),
+        "moe": ("transformer.transformer_lm.custom_model", 2, 2, 1),
     }
     monkeypatch.setattr(bench_suite, "CONFIGS", tiny)
     monkeypatch.setattr(bench_suite, "TRANSFORMER_SEQ", 16)
@@ -32,9 +33,11 @@ def tiny_configs(monkeypatch):
     def tiny_transformer(spec, name="transformer"):
         from elasticdl_tpu.models.transformer import TransformerConfig
 
+        moe = dict(moe_experts=4, moe_every=2, moe_dispatch="scatter") \
+            if name == "moe" else {}
         cfg = TransformerConfig(
-            vocab_size=64, d_model=16, n_heads=2, n_layers=1,
-            d_ff=32, max_len=16,
+            vocab_size=64, d_model=16, n_heads=2, n_layers=2 if moe else 1,
+            d_ff=32, max_len=16, **moe,
         )
         spec.model = spec.module.custom_model(config=cfg)
         return spec
@@ -46,7 +49,7 @@ def tiny_configs(monkeypatch):
 
     def clamped(name, batch, rng):
         b = orig(name, batch, rng)
-        if name == "transformer":
+        if name in ("transformer", "moe"):
             b["features"] = (b["features"] % 64).astype(np.int32)
             b["labels"] = (b["labels"] % 64).astype(np.int32)
         return b
@@ -76,7 +79,8 @@ def test_recsys_config_runs_tiny(monkeypatch):
 
 
 @pytest.mark.parametrize(
-    "name", ["mnist", "cifar10", "deepfm", "census", "transformer"]
+    "name", ["mnist", "cifar10", "deepfm", "census", "transformer",
+             "moe"]
 )
 def test_config_runs(name):
     m = bench_suite.run_config(name)
@@ -199,3 +203,57 @@ def test_bench_timeout_still_prints_summary(monkeypatch, capsys):
     last = capsys.readouterr().out.strip().splitlines()[-1]
     rec = json.loads(last)  # the one-JSON-line contract holds
     assert rec["value"] == 0.0
+
+
+def test_analytic_bytes_per_step_model():
+    """The hbm_frac numerator is auditable: dense leaves cost
+    5x params + 2x opt bytes; sparse tables cost (5 + 2*slots) rows of
+    traffic per batch id and NOTHING for untouched rows."""
+    import types
+
+    import jax.numpy as jnp
+
+    from benchlib import analytic_bytes_per_step
+    from elasticdl_tpu.embedding.device_sparse import TableSpec
+
+    params = {"w": np.zeros((10, 4), np.float32)}       # 160 B
+    opt = {"m": np.zeros((10, 4), np.float32)}          # 160 B
+    state = types.SimpleNamespace(params=params, opt_state=opt)
+    dense = analytic_bytes_per_step(state, {"features": {}})
+    assert dense == 5 * 160 + 2 * 160
+
+    table = jnp.zeros((100, 8), jnp.float32)
+    state = types.SimpleNamespace(
+        params=params, opt_state=opt,
+        tables={"t": table},
+        slot_tables={"t": {"accumulator": table}},
+    )
+    spec = TableSpec(name="t", vocab=100, dim=8, feature_key="ids")
+    batch = {"features": {"ids": np.zeros((4, 3), np.int32)}}
+    got = analytic_bytes_per_step(state, batch, table_specs=(spec,))
+    # 12 ids x 8 cols x 4 B = 384 B/row-pass; (5 + 2*1 slot) passes.
+    assert got == dense + (5 + 2) * 12 * 8 * 4
+
+
+def test_analytic_bytes_packed_layout():
+    """A packed table (width > spec.dim, empty slot dict) switches to
+    the 3*width + 2*dim per-id model."""
+    import types
+
+    import jax.numpy as jnp
+
+    from benchlib import analytic_bytes_per_step
+    from elasticdl_tpu.embedding.device_sparse import TableSpec
+
+    params = {"w": np.zeros((10, 4), np.float32)}       # 160 B
+    opt = {"m": np.zeros((10, 4), np.float32)}          # 160 B
+    dense = 5 * 160 + 2 * 160
+    state = types.SimpleNamespace(
+        params=params, opt_state=opt,
+        tables={"t": jnp.zeros((100, 16), jnp.float32)},  # packed 2x8
+        slot_tables={"t": {}},
+    )
+    spec = TableSpec(name="t", vocab=100, dim=8, feature_key="ids")
+    batch = {"features": {"ids": np.zeros((4, 3), np.int32)}}
+    got = analytic_bytes_per_step(state, batch, table_specs=(spec,))
+    assert got == dense + 12 * 4 * (3 * 16 + 2 * 8)

@@ -462,3 +462,97 @@ class TestMeshShardedRunner:
         runner = DeviceSparseRunner(SPECS, Adagrad(lr=0.05), mesh=mesh)
         # 512 x 128 f32 = 256KB < 2MB
         assert runner.sharded_tables == frozenset()
+
+
+class TestPackedSlots:
+    """Slot tables packed into the main table rows (one gather + one
+    scatter per apply — optimizer.sparse_apply_packed, the measured
+    v5e scatter-latency win). Packing must change LAYOUT only: the
+    trajectory, final rows, and slot values equal the split-table
+    runner's for every optimizer family."""
+
+    @pytest.mark.parametrize(
+        "opt_name", ["SGD", "Momentum", "Adagrad", "Adam"]
+    )
+    def test_matches_split_runner(self, opt_name):
+        from elasticdl_tpu.embedding.optimizer import unpack_table
+
+        opt = make_row_optimizer(opt_name, lr=0.05)
+        batches = [make_batch(np.random.RandomState(s)) for s in range(3)]
+        packed_runner = DeviceSparseRunner(
+            SPECS, opt, use_pallas="never", packed_slots=True
+        )
+        state_p, losses_p = _train_with(packed_runner, batches)
+        state_s, losses_s = _train_with(_runner("never", opt=opt), batches)
+        np.testing.assert_allclose(losses_p, losses_s,
+                                   rtol=1e-4, atol=1e-5)
+        table_p, slots_p = unpack_table(
+            state_p.tables["items"], opt, DIM
+        )
+        np.testing.assert_allclose(
+            np.asarray(table_p), np.asarray(state_s.tables["items"]),
+            rtol=1e-4, atol=1e-5,
+        )
+        for name in opt.slot_names:
+            np.testing.assert_allclose(
+                np.asarray(slots_p[name]),
+                np.asarray(state_s.slot_tables["items"][name]),
+                rtol=1e-4, atol=1e-5,
+            )
+        assert state_p.slot_tables["items"] == {}
+
+    def test_eval_and_checkpoint_roundtrip(self, tmp_path):
+        from elasticdl_tpu.checkpoint import CheckpointHook, restore_from_dir
+
+        opt = Adagrad(lr=0.05)
+        batch = make_batch(np.random.RandomState(5))
+        runner = DeviceSparseRunner(
+            SPECS, opt, use_pallas="never", packed_slots=True
+        )
+        state = runner.init_state(TinySparseModel(), optax.sgd(0.1), batch)
+        step = runner.train_step(loss_fn)
+        for _ in range(2):
+            state, _ = step(state, batch)
+        preds = runner.eval_step()(state, batch)
+        assert np.isfinite(np.asarray(preds)).all()
+
+        hook = CheckpointHook(checkpoint_dir=str(tmp_path / "c"),
+                              checkpoint_steps=1, async_save=False)
+        assert hook.maybe_save(state)
+        runner2 = DeviceSparseRunner(
+            SPECS, opt, use_pallas="never", packed_slots=True
+        )
+        state2 = runner2.init_state(
+            TinySparseModel(), optax.sgd(0.1), batch, seed=9
+        )
+        state2 = restore_from_dir(state2, str(tmp_path / "c"))
+        np.testing.assert_array_equal(
+            np.asarray(state2.tables["items"]),
+            np.asarray(state.tables["items"]),
+        )
+
+    def test_mesh_and_forced_kernels_rejected(self):
+        from elasticdl_tpu.parallel.mesh import make_mesh
+
+        devices = jax.devices("cpu")
+        mesh = make_mesh((2,), ("dp",), devices=devices[:2])
+        with pytest.raises(ValueError, match="single-mesh"):
+            DeviceSparseRunner(
+                SPECS, Adagrad(), packed_slots=True, mesh=mesh
+            )
+        with pytest.raises(ValueError, match="packed_slots"):
+            DeviceSparseRunner(
+                SPECS, Adagrad(), packed_slots=True, use_pallas="always"
+            )
+
+
+def _train_with(runner, batches, seed=0):
+    state = runner.init_state(
+        TinySparseModel(), optax.sgd(0.1), batches[0], seed=seed
+    )
+    step = runner.train_step(loss_fn)
+    losses = []
+    for b in batches:
+        state, metrics = step(state, b)
+        losses.append(float(metrics["loss"]))
+    return state, losses

@@ -154,64 +154,21 @@ def test_mesh_regrow_reshards_tp(tmp_path):
 # changes (dp4 -> dp2 doubles every shard), and restore must re-place
 # rows under the new mesh with the training math unchanged.
 
-SPARSE_VOCAB = 64
-SPARSE_DIM = 16
-
-
-def _TinySparse():
-    import flax.linen as nn
-
-    from elasticdl_tpu.embedding.device_sparse import SparseEmbed
-
-    class TinySparse(nn.Module):
-        @nn.compact
-        def __call__(self, features, training=False):
-            emb = SparseEmbed("items", SPARSE_DIM)()
-            x = nn.relu(nn.Dense(8)(emb))
-            return nn.Dense(1, dtype=np.float32)(x)[..., 0]
-
-    return TinySparse()
-
-
-def _sparse_loss(labels, preds, mask):
-    import jax.numpy as jnp
-    import optax
-
-    per = optax.sigmoid_binary_cross_entropy(
-        preds, labels.astype(np.float32)
-    )
-    return (per * mask).sum() / jnp.maximum(mask.sum(), 1)
-
-
-def _sparse_runner(mesh):
-    from elasticdl_tpu.embedding.device_sparse import (
-        DeviceSparseRunner,
-        TableSpec,
-    )
-    from elasticdl_tpu.embedding.optimizer import Adagrad
-
-    specs = (TableSpec(name="items", vocab=SPARSE_VOCAB, dim=SPARSE_DIM,
-                       combiner="sum", feature_key="ids"),)
-    return DeviceSparseRunner(
-        specs, Adagrad(lr=0.05), use_pallas="never", mesh=mesh,
-        partition_threshold_bytes=0,
-    )
+# Shared tiny sparse scaffolding — the SAME model/runner/loss/batches
+# the 2-process smoke uses (tests/sparse_common.py), so the two
+# trajectory-equality suites cannot drift apart.
+from tests.sparse_common import (  # noqa: E402
+    SPARSE_DIM,
+    SPARSE_VOCAB,
+    global_batch,
+    make_model as _TinySparse,
+    make_runner as _sparse_runner,
+    sparse_loss as _sparse_loss,
+)
 
 
 def _sparse_batches(n, batch=8):
-    out = []
-    for s in range(n):
-        rng = np.random.RandomState(100 + s)
-        out.append({
-            "features": {
-                "ids": rng.randint(
-                    0, SPARSE_VOCAB, (batch, 4)
-                ).astype(np.int32),
-            },
-            "labels": rng.randint(0, 2, batch).astype(np.int32),
-            "mask": np.ones((batch,), np.float32),
-        })
-    return out
+    return [global_batch(s, batch=batch) for s in range(n)]
 
 
 def _assert_table_on(state, mesh_shape, table="items"):

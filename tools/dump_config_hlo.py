@@ -40,12 +40,25 @@ def main():
     from elasticdl_tpu.core.train_state import init_train_state
 
     spec, task, batch, steps, _ = load_config_harness(args.config)
-    state = init_train_state(
-        spec.model, spec.make_optimizer(),
-        jax.tree.map(lambda x: x[0], task), seed=0,
-    )
-    multi_step = build_multi_step(spec.loss)
-    lowered = jax.jit(multi_step, donate_argnums=(0,)).lower(state, task)
+    if getattr(spec, "make_sparse_runner", None):
+        # Device-tier sparse configs compile the runner's program, not
+        # the dense multi_step (same branch as measure_multi_step).
+        runner = spec.make_sparse_runner()
+        state = runner.init_state(
+            spec.model, spec.make_optimizer(),
+            jax.tree.map(lambda x: x[0], task), seed=0,
+        )
+        multi_step = runner.train_multi_step(spec.loss)
+        lowered = multi_step.lower(state, task)
+    else:
+        state = init_train_state(
+            spec.model, spec.make_optimizer(),
+            jax.tree.map(lambda x: x[0], task), seed=0,
+        )
+        multi_step = build_multi_step(spec.loss)
+        lowered = jax.jit(
+            multi_step, donate_argnums=(0,)
+        ).lower(state, task)
     compiled = lowered.compile()
     text = compiled.as_text()
     if args.out:
