@@ -422,7 +422,15 @@ class _ShardedTable:
     other). The TPU-native shape of the reference worker's pull scatter
     over N PS pods (``worker/worker.py:362-391``,
     ``common/hash_utils.py:4-49``); per-shard pulls fan out on the
-    engine's shard pool so N servers' line rates aggregate."""
+    engine's shard pool, so N servers' line rates aggregate WHEN the
+    servers are the binding constraint (each on its own cores/NIC —
+    the reference's N-pod regime). Measured on this repo's 1-core
+    bench host (ROW_SERVICE_SCALING.json, tools/bench_row_service.py):
+    one native-store shard serves ~2.2M pull / ~1.8M push rows/s
+    through the full msgpack-RPC path, and sharding there only splits
+    requests into smaller sub-RPCs — use shards for capacity
+    partitioning and for multi-host deployments, not single-host
+    throughput."""
 
     concurrent_safe = True
 

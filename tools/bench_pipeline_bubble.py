@@ -1,0 +1,218 @@
+"""Measure the GPipe fill-drain bubble against its analytic model.
+
+``parallel/pipeline.py`` predicts: M microbatches through n stages run
+``M + n - 1`` scan ticks, so the bubble fraction is ``(n-1)/(M+n-1)``
+(pipeline.py:12-15). This tool confirms the prediction EMPIRICALLY:
+every tick performs real SPMD stage work on every device (the fill and
+drain ticks compute on masked data — that is the bubble's cost), so
+total executed work per step is ``n * (M + n - 1)`` stage applications
+and wall time at fixed microbatch size must scale as ``M + n - 1`` —
+NOT as ``M``, which is what a bubble-free schedule would cost. The
+(M + n - 1) signature is host-topology independent: on the 1-core
+bench host the virtual devices time-share, but the slot count (and so
+the measured ratio between M points) is the same arithmetic the model
+claims for parallel hardware.
+
+Two sweeps on a virtual CPU mesh, written to PIPELINE_BUBBLE.json:
+
+- M-sweep (n=4, M in {8,16,32}): wall + per-tick cost + the model's
+  bubble fraction per point. (With a free intercept, a*(M+n-1)+b and
+  a*M+b are the same linear family — the M-sweep records the curve but
+  cannot by itself discriminate the schedule.)
+- n-sweep (M=16, n in {2,4,8}, fixed per-stage work) — the
+  DISCRIMINATOR: total executed stage work is n*(M+n-1), so on the
+  time-shared host wall/n must grow as (M+n-1)/(M+1): 1.0, 1.118,
+  1.353 for n=2,4,8. A bubble-free schedule (n*M work) would keep
+  wall/n flat at 1.0.
+
+How the model is confirmed (and what is measured vs static):
+
+- the TICK COUNT is static source arithmetic, not a measurement:
+  _pipeline_local scans over jnp.arange(m + n - 1) and
+  pipeline_apply's (n, ticks) reshape would fail on any other length —
+  the schedule cannot silently be something else;
+- the M-sweep MEASURES that the MARGINAL per-tick cost is constant in
+  M (each tick is the same SPMD stage program): slopes between
+  consecutive M points — which cancel the per-program dispatch
+  overhead that inflates wall/ticks at small M — must agree. With the
+  static tick count this gives step time = (M+n-1) x tick (+ fixed
+  program overhead) and bubble = (n-1)/(M+n-1) exactly;
+- the n-sweep gate rejects the bubble-free alternative at n=8, the
+  most-discriminating point (model 1.353 vs flat 1.0). The measured
+  ratio may OVERSHOOT the model there: the threaded CPU backend's
+  ppermute rendezvous grows with participant count — recorded, not
+  gated, since real-ICI permutes don't share one core.
+
+Usage: JAX_PLATFORMS=cpu (the tool forces it) python
+tools/bench_pipeline_bubble.py
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, HERE)
+
+
+def main():
+    os.environ.setdefault(
+        "XLA_FLAGS", "--xla_force_host_platform_device_count=8"
+    )
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from elasticdl_tpu.parallel.mesh import make_mesh
+    from elasticdl_tpu.parallel.pipeline import (
+        pipeline_apply,
+        stack_stage_params,
+    )
+
+    n = 4
+    # Stage work must dwarf the per-tick ppermute rendezvous (on the
+    # threaded CPU backend the collective costs grow with n); 2 matmuls
+    # at dim 768 x mb 32 is ~75 MFLOP per stage-tick.
+    mb, dim = 32, 768
+    devices = jax.devices("cpu")
+    assert len(devices) >= n, "need xla_force_host_platform_device_count"
+
+    def init_stage(rng):
+        k1, k2 = jax.random.split(rng)
+        return {
+            "w1": jax.random.normal(k1, (dim, dim)) * 0.02,
+            "w2": jax.random.normal(k2, (dim, dim)) * 0.02,
+        }
+
+    def stage_fn(params, act):
+        h = jnp.tanh(act @ params["w1"])
+        return act + h @ params["w2"]
+
+    def timed(stages, m):
+        mesh_ = make_mesh((stages,), ("pp",),
+                          devices=devices[:stages])
+        params_ = stack_stage_params(
+            init_stage, jax.random.PRNGKey(0), stages
+        )
+        params_ = jax.device_put(
+            params_,
+            jax.tree.map(
+                lambda p: jax.sharding.NamedSharding(
+                    mesh_, jax.sharding.PartitionSpec("pp", None, None)
+                ),
+                params_,
+            ),
+        )
+        x = jnp.asarray(
+            np.random.RandomState(m).randn(m, mb, dim), jnp.float32
+        )
+        f = jax.jit(
+            lambda p, x: pipeline_apply(
+                stage_fn, p, x, mesh_, axis="pp"
+            )
+        )
+        jax.block_until_ready(f(params_, x))       # compile
+        reps = max(2, 64 // m)
+        best = float("inf")
+        for _ in range(8):
+            start = time.perf_counter()
+            for _ in range(reps):
+                out = f(params_, x)
+            jax.block_until_ready(out)
+            best = min(best, (time.perf_counter() - start) / reps)
+        return best
+
+    # --- M-sweep at n=4: the recorded curve ---------------------------
+    # Interleaved passes with min-per-M: host load drifts over seconds
+    # on the 1-core bench machine, and the slope gate differences
+    # adjacent points — back-to-back measurement would bake drift into
+    # the slopes.
+    ms_points = (8, 16, 32)
+    walls = {m: float("inf") for m in ms_points}
+    for _ in range(3):
+        for m in ms_points:
+            walls[m] = min(walls[m], timed(n, m))
+    points = []
+    for m in ms_points:
+        ticks = m + n - 1
+        points.append({
+            "M": m,
+            "wall_ms": round(walls[m] * 1e3, 3),
+            "ticks": ticks,
+            "model_bubble_frac": round((n - 1) / ticks, 4),
+            "wall_per_tick_ms": round(walls[m] * 1e3 / ticks, 4),
+        })
+        print(json.dumps(points[-1]), flush=True)
+
+    # --- n-sweep at M=16: the schedule discriminator ------------------
+    m_fix = 16
+    n_points = []
+    base = None
+    for stages in (2, 4, 8):
+        if len(devices) < stages:
+            continue
+        wall = timed(stages, m_fix)
+        per_stage = wall / stages
+        if base is None:
+            base = per_stage
+        n_points.append({
+            "n": stages,
+            "wall_ms": round(wall * 1e3, 3),
+            "wall_over_n_ratio": round(per_stage / base, 4),
+            "model_ratio": round((m_fix + stages - 1) / (m_fix + 1), 4),
+            "bubble_free_ratio": 1.0,
+        })
+        print(json.dumps(n_points[-1]), flush=True)
+
+    summary = {
+        "n_stages": n, "microbatch": mb, "dim": dim,
+        "host_cores": os.cpu_count(),
+        "m_sweep": points,
+        "n_sweep": n_points,
+        "method": "n-sweep is the discriminator: total stage work is "
+                  "n*(M+n-1), so wall/n tracks (M+n-1)/(M+1) iff the "
+                  "fill-drain ticks execute (see module docstring)",
+    }
+    print(json.dumps({"summary": {
+        k: v for k, v in summary.items() if k not in ("m_sweep",)
+    }}))
+    # Gates (see docstring): constant per-tick cost across the M-sweep,
+    # and the n=8 discriminator must exclude the bubble-free flat line
+    # (>= the model/flat midpoint 1.176; overshoot from threaded-
+    # backend collectives is expected and recorded).
+    slopes = [
+        (points[i + 1]["wall_ms"] - points[i]["wall_ms"])
+        / (points[i + 1]["ticks"] - points[i]["ticks"])
+        for i in range(len(points) - 1)
+    ]
+    spread = (max(slopes) - min(slopes)) / min(slopes)
+    summary["marginal_ms_per_tick"] = [round(x, 4) for x in slopes]
+    summary["marginal_slope_spread"] = round(spread, 4)
+    with open(os.path.join(HERE, "PIPELINE_BUBBLE.json"), "w") as f:
+        json.dump(summary, f, indent=1)
+    if spread > 0.20:
+        raise SystemExit(
+            f"marginal per-tick cost varies {spread:.1%} across M — "
+            "constant-tick assumption not confirmed"
+        )
+    last = n_points[-1]
+    if last["n"] != 8:
+        raise SystemExit(
+            f"n-sweep stopped at n={last['n']} (only {len(devices)} "
+            "devices visible) — the n=8 discriminator never ran; set "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=8"
+        )
+    midpoint = (last["model_ratio"] + 1.0) / 2.0
+    if last["wall_over_n_ratio"] < midpoint:
+        raise SystemExit(
+            f"n={last['n']}: ratio {last['wall_over_n_ratio']} does "
+            f"not exclude the bubble-free schedule (midpoint {midpoint})"
+        )
+
+
+if __name__ == "__main__":
+    main()
