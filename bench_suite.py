@@ -91,10 +91,13 @@ CONFIGS = {
     # _scatter_dispatch — one-hot-cumsum ranking, (E, C, D) scatter,
     # batched expert FFN, gather-combine). One chip = no ep all-to-all;
     # what this config times is the dispatch machinery itself against
-    # the dense einsum the same model would otherwise run. B16/steps 16
-    # matches the d512 flagship tuple (sweep-confirmed there); capacity
-    # factor stays the model default (1.25), the standard Switch
-    # operating point.
+    # the dense einsum the same model would otherwise run. Device sweep
+    # (round 5): B8 257k / B16 265k / B32 246k tok/s at cf 1.25 — B16
+    # stands; capacity factor 1.0/1.25/2.0 measured 271k/265k/245k —
+    # cf 1.0 is +2.3% rate but drops more tokens (a quality trade), so
+    # the config keeps the Switch-canonical 1.25. (MFU RISES with cf —
+    # 38.4/39.3/41.0% — because capacity padding adds counted FLOPs;
+    # token rate is the honest metric for this row.)
     "moe": ("transformer.transformer_lm.custom_model", 16, 16, 2),
 }
 TRANSFORMER_SEQ = 1024
