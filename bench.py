@@ -77,6 +77,7 @@ def main():
         configs[name] = {
             "rate": rec["value"], "unit": rec["unit"],
             "vs_floor": rec["vs_baseline"], "mfu": rec.get("mfu"),
+            "hbm_frac": rec.get("hbm_frac"),
             "rate_device": rec.get("rate_device"),
             "gate": rec.get("gate"),
             "platform": platform,
