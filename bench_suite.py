@@ -73,12 +73,16 @@ CONFIGS = {
     # sweep (B8 42.4% / B16 43.1% / B32 39.7% MFU); steps halved so
     # tokens/task stays 262k.
     "transformer": ("transformer.transformer_lm.custom_model", 16, 16, 2),
-    # Large-LM edition (d1024/H16/L12/ff4096): bigger matmuls stretch
-    # the MXU where the d512 flagship is dispatch/HBM-shaped — the
-    # config that shows the framework's MFU headroom at sizes closer to
-    # real LM training. Fewer steps/task: each step is ~6x the d512
-    # cost, so dispatch amortization needs less fusing.
-    "transformer_l": ("transformer.transformer_lm.custom_model", 8, 8, 2),
+    # Large-LM edition (d1024/H8(D128)/L12/ff4096): bigger matmuls
+    # stretch the MXU where the d512 flagship is dispatch/HBM-shaped —
+    # the config that shows the framework's MFU headroom at sizes
+    # closer to real LM training. B16: the D=64-era "activation
+    # pressure at B16" negative FLIPPED at D=128 heads (B8 107.0k vs
+    # B16 109.8k tok/s device, 64.5% vs 66.2% MFU — fewer, wider heads
+    # shrink the attention intermediates); steps halved so tokens/task
+    # stays 65k. Few steps/task: each step is ~6x the d512 cost, so
+    # dispatch amortization needs less fusing.
+    "transformer_l": ("transformer.transformer_lm.custom_model", 16, 4, 2),
     # Large-recsys flagship: 1M x 256 table trained through the
     # device-tier sparse plane (embedding/device_sparse.py) — row grads
     # for only the touched ids, scatter-apply, no dense (V, D) gradient.
@@ -103,11 +107,20 @@ CONFIGS = {
 TRANSFORMER_SEQ = 1024
 TRANSFORMER_VOCAB = 32768
 
+# head_dim 128 = the MXU/lane width: the round-5 head-geometry sweep
+# measured D=64 heads at HALF the attention-kernel throughput (d512:
+# H8/D64 304.6k vs H4/D128 378.0k tok/s device, 43.1% -> 53.5% MFU;
+# d1024: H16/D64 88.4k vs H8/D128 107.0k, 53.3% -> 64.5% MFU; H2/D256
+# only +1.5% more — diminishing). The flagships are OUR models (net-new
+# vs the reference) and the project is TPU-first, so they pick the
+# TPU-native head shape — the same choice PaLM/T5-class TPU models
+# make. Flash 1024x1024 blocks re-confirmed best at D=128 (1.231 ms
+# fwd+bwd at the bench shape, vs 2.529 at D=64).
 _TRANSFORMER_SIZES = {
-    "transformer": dict(d_model=512, n_heads=8, n_layers=8, d_ff=2048),
-    "transformer_l": dict(d_model=1024, n_heads=16, n_layers=12,
+    "transformer": dict(d_model=512, n_heads=4, n_layers=8, d_ff=2048),
+    "transformer_l": dict(d_model=1024, n_heads=8, n_layers=12,
                           d_ff=4096),
-    "moe": dict(d_model=512, n_heads=8, n_layers=8, d_ff=2048,
+    "moe": dict(d_model=512, n_heads=4, n_layers=8, d_ff=2048,
                 moe_experts=8, moe_every=2, moe_top_k=1,
                 moe_dispatch="scatter"),
 }
