@@ -69,9 +69,10 @@ CONFIGS = {
     # (= examples x seq). Fused-task programs amortize host->device
     # dispatch (measured +17%/+26% at 16/32 steps over 4-step tasks
     # through the tunnel — the reference tunes the same knob as
-    # num_minibatches_per_task). batch 16: best of the round-4 device
-    # sweep (B8 42.4% / B16 43.1% / B32 39.7% MFU); steps halved so
-    # tokens/task stays 262k.
+    # num_minibatches_per_task). batch 16: sweep-confirmed at BOTH head
+    # geometries (D=64 round 4: B8 42.4/B16 43.1/B32 39.7% MFU; D=128
+    # round 5: B8 373.0k/B16 378.0k/B32 380.3k tok/s device — B32's
+    # +0.6% is under the <2% device noise floor, B16 stands).
     "transformer": ("transformer.transformer_lm.custom_model", 16, 16, 2),
     # Large-LM edition (d1024/H8(D128)/L12/ff4096): bigger matmuls
     # stretch the MXU where the d512 flagship is dispatch/HBM-shaped —
