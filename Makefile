@@ -4,7 +4,13 @@
 
 PY ?= python
 
-.PHONY: test test-all test-tpu test-k8s native bench dryrun clean lint
+.PHONY: test test-all test-tpu test-k8s native bench dryrun clean lint \
+	metrics
+
+# Scrape-and-pretty-print a master's /metrics (docs/observability.md).
+METRICS_ADDR ?= localhost:8080
+metrics:
+	$(PY) tools/dump_metrics.py $(METRICS_ADDR)
 
 # Fast lane (<4 min): everything not marked slow. conftest.py
 # auto-marks the heavy zoo/multi-process/bench suites.

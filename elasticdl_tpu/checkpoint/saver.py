@@ -21,6 +21,7 @@ recorded ``num_shards`` ("slowest-PS-wins" validity, save_utils.py:154-167).
 import os
 import re
 import shutil
+import time
 from typing import Dict, Optional, Tuple
 
 import numpy as np
@@ -65,6 +66,11 @@ class CheckpointSaver:
         embeddings: Optional[Dict[str, EmbeddingTable]] = None,
     ) -> str:
         """Write all shards of one version, then GC old versions."""
+        from elasticdl_tpu.observability import default_registry
+
+        registry = default_registry()
+        save_t0 = time.monotonic()
+        bytes_written = 0
         vdir = _version_dir(self.checkpoint_dir, version)
         tmp = vdir + ".tmp"
         if os.path.exists(tmp):
@@ -100,13 +106,24 @@ class CheckpointSaver:
                     values=rows[keep], ids=ids[keep]
                 )
             path = os.path.join(tmp, f"variables-{shard}-of-{n}.ckpt")
+            blob = tensor_utils.dumps(payload)
+            bytes_written += len(blob)
             with open(path, "wb") as f:
-                f.write(tensor_utils.dumps(payload))
+                f.write(blob)
         # Atomic-ish publish: the version dir appears only when complete.
         if os.path.exists(vdir):
             shutil.rmtree(vdir)
         os.rename(tmp, vdir)
         logger.info("Saved checkpoint version %s (%s shards)", version, n)
+        registry.histogram(
+            "checkpoint_save_seconds", "Checkpoint save duration",
+        ).observe(time.monotonic() - save_t0)
+        registry.counter(
+            "checkpoint_saved_bytes_total", "Checkpoint payload bytes",
+        ).inc(bytes_written)
+        registry.counter(
+            "checkpoint_saves_total", "Checkpoint versions written",
+        ).inc()
         self.gc()
         return vdir
 

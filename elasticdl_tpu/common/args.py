@@ -229,6 +229,23 @@ def add_train_params(parser):
                         default=5)
     parser.add_argument("--profile_steps", type=pos_int, default=5)
     parser.add_argument("--task_timeout_secs", type=pos_float, default=300.0)
+    parser.add_argument("--metrics_port", type=int, default=-1,
+                        help="Master Prometheus endpoint (/metrics + "
+                             "/healthz): port to serve on; 0 picks an "
+                             "ephemeral port, -1 (default) disables")
+    parser.add_argument("--metrics_report_secs", type=pos_float,
+                        default=15.0,
+                        help="How often each worker piggybacks a metrics "
+                             "registry snapshot on master RPCs")
+    parser.add_argument("--metrics_ttl_secs", type=pos_float, default=None,
+                        help="Master drops a worker's metrics after this "
+                             "long without a report (elastic resize "
+                             "aging). Snapshots only ride existing RPCs, "
+                             "so a healthy worker can go silent for a "
+                             "whole task (fused steps, stragglers) — "
+                             "keep this above the longest task, not just "
+                             "a few report intervals; default is 2x "
+                             "task_timeout_secs")
 
 
 def add_evaluate_params(parser):

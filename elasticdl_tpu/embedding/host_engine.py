@@ -31,6 +31,8 @@ Pserver sparse role over RPC (`--row_service_addr`).
 
 import queue
 import threading
+import time
+import weakref
 from typing import Callable, Dict, Iterable, NamedTuple, Optional, Tuple
 
 import jax
@@ -220,7 +222,8 @@ class HostEmbeddingEngine:
                   the inverse map.
     """
 
-    def __init__(self, tables: Dict, optimizer, id_keys: Dict[str, str]):
+    def __init__(self, tables: Dict, optimizer, id_keys: Dict[str, str],
+                 metrics_registry=None):
         # Serializes host-side table access: in-process multi-worker
         # jobs share ONE engine (threads), and neither the dict table
         # nor the C++ open-addressing row map (which rehashes on
@@ -253,6 +256,51 @@ class HostEmbeddingEngine:
         self.tables = tables
         self.optimizer = optimizer
         self.id_keys = id_keys
+        # Telemetry: lookup/update latency, row traffic, and the dedup
+        # ("cache hit") ratio — total vs unique ids per batch. Rows
+        # materialized is a pull-time gauge over the live tables.
+        from elasticdl_tpu.observability import default_registry
+
+        registry = metrics_registry or default_registry()
+        self._m_lookup = registry.histogram(
+            "embedding_lookup_seconds",
+            "Host row pull + dedup + pad latency per batch",
+        )
+        self._m_update = registry.histogram(
+            "embedding_update_seconds",
+            "Row-gradient scatter/apply latency per step",
+        )
+        self._m_ids = registry.counter(
+            "embedding_lookup_ids_total",
+            "Raw ids looked up (pre-dedup)",
+        )
+        self._m_unique = registry.counter(
+            "embedding_lookup_unique_ids_total",
+            "Unique rows actually pulled (1 - unique/raw = batch dedup "
+            "hit rate)",
+        )
+        self._m_rows_updated = registry.counter(
+            "embedding_rows_updated_total",
+            "Rows receiving gradient updates",
+        )
+        # weakref: the registry is process-global and outlives engines;
+        # a strong closure over self would pin the (larger-than-HBM)
+        # host tables of every discarded engine for the process life.
+        self_ref = weakref.ref(self)
+
+        def _rows_materialized() -> float:
+            engine = self_ref()
+            if engine is None:
+                return 0.0
+            return sum(
+                t.num_rows for t in engine.tables.values()
+                if hasattr(t, "num_rows")
+            )
+
+        registry.gauge(
+            "embedding_rows_materialized",
+            "Rows resident across host tables (lazy-init high-water)",
+        ).set_function(_rows_materialized)
 
     def prepare_batch(self, batch: dict) -> Tuple[dict, dict, dict]:
         """Host-side half of the step (runs off-thread under
@@ -265,10 +313,14 @@ class HostEmbeddingEngine:
           padding whose grads are dropped,
         - uniques — {table: (unique_ids, u)} for apply_row_grads.
         """
-        if self.concurrent_io:
-            return self._prepare_batch_locked(batch)
-        with self.lock:
-            return self._prepare_batch_locked(batch)
+        t0 = time.monotonic()
+        try:
+            if self.concurrent_io:
+                return self._prepare_batch_locked(batch)
+            with self.lock:
+                return self._prepare_batch_locked(batch)
+        finally:
+            self._m_lookup.observe(time.monotonic() - t0)
 
     def _prepare_batch_locked(self, batch):
         if not isinstance(batch["features"], dict):
@@ -285,6 +337,8 @@ class HostEmbeddingEngine:
             raw = np.asarray(ids.ids if ragged else ids)
             uniq, inverse = np.unique(raw, return_inverse=True)
             u = len(uniq)
+            self._m_ids.inc(raw.size)
+            self._m_unique.inc(u)
             bucket = bucket_size(u)
             table = self.tables[table_name]
             rows = np.zeros((bucket, table.dim), np.float32)
@@ -302,15 +356,20 @@ class HostEmbeddingEngine:
     def apply_row_grads(self, row_grads: dict, uniques: dict) -> None:
         """Scatter the step's row gradients into the host tables
         (lookup-apply-writeback, reference optimizer_wrapper.py:143)."""
-        if self.concurrent_io:
-            self._apply_row_grads_inner(row_grads, uniques)
-            return
-        with self.lock:
-            self._apply_row_grads_inner(row_grads, uniques)
+        t0 = time.monotonic()
+        try:
+            if self.concurrent_io:
+                self._apply_row_grads_inner(row_grads, uniques)
+                return
+            with self.lock:
+                self._apply_row_grads_inner(row_grads, uniques)
+        finally:
+            self._m_update.observe(time.monotonic() - t0)
 
     def _apply_row_grads_inner(self, row_grads, uniques):
         for table_name, (uniq, u) in uniques.items():
             grads = np.asarray(row_grads[table_name])[:u]
+            self._m_rows_updated.inc(u)
             self.optimizer.apply_gradients(
                 self.tables[table_name], uniq, grads
             )

@@ -88,9 +88,32 @@ class HostRowService:
     """
 
     def __init__(self, tables: Dict, optimizer, checkpoint_dir: str = "",
-                 checkpoint_steps: int = 0, keep_max: int = 3):
+                 checkpoint_steps: int = 0, keep_max: int = 3,
+                 metrics_registry=None):
         self._tables = tables
         self._optimizer = optimizer
+        # Telemetry: served row traffic + handler latency (the row
+        # plane's pressure gauges; scrape the serving process).
+        from elasticdl_tpu.observability import default_registry
+
+        registry = metrics_registry or default_registry()
+        self._m_pull = registry.histogram(
+            "row_service_pull_seconds", "pull_rows handler latency",
+        )
+        self._m_push = registry.histogram(
+            "row_service_push_seconds", "push_row_grads handler latency",
+        )
+        self._m_pulled = registry.counter(
+            "row_service_pulled_rows_total", "Rows served to pulls",
+        )
+        self._m_pushed = registry.counter(
+            "row_service_pushed_rows_total",
+            "Row gradients applied from pushes",
+        )
+        self._m_dup = registry.counter(
+            "row_service_duplicate_pushes_total",
+            "Retried pushes dropped by (client, seq) dedup",
+        )
         self._lock = threading.RLock()
         self._server: Optional[RpcServer] = None
         self._push_count = 0
@@ -125,9 +148,13 @@ class HostRowService:
         }
 
     def _pull_rows(self, request: dict) -> dict:
+        t0 = time.monotonic()
         table = self._tables[request["table"]]
+        ids = np.asarray(request["ids"], np.int64)
         with self._lock:
-            rows = table.get(np.asarray(request["ids"], np.int64))
+            rows = table.get(ids)
+        self._m_pulled.inc(ids.size)
+        self._m_pull.observe(time.monotonic() - t0)
         return {"rows": np.asarray(rows, np.float32)}
 
     def _export_rows(self, request: dict) -> dict:
@@ -154,19 +181,22 @@ class HostRowService:
         return {"rows": dense.astype(np.float32)}
 
     def _push_row_grads(self, request: dict) -> dict:
+        t0 = time.monotonic()
         table = self._tables[request["table"]]
         client = request.get("client", "")
         seq = int(request.get("seq", -1))
+        ids = np.asarray(request["ids"], np.int64)
         with self._lock:
             if client and seq >= 0:
                 key = _client_key(client)
                 if seq <= self._applied_seq.get(key, -1):
                     # Retried push whose first attempt DID apply before
                     # the reply was lost (at-most-once semantics).
+                    self._m_dup.inc()
                     return {"duplicate": True}
             self._optimizer.apply_gradients(
                 table,
-                np.asarray(request["ids"], np.int64),
+                ids,
                 np.asarray(request["grads"], np.float32),
             )
             if client and seq >= 0:
@@ -176,6 +206,8 @@ class HostRowService:
                 self._applied_seq[_client_key(client)] = seq
             self._push_count += 1
             version = self._push_count
+        self._m_pushed.inc(ids.size)
+        self._m_push.observe(time.monotonic() - t0)
         if (
             self._saver is not None and self._checkpoint_steps
             and version % self._checkpoint_steps == 0
@@ -643,6 +675,11 @@ def main(argv=None):
     parser.add_argument("--keep_checkpoint_max", type=int, default=3)
     parser.add_argument("--shard_id", type=int, default=0)
     parser.add_argument("--num_shards", type=int, default=1)
+    parser.add_argument("--metrics_port", type=int, default=-1,
+                        help="Serve this process's own registry "
+                             "(row_service_* pull/push metrics) as "
+                             "Prometheus /metrics; 0 = ephemeral, "
+                             "-1 (default) = disabled")
     args = parser.parse_args(argv)
 
     module, _ = load_model_zoo_module(args.model_zoo, args.model_def)
@@ -662,6 +699,20 @@ def main(argv=None):
         )
     service.start(args.addr)
     logger.info("Row service serving on %s", args.addr)
+    if args.metrics_port >= 0:
+        # A row-service pod reports to no master, so its registry
+        # (row_service_* counters/latency) is scrapeable directly —
+        # without this its metrics would be write-only.
+        from elasticdl_tpu.observability import (
+            MetricsHTTPServer,
+            default_registry,
+            render_prometheus,
+        )
+
+        MetricsHTTPServer(
+            lambda: render_prometheus(default_registry().snapshot()),
+            port=args.metrics_port,
+        ).start()
     service.wait()
 
 

@@ -123,10 +123,26 @@ class Master:
             eval_only=bool(validation_data and not training_data),
             summary_writer=tb_service,
         )
+        # Telemetry plane: master-local registry (dispatcher gauges,
+        # straggler counter) + worker snapshot aggregation + /metrics;
+        # selected aggregates mirror into TensorBoard each run tick.
+        from elasticdl_tpu.observability import MetricsPlane
+
+        metrics_ttl = getattr(args, "metrics_ttl_secs", None)
+        if metrics_ttl is None:
+            # Documented default: 2x the straggler deadline, so a worker
+            # that is merely slow (silent for one whole task) is never
+            # aged out of the cluster view.
+            metrics_ttl = 2.0 * getattr(args, "task_timeout_secs", 300.0)
+        self.metrics_plane = MetricsPlane(
+            ttl_secs=metrics_ttl,
+            summary_writer=tb_service,
+        )
         self.servicer = MasterServicer(
             self.task_dispatcher,
             self.evaluation_service,
             task_timeout_secs=getattr(args, "task_timeout_secs", 300.0),
+            metrics_plane=self.metrics_plane,
         )
         self._server = None
         self.instance_manager = None
@@ -273,6 +289,9 @@ class Master:
             {SERVICE_NAME: self.servicer.handlers()},
         ).start()
         logger.info("Master RPC serving on port %d", self._server.port)
+        metrics_port = int(getattr(self._args, "metrics_port", -1))
+        if metrics_port >= 0:
+            self.metrics_plane.serve(port=metrics_port)
         if self.tb_service is not None:
             self.tb_service.start()
         if self._k8s_client is not None:
@@ -353,11 +372,24 @@ class Master:
                         self.instance_manager.kill_worker(worker_id)
                     else:
                         self.task_dispatcher.recover_tasks(worker_id)
+                    # The relaunch comes back under a NEW worker id —
+                    # drop the dead id's series now, not at the TTL.
+                    self.servicer.remove_worker_metrics(worker_id)
+                self.metrics_plane.publish_tensorboard(
+                    self.servicer.model_version
+                )
         finally:
+            # The last tasks finish during the final poll sleep; flush
+            # that interval's aggregates to TensorBoard before stop()
+            # tears down the plane, or the tfevents tail under-counts.
+            self.metrics_plane.publish_tensorboard(
+                self.servicer.model_version
+            )
             self.stop()
         return 0
 
     def stop(self):
+        self.metrics_plane.stop()
         self.evaluation_service.stop()
         if self.instance_manager is not None:
             self.instance_manager.stop()

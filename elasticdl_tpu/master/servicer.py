@@ -24,11 +24,24 @@ SERVICE_NAME = "elasticdl_tpu.Master"
 
 class MasterServicer:
     def __init__(self, task_dispatcher, evaluation_service=None,
-                 task_timeout_secs: float = 300.0):
+                 task_timeout_secs: float = 300.0, metrics_plane=None):
+        from elasticdl_tpu.observability import MetricsPlane
+
         self._task_d = task_dispatcher
         self._eval_service = evaluation_service
+        # Cluster telemetry: workers piggyback registry snapshots on the
+        # RPCs below; the plane merges them keyed by worker id and ages
+        # out workers that stop reporting (elastic resize / preemption).
+        self.metrics_plane = metrics_plane or MetricsPlane()
+        self._m_straggler = self.metrics_plane.registry.counter(
+            "master_straggler_timeouts_total",
+            "Tasks that blew the straggler deadline (factor x mean)",
+        )
         self._lock = threading.Lock()
         self._worker_liveness: Dict[int, float] = {}
+        # Task ids already counted as stragglers (pruned against the
+        # doing set so re-queued ids can be counted again).
+        self._straggler_counted = set()
         # Running mean of task duration, for straggler detection
         # (reference servicer.py:107-121: default 300s until enough data).
         self._default_task_secs = task_timeout_secs
@@ -50,9 +63,15 @@ class MasterServicer:
 
     # ---- RPC handlers --------------------------------------------------
 
+    def _ingest_metrics(self, worker_id: int, request: dict):
+        snapshot = request.get("metrics")
+        if snapshot:
+            self.metrics_plane.ingest(worker_id, snapshot)
+
     def get_task(self, request: dict) -> dict:
         worker_id = int(request.get("worker_id", -1))
         self._record_liveness(worker_id)
+        self._ingest_metrics(worker_id, request)
         task = self._task_d.get(worker_id)
         if task is not None:
             with self._lock:
@@ -69,6 +88,7 @@ class MasterServicer:
         task_id = int(request["task_id"])
         err_reason = request.get("err_reason", "")
         success = not err_reason
+        self._ingest_metrics(int(request.get("worker_id", -1)), request)
         with self._lock:
             start = self._task_start_times.pop(task_id, None)
             if success and start is not None:
@@ -101,6 +121,7 @@ class MasterServicer:
         version = int(request["model_version"])
         worker_id = int(request.get("worker_id", -1))
         self._record_liveness(worker_id)
+        self._ingest_metrics(worker_id, request)
         with self._lock:
             self.model_version = max(self.model_version, version)
         self._task_d.record_worker_version(worker_id, version)
@@ -131,9 +152,26 @@ class MasterServicer:
         threshold = factor * self.average_task_secs()
         now = time.time()
         out = []
-        for task_id, (worker_id, start) in (
-            self._task_d.doing_start_times().items()
-        ):
+        doing = self._task_d.doing_start_times()
+        for task_id, (worker_id, start) in doing.items():
             if now - start > threshold:
                 out.append((task_id, worker_id))
+        with self._lock:
+            # Count each straggling task once, not once per poll tick —
+            # in k8s mode kill_worker recovery is async (the pod DELETED
+            # watch event), so a timed-out task stays in the doing set
+            # for several ticks before it is re-queued.
+            self._straggler_counted &= set(doing)
+            fresh = [t for t, _w in out if t not in self._straggler_counted]
+            self._straggler_counted.update(fresh)
+        if fresh:
+            self._m_straggler.inc(len(fresh))
         return out
+
+    def remove_worker_metrics(self, worker_id: int):
+        """Drop a departed worker from the cluster view immediately
+        (recovery / elastic scale-down path) instead of waiting for the
+        report TTL."""
+        self.metrics_plane.cluster.remove_worker(worker_id)
+        with self._lock:
+            self._worker_liveness.pop(worker_id, None)

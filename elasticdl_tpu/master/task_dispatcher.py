@@ -14,6 +14,7 @@ import dataclasses
 import random
 import threading
 import time
+import weakref
 from typing import Callable, Dict, List, Optional, Tuple
 
 from elasticdl_tpu.common.constants import MAX_TASK_RETRIES, TaskType
@@ -51,6 +52,7 @@ class TaskDispatcher:
         num_epochs: int = 1,
         shuffle: bool = True,
         seed: int = 0,
+        metrics_registry=None,
     ):
         self._lock = threading.Lock()
         self._training_shards = dict(training_shards or {})
@@ -75,6 +77,44 @@ class TaskDispatcher:
         self._deferred_callbacks: List[Callable] = []
         self._worker_version: Dict[int, int] = {}
         self.counters = JobCounters()
+
+        # Telemetry: queue health as pull-time gauges (evaluated per
+        # scrape; reading a list length needs no lock) + dispatch
+        # outcome counters. Families are idempotent on the shared
+        # registry; set_function re-binds to the newest dispatcher.
+        from elasticdl_tpu.observability import default_registry
+
+        registry = metrics_registry or default_registry()
+        # weakref: the registry is process-global and outlives
+        # dispatchers; a strong closure would pin a drained job's task
+        # lists and shard metadata for the process lifetime.
+        self_ref = weakref.ref(self)
+        registry.gauge(
+            "master_task_queue_depth", "Tasks waiting in the todo queue"
+        ).set_function(
+            lambda: len(d._todo) if (d := self_ref()) is not None else 0.0
+        )
+        registry.gauge(
+            "master_tasks_doing", "Tasks currently leased to workers"
+        ).set_function(
+            lambda: len(d._doing) if (d := self_ref()) is not None else 0.0
+        )
+        self._m_dispatched = registry.counter(
+            "master_tasks_dispatched_total",
+            "Tasks handed to workers", ["type"],
+        )
+        self._m_completed = registry.counter(
+            "master_tasks_completed_total",
+            "Tasks reported successful", ["type"],
+        )
+        self._m_failed = registry.counter(
+            "master_tasks_failed_total",
+            "Tasks failed permanently (retry cap exhausted)", ["type"],
+        )
+        self._m_requeued = registry.counter(
+            "master_task_requeues_total",
+            "Failed/preempted tasks re-queued for another worker",
+        )
 
         if self._training_shards:
             self.create_tasks(TaskType.TRAINING)
@@ -204,6 +244,7 @@ class TaskDispatcher:
                 self._task_id += 1
                 task.task_id = self._task_id
                 self._doing[task.task_id] = (task, worker_id, time.time())
+                self._m_dispatched.labels(task.type).inc()
             elif (
                 not self._doing
                 and not self._epochs_pending_locked()
@@ -241,6 +282,7 @@ class TaskDispatcher:
             task, worker_id, _start = entry
             if success:
                 self.counters.add_completed(task.type, task.num_records)
+                self._m_completed.labels(task.type).inc()
             else:
                 key = f"{task.shard_name}:{task.start}:{task.end}"
                 # Graceful preemption hand-backs (SIGTERM before the
@@ -262,12 +304,14 @@ class TaskDispatcher:
                     # the reporting worker; re-dispatch must not mutate it.
                     self._todo.insert(0, dataclasses.replace(task))
                     requeued = True
+                    self._m_requeued.inc()
                     if task.type == TaskType.TRAINING:
                         # Re-queued records will be re-dispatched; release
                         # them from the max-steps budget.
                         self._train_records_dispatched -= task.num_records
                 else:
                     self.counters.add_failed(task.type, task.num_records)
+                    self._m_failed.labels(task.type).inc()
                     logger.error(
                         "Task %d failed permanently after %d retries (%s)",
                         task_id, MAX_TASK_RETRIES, err_reason,

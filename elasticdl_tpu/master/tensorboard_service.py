@@ -93,9 +93,16 @@ def _frame(payload: bytes) -> bytes:
 
 
 class SummaryWriter:
-    """Append-only tfevents writer for scalar summaries."""
+    """Append-only tfevents writer for scalar summaries.
+
+    A context manager (``with SummaryWriter(d) as w:``) that flushes on
+    every write — a crashed master must leave readable event files, not
+    a buffered tail."""
 
     def __init__(self, logdir: str):
+        # exist_ok + recursive: the logdir (and any missing parents —
+        # jobs point this at per-run subdirs that don't exist yet) is
+        # created on first use.
         os.makedirs(logdir, exist_ok=True)
         fname = "events.out.tfevents.%d.%s" % (
             int(time.time()), socket.gethostname(),
@@ -113,6 +120,8 @@ class SummaryWriter:
         self._f.flush()
 
     def add_scalars(self, scalars: Dict[str, float], step: int):
+        if self._f.closed:
+            raise ValueError("SummaryWriter is closed")
         now = time.time()
         self._f.write(_frame(_encode_scalar_event(step, now, scalars)))
         self._f.flush()
@@ -123,8 +132,19 @@ class SummaryWriter:
                 }}
             ) + "\n")
 
+    def flush(self):
+        if not self._f.closed:
+            self._f.flush()
+
     def close(self):
         self._f.close()
+
+    def __enter__(self) -> "SummaryWriter":
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.close()
+        return False
 
 
 class TensorboardService:

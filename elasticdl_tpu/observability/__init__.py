@@ -1,0 +1,35 @@
+"""Unified telemetry plane (metrics registry → master aggregation →
+Prometheus exposition).
+
+The reference's only observability is per-phase wall-clock accumulators
+and a TensorBoard sidecar (SURVEY §L1/§5). This subsystem gives every
+layer a shared measurement substrate instead:
+
+- ``registry``:    process-local counters / gauges / histograms
+                   (labeled families, thread-safe) that the worker step
+                   loop, ``common/timing.py``, the task dispatcher, the
+                   embedding tier, and the checkpoint saver feed into;
+- ``aggregator``:  the master-side cluster view — workers piggyback
+                   registry snapshots on existing master-client RPCs,
+                   the servicer merges them keyed by worker id, and
+                   departed workers age out on elastic resize;
+- ``exposition``:  Prometheus text format over a stdlib-only HTTP
+                   endpoint (``/metrics`` + ``/healthz``) plus a bridge
+                   mirroring selected aggregates into the tfevents
+                   ``SummaryWriter`` so TensorBoard stays the human view.
+
+Metric names follow ``edl_tpu_<layer>_<name>`` (docs/observability.md).
+"""
+
+from elasticdl_tpu.observability.aggregator import (  # noqa: F401
+    ClusterMetrics,
+    MetricsPlane,
+)
+from elasticdl_tpu.observability.exposition import (  # noqa: F401
+    MetricsHTTPServer,
+    render_prometheus,
+)
+from elasticdl_tpu.observability.registry import (  # noqa: F401
+    MetricsRegistry,
+    default_registry,
+)

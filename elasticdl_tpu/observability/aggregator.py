@@ -1,0 +1,217 @@
+"""Master-side cluster metrics: merge worker snapshots, age out leavers.
+
+Workers piggyback registry snapshots on master-client RPCs they already
+make (get_task / report_task_result / report_version) — no new RPC, no
+scrape path into worker pods. ``ClusterMetrics`` keeps the latest
+snapshot per worker id plus its arrival time; a worker that stops
+reporting (preempted, scaled away on elastic resize) ages out after
+``ttl_secs`` and its series vanish from ``/metrics``; the master's
+recovery path removes it immediately.
+
+``MetricsPlane`` is the whole master-side assembly: the master-local
+registry (task dispatcher, checkpoint, straggler counters), the cluster
+view, the ``/metrics`` HTTP endpoint, and the TensorBoard bridge that
+mirrors selected cluster aggregates into the existing ``SummaryWriter``
+so TensorBoard stays the human view.
+"""
+
+import threading
+import time
+from typing import Dict, Optional
+
+from elasticdl_tpu.observability.exposition import (
+    MetricsHTTPServer,
+    render_prometheus,
+)
+from elasticdl_tpu.observability.registry import (
+    MetricsRegistry,
+    default_registry,
+)
+
+
+def _accumulate(snapshot: dict, totals: Dict[str, float],
+                hist: Dict[str, list], include_gauges: bool):
+    """Fold one snapshot into scalar accumulators: counters sum,
+    histograms pool (sum, count). Gauges are point-in-time, so a
+    departed worker's gauges must NOT linger — callers pass
+    ``include_gauges=False`` for retired snapshots."""
+    for family in snapshot.get("families", []):
+        name = family["name"]
+        kind = family["kind"]
+        if kind == "gauge" and not include_gauges:
+            continue
+        for series in family.get("series", []):
+            if kind == "histogram":
+                acc = hist.setdefault(name, [0.0, 0])
+                acc[0] += series["sum"]
+                acc[1] += series["count"]
+            else:
+                totals[name] = totals.get(name, 0.0) + series["value"]
+
+
+class ClusterMetrics:
+    """Latest snapshot per worker id, with TTL-based aging.
+
+    Departure does not lose history: a removed/expired worker's last
+    snapshot is *retired*, and ``aggregate()`` keeps counting its
+    counters and histogram totals so the TensorBoard-bridged cluster
+    totals stay monotonic across elastic resizes. Its labeled series
+    still vanish from ``/metrics`` (Prometheus handles departures via
+    staleness; the scalar bridge can't). The snapshot's registry
+    ``instance`` token disambiguates a reappearing worker id: same
+    token → the live process flapped past the TTL, its cumulative
+    values continue (un-retire); different token → a replacement
+    process whose counters restarted, the old values fold into a
+    permanent base."""
+
+    def __init__(self, ttl_secs: float = 60.0):
+        self.ttl_secs = float(ttl_secs)
+        self._lock = threading.Lock()
+        # worker_id -> (snapshot, monotonic arrival time)
+        self._snapshots: Dict[int, tuple] = {}
+        # worker_id -> last snapshot at departure (counters still owed
+        # to the aggregate until the id reappears and is reconciled).
+        self._retired: Dict[int, dict] = {}
+        # Folded counter/histogram base from replaced workers.
+        self._retired_totals: Dict[str, float] = {}
+        self._retired_hist: Dict[str, list] = {}
+
+    def ingest(self, worker_id: int, snapshot: dict,
+               now: Optional[float] = None):
+        if worker_id < 0 or not snapshot:
+            return
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            retired = self._retired.pop(int(worker_id), None)
+            if retired is not None:
+                old = retired.get("instance")
+                new = snapshot.get("instance")
+                if old and new and old != new:
+                    _accumulate(
+                        retired, self._retired_totals,
+                        self._retired_hist, include_gauges=False,
+                    )
+                # Same (or unknown) instance: the retired snapshot's
+                # values live on inside the new one — just un-retire.
+            self._snapshots[int(worker_id)] = (snapshot, now)
+
+    def remove_worker(self, worker_id: int):
+        """Immediate removal (master recovered the worker's tasks /
+        elastic resize scaled it away) — don't wait for the TTL."""
+        with self._lock:
+            self._retire_locked(int(worker_id))
+
+    def _retire_locked(self, worker_id: int):
+        entry = self._snapshots.pop(worker_id, None)
+        if entry is not None:
+            self._retired[worker_id] = entry[0]
+
+    def worker_ids(self):
+        return sorted(self.snapshots())
+
+    def snapshots(self, now: Optional[float] = None) -> Dict[int, dict]:
+        """Live snapshots; expired workers are retired as a side effect."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            expired = [
+                wid for wid, (_s, ts) in self._snapshots.items()
+                if now - ts > self.ttl_secs
+            ]
+            for wid in expired:
+                self._retire_locked(wid)
+            return {
+                wid: snap for wid, (snap, _ts) in self._snapshots.items()
+            }
+
+    # ---- cross-worker scalar aggregates --------------------------------
+
+    def aggregate(self) -> Dict[str, float]:
+        """Sum counters/gauges and mean histograms across live workers,
+        plus retired workers' counters/histograms (gauges excluded) —
+        the scalar view the TensorBoard bridge mirrors."""
+        live = self.snapshots()
+        with self._lock:
+            totals = dict(self._retired_totals)
+            hist = {k: list(v) for k, v in self._retired_hist.items()}
+            retired = list(self._retired.values())
+        for snapshot in retired:
+            _accumulate(snapshot, totals, hist, include_gauges=False)
+        for snapshot in live.values():
+            _accumulate(snapshot, totals, hist, include_gauges=True)
+        for name, (total, count) in hist.items():
+            totals[f"{name}_count"] = totals.get(
+                f"{name}_count", 0.0
+            ) + count
+            if count:
+                totals[f"{name}_mean"] = total / count
+        return totals
+
+
+class MetricsPlane:
+    """Master-side telemetry assembly: local registry + cluster view +
+    exposition endpoint + TensorBoard bridge."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None,
+                 ttl_secs: float = 60.0, summary_writer=None):
+        self.registry = registry or default_registry()
+        self.cluster = ClusterMetrics(ttl_secs)
+        # TensorboardService (write_dict_to_summary) or SummaryWriter
+        # (add_scalars) — both are duck-typed below; None = no bridge.
+        self._summary_writer = summary_writer
+        self._last_published = None
+        self._http: Optional[MetricsHTTPServer] = None
+
+    # ---- ingest / render ----------------------------------------------
+
+    def ingest(self, worker_id: int, snapshot: dict):
+        self.cluster.ingest(worker_id, snapshot)
+
+    def render(self) -> str:
+        return render_prometheus(
+            self.registry.snapshot(), self.cluster.snapshots()
+        )
+
+    # ---- HTTP ----------------------------------------------------------
+
+    def serve(self, port: int = 0, host: str = "") -> MetricsHTTPServer:
+        self._http = MetricsHTTPServer(
+            self.render, port=port, host=host
+        ).start()
+        return self._http
+
+    @property
+    def http_port(self) -> Optional[int]:
+        return self._http.port if self._http else None
+
+    def stop(self):
+        if self._http is not None:
+            self._http.stop()
+            self._http = None
+
+    # ---- TensorBoard bridge -------------------------------------------
+
+    def set_summary_writer(self, writer):
+        self._summary_writer = writer
+
+    def publish_tensorboard(self, step: int):
+        """Mirror cluster scalar aggregates (prefixed ``metrics/``) into
+        the SummaryWriter; called from the master run-loop tick."""
+        if self._summary_writer is None:
+            return
+        scalars = {
+            f"metrics/{name}": value
+            for name, value in self.cluster.aggregate().items()
+        }
+        if not scalars:
+            return
+        # The master calls this every poll tick; during idle stretches
+        # (eval phases, stalled workers) step and aggregates sit still —
+        # re-writing the identical frame each tick only bloats tfevents.
+        if self._last_published == (int(step), scalars):
+            return
+        self._last_published = (int(step), scalars)
+        add = getattr(self._summary_writer, "write_dict_to_summary", None)
+        if add is not None:
+            add(scalars, int(step))
+        else:
+            self._summary_writer.add_scalars(scalars, int(step))

@@ -1,0 +1,200 @@
+"""Prometheus text-format rendering + the stdlib-only /metrics endpoint.
+
+``render_prometheus`` turns registry snapshots (``registry.snapshot()``
+dicts) into Prometheus exposition format 0.0.4: one ``# HELP`` /
+``# TYPE`` pair per family, escaped label values, and cumulative
+histogram ``_bucket``/``_sum``/``_count`` series with ``le`` labels.
+Worker snapshots get a ``worker="<id>"`` label so the cluster view
+keeps per-worker series apart (and a departed worker's series simply
+stop appearing once the aggregator ages it out).
+
+``MetricsHTTPServer`` serves ``/metrics`` and ``/healthz`` from a
+``http.server.ThreadingHTTPServer`` on a daemon thread — no new
+dependency, ephemeral-port friendly (``port=0``), scrapeable by real
+Prometheus or ``tools/dump_metrics.py``.
+"""
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Optional
+
+from elasticdl_tpu.common.log_utils import get_logger
+
+logger = get_logger("metrics_http")
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def _escape_help(text: str) -> str:
+    return text.replace("\\", r"\\").replace("\n", r"\n")
+
+
+def _escape_label_value(value: str) -> str:
+    return (
+        value.replace("\\", r"\\").replace('"', r"\"").replace("\n", r"\n")
+    )
+
+
+def _format_value(value: float) -> str:
+    if value == float("inf"):
+        return "+Inf"
+    if value == float("-inf"):
+        return "-Inf"
+    if float(value) == int(value) and abs(value) < 1e15:
+        return str(int(value))
+    return repr(float(value))
+
+
+def _label_str(labelnames, labelvalues, extra: Dict[str, str]) -> str:
+    parts = [
+        f'{name}="{_escape_label_value(str(value))}"'
+        for name, value in list(zip(labelnames, labelvalues))
+        + sorted(extra.items())
+    ]
+    return "{%s}" % ",".join(parts) if parts else ""
+
+
+def _render_series(lines, family: dict, series: dict,
+                   extra: Dict[str, str]):
+    name = family["name"]
+    labelnames = family.get("labelnames", [])
+    values = series.get("labels", [])
+    if family["kind"] == "histogram":
+        cumulative = 0
+        for ub, n in zip(family["buckets"], series["buckets"]):
+            cumulative += n
+            le = {"le": _format_value(ub)}
+            lines.append(
+                f"{name}_bucket"
+                f"{_label_str(labelnames, values, {**extra, **le})}"
+                f" {cumulative}"
+            )
+        lines.append(
+            f"{name}_bucket"
+            f"{_label_str(labelnames, values, {**extra, 'le': '+Inf'})}"
+            f" {series['count']}"
+        )
+        lines.append(
+            f"{name}_sum{_label_str(labelnames, values, extra)}"
+            f" {_format_value(series['sum'])}"
+        )
+        lines.append(
+            f"{name}_count{_label_str(labelnames, values, extra)}"
+            f" {series['count']}"
+        )
+    else:
+        lines.append(
+            f"{name}{_label_str(labelnames, values, extra)}"
+            f" {_format_value(series['value'])}"
+        )
+
+
+def render_prometheus(
+    local_snapshot: Optional[dict] = None,
+    worker_snapshots: Optional[Dict[int, dict]] = None,
+) -> str:
+    """Render the master-local snapshot plus per-worker snapshots.
+
+    Families appearing in several snapshots (every worker instruments
+    the same code) emit ONE ``# HELP``/``# TYPE`` header; worker series
+    carry a ``worker`` label, master-local series none.
+    """
+    # family name -> (family dict, [(series, extra_labels)])
+    merged: Dict[str, tuple] = {}
+
+    def _ingest(snapshot: dict, extra: Dict[str, str]):
+        for family in snapshot.get("families", []):
+            entry = merged.get(family["name"])
+            if entry is None:
+                entry = merged[family["name"]] = (family, [])
+            for series in family.get("series", []):
+                entry[1].append((family, series, extra))
+
+    if local_snapshot:
+        _ingest(local_snapshot, {})
+    for worker_id in sorted(worker_snapshots or {}):
+        _ingest(worker_snapshots[worker_id], {"worker": str(worker_id)})
+
+    lines = []
+    for name in sorted(merged):
+        family, series_list = merged[name]
+        lines.append(f"# HELP {name} {_escape_help(family.get('help', ''))}")
+        lines.append(f"# TYPE {name} {family['kind']}")
+        for owning_family, series, extra in series_list:
+            _render_series(lines, owning_family, series, extra)
+    return "\n".join(lines) + "\n"
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # Populated per-server via functools.partial-style subclassing in
+    # MetricsHTTPServer.start().
+    render: Callable[[], str] = staticmethod(lambda: "")
+
+    def do_GET(self):  # noqa: N802 (BaseHTTPRequestHandler API)
+        path = self.path.split("?", 1)[0]
+        if path == "/metrics":
+            try:
+                body = type(self).render().encode("utf-8")
+            except Exception as exc:
+                self.send_error(500, f"{type(exc).__name__}: {exc}")
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", CONTENT_TYPE)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        elif path == "/healthz":
+            body = b"ok\n"
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        else:
+            self.send_error(404, "try /metrics or /healthz")
+
+    def log_message(self, fmt, *args):
+        logger.debug("metrics http: " + fmt, *args)
+
+
+class MetricsHTTPServer:
+    """``/metrics`` + ``/healthz`` on a daemon thread.
+
+    ``render`` is a zero-arg callable returning the exposition text
+    (typically ``MetricsPlane.render``); evaluated per scrape so gauges
+    with pull-time callbacks stay live.
+    """
+
+    def __init__(self, render: Callable[[], str], port: int = 0,
+                 host: str = ""):
+        self._render = render
+        self._host = host
+        self._requested_port = int(port)
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "MetricsHTTPServer":
+        handler = type("_BoundHandler", (_Handler,), {
+            "render": staticmethod(self._render),
+        })
+        self._httpd = ThreadingHTTPServer(
+            (self._host, self._requested_port), handler
+        )
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="metrics-http",
+        )
+        self._thread.start()
+        logger.info("/metrics serving on port %d", self.port)
+        return self
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1] if self._httpd else 0
+
+    def stop(self):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None

@@ -46,6 +46,9 @@ class MiniCluster:
         checkpoint_dir_for_init: str = "",
         mesh=None,
         fuse_task_steps: bool = False,
+        metrics_port: Optional[int] = None,
+        metrics_report_secs: float = 0.0,
+        metrics_ttl_secs: float = 600.0,
     ):
         self.spec = get_model_spec(model_zoo, model_def)
         if mesh is not None:
@@ -96,7 +99,22 @@ class MiniCluster:
             self.dispatcher, metrics_fns, eval_steps=eval_steps,
             eval_only=bool(validation_data and not training_data),
         )
-        self.servicer = MasterServicer(self.dispatcher, self.eval_service)
+        # Telemetry: in-process tests share ONE process registry across
+        # master and workers (production is one worker per process);
+        # per-worker keying comes from each client's worker_id at report
+        # time. metrics_report_secs=0 → workers attach a snapshot to
+        # every report so short jobs still populate the cluster view.
+        from elasticdl_tpu.observability import MetricsPlane
+
+        self.metrics_plane = MetricsPlane(ttl_secs=metrics_ttl_secs)
+        self.servicer = MasterServicer(
+            self.dispatcher, self.eval_service,
+            metrics_plane=self.metrics_plane,
+        )
+        self.metrics_http = (
+            self.metrics_plane.serve(port=metrics_port)
+            if metrics_port is not None else None
+        )
 
         self._server = None
         self._use_rpc = use_rpc
@@ -166,6 +184,7 @@ class MiniCluster:
                     checkpoint_hook=hook if wid == 0 else None,
                     checkpoint_dir_for_init=checkpoint_dir_for_init,
                     fuse_task_steps=fuse_task_steps,
+                    metrics_report_secs=metrics_report_secs,
                 )
             )
 
@@ -187,6 +206,12 @@ class MiniCluster:
         if self._server is not None:
             self._server.stop(0)
         return results
+
+    def stop(self):
+        """Release the metrics endpoint (its daemon thread and bound
+        port outlive run() on purpose, so tests can scrape the final
+        cluster state first)."""
+        self.metrics_plane.stop()
 
     @property
     def finished(self) -> bool:

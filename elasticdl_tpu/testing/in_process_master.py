@@ -25,16 +25,24 @@ class InProcessMaster:
             self._callbacks[name](request)
         return self._servicer.handlers()[name](request)
 
-    def get_task(self) -> Tuple[Optional[Task], bool]:
-        resp = self._call("get_task", {"worker_id": self._worker_id})
+    def get_task(self, metrics=None) -> Tuple[Optional[Task], bool]:
+        request = {"worker_id": self._worker_id}
+        if metrics:
+            request["metrics"] = metrics
+        resp = self._call("get_task", request)
         task = Task.from_dict(resp["task"]) if resp.get("task") else None
         return task, bool(resp.get("finished"))
 
-    def report_task_result(self, task_id: int, err_reason: str = "") -> bool:
-        resp = self._call(
-            "report_task_result",
-            {"task_id": task_id, "err_reason": err_reason},
-        )
+    def report_task_result(self, task_id: int, err_reason: str = "",
+                           metrics=None) -> bool:
+        request = {
+            "task_id": task_id,
+            "err_reason": err_reason,
+            "worker_id": self._worker_id,
+        }
+        if metrics:
+            request["metrics"] = metrics
+        resp = self._call("report_task_result", request)
         return bool(resp.get("accepted"))
 
     def report_evaluation_metrics(self, model_outputs, labels) -> bool:
@@ -47,12 +55,14 @@ class InProcessMaster:
         )
         return bool(resp.get("accepted"))
 
-    def report_version(self, model_version: int) -> None:
-        self._call(
-            "report_version",
-            {"model_version": int(model_version),
-             "worker_id": self._worker_id},
-        )
+    def report_version(self, model_version: int, metrics=None) -> None:
+        request = {
+            "model_version": int(model_version),
+            "worker_id": self._worker_id,
+        }
+        if metrics:
+            request["metrics"] = metrics
+        self._call("report_version", request)
 
     def close(self):
         pass

@@ -25,28 +25,42 @@ class Profiler:
     """Step-windowed jax.profiler trace.
 
     ``observe_step(step)`` is called once per training step; the trace
-    runs for steps [start_step, start_step + num_steps).
+    runs for steps [start_step, start_step + num_steps). The window is
+    closed by ``stop()`` — the worker calls it on loop exit so a
+    training run that ends (or is preempted) before the window fills
+    still lands its trace, and a later ``start_trace`` in the process
+    doesn't raise "already started".
+
+    ``backend`` defaults to ``jax.profiler`` (imported lazily); tests
+    inject a fake with the same ``start_trace``/``stop_trace`` surface.
     """
 
     def __init__(self, profile_dir: str = "", start_step: int = 5,
-                 num_steps: int = 5):
+                 num_steps: int = 5, backend=None):
         self.profile_dir = profile_dir
         self.start_step = int(start_step)
         self.num_steps = int(num_steps)
+        self._backend = backend
         self._active = False
         self._done = False
+        self._window_end = None
 
     @property
     def enabled(self) -> bool:
         return bool(self.profile_dir)
 
+    def _get_backend(self):
+        if self._backend is None:
+            import jax
+
+            self._backend = jax.profiler
+        return self._backend
+
     def observe_step(self, step: int):
         if not self.enabled or self._done:
             return
         if not self._active and step >= self.start_step:
-            import jax
-
-            jax.profiler.start_trace(self.profile_dir)
+            self._get_backend().start_trace(self.profile_dir)
             self._active = True
             self._window_end = step + self.num_steps
             logger.info(
@@ -55,12 +69,13 @@ class Profiler:
             )
         elif self._active and step >= self._window_end:
             self.stop()
+        # step < window_end while active (out-of-order final steps — a
+        # restored state can rewind the counter): keep tracing; stop()
+        # on loop exit closes the window regardless.
 
     def stop(self):
         if self._active:
-            import jax
-
-            jax.profiler.stop_trace()
+            self._get_backend().stop_trace()
             self._active = False
             self._done = True
             logger.info("profiler: trace written to %s", self.profile_dir)
@@ -71,9 +86,11 @@ class Profiler:
         if not self.enabled:
             yield
             return
-        import jax
-
-        with jax.profiler.TraceAnnotation(name):
+        annotate = getattr(self._get_backend(), "TraceAnnotation", None)
+        if annotate is None:  # fake backends need not implement it
+            yield
+            return
+        with annotate(name):
             yield
 
 

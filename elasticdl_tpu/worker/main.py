@@ -256,11 +256,14 @@ def build_worker(args, master_client=None) -> Worker:
         version_report_steps=getattr(args, "get_model_steps", 1),
         prediction_outputs_processor=spec.prediction_outputs_processor,
         callbacks=callbacks,
+        # Worker.__init__ publishes this into the process registry
+        # (phase histograms on /metrics), which also enables measuring.
         timing=Timing(args.log_level.upper() == "DEBUG"),
         checkpoint_hook=checkpoint_hook,
         profiler=profiler_from_args(args),
         fuse_task_steps=getattr(args, "fuse_task_steps", False),
         prefetch_depth=getattr(args, "prefetch_depth", 2),
+        metrics_report_secs=getattr(args, "metrics_report_secs", 15.0),
         **resolve_init_checkpoint(args),
     )
 

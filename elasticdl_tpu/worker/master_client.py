@@ -27,15 +27,27 @@ class MasterClient:
         self._stub = RpcStub(self._channel, SERVICE_NAME)
         self._worker_id = worker_id
 
-    def get_task(self) -> Tuple[Optional[Task], bool]:
-        resp = self._stub.call("get_task", worker_id=self._worker_id)
+    def get_task(self, metrics: Optional[dict] = None,
+                 ) -> Tuple[Optional[Task], bool]:
+        fields = {"worker_id": self._worker_id}
+        if metrics:
+            fields["metrics"] = metrics
+        resp = self._stub.call("get_task", **fields)
         task = Task.from_dict(resp["task"]) if resp.get("task") else None
         return task, bool(resp.get("finished"))
 
-    def report_task_result(self, task_id: int, err_reason: str = "") -> bool:
-        resp = self._stub.call(
-            "report_task_result", task_id=task_id, err_reason=err_reason
-        )
+    def report_task_result(self, task_id: int, err_reason: str = "",
+                           metrics: Optional[dict] = None) -> bool:
+        fields = {
+            "task_id": task_id,
+            "err_reason": err_reason,
+            "worker_id": self._worker_id,
+        }
+        if metrics:
+            # Piggybacked registry snapshot (observability/): the master
+            # merges it into the cluster view keyed by worker id.
+            fields["metrics"] = metrics
+        resp = self._stub.call("report_task_result", **fields)
         return bool(resp.get("accepted"))
 
     def report_evaluation_metrics(self, model_outputs, labels) -> bool:
@@ -46,12 +58,15 @@ class MasterClient:
         )
         return bool(resp.get("accepted"))
 
-    def report_version(self, model_version: int) -> None:
-        self._stub.call(
-            "report_version",
-            model_version=int(model_version),
-            worker_id=self._worker_id,
-        )
+    def report_version(self, model_version: int,
+                       metrics: Optional[dict] = None) -> None:
+        fields = {
+            "model_version": int(model_version),
+            "worker_id": self._worker_id,
+        }
+        if metrics:
+            fields["metrics"] = metrics
+        self._stub.call("report_version", **fields)
 
     def close(self):
         self._stub.close()

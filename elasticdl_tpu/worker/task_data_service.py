@@ -34,8 +34,14 @@ _TASK_TYPE_TO_MODE = {
 class TaskDataService:
     def __init__(self, master_client, data_reader, dataset_fn,
                  minibatch_size: int, wait_sleep_secs: float = 2.0,
-                 prefetch_depth: int = 2, on_wait=None):
+                 prefetch_depth: int = 2, on_wait=None, metrics_fn=None):
         self._master = master_client
+        # Zero-arg callable returning a (rate-limited) registry snapshot
+        # to piggyback on get_task, or None. Without it an idle worker —
+        # polling WAIT tasks between epochs — makes no reporting RPC and
+        # would age out of the master's cluster metrics view while
+        # perfectly alive.
+        self._metrics_fn = metrics_fn
         self._reader = data_reader
         self._dataset_fn = dataset_fn
         self._minibatch_size = minibatch_size
@@ -72,7 +78,9 @@ class TaskDataService:
         rpc_failures = 0
         while True:
             try:
-                task, finished = self._master.get_task()
+                task, finished = self._master.get_task(
+                    metrics=self._metrics_fn() if self._metrics_fn else None
+                )
             except RpcError as exc:
                 rpc_failures += 1
                 logger.warning(

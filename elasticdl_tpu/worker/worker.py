@@ -23,6 +23,7 @@ are globally sharded, the optimizer state is ZeRO-sharded (parallel/), and
 collectives ride ICI inside the compiled step (see parallel/mesh_runner.py).
 """
 
+import time
 import traceback
 from typing import Optional
 
@@ -69,6 +70,8 @@ class Worker:
         profiler=None,
         fuse_task_steps: bool = False,
         prefetch_depth: int = 2,
+        metrics_registry=None,
+        metrics_report_secs: float = 15.0,
     ):
         self._id = worker_id
         self._master = master_client
@@ -90,6 +93,10 @@ class Worker:
             master_client, data_reader, model_spec.dataset_fn,
             minibatch_size, prefetch_depth=prefetch_depth,
             on_wait=self._wait_tick,
+            # Keep an idle worker alive in the master's cluster metrics
+            # view: snapshots ride get_task too, not just the report
+            # RPCs (rate-limited inside _metrics_snapshot).
+            metrics_fn=self._metrics_snapshot,
         )
         self.last_metrics = None
         # Periodic sharded checkpoint (reference PS saves inside
@@ -126,12 +133,46 @@ class Worker:
         # signal handler).
         self._stop_requested = False
         self._checkpoint_init_required = checkpoint_init_required
+        # Telemetry (observability/): the step loop feeds the process
+        # registry; snapshots piggyback on report_task_result /
+        # report_version every metrics_report_secs (0 = every report,
+        # for tests) so the master's cluster view stays fresh without a
+        # dedicated RPC.
+        from elasticdl_tpu.observability import default_registry
+
+        self._metrics = metrics_registry or default_registry()
+        self._metrics_report_secs = float(metrics_report_secs)
+        self._last_metrics_report = 0.0
+        self._m_step = self._metrics.histogram(
+            "worker_step_seconds",
+            "Device step latency (host-observed)", ["kind"],
+        )
+        self._m_examples = self._metrics.counter(
+            "worker_examples_total",
+            "Examples processed", ["task_type"],
+        )
+        self._m_h2d_bytes = self._metrics.counter(
+            "worker_h2d_bytes_total",
+            "Host batch bytes shipped to the device step",
+        )
+        self._m_compiles = self._metrics.counter(
+            "worker_compiles_total",
+            "Step-program builds (each first call triggers XLA compile)",
+        )
+        self._m_tasks = self._metrics.counter(
+            "worker_tasks_total",
+            "Tasks processed", ["type", "result"],
+        )
+        # Phase accumulators feed the registry too (publish enables
+        # timing; DEBUG log output stays gated on a logger being set).
+        self._timing.publish(self._metrics)
 
     # ---- state init ----------------------------------------------------
 
     def _maybe_init(self, batch):
         if self.state is not None:
             return
+        self._m_compiles.inc()
         from elasticdl_tpu.callbacks import apply_callbacks_to_optimizer
 
         tx = apply_callbacks_to_optimizer(
@@ -201,6 +242,30 @@ class Worker:
     def set_state(self, state):
         """Install restored state (checkpoint resume / elastic re-init)."""
         self.state = state
+
+    # ---- telemetry ------------------------------------------------------
+
+    def _metrics_snapshot(self) -> Optional[dict]:
+        """Registry snapshot for piggybacking, rate-limited to one per
+        metrics_report_secs; None between reports."""
+        now = time.monotonic()
+        if now - self._last_metrics_report < self._metrics_report_secs:
+            return None
+        self._last_metrics_report = now
+        return self._metrics.snapshot()
+
+    @staticmethod
+    def _batch_nbytes(batch) -> int:
+        return sum(
+            getattr(leaf, "nbytes", 0)
+            for leaf in jax.tree_util.tree_leaves(batch)
+        )
+
+    def _batch_examples(self, batch) -> int:
+        mask = batch.get("mask") if isinstance(batch, dict) else None
+        if mask is not None:
+            return int(np.sum(np.asarray(mask) > 0))
+        return self._minibatch_size
 
     # ---- task processing ----------------------------------------------
 
@@ -350,17 +415,27 @@ class Worker:
                     # Pre-step so the window [start, start+num) captures
                     # the steps it names.
                     self._profiler.observe_step(int(self.state.step))
+                step_t0 = time.monotonic()
                 with self._timing.record("batch_process"):
                     if self._profiler is not None:
                         with self._profiler.annotation("train_step"):
                             self._process_train_batch(batch)
                     else:
                         self._process_train_batch(batch)
+                self._m_step.labels("train").observe(
+                    time.monotonic() - step_t0
+                )
+                self._m_examples.labels(task.type).inc(
+                    self._batch_examples(raw)
+                )
+                self._m_h2d_bytes.inc(self._batch_nbytes(raw))
                 count += 1
                 version = int(self.state.step)
                 if version % self._version_report_steps == 0:
                     with self._timing.record("report_version"):
-                        self._master.report_version(version)
+                        self._master.report_version(
+                            version, metrics=self._metrics_snapshot()
+                        )
                 with self._timing.record("checkpoint"):
                     self._checkpoint.maybe_save(self.state)
         finally:
@@ -402,6 +477,7 @@ class Worker:
         if self._profiler is not None:
             self._profiler.observe_step(int(self.state.step))
         stacked = stack_batches(batch_list)
+        step_t0 = time.monotonic()
         with self._timing.record("batch_process"):
             for attempt in range(MAX_MINIBATCH_RETRY_NUM):
                 try:
@@ -420,6 +496,15 @@ class Worker:
                     f"{MAX_MINIBATCH_RETRY_NUM} retries"
                 )
         self.last_metrics = {"loss": metrics["loss"][-1]}
+        self._m_step.labels("train_fused").observe(
+            time.monotonic() - step_t0
+        )
+        self._m_examples.labels(TaskType.TRAINING).inc(
+            sum(self._batch_examples(b) for b in batch_list)
+        )
+        self._m_h2d_bytes.inc(
+            sum(self._batch_nbytes(b) for b in batch_list)
+        )
         version = int(self.state.step)
         # Same SSP gating as the per-step path, at task granularity:
         # report iff a version_report_steps boundary was crossed.
@@ -429,7 +514,9 @@ class Worker:
             > prev // self._version_report_steps
         ):
             with self._timing.record("report_version"):
-                self._master.report_version(version)
+                self._master.report_version(
+                    version, metrics=self._metrics_snapshot()
+                )
         with self._timing.record("checkpoint"):
             self._checkpoint.maybe_save(self.state)
         return len(batch_list)
@@ -477,8 +564,12 @@ class Worker:
                 from elasticdl_tpu.parallel import multihost
 
                 self._await_turn(multihost.STEP_FORWARD)
+            step_t0 = time.monotonic()
             preds = self._eval_step(self.state, batch)
+            self._m_step.labels("eval").observe(time.monotonic() - step_t0)
             real = int(np.sum(batch["mask"]))
+            self._m_examples.labels(task.type).inc(real)
+            self._m_h2d_bytes.inc(self._batch_nbytes(batch))
             outputs_acc.append(self._local_rows(preds)[:real])
             labels_acc.append(np.asarray(batch["labels"])[:real])
         if outputs_acc:
@@ -495,8 +586,14 @@ class Worker:
                 from elasticdl_tpu.parallel import multihost
 
                 self._await_turn(multihost.STEP_FORWARD)
+            step_t0 = time.monotonic()
             preds = self._eval_step(self.state, batch)
+            self._m_step.labels("predict").observe(
+                time.monotonic() - step_t0
+            )
             real = int(np.sum(batch["mask"]))
+            self._m_examples.labels(task.type).inc(real)
+            self._m_h2d_bytes.inc(self._batch_nbytes(batch))
             if self._processor is not None:
                 self._processor.process(
                     self._local_rows(preds)[:real], self._id
@@ -562,13 +659,24 @@ class Worker:
         trained_batches = 0
         for task, batches in self._task_data.task_stream():
             if task.type == TaskType.TRAIN_END_CALLBACK:
+                # Count the callback outcome once: a task whose report
+                # RPC fails after the callback succeeded must not land
+                # in both the ok and error series.
+                callbacks_ok = False
                 try:
                     self._run_train_end_callbacks()
-                    self._master.report_task_result(task.task_id)
+                    callbacks_ok = True
+                    self._m_tasks.labels(task.type, "ok").inc()
+                    self._master.report_task_result(
+                        task.task_id, metrics=self._metrics_snapshot()
+                    )
                 except Exception as exc:
+                    if not callbacks_ok:
+                        self._m_tasks.labels(task.type, "error").inc()
                     self._master.report_task_result(
                         task.task_id,
                         err_reason=f"callback: {type(exc).__name__}: {exc}",
+                        metrics=self._metrics_snapshot(),
                     )
                 continue
             if self._stop_requested:
@@ -599,10 +707,17 @@ class Worker:
                     logger.error(
                         "final checkpoint on preemption failed: %s", exc
                     )
+                self._m_tasks.labels(task.type, "preempted").inc()
                 self._master.report_task_result(
-                    task.task_id, err_reason="preempted (SIGTERM)"
+                    task.task_id, err_reason="preempted (SIGTERM)",
+                    metrics=self._metrics_snapshot(),
                 )
                 break
+            # Counts the processing outcome, not the report RPC's: a
+            # task that trained fine but whose report raised stays an
+            # "ok" task (the except below re-reports it, and without
+            # the flag it would land in both series).
+            processed_ok = False
             try:
                 with self._timing.record("task_process"):
                     if task.type == TaskType.TRAINING:
@@ -613,7 +728,11 @@ class Worker:
                         self._process_eval_task(task, batches)
                     elif task.type == TaskType.PREDICTION:
                         self._process_predict_task(task, batches)
-                self._master.report_task_result(task.task_id)
+                processed_ok = True
+                self._m_tasks.labels(task.type, "ok").inc()
+                self._master.report_task_result(
+                    task.task_id, metrics=self._metrics_snapshot()
+                )
             except Exception as exc:
                 if self._multihost_sync:
                     # A failed step after winning a barrier tick leaves
@@ -632,9 +751,12 @@ class Worker:
                 )
                 # type name prefix guarantees a non-empty reason (an empty
                 # err_reason would read as success at the master).
+                if not processed_ok:
+                    self._m_tasks.labels(task.type, "error").inc()
                 self._master.report_task_result(
                     task.task_id,
                     err_reason=f"{type(exc).__name__}: {exc}",
+                    metrics=self._metrics_snapshot(),
                 )
         if not self._stop_requested:
             # A stopping worker must not drain: the barrier drains only

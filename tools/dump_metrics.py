@@ -1,0 +1,120 @@
+#!/usr/bin/env python
+"""Scrape a running master's /metrics and pretty-print it.
+
+Usage::
+
+    python tools/dump_metrics.py localhost:8080          # pretty table
+    python tools/dump_metrics.py http://host:port --raw  # exposition text
+    make metrics METRICS_ADDR=localhost:8080
+
+Works against any Prometheus text endpoint — the in-process test
+cluster (``MiniCluster(metrics_port=0)``), a real master started with
+``--metrics_port``, or a row-service process wired to serve its own
+registry. Stdlib only (urllib), like the endpoint itself.
+"""
+
+import argparse
+import re
+import sys
+import urllib.request
+
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?P<labels>\{.*\})?\s+(?P<value>\S+)$"
+)
+
+
+def normalize_url(addr: str) -> str:
+    if not addr.startswith("http://") and not addr.startswith("https://"):
+        addr = f"http://{addr}"
+    if not addr.rstrip("/").endswith("/metrics"):
+        addr = addr.rstrip("/") + "/metrics"
+    return addr
+
+
+def fetch_metrics(addr: str, timeout: float = 10.0) -> str:
+    with urllib.request.urlopen(
+        normalize_url(addr), timeout=timeout
+    ) as resp:
+        return resp.read().decode("utf-8")
+
+
+def parse_samples(text: str):
+    """Yield (family_help, family_type) headers and samples as dicts."""
+    families = {}
+    order = []
+    current_help = {}
+    current_type = {}
+    for line in text.splitlines():
+        line = line.rstrip()
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            _, _, rest = line.partition("# HELP ")
+            name, _, help_text = rest.partition(" ")
+            current_help[name] = help_text
+            continue
+        if line.startswith("# TYPE "):
+            _, _, rest = line.partition("# TYPE ")
+            name, _, kind = rest.partition(" ")
+            current_type[name] = kind
+            continue
+        if line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            continue
+        sample_name = m.group("name")
+        # _bucket/_sum/_count samples belong to their histogram family.
+        base = re.sub(r"_(bucket|sum|count)$", "", sample_name)
+        family = base if base in current_type else sample_name
+        if family not in families:
+            families[family] = []
+            order.append(family)
+        families[family].append(
+            (sample_name, m.group("labels") or "", m.group("value"))
+        )
+    return order, families, current_help, current_type
+
+
+def pretty_print(text: str, out=None):
+    out = out if out is not None else sys.stdout
+    order, families, helps, types = parse_samples(text)
+    for family in order:
+        kind = types.get(family, "untyped")
+        out.write(f"{family}  [{kind}]  {helps.get(family, '')}\n")
+        samples = families[family]
+        if kind == "histogram":
+            # Collapse buckets into one line per series: count/sum only
+            # (buckets are for Prometheus, not eyeballs).
+            for name, labels, value in samples:
+                if name.endswith("_count") or name.endswith("_sum"):
+                    out.write(f"    {name}{labels} = {value}\n")
+        else:
+            for name, labels, value in samples:
+                out.write(f"    {name}{labels} = {value}\n")
+        out.write("\n")
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser("dump_metrics")
+    parser.add_argument("addr", help="host:port or URL of the master "
+                                     "metrics endpoint")
+    parser.add_argument("--raw", action="store_true",
+                        help="Print the exposition text verbatim")
+    parser.add_argument("--timeout", type=float, default=10.0)
+    args = parser.parse_args(argv)
+    try:
+        text = fetch_metrics(args.addr, timeout=args.timeout)
+    except OSError as exc:
+        print(f"scrape failed: {exc}", file=sys.stderr)
+        return 1
+    if args.raw:
+        sys.stdout.write(text)
+    else:
+        pretty_print(text)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
