@@ -4,8 +4,8 @@
 
 PY ?= python
 
-.PHONY: test test-all test-tpu test-k8s native bench dryrun clean lint \
-	metrics
+.PHONY: test test-all test-tpu test-k8s native bench serve-bench dryrun \
+	clean lint metrics
 
 # Scrape-and-pretty-print a master's /metrics (docs/observability.md).
 METRICS_ADDR ?= localhost:8080
@@ -42,6 +42,11 @@ native:
 # Kernel correctness on the chip gates the bench (VERDICT r1 #3).
 bench: test-tpu
 	$(PY) bench.py
+
+# Serving-plane latency/throughput vs batch deadline (docs/serving.md);
+# writes BENCH_SERVING.json.
+serve-bench:
+	$(PY) bench_serving.py
 
 # Multi-chip sharding dry run on a virtual 8-device CPU mesh.
 dryrun:

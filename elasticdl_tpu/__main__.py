@@ -1,5 +1,7 @@
 """``python -m elasticdl_tpu`` → the CLI (reference setup.py:33-35
-console entry point ``elasticdl``)."""
+console entry point ``elasticdl``): ``train | evaluate | predict |
+serve | clean`` (``serve`` = the online inference server,
+serving/server.py)."""
 
 import sys
 

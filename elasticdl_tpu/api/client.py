@@ -1,5 +1,5 @@
 """The ``elasticdl_tpu`` CLI (reference elasticdl/python/elasticdl/client.py
-+ api.py): ``train | evaluate | predict | clean`` subcommands.
++ api.py): ``train | evaluate | predict | serve | clean`` subcommands.
 
 - ``--distribution_strategy=Local``: run the whole job in-process via
   LocalExecutor (reference api.py:20-23).
@@ -7,6 +7,9 @@
   everything else (reference api.py:175-216). Without the ``kubernetes``
   package, ``--dry_run`` style manifest rendering is still available: the
   manifests are printed for ``kubectl apply -f -``.
+- ``serve``: run the online inference server over an exported bundle
+  directory (serving/server.py; the reference delegated this to TF
+  Serving — here it is native, see docs/serving.md).
 - ``clean``: delete every pod/service of a job (reference
   ``elasticdl clean``).
 """
@@ -30,7 +33,7 @@ from elasticdl_tpu.platform.k8s_client import (
 
 logger = get_logger("client")
 
-_SUBCOMMANDS = ("train", "evaluate", "predict", "clean")
+_SUBCOMMANDS = ("train", "evaluate", "predict", "serve", "clean")
 
 
 def _master_manifests(args, mode: str):
@@ -143,11 +146,18 @@ def main(argv=None):
     argv = list(sys.argv[1:] if argv is None else argv)
     if not argv or argv[0] not in _SUBCOMMANDS:
         print(
-            "usage: elasticdl_tpu {train|evaluate|predict|clean} <flags>",
+            "usage: elasticdl_tpu {train|evaluate|predict|serve|clean}"
+            " <flags>",
             file=sys.stderr,
         )
         return 2
     mode, rest = argv[0], argv[1:]
+    if mode == "serve":
+        # The serving plane has its own flag surface (bundle dir,
+        # batching knobs) and no job/k8s context — dispatch directly.
+        from elasticdl_tpu.serving.server import main as serve_main
+
+        return serve_main(rest)
     args = build_parser(mode).parse_args(rest)
     if mode == "clean":
         return _clean(args)

@@ -1,4 +1,19 @@
+"""Serving plane: bundle export + the online inference server.
+
+``export.py`` writes the training-side artifact (the SavedModel
+equivalent); ``model_store.py`` manages versions at serve time (hot
+reload, rollback, host-row resolution); ``server.py`` is the batched
+HTTP front (``elasticdl_tpu serve``). See docs/serving.md.
+"""
+
 from elasticdl_tpu.serving.export import (  # noqa: F401
+    HOST_ROWS_FEATURE_PREFIX,
     export_serving_bundle,
     load_predictor,
+)
+from elasticdl_tpu.serving.model_store import (  # noqa: F401
+    HostRowResolver,
+    ModelStore,
+    ServedModel,
+    load_served_model,
 )

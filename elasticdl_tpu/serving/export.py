@@ -22,6 +22,7 @@ import os
 from typing import Any, Callable, Optional
 
 import jax
+import jax.export  # not auto-imported by `import jax`; used via jax.export.*
 import numpy as np
 from flax import serialization
 
@@ -32,6 +33,13 @@ logger = get_logger("serving_export")
 PARAMS_FILE = "params.msgpack"
 META_FILE = "metadata.json"
 HLO_FILE = "predict.stablehlo"
+
+# Row-service serving mode: the exported predict takes each host
+# table's row block as an EXTRA feature under this key prefix (with a
+# symbolic leading dim), so the online server can pull fresh rows from
+# embedding/row_service.py per request instead of baking a dense
+# (vocab, dim) copy into the bundle.
+HOST_ROWS_FEATURE_PREFIX = "__host_rows__:"
 
 
 def _predict_fn(model):
@@ -111,6 +119,22 @@ def materialize_host_rows(tables, vocab_sizes, chunk: int = 65536,
     return out
 
 
+def _feature_signature(features, batch_dim: int):
+    """JSON-able {shape, dtype} tree of the predict input; the leading
+    dim is ``None`` where it carries the batch (the reference
+    SavedModel signature's None batch dim). Lets the serving plane
+    coerce JSON payloads and synthesize load-generator traffic without
+    the model code."""
+
+    def leaf(x):
+        shape = list(np.shape(x))
+        if shape and shape[0] == batch_dim:
+            shape[0] = None
+        return {"shape": shape, "dtype": np.asarray(x).dtype.name}
+
+    return jax.tree.map(leaf, features)
+
+
 def export_serving_bundle(
     output_dir: str,
     model: Any,
@@ -120,14 +144,36 @@ def export_serving_bundle(
     host_tables: Optional[dict] = None,
     host_vocab: Optional[dict] = None,
     host_lock=None,
+    host_id_keys: Optional[dict] = None,
 ) -> str:
     """Write the serving bundle; returns ``output_dir``.
 
     ``host_tables``+``host_vocab`` (host-tier models): each table is
     materialized dense into the ``host_rows`` collection so the bundle
     is standalone and serves raw ids (requires ``batch_example`` for
-    the collection template; ``host_lock`` guards live tables)."""
+    the collection template; ``host_lock`` guards live tables).
+
+    ``host_id_keys`` ({table: feature key}) exports the ROW-SERVICE
+    serving mode instead: no rows are baked in; the predict artifact
+    takes each table's row block as an extra feature with a SYMBOLIC
+    leading dim (``HOST_ROWS_FEATURE_PREFIX + table``), and the online
+    server resolves raw ids against a live ``HostRowService`` per
+    request (dedup -> pull -> bucket-pad; serving/model_store.py).
+    This is the servable shape for host-partitioned tables too large
+    to materialize dense. Mutually exclusive with ``host_tables``."""
     os.makedirs(output_dir, exist_ok=True)
+    if host_id_keys and host_tables:
+        raise ValueError(
+            "host_id_keys (row-service serving) and host_tables "
+            "(materialized dense rows) are mutually exclusive"
+        )
+    if host_id_keys:
+        if batch_example is None:
+            raise ValueError("host_id_keys export requires batch_example")
+        return _export_row_service_bundle(
+            output_dir, model, state, batch_example, model_def,
+            host_id_keys,
+        )
     if batch_example is not None and not (
         isinstance(batch_example, dict) and "features" in batch_example
     ):
@@ -161,6 +207,14 @@ def export_serving_bundle(
         "model_def": model_def,
         "format": 1,
     }
+    if batch_example is not None:
+        leaves = jax.tree.leaves(batch_example["features"])
+        batch_dim = (
+            np.shape(leaves[0])[0] if leaves and np.ndim(leaves[0]) else 0
+        )
+        meta["feature_signature"] = _feature_signature(
+            batch_example["features"], batch_dim
+        )
     hlo_written = False
     if host_tables and host_vocab and batch_example is None:
         # No example -> no collection template: the host model cannot
@@ -221,6 +275,125 @@ def export_serving_bundle(
             else 0
         )
     meta["self_contained"] = hlo_written
+    with open(os.path.join(output_dir, META_FILE), "w") as f:
+        json.dump(meta, f, indent=1)
+    return output_dir
+
+
+def _export_row_service_bundle(
+    output_dir: str, model: Any, state: Any, batch_example: Any,
+    model_def: str, host_id_keys: dict,
+) -> str:
+    """The ``host_id_keys`` arm of ``export_serving_bundle``: trace
+    predict with the per-table row blocks as extra features whose
+    leading dim is SYMBOLIC, so ONE StableHLO artifact serves every
+    (batch bucket, row bucket) combination the online batcher produces.
+    The bundle stays standalone (no zoo code at serve time); only the
+    rows live elsewhere — on the row service, pulled per request."""
+    from elasticdl_tpu.embedding.host_engine import (
+        HOST_ROWS_COLLECTION,
+        _iter_leaves,
+        _nest_rows,
+        host_rows_template,
+    )
+
+    if not (isinstance(batch_example, dict)
+            and "features" in batch_example):
+        batch_example = {"features": batch_example}
+    template = host_rows_template(model, batch_example)
+    table_dims = {k: int(np.shape(v)[-1])
+                  for k, v in _iter_leaves(template)}
+    mismatch = set(table_dims) ^ set(host_id_keys)
+    if mismatch:
+        raise ValueError(
+            f"host_id_keys must name exactly the model's host tables "
+            f"{sorted(table_dims)}; mismatched: {sorted(mismatch)}"
+        )
+    variables = _variables(state)
+    with open(os.path.join(output_dir, PARAMS_FILE), "wb") as f:
+        f.write(serialization.to_bytes(variables))
+
+    names = sorted(table_dims)
+
+    def predict(variables, features):
+        features = dict(features)
+        flat_rows = {
+            name: features.pop(HOST_ROWS_FEATURE_PREFIX + name)
+            for name in names
+        }
+        merged = dict(variables)
+        merged[HOST_ROWS_COLLECTION] = _nest_rows(template, flat_rows)
+        return model.apply(merged, features, training=False)
+
+    features = dict(batch_example["features"])
+    leaves = jax.tree.leaves(features)
+    example_batch_dim = (
+        np.shape(leaves[0])[0] if leaves and np.ndim(leaves[0]) else 0
+    )
+    var_shapes = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(np.shape(x), x.dtype), variables
+    )
+
+    def feat_shapes_with(batch_dim, row_dims):
+        def leaf_shape(x):
+            shape = tuple(np.shape(x))
+            if shape and shape[0] == example_batch_dim:
+                shape = (batch_dim,) + shape[1:]
+            return jax.ShapeDtypeStruct(shape, np.asarray(x).dtype)
+
+        shapes = jax.tree.map(leaf_shape, features)
+        for name, row_dim in zip(names, row_dims):
+            shapes[HOST_ROWS_FEATURE_PREFIX + name] = (
+                jax.ShapeDtypeStruct(
+                    (row_dim, table_dims[name]), np.float32
+                )
+            )
+        return shapes
+
+    export_fn = jax.export.export(jax.jit(predict))
+    # One scope for every symbol (jax.export requires it); row-bucket
+    # dims are ALWAYS symbolic (the whole point of this mode), the
+    # batch dim preferably so.
+    syms = jax.export.symbolic_shape(
+        ", ".join(["b"] + [f"u{i}" for i in range(len(names))])
+    )
+    batch_polymorphic = True
+    try:
+        exported = export_fn(
+            var_shapes, feat_shapes_with(syms[0], syms[1:])
+        )
+    except Exception as exc:
+        logger.warning(
+            "Batch-polymorphic row-service export failed (%s: %s); "
+            "retrying with the example's static batch size %d",
+            type(exc).__name__, exc, example_batch_dim,
+        )
+        syms = jax.export.symbolic_shape(
+            ", ".join(f"u{i}" for i in range(len(names)))
+        )
+        exported = export_fn(
+            var_shapes, feat_shapes_with(example_batch_dim, syms)
+        )
+        batch_polymorphic = False
+    with open(os.path.join(output_dir, HLO_FILE), "wb") as f:
+        f.write(exported.serialize())
+
+    meta = {
+        "model_version": int(state.step),
+        "model_def": model_def,
+        "format": 1,
+        "self_contained": True,
+        "batch_polymorphic": batch_polymorphic,
+        "batch_size": int(example_batch_dim),
+        "feature_signature": _feature_signature(
+            features, example_batch_dim
+        ),
+        "host_serving": {
+            "id_keys": dict(host_id_keys),
+            "tables": table_dims,
+            "rows_feature_prefix": HOST_ROWS_FEATURE_PREFIX,
+        },
+    }
     with open(os.path.join(output_dir, META_FILE), "w") as f:
         json.dump(meta, f, indent=1)
     return output_dir

@@ -1,0 +1,405 @@
+"""Multi-version bundle management for the online serving plane.
+
+The reference's serving story ended at a SavedModel directory handed to
+TF Serving — which owns version polling, atomic swap, and rollback
+(SURVEY §2.5's export path). Here the equivalent lifecycle is native:
+
+- ``ModelStore`` watches an export root. Two layouts are accepted:
+  a directory of versioned bundle subdirectories (what a training job's
+  periodic ``SavedModelExporter`` produces when pointed at
+  ``root/v<step>``) or a single bundle directory. A bundle is eligible
+  only once ``metadata.json`` exists — the exporter writes it LAST, so
+  presence == complete bundle (no partial-read races with the writer).
+- New versions load on the store's poll thread, NEVER on the serving
+  thread: the batcher keeps draining on the old version while the new
+  one deserializes/compiles, then one atomic reference swap publishes
+  it. The previous ``retain`` versions stay resident for instant
+  ``rollback()`` (which also pins the rolled-back version so the
+  poller doesn't immediately re-promote it).
+- ``ServedModel.predict`` is the single entry the server calls. For
+  row-service bundles (``metadata.host_serving``, exported via
+  ``export_serving_bundle(host_id_keys=...)``) it resolves host-tier
+  sparse features first: dedup the batch's raw ids, pull unique rows
+  from the live ``HostRowService`` (embedding/row_service.py — the
+  same pull plane training uses), bucket-pad to a power of two, and
+  hand the row block to the StableHLO artifact through its symbolic
+  row dim. Dense bundles pass features straight through.
+"""
+
+import json
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.serving.export import (
+    META_FILE,
+    load_predictor,
+)
+
+logger = get_logger("model_store")
+
+
+def _np_features(features):
+    import jax
+
+    return jax.tree.map(np.asarray, features)
+
+
+class HostRowResolver:
+    """Inference-time sparse-feature resolution against the row plane.
+
+    Rewrites a combined batch's raw-id features into (inverse map,
+    bucket-padded row block) pairs — the same dedup/bucket discipline
+    ``HostEmbeddingEngine.prepare_batch`` applies in training, so the
+    compiled-shape count stays O(log unique-ids) per table. Rows come
+    from ``embedding/row_service.py`` remote tables (or any table-like
+    with ``get(ids) -> (n, dim)``), which is what makes host-partitioned
+    DeepFM-style models servable without materializing the vocab."""
+
+    def __init__(self, host_serving: dict, tables: Dict,
+                 feature_signature: Optional[dict] = None):
+        self._id_keys = dict(host_serving["id_keys"])
+        self._dims = {k: int(v)
+                      for k, v in host_serving["tables"].items()}
+        self._prefix = host_serving.get(
+            "rows_feature_prefix", "__host_rows__:"
+        )
+        missing = set(self._id_keys) - set(tables)
+        if missing:
+            raise ValueError(
+                f"row source serves no table(s) {sorted(missing)} "
+                f"required by the bundle"
+            )
+        self._tables = tables
+        # Inverse maps must be emitted in the DTYPE the artifact was
+        # traced with (jax.export validates input avals strictly; an
+        # int64-id example would otherwise reject every int32 inverse).
+        self._id_dtypes = {}
+        signature = feature_signature or {}
+        for table_name, key in self._id_keys.items():
+            spec = signature.get(key) if isinstance(signature, dict) \
+                else None
+            self._id_dtypes[table_name] = np.dtype(
+                spec["dtype"] if isinstance(spec, dict)
+                and "dtype" in spec else np.int32
+            )
+
+    def resolve(self, features: dict) -> dict:
+        from elasticdl_tpu.embedding.host_engine import bucket_size
+
+        if not isinstance(features, dict):
+            raise TypeError(
+                "row-service bundles need dict features carrying the "
+                f"id keys {sorted(self._id_keys.values())}"
+            )
+        out = dict(features)
+        for table_name, key in self._id_keys.items():
+            raw = np.asarray(out[key])
+            uniq, inverse = np.unique(raw, return_inverse=True)
+            bucket = bucket_size(len(uniq))
+            dim = self._dims[table_name]
+            rows = np.zeros((bucket, dim), np.float32)
+            rows[: len(uniq)] = np.asarray(
+                self._tables[table_name].get(uniq), np.float32
+            )
+            out[key] = inverse.reshape(raw.shape).astype(
+                self._id_dtypes[table_name]
+            )
+            out[self._prefix + table_name] = rows
+        return out
+
+
+def make_row_service_tables(addr: str, host_serving: dict,
+                            retries: int = 12,
+                            backoff_secs: float = 0.5) -> Dict:
+    """Remote pull-only tables over running row-service shard(s) —
+    the serving-side counterpart of ``make_remote_engine`` (no
+    optimizer: inference never pushes)."""
+    from elasticdl_tpu.embedding.row_service import make_remote_engine
+
+    engine = make_remote_engine(
+        addr,
+        id_keys=dict(host_serving["id_keys"]),
+        retries=retries, backoff_secs=backoff_secs,
+    )
+    return engine.tables
+
+
+class ServedModel:
+    """One loaded, callable bundle version."""
+
+    def __init__(self, path: str, version: int, meta: dict,
+                 predictor: Callable,
+                 resolver: Optional[HostRowResolver] = None):
+        self.path = path
+        self.version = int(version)
+        self.meta = meta
+        self._predictor = predictor
+        self._resolver = resolver
+
+    @property
+    def batch_polymorphic(self) -> bool:
+        return bool(self.meta.get("batch_polymorphic", False))
+
+    @property
+    def static_batch_size(self) -> Optional[int]:
+        """The one batch size a non-polymorphic artifact serves."""
+        if self.batch_polymorphic:
+            return None
+        return int(self.meta.get("batch_size", 0)) or None
+
+    def predict(self, features):
+        if self._resolver is not None:
+            features = self._resolver.resolve(features)
+        return _np_features(self._predictor(features))
+
+
+def load_served_model(bundle_dir: str, model=None,
+                      row_tables: Optional[Dict] = None,
+                      row_service_addr: str = "") -> ServedModel:
+    """Load one bundle directory into a ``ServedModel``.
+
+    ``row_tables`` / ``row_service_addr``: the row source for bundles
+    exported in row-service mode (``metadata.host_serving``); exactly
+    one is required for those, ignored for dense bundles. ``model`` is
+    the flax-module fallback for non-self-contained dense bundles."""
+    with open(os.path.join(bundle_dir, META_FILE)) as f:
+        meta = json.load(f)
+    resolver = None
+    host_serving = meta.get("host_serving")
+    if host_serving:
+        if row_tables is None:
+            if not row_service_addr:
+                raise ValueError(
+                    f"bundle {bundle_dir} was exported in row-service "
+                    "mode; pass --row_service_addr (or row_tables) so "
+                    "the server can pull rows at inference time"
+                )
+            row_tables = make_row_service_tables(
+                row_service_addr, host_serving
+            )
+        resolver = HostRowResolver(
+            host_serving, row_tables,
+            feature_signature=meta.get("feature_signature"),
+        )
+    predictor = load_predictor(bundle_dir, model=model)
+    return ServedModel(
+        bundle_dir, meta.get("model_version", 0), meta, predictor,
+        resolver,
+    )
+
+
+class ModelStore:
+    """Version discovery + atomic hot reload + N-version rollback.
+
+    ``root`` is either a directory of bundle subdirectories or itself a
+    bundle. ``loader`` maps a bundle path to a ``ServedModel`` (the
+    default binds ``load_served_model`` with this store's row source /
+    fallback module). ``start_polling`` swaps in newer versions as the
+    exporter publishes them; ``current()`` is what the serving thread
+    reads — one attribute load, no lock on the hot path."""
+
+    def __init__(self, root: str, model=None,
+                 row_tables: Optional[Dict] = None,
+                 row_service_addr: str = "",
+                 retain: int = 1,
+                 poll_seconds: float = 2.0,
+                 loader: Optional[Callable[[str], ServedModel]] = None,
+                 metrics_registry=None):
+        self.root = root
+        self._retain = max(0, int(retain))
+        self._poll_seconds = float(poll_seconds)
+        if loader is None:
+            def loader(path):
+                return load_served_model(
+                    path, model=model, row_tables=row_tables,
+                    row_service_addr=row_service_addr,
+                )
+        self._loader = loader
+        self._lock = threading.Lock()
+        self._current: Optional[ServedModel] = None
+        self._previous: List[ServedModel] = []  # newest last
+        self._rejected = set()  # rolled-back versions (operator pin)
+        # Load failures back off instead of pinning: a row-service
+        # bundle can fail to load while its row plane restarts, and
+        # re-exporting the same checkpoint reuses the same version
+        # number — permanent rejection would wedge until a server
+        # restart. {version: (consecutive failures, next retry time)}.
+        self._load_failures: Dict[int, tuple] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+        from elasticdl_tpu.observability import default_registry
+
+        registry = metrics_registry or default_registry()
+        self._m_version = registry.gauge(
+            "serving_model_version",
+            "Model version currently served",
+        )
+        self._m_reloads = registry.counter(
+            "serving_model_reloads_total",
+            "Successful hot reloads", labelnames=("result",),
+        )
+        self._m_load_seconds = registry.histogram(
+            "serving_model_load_seconds",
+            "Bundle load (deserialize + warm) latency",
+        )
+
+    # ---- discovery -----------------------------------------------------
+
+    def _candidates(self) -> List[str]:
+        """Complete bundle dirs under root (root itself counts)."""
+        if os.path.exists(os.path.join(self.root, META_FILE)):
+            return [self.root]
+        if not os.path.isdir(self.root):
+            return []
+        out = []
+        for name in sorted(os.listdir(self.root)):
+            path = os.path.join(self.root, name)
+            if os.path.exists(os.path.join(path, META_FILE)):
+                out.append(path)
+        return out
+
+    @staticmethod
+    def _bundle_version(path: str) -> int:
+        try:
+            with open(os.path.join(path, META_FILE)) as f:
+                return int(json.load(f).get("model_version", 0))
+        except (OSError, ValueError, json.JSONDecodeError):
+            return -1
+
+    def newest_available(self):
+        """(version, path) of the newest complete bundle that is
+        neither rolled back nor inside its failure backoff window."""
+        best = None
+        for path in self._candidates():
+            version = self._bundle_version(path)
+            if version < 0 or version in self._rejected:
+                continue
+            _, next_retry = self._load_failures.get(version, (0, 0.0))
+            if time.monotonic() < next_retry:
+                continue
+            if best is None or version > best[0]:
+                best = (version, path)
+        return best
+
+    # ---- load / swap / rollback ---------------------------------------
+
+    def current(self) -> Optional[ServedModel]:
+        return self._current
+
+    def versions(self) -> List[int]:
+        """Resident versions, current last."""
+        with self._lock:
+            out = [m.version for m in self._previous]
+            if self._current is not None:
+                out.append(self._current.version)
+            return out
+
+    def load_initial(self) -> ServedModel:
+        """Blocking first load (the server refuses to start empty)."""
+        found = self.newest_available()
+        if found is None:
+            raise FileNotFoundError(
+                f"no complete serving bundle under {self.root}"
+            )
+        self._swap(self._load(found[1]))
+        return self._current
+
+    def _load(self, path: str) -> ServedModel:
+        t0 = time.monotonic()
+        model = self._loader(path)
+        self._m_load_seconds.observe(time.monotonic() - t0)
+        return model
+
+    def _swap(self, model: ServedModel):
+        with self._lock:
+            if self._current is not None:
+                self._previous.append(self._current)
+                if self._retain:
+                    del self._previous[:-self._retain]
+                else:
+                    self._previous.clear()
+            self._current = model
+        self._m_version.set(model.version)
+        logger.info(
+            "Serving model version %d from %s", model.version, model.path
+        )
+
+    def rollback(self) -> ServedModel:
+        """Swap back to the most recent retained version; the dropped
+        version is pinned out of future polls until a NEWER export
+        appears (a fixed re-export gets a new version number)."""
+        with self._lock:
+            if not self._previous:
+                raise RuntimeError("no previous version retained")
+            bad = self._current
+            self._current = self._previous.pop()
+            self._rejected.add(bad.version)
+            current = self._current
+        self._m_version.set(current.version)
+        self._m_reloads.labels(result="rollback").inc()
+        logger.warning(
+            "Rolled back serving model %d -> %d",
+            bad.version, current.version,
+        )
+        return current
+
+    def poll_once(self) -> bool:
+        """One discovery+reload cycle; True if a new version went live."""
+        found = self.newest_available()
+        if found is None:
+            return False
+        version, path = found
+        current = self._current
+        if current is not None and version <= current.version:
+            return False
+        try:
+            model = self._load(path)
+        except Exception:
+            failures, _ = self._load_failures.get(version, (0, 0.0))
+            failures += 1
+            backoff = min(
+                self._poll_seconds * (2 ** failures), 300.0
+            )
+            self._load_failures[version] = (
+                failures, time.monotonic() + backoff
+            )
+            logger.exception(
+                "Failed to load bundle %s (version %d, attempt %d); "
+                "retrying in %.0fs",
+                path, version, failures, backoff,
+            )
+            self._m_reloads.labels(result="error").inc()
+            return False
+        self._load_failures.pop(version, None)
+        self._swap(model)
+        self._m_reloads.labels(result="ok").inc()
+        return True
+
+    def _poll_loop(self):
+        while not self._stop.wait(self._poll_seconds):
+            try:
+                self.poll_once()
+            except Exception:
+                logger.exception("model store poll failed")
+
+    def start_polling(self) -> "ModelStore":
+        if self._thread is None:
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._poll_loop, daemon=True,
+                name="model-store-poll",
+            )
+            self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

@@ -1,0 +1,691 @@
+"""Online inference server: adaptive micro-batching over a bundle.
+
+The reference handed its SavedModel to TF Serving; this is the
+TPU-native equivalent over ``serving/export.py`` bundles, shaped like
+the batched-actor serving loop in Podracer (arxiv 2104.06272): request
+handler threads enqueue, ONE batcher thread drains, so the compiled
+predict function always sees a single in-flight program.
+
+- **Transport**: stdlib ``ThreadingHTTPServer``. ``POST /v1/predict``
+  takes msgpack (``application/x-msgpack``, the framework's tensor
+  serde — ndarrays ride raw buffers) or JSON (lists, coerced to the
+  bundle's recorded feature signature). ``GET /v1/models`` lists
+  resident versions; ``POST /v1/models/rollback`` swaps back one
+  version; ``/metrics`` + ``/healthz`` expose the process registry so
+  the serving families land on the SAME endpoint the rest of the
+  telemetry plane uses (docs/observability.md).
+- **Adaptive micro-batching**: requests accumulate until either
+  ``max_batch_size`` examples are waiting or ``batch_deadline_ms`` has
+  passed since the OLDEST queued request arrived — flush on size fills
+  the device at load, flush on deadline bounds p99 when idle.
+- **Bucketed shapes**: the combined batch pads up to a power-of-two
+  bucket (clamped to ``max_batch_size``; non-polymorphic bundles pad
+  to their one exported batch size), so the artifact compiles
+  O(log max_batch) programs total instead of one per occupancy.
+- **Backpressure**: the request queue is bounded; when it saturates,
+  new requests are shed immediately with 429 (the client's signal to
+  back off) rather than queued into a latency cliff, and the depth
+  gauge + shed counter make the saturation visible on ``/metrics``.
+"""
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional
+
+import numpy as np
+
+from elasticdl_tpu.common.log_utils import get_logger
+
+logger = get_logger("serving")
+
+MSGPACK_CONTENT_TYPE = "application/x-msgpack"
+
+# Batch-occupancy buckets: powers of two up to the largest batch any
+# config uses (the registry default buckets are latency-shaped).
+_BATCH_BUCKETS = tuple(float(2 ** i) for i in range(13))
+
+
+def _tree_leaves_equal_structure(a, b) -> bool:
+    import jax
+
+    return (jax.tree.structure(a) == jax.tree.structure(b))
+
+
+def _batch_dim(features) -> int:
+    import jax
+
+    leaves = jax.tree.leaves(features)
+    if not leaves or np.ndim(leaves[0]) == 0:
+        raise ValueError("features must carry a leading batch dim")
+    n = int(np.shape(leaves[0])[0])
+    for leaf in leaves:
+        if np.ndim(leaf) == 0 or int(np.shape(leaf)[0]) != n:
+            raise ValueError(
+                "all feature leaves must share the leading batch dim"
+            )
+    return n
+
+
+def _concat_trees(trees):
+    import jax
+
+    return jax.tree.map(
+        lambda *xs: np.concatenate([np.asarray(x) for x in xs], axis=0),
+        *trees,
+    )
+
+
+def _pad_tree(features, target: int, n: int):
+    """Pad the batch dim from ``n`` to ``target`` by repeating row 0 —
+    real vocabulary ids, so padding never widens the unique-id set a
+    sparse resolver pulls."""
+    import jax
+
+    if target == n:
+        return features
+
+    def pad(x):
+        x = np.asarray(x)
+        reps = np.repeat(x[:1], target - n, axis=0)
+        return np.concatenate([x, reps], axis=0)
+
+    return jax.tree.map(pad, features)
+
+
+def _slice_tree(outputs, lo: int, hi: int):
+    import jax
+
+    return jax.tree.map(lambda x: np.asarray(x)[lo:hi], outputs)
+
+
+def _coerce_signature(features, signature):
+    """Cast a JSON payload (nested lists) onto the bundle's recorded
+    dtypes; msgpack payloads arrive typed and pass through."""
+    import jax
+
+    if signature is None:
+        return jax.tree.map(np.asarray, features)
+
+    def leaf(x, spec):
+        arr = np.asarray(x)
+        if isinstance(spec, dict) and "dtype" in spec:
+            arr = arr.astype(spec["dtype"])
+        return arr
+
+    return jax.tree.map(
+        leaf, features, signature,
+        is_leaf=lambda x: not isinstance(x, dict),
+    )
+
+
+class _Request:
+    __slots__ = ("features", "n", "event", "outputs", "error",
+                 "version", "enqueued_at", "cancelled")
+
+    def __init__(self, features, n: int):
+        self.features = features
+        self.n = n
+        self.event = threading.Event()
+        self.outputs = None
+        self.error: Optional[Exception] = None
+        self.version = 0
+        self.enqueued_at = time.monotonic()
+        # Set when the submitting handler gave up (timeout): the
+        # batcher drops it instead of computing dead work — under
+        # sustained overload that dead work is what keeps the server
+        # from ever recovering goodput.
+        self.cancelled = False
+
+
+class BatchingPredictor:
+    """The queue + batcher half of the server, transport-agnostic (the
+    HTTP layer and tests drive it directly). ``submit`` blocks the
+    calling handler thread until its slice of a flushed batch returns;
+    ``QueueFullError`` is the load-shed signal (HTTP 429)."""
+
+    class QueueFullError(RuntimeError):
+        pass
+
+    def __init__(self, store, max_batch_size: int = 64,
+                 batch_deadline_ms: float = 5.0,
+                 max_queue: int = 256,
+                 metrics_registry=None):
+        self._store = store
+        self.max_batch_size = int(max_batch_size)
+        self.batch_deadline = float(batch_deadline_ms) / 1e3
+        self.max_queue = int(max_queue)
+        self._queue: List[_Request] = []
+        self._cond = threading.Condition()
+        self._stop = False
+        self._thread: Optional[threading.Thread] = None
+
+        from elasticdl_tpu.observability import default_registry
+
+        registry = metrics_registry or default_registry()
+        self._m_requests = registry.counter(
+            "serving_requests_total",
+            "Predict requests by HTTP status code",
+            labelnames=("code",),
+        )
+        self._m_latency = registry.histogram(
+            "serving_request_seconds",
+            "End-to-end predict latency (enqueue to reply)",
+        )
+        self._m_batch_seconds = registry.histogram(
+            "serving_batch_seconds",
+            "Predict-call latency per flushed batch",
+        )
+        self._m_batch_size = registry.histogram(
+            "serving_batch_occupancy",
+            "Real examples per flushed batch (pre-padding)",
+            buckets=_BATCH_BUCKETS,
+        )
+        self._m_flushes = registry.counter(
+            "serving_batch_flushes_total",
+            "Batch flushes by trigger",
+            labelnames=("reason",),
+        )
+        self._m_shed = registry.counter(
+            "serving_load_shed_total",
+            "Requests shed with 429 because the queue was full",
+        )
+        self._m_padded = registry.counter(
+            "serving_padded_examples_total",
+            "Padding examples added to reach the shape bucket",
+        )
+        self._m_errors = registry.counter(
+            "serving_batch_errors_total",
+            "Batches whose predict call raised",
+        )
+        # weakref: the registry is process-global and outlives
+        # predictors; a strong closure would pin every discarded
+        # predictor (and through its store, every resident model's
+        # params) for the process life — same reasoning as the
+        # host-engine rows gauge (embedding/host_engine.py).
+        import weakref
+
+        self_ref = weakref.ref(self)
+
+        def _queue_depth() -> float:
+            predictor = self_ref()
+            return float(len(predictor._queue)) if predictor else 0.0
+
+        registry.gauge(
+            "serving_queue_depth",
+            "Requests waiting for a batch slot",
+        ).set_function(_queue_depth)
+
+    # ---- client side ---------------------------------------------------
+
+    def submit(self, features, timeout: float = 30.0):
+        """Enqueue one request; returns (outputs, model_version)."""
+        model = self._store.current()
+        if model is None:
+            raise RuntimeError("no model loaded")
+        n = _batch_dim(features)
+        limit = max(
+            self._effective_limit(), model.static_batch_size or 0
+        )
+        if n > limit:
+            raise ValueError(
+                f"request batch {n} exceeds the server's max batch "
+                f"size {limit}; split the request"
+            )
+        request = _Request(features, n)
+        with self._cond:
+            if len(self._queue) >= self.max_queue:
+                self._m_shed.inc()
+                raise self.QueueFullError(
+                    f"queue full ({self.max_queue} requests waiting)"
+                )
+            self._queue.append(request)
+            self._cond.notify_all()
+        if not request.event.wait(timeout):
+            request.cancelled = True
+            raise TimeoutError("predict timed out")
+        self._m_latency.observe(time.monotonic() - request.enqueued_at)
+        if request.error is not None:
+            raise request.error
+        return request.outputs, request.version
+
+    # ---- batcher side --------------------------------------------------
+
+    def _effective_limit(self) -> int:
+        """Flush/pad ceiling: a non-polymorphic bundle caps the batch
+        at its ONE exported size regardless of the configured max."""
+        model = self._store.current()
+        static = model.static_batch_size if model is not None else None
+        if static:
+            return min(self.max_batch_size, static)
+        return self.max_batch_size
+
+    def _take_batch(self) -> List[_Request]:
+        """Block until a flushable batch exists, then pop it. Flush
+        when the queued examples reach the batch limit OR the oldest
+        request has waited batch_deadline."""
+        with self._cond:
+            while True:
+                if self._stop:
+                    return []
+                if self._queue:
+                    # Purge abandoned requests first: their handlers
+                    # already returned 504 and nobody reads the result.
+                    self._queue = [
+                        r for r in self._queue if not r.cancelled
+                    ]
+                    if not self._queue:
+                        continue
+                    limit = self._effective_limit()
+                    oldest = self._queue[0].enqueued_at
+                    deadline = oldest + self.batch_deadline
+                    total = 0
+                    take = 0
+                    for request in self._queue:
+                        if total + request.n > limit:
+                            break
+                        total += request.n
+                        take += 1
+                    if take == 0:
+                        # Head request alone exceeds the limit (only
+                        # possible when a static bundle's batch size
+                        # exceeds max_batch_size): it flushes alone —
+                        # the pad target is the static size anyway.
+                        take, total = 1, self._queue[0].n
+                    full = (
+                        total >= limit
+                        or (take < len(self._queue) and take > 0)
+                    )
+                    now = time.monotonic()
+                    if full or now >= deadline:
+                        batch = self._queue[:take]
+                        del self._queue[:take]
+                        self._m_flushes.labels(
+                            reason="size" if full else "deadline"
+                        ).inc()
+                        return batch
+                    self._cond.wait(timeout=deadline - now)
+                else:
+                    self._cond.wait(timeout=0.1)
+
+    @staticmethod
+    def bucket_batch(n: int, limit: int) -> int:
+        """Padded batch size: next power of two >= n, clamped to the
+        limit (so the top bucket is the configured max, not its
+        power-of-two ceiling)."""
+        bucket = 1
+        while bucket < n:
+            bucket *= 2
+        return min(bucket, max(limit, n))
+
+    def _run_batch(self, batch: List[_Request]):
+        model = self._store.current()
+        total = sum(r.n for r in batch)
+        try:
+            structure0 = batch[0].features
+            for request in batch[1:]:
+                if not _tree_leaves_equal_structure(
+                    structure0, request.features
+                ):
+                    raise ValueError(
+                        "requests in one batch disagree on feature "
+                        "structure"
+                    )
+            features = _concat_trees([r.features for r in batch])
+            if model.static_batch_size:
+                target = model.static_batch_size
+            else:
+                target = self.bucket_batch(total, self._effective_limit())
+            features = _pad_tree(features, target, total)
+            self._m_padded.inc(target - total)
+            t0 = time.monotonic()
+            outputs = model.predict(features)
+            self._m_batch_seconds.observe(time.monotonic() - t0)
+            self._m_batch_size.observe(total)
+            lo = 0
+            for request in batch:
+                request.outputs = _slice_tree(
+                    outputs, lo, lo + request.n
+                )
+                request.version = model.version
+                lo += request.n
+        except Exception as exc:
+            self._m_errors.inc()
+            if len(batch) > 1:
+                # Isolate the poison request: one bad payload (wrong
+                # structure, stray dtype) must not 500 the innocent
+                # requests sharing its flush.
+                for request in batch:
+                    self._run_batch([request])
+                return
+            for request in batch:
+                request.error = exc
+        finally:
+            for request in batch:
+                if not request.event.is_set():
+                    request.event.set()
+
+    def _loop(self):
+        while True:
+            batch = self._take_batch()
+            if not batch:
+                return
+            self._run_batch(batch)
+
+    def start(self) -> "BatchingPredictor":
+        if self._thread is None:
+            self._stop = False
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True, name="serving-batcher"
+            )
+            self._thread.start()
+        return self
+
+    def stop(self):
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def record_status(self, code: int):
+        self._m_requests.labels(code=str(code)).inc()
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_ref = None  # type: Optional[InferenceServer]
+
+    protocol_version = "HTTP/1.1"
+
+    def _reply(self, code: int, body: bytes, content_type: str):
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _reply_error(self, code: int, message: str, as_msgpack: bool):
+        srv = type(self).server_ref
+        srv.predictor.record_status(code)
+        if as_msgpack:
+            from elasticdl_tpu.common import tensor_utils
+
+            body = tensor_utils.dumps({"error": message})
+            self._reply(code, body, MSGPACK_CONTENT_TYPE)
+        else:
+            body = json.dumps({"error": message}).encode("utf-8")
+            self._reply(code, body, "application/json")
+
+    def do_GET(self):  # noqa: N802 (BaseHTTPRequestHandler API)
+        srv = type(self).server_ref
+        path = self.path.split("?", 1)[0]
+        if path == "/metrics":
+            from elasticdl_tpu.observability import render_prometheus
+
+            body = render_prometheus(srv.registry.snapshot())
+            self._reply(
+                200, body.encode("utf-8"),
+                "text/plain; version=0.0.4; charset=utf-8",
+            )
+        elif path == "/healthz":
+            ok = srv.store.current() is not None
+            self._reply(
+                200 if ok else 503,
+                b"ok\n" if ok else b"no model\n",
+                "text/plain; charset=utf-8",
+            )
+        elif path == "/v1/models":
+            current = srv.store.current()
+            body = json.dumps({
+                "versions": srv.store.versions(),
+                "current": current.version if current else None,
+                "meta": current.meta if current else None,
+            }).encode("utf-8")
+            self._reply(200, body, "application/json")
+        else:
+            self.send_error(404, "try /v1/predict, /v1/models, /metrics")
+
+    def do_POST(self):  # noqa: N802
+        srv = type(self).server_ref
+        path = self.path.split("?", 1)[0]
+        if path == "/v1/models/rollback":
+            try:
+                model = srv.store.rollback()
+            except RuntimeError as exc:
+                self._reply_error(409, str(exc), as_msgpack=False)
+                return
+            self._reply(
+                200,
+                json.dumps({"current": model.version}).encode("utf-8"),
+                "application/json",
+            )
+            return
+        if path != "/v1/predict":
+            self.send_error(404, "POST /v1/predict")
+            return
+        length = int(self.headers.get("Content-Length", 0))
+        raw = self.rfile.read(length)
+        as_msgpack = self.headers.get(
+            "Content-Type", ""
+        ).startswith(MSGPACK_CONTENT_TYPE)
+        try:
+            if as_msgpack:
+                from elasticdl_tpu.common import tensor_utils
+
+                payload = tensor_utils.loads(raw)
+            else:
+                payload = json.loads(raw.decode("utf-8"))
+            features = payload["features"]
+            model = srv.store.current()
+            if model is not None:
+                # Coerce BOTH transports onto the recorded signature:
+                # JSON arrives as lists, and a msgpack client's stray
+                # float64/int64 leaf would otherwise promote the whole
+                # concatenated batch and fail the artifact's aval check.
+                features = _coerce_signature(
+                    features, model.meta.get("feature_signature")
+                )
+        except Exception as exc:
+            self._reply_error(
+                400, f"bad request: {exc}", as_msgpack=as_msgpack
+            )
+            return
+        try:
+            outputs, version = srv.predictor.submit(
+                features, timeout=srv.request_timeout
+            )
+        except BatchingPredictor.QueueFullError as exc:
+            self._reply_error(429, str(exc), as_msgpack=as_msgpack)
+            return
+        except TimeoutError as exc:
+            self._reply_error(504, str(exc), as_msgpack=as_msgpack)
+            return
+        except (ValueError, TypeError) as exc:
+            self._reply_error(400, str(exc), as_msgpack=as_msgpack)
+            return
+        except RuntimeError as exc:
+            self._reply_error(503, str(exc), as_msgpack=as_msgpack)
+            return
+        except Exception as exc:
+            self._reply_error(
+                500, f"{type(exc).__name__}: {exc}", as_msgpack=as_msgpack
+            )
+            return
+        srv.predictor.record_status(200)
+        if as_msgpack:
+            from elasticdl_tpu.common import tensor_utils
+
+            body = tensor_utils.dumps(
+                {"predictions": outputs, "model_version": version}
+            )
+            self._reply(200, body, MSGPACK_CONTENT_TYPE)
+        else:
+            import jax
+
+            body = json.dumps({
+                "predictions": jax.tree.map(
+                    lambda x: np.asarray(x).tolist(), outputs
+                ),
+                "model_version": version,
+            }).encode("utf-8")
+            self._reply(200, body, "application/json")
+
+    def log_message(self, fmt, *args):
+        logger.debug("serving http: " + fmt, *args)
+
+
+class InferenceServer:
+    """The assembled serving process: store + batcher + HTTP front.
+
+    ``port=0`` binds an ephemeral port (tests/bench); ``start()``
+    returns immediately (daemon threads), ``wait()`` blocks for a
+    process-main lifetime."""
+
+    def __init__(self, store, max_batch_size: int = 64,
+                 batch_deadline_ms: float = 5.0, max_queue: int = 256,
+                 port: int = 8500, host: str = "",
+                 request_timeout: float = 30.0,
+                 metrics_registry=None):
+        from elasticdl_tpu.observability import default_registry
+
+        self.store = store
+        self.registry = metrics_registry or default_registry()
+        self.predictor = BatchingPredictor(
+            store, max_batch_size=max_batch_size,
+            batch_deadline_ms=batch_deadline_ms, max_queue=max_queue,
+            metrics_registry=self.registry,
+        )
+        self.request_timeout = float(request_timeout)
+        self._host = host
+        self._requested_port = int(port)
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1] if self._httpd else 0
+
+    def start(self) -> "InferenceServer":
+        self.predictor.start()
+        handler = type("_BoundHandler", (_Handler,), {
+            "server_ref": self,
+        })
+        self._httpd = ThreadingHTTPServer(
+            (self._host, self._requested_port), handler,
+            bind_and_activate=False,
+        )
+        # socketserver's default listen backlog (5) SYN-drops a
+        # client fleet connecting at once — each drop is a ~1s
+        # retransmit stall that reads as a fake p99 cliff.
+        self._httpd.request_queue_size = 128
+        self._httpd.server_bind()
+        self._httpd.server_activate()
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="serving-http",
+        )
+        self._thread.start()
+        logger.info("Inference server on port %d", self.port)
+        return self
+
+    def wait(self):
+        self._thread.join()
+
+    def stop(self):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        self.predictor.stop()
+        self.store.stop()
+
+
+def main(argv=None) -> int:
+    """``elasticdl_tpu serve`` entry: serve an export directory.
+
+    The minimal deployment is one process per replica behind any HTTP
+    load balancer; the bundle directory is the handoff from training
+    (``SavedModelExporter`` / ``export_serving_bundle``)."""
+    import argparse
+
+    parser = argparse.ArgumentParser("elasticdl_tpu-serve")
+    parser.add_argument(
+        "--model_dir", required=True,
+        help="A bundle directory, or a directory of versioned bundle "
+             "subdirectories (hot reload polls it)",
+    )
+    parser.add_argument("--port", type=int, default=8500)
+    parser.add_argument("--max_batch_size", type=int, default=64)
+    parser.add_argument(
+        "--batch_deadline_ms", type=float, default=5.0,
+        help="Max time the oldest queued request waits before a "
+             "partial batch flushes",
+    )
+    parser.add_argument("--max_queue", type=int, default=256,
+                        help="Queued requests beyond this shed with 429")
+    parser.add_argument(
+        "--row_service_addr", default="",
+        help="Comma list of HostRowService shard addresses — required "
+             "for bundles exported in row-service mode (host_id_keys)",
+    )
+    parser.add_argument(
+        "--model_zoo", default="",
+        help="Zoo path for non-self-contained bundles (params-only "
+             "fallback re-applies the flax module)",
+    )
+    parser.add_argument("--poll_seconds", type=float, default=2.0)
+    parser.add_argument("--retain_versions", type=int, default=1)
+    parser.add_argument("--request_timeout", type=float, default=30.0)
+    args = parser.parse_args(argv)
+
+    from elasticdl_tpu.serving.model_store import ModelStore
+
+    model = None
+    if args.model_zoo:
+        import os
+
+        from elasticdl_tpu.core.model_spec import load_model_zoo_module
+        from elasticdl_tpu.serving.export import META_FILE
+
+        meta_path = os.path.join(args.model_dir, META_FILE)
+        model_def = ""
+        if os.path.exists(meta_path):
+            with open(meta_path) as f:
+                model_def = json.load(f).get("model_def", "")
+        if model_def:
+            module, model_fn_name = load_model_zoo_module(
+                args.model_zoo, model_def
+            )
+            model = getattr(module, model_fn_name)()
+    store = ModelStore(
+        args.model_dir, model=model,
+        row_service_addr=args.row_service_addr,
+        retain=args.retain_versions,
+        poll_seconds=args.poll_seconds,
+    )
+    store.load_initial()
+    store.start_polling()
+    server = InferenceServer(
+        store,
+        max_batch_size=args.max_batch_size,
+        batch_deadline_ms=args.batch_deadline_ms,
+        max_queue=args.max_queue,
+        port=args.port,
+        request_timeout=args.request_timeout,
+    ).start()
+    logger.info(
+        "Serving %s on :%d (max_batch=%d, deadline=%.1fms)",
+        args.model_dir, server.port, args.max_batch_size,
+        args.batch_deadline_ms,
+    )
+    server.wait()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -1,0 +1,738 @@
+"""Serving plane: batching semantics, hot reload, load shed, sparse e2e.
+
+The batcher-level tests drive ``BatchingPredictor`` directly with a
+recording fake predictor (no compile cost); the end-to-end tests run
+real exported bundles through the HTTP front, including a DeepFM-style
+host-tier bundle whose rows resolve through an in-process
+``HostRowService`` at inference time (the reference's PS-backed
+serving shape, online).
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from elasticdl_tpu.observability import MetricsRegistry
+from elasticdl_tpu.serving.model_store import (
+    ModelStore,
+    ServedModel,
+    load_served_model,
+)
+from elasticdl_tpu.serving.server import BatchingPredictor, InferenceServer
+
+FEATURE_DIM = 6
+
+
+class RecordingPredictor:
+    """Fake model: output = features @ 1s; records every batch shape
+    it is called with (the 'compile log')."""
+
+    def __init__(self, delay: float = 0.0):
+        self.shapes = []
+        self.delay = delay
+        self.calls = 0
+
+    def __call__(self, features):
+        features = np.asarray(features)
+        self.shapes.append(features.shape)
+        self.calls += 1
+        if self.delay:
+            time.sleep(self.delay)
+        return features.sum(axis=1, keepdims=True)
+
+
+class FakeStore:
+    def __init__(self, predictor, version=1, meta=None):
+        self._model = ServedModel(
+            "fake", version, meta or {"batch_polymorphic": True},
+            predictor,
+        )
+
+    def current(self):
+        return self._model
+
+    def versions(self):
+        return [self._model.version]
+
+    def stop(self):
+        pass
+
+
+def _features(n):
+    return np.ones((n, FEATURE_DIM), np.float32)
+
+
+def _submit_many(predictor, sizes):
+    """Concurrent submits of the given batch sizes; returns outputs."""
+    results = [None] * len(sizes)
+    errors = []
+
+    def call(i, n):
+        try:
+            results[i], _ = predictor.submit(_features(n))
+        except Exception as exc:  # collected for assertions
+            errors.append(exc)
+
+    threads = [
+        threading.Thread(target=call, args=(i, n))
+        for i, n in enumerate(sizes)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return results, errors
+
+
+def _flush_counts(registry):
+    for family in registry.snapshot()["families"]:
+        if family["name"] == "edl_tpu_serving_batch_flushes_total":
+            return {
+                s["labels"][0]: s["value"] for s in family["series"]
+            }
+    return {}
+
+
+class TestBatchingSemantics:
+    def test_deadline_flush_partial_batch(self):
+        """A lone request must not wait for a full batch: it flushes
+        when the deadline expires, and not (much) before."""
+        registry = MetricsRegistry()
+        fake = RecordingPredictor()
+        predictor = BatchingPredictor(
+            FakeStore(fake), max_batch_size=64,
+            batch_deadline_ms=120.0, metrics_registry=registry,
+        ).start()
+        try:
+            t0 = time.monotonic()
+            out, _ = predictor.submit(_features(3))
+            elapsed = time.monotonic() - t0
+            assert out.shape == (3, 1)
+            # Flushed by deadline: waited at least ~the window.
+            assert elapsed >= 0.09
+            assert _flush_counts(registry).get("deadline", 0) == 1
+        finally:
+            predictor.stop()
+
+    def test_size_flush_preempts_deadline(self):
+        """Once max_batch_size examples wait, the flush is immediate
+        even under a long deadline."""
+        registry = MetricsRegistry()
+        fake = RecordingPredictor()
+        predictor = BatchingPredictor(
+            FakeStore(fake), max_batch_size=8,
+            batch_deadline_ms=10_000.0, metrics_registry=registry,
+        ).start()
+        try:
+            t0 = time.monotonic()
+            results, errors = _submit_many(predictor, [4, 4])
+            elapsed = time.monotonic() - t0
+            assert not errors
+            assert elapsed < 5.0  # nowhere near the 10s deadline
+            assert [r.shape for r in results] == [(4, 1), (4, 1)]
+            assert _flush_counts(registry).get("size", 0) >= 1
+        finally:
+            predictor.stop()
+
+    def test_batch_splits_outputs_per_request(self):
+        fake = RecordingPredictor()
+        predictor = BatchingPredictor(
+            FakeStore(fake), max_batch_size=16, batch_deadline_ms=30.0,
+            metrics_registry=MetricsRegistry(),
+        ).start()
+        try:
+            results, errors = _submit_many(predictor, [1, 2, 5])
+            assert not errors
+            assert [r.shape[0] for r in results] == [1, 2, 5]
+            # sum over FEATURE_DIM ones = FEATURE_DIM for every row
+            for r in results:
+                np.testing.assert_allclose(r, FEATURE_DIM)
+        finally:
+            predictor.stop()
+
+    def test_oversized_request_rejected(self):
+        predictor = BatchingPredictor(
+            FakeStore(RecordingPredictor()), max_batch_size=4,
+            metrics_registry=MetricsRegistry(),
+        ).start()
+        try:
+            with pytest.raises(ValueError, match="exceeds"):
+                predictor.submit(_features(5))
+        finally:
+            predictor.stop()
+
+
+class TestShapeBuckets:
+    def test_padded_shapes_reuse_buckets(self):
+        """Whatever occupancy mix arrives, the predictor only ever
+        sees power-of-two batch dims (clamped to max): a bounded
+        compiled-program set instead of one per occupancy."""
+        fake = RecordingPredictor()
+        predictor = BatchingPredictor(
+            FakeStore(fake), max_batch_size=16, batch_deadline_ms=1.0,
+            metrics_registry=MetricsRegistry(),
+        ).start()
+        try:
+            for sizes in ([1], [3], [5, 2], [7], [2, 2, 2], [16], [9]):
+                _, errors = _submit_many(predictor, sizes)
+                assert not errors
+            observed = {s[0] for s in fake.shapes}
+            allowed = {1, 2, 4, 8, 16}
+            assert observed <= allowed
+            # Distinct occupancies above collapsed into <= 5 shapes.
+            assert len(observed) < len(fake.shapes)
+        finally:
+            predictor.stop()
+
+    def test_static_bundle_pads_to_exported_size(self):
+        """A non-polymorphic bundle serves ONLY its exported batch
+        size: every call is padded to exactly that."""
+        fake = RecordingPredictor()
+        store = FakeStore(
+            fake, meta={"batch_polymorphic": False, "batch_size": 8}
+        )
+        predictor = BatchingPredictor(
+            store, max_batch_size=64, batch_deadline_ms=1.0,
+            metrics_registry=MetricsRegistry(),
+        ).start()
+        try:
+            _, errors = _submit_many(predictor, [1, 3])
+            assert not errors
+            assert {s[0] for s in fake.shapes} == {8}
+            with pytest.raises(ValueError, match="exceeds"):
+                predictor.submit(_features(9))
+        finally:
+            predictor.stop()
+
+
+class TestBatchIsolation:
+    def test_poison_request_does_not_fail_cobatched(self):
+        """A structurally bad request 400s alone; the valid request
+        sharing its flush still gets its predictions."""
+        fake = RecordingPredictor()
+        predictor = BatchingPredictor(
+            FakeStore(fake), max_batch_size=16, batch_deadline_ms=50.0,
+            metrics_registry=MetricsRegistry(),
+        ).start()
+        try:
+            results = {}
+            errors = {}
+
+            def good():
+                results["good"], _ = predictor.submit(_features(2))
+
+            def bad():
+                try:
+                    # Wrong structure: dict where the co-batched
+                    # request sends a bare array.
+                    predictor.submit({"a": _features(2)})
+                except Exception as exc:
+                    errors["bad"] = exc
+
+            threads = [
+                threading.Thread(target=good),
+                threading.Thread(target=bad),
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert results["good"].shape == (2, 1)
+            assert isinstance(errors["bad"], ValueError)
+        finally:
+            predictor.stop()
+
+
+def test_row_resolver_emits_traced_id_dtype():
+    """Bundles traced with int64 id features must receive int64
+    inverse maps (jax.export validates input dtypes strictly)."""
+    from elasticdl_tpu.serving.model_store import HostRowResolver
+
+    class Table:
+        def get(self, ids):
+            return np.zeros((len(ids), 4), np.float32)
+
+    resolver = HostRowResolver(
+        {"id_keys": {"tbl": "ids"}, "tables": {"tbl": 4}},
+        {"tbl": Table()},
+        feature_signature={"ids": {"shape": [None, 3],
+                                   "dtype": "int64"}},
+    )
+    out = resolver.resolve(
+        {"ids": np.array([[5, 5, 9]], np.int64)}
+    )
+    assert out["ids"].dtype == np.int64
+    assert out["__host_rows__:tbl"].shape == (8, 4)
+    # Default (no signature) stays int32.
+    resolver32 = HostRowResolver(
+        {"id_keys": {"tbl": "ids"}, "tables": {"tbl": 4}},
+        {"tbl": Table()},
+    )
+    out32 = resolver32.resolve(
+        {"ids": np.array([[5, 5, 9]], np.int64)}
+    )
+    assert out32["ids"].dtype == np.int32
+
+
+class TestLoadShedding:
+    def test_queue_saturation_sheds(self):
+        """With a slow model and a tiny queue, excess concurrent
+        requests shed instead of queueing unboundedly."""
+        registry = MetricsRegistry()
+        fake = RecordingPredictor(delay=0.2)
+        predictor = BatchingPredictor(
+            FakeStore(fake), max_batch_size=1, batch_deadline_ms=0.0,
+            max_queue=2, metrics_registry=registry,
+        ).start()
+        try:
+            results, errors = _submit_many(predictor, [1] * 10)
+            shed = [
+                e for e in errors
+                if isinstance(e, BatchingPredictor.QueueFullError)
+            ]
+            assert shed, "expected at least one shed request"
+            assert all(
+                isinstance(e, BatchingPredictor.QueueFullError)
+                for e in errors
+            )
+            served = [r for r in results if r is not None]
+            assert len(served) + len(shed) == 10
+            snapshot = {
+                f["name"]: f
+                for f in registry.snapshot()["families"]
+            }
+            assert snapshot[
+                "edl_tpu_serving_load_shed_total"
+            ]["series"][0]["value"] == len(shed)
+            # Queue-depth gauge is wired (pull-time callback).
+            assert "edl_tpu_serving_queue_depth" in snapshot
+        finally:
+            predictor.stop()
+
+
+# ---- end-to-end over real bundles -----------------------------------
+
+
+def _export_dense_bundle(tmpdir, seed=0, step=0):
+    import flax.linen as nn
+    import optax
+
+    from elasticdl_tpu.core.train_state import init_train_state
+    from elasticdl_tpu.serving.export import export_serving_bundle
+
+    class Tiny(nn.Module):
+        @nn.compact
+        def __call__(self, x, training=False):
+            return nn.Dense(3)(x)
+
+    model = Tiny()
+    batch = {
+        "features": np.random.RandomState(0)
+        .rand(4, FEATURE_DIM).astype(np.float32),
+        "labels": np.zeros((4,), np.int32),
+        "mask": np.ones((4,), np.float32),
+    }
+    state = init_train_state(model, optax.sgd(0.1), batch, seed=seed)
+    state = state.replace(step=step)
+    export_serving_bundle(
+        str(tmpdir), model, state, batch_example=batch, model_def="tiny"
+    )
+    return model, state
+
+
+def _post(port, payload, path="/v1/predict", msgpack=True):
+    import urllib.error
+    import urllib.request
+
+    from elasticdl_tpu.common import tensor_utils
+
+    if msgpack:
+        body = tensor_utils.dumps(payload)
+        content_type = "application/x-msgpack"
+    else:
+        body = json.dumps(payload).encode("utf-8")
+        content_type = "application/json"
+    request = urllib.request.Request(
+        f"http://localhost:{port}{path}", data=body,
+        headers={"Content-Type": content_type},
+    )
+    try:
+        with urllib.request.urlopen(request, timeout=30) as resp:
+            raw = resp.read()
+            return resp.status, (
+                tensor_utils.loads(raw) if msgpack
+                else json.loads(raw)
+            )
+    except urllib.error.HTTPError as exc:
+        exc.read()
+        return exc.code, None
+
+
+def _get(port, path):
+    import urllib.request
+
+    with urllib.request.urlopen(
+        f"http://localhost:{port}{path}", timeout=30
+    ) as resp:
+        return resp.read().decode("utf-8")
+
+
+class TestHotReload:
+    def test_version_swap_and_rollback(self, tmp_path):
+        model1, state1 = _export_dense_bundle(tmp_path / "v1", seed=0,
+                                              step=1)
+        store = ModelStore(str(tmp_path), retain=1, poll_seconds=0.05)
+        store.load_initial()
+        assert store.current().version == 1
+
+        # Publish version 2 (different params => different outputs).
+        model2, state2 = _export_dense_bundle(tmp_path / "v2", seed=7,
+                                              step=2)
+        assert store.poll_once() is True
+        assert store.current().version == 2
+        assert store.versions() == [1, 2]
+
+        x = np.ones((2, FEATURE_DIM), np.float32)
+        out2 = store.current().predict(x)
+        ref2 = model2.apply({"params": state2.params}, x, training=False)
+        np.testing.assert_allclose(out2, np.asarray(ref2), atol=1e-5)
+
+        # Rollback pins v2 out; the poller must NOT re-promote it.
+        store.rollback()
+        assert store.current().version == 1
+        assert store.poll_once() is False
+        assert store.current().version == 1
+        out1 = store.current().predict(x)
+        ref1 = model1.apply({"params": state1.params}, x, training=False)
+        np.testing.assert_allclose(out1, np.asarray(ref1), atol=1e-5)
+        # The two versions genuinely differ.
+        assert not np.allclose(out1, out2)
+
+    def test_incomplete_bundle_ignored(self, tmp_path):
+        _export_dense_bundle(tmp_path / "v1", step=1)
+        # A partially written bundle (no metadata.json yet) must be
+        # invisible to discovery.
+        os.makedirs(tmp_path / "v2")
+        (tmp_path / "v2" / "params.msgpack").write_bytes(b"partial")
+        store = ModelStore(str(tmp_path), poll_seconds=0.05)
+        store.load_initial()
+        assert store.poll_once() is False
+        assert store.current().version == 1
+
+    def test_reload_happens_off_serving_thread(self, tmp_path):
+        """Predictions keep flowing from the old version while the new
+        one loads: the swap is a reference assignment, not a pause."""
+        _export_dense_bundle(tmp_path / "v1", step=1)
+        store = ModelStore(str(tmp_path), poll_seconds=0.05)
+        store.load_initial()
+
+        slow_loaded = threading.Event()
+        release = threading.Event()
+        real_loader = store._loader
+
+        def slow_loader(path):
+            if path.endswith("v2"):
+                slow_loaded.set()
+                release.wait(timeout=10)
+            return real_loader(path)
+
+        store._loader = slow_loader
+        _export_dense_bundle(tmp_path / "v2", step=2)
+        poller = threading.Thread(target=store.poll_once, daemon=True)
+        poller.start()
+        assert slow_loaded.wait(timeout=10)
+        # Load in flight -> still serving v1.
+        assert store.current().version == 1
+        assert store.current().predict(
+            np.ones((1, FEATURE_DIM), np.float32)
+        ).shape == (1, 3)
+        release.set()
+        poller.join(timeout=10)
+        assert store.current().version == 2
+
+
+class TestHTTPEndToEnd:
+    @pytest.fixture
+    def served(self, tmp_path):
+        model, state = _export_dense_bundle(tmp_path / "v1", step=1)
+        store = ModelStore(str(tmp_path), poll_seconds=60)
+        store.load_initial()
+        server = InferenceServer(
+            store, max_batch_size=8, batch_deadline_ms=2.0, port=0
+        ).start()
+        yield server, model, state
+        server.stop()
+
+    def test_msgpack_and_json_predict(self, served):
+        server, model, state = served
+        x = np.random.RandomState(3).rand(3, FEATURE_DIM).astype(
+            np.float32
+        )
+        ref = np.asarray(
+            model.apply({"params": state.params}, x, training=False)
+        )
+        status, out = _post(server.port, {"features": x})
+        assert status == 200
+        np.testing.assert_allclose(out["predictions"], ref, atol=1e-5)
+        assert out["model_version"] == 1
+
+        status, out = _post(
+            server.port, {"features": x.tolist()}, msgpack=False
+        )
+        assert status == 200
+        np.testing.assert_allclose(
+            np.asarray(out["predictions"]), ref, atol=1e-4
+        )
+
+    def test_bad_request_is_400(self, served):
+        server, _, _ = served
+        status, _ = _post(server.port, {"nope": 1})
+        assert status == 400
+
+    def test_models_and_health_endpoints(self, served):
+        server, _, _ = served
+        info = json.loads(_get(server.port, "/v1/models"))
+        assert info["current"] == 1
+        assert info["meta"]["feature_signature"]["shape"] == [
+            None, FEATURE_DIM,
+        ]
+        assert _get(server.port, "/healthz") == "ok\n"
+
+    def test_metrics_families_exposed(self, served):
+        server, _, _ = served
+        _post(server.port, {
+            "features": np.ones((2, FEATURE_DIM), np.float32)
+        })
+        text = _get(server.port, "/metrics")
+        for family in (
+            "edl_tpu_serving_requests_total",
+            "edl_tpu_serving_request_seconds",
+            "edl_tpu_serving_batch_occupancy",
+            "edl_tpu_serving_queue_depth",
+            "edl_tpu_serving_model_version",
+        ):
+            assert family in text
+        assert 'edl_tpu_serving_requests_total{code="200"}' in text
+
+    def test_http_429_under_saturation(self, tmp_path):
+        fake = RecordingPredictor(delay=0.15)
+        store = FakeStore(
+            fake,
+            meta={
+                "batch_polymorphic": True,
+                "feature_signature": {
+                    "shape": [None, FEATURE_DIM], "dtype": "float32",
+                },
+            },
+        )
+        server = InferenceServer(
+            store, max_batch_size=1, batch_deadline_ms=0.0,
+            max_queue=1, port=0,
+        ).start()
+        try:
+            statuses = []
+            lock = threading.Lock()
+
+            def fire():
+                status, _ = _post(server.port, {
+                    "features": np.ones((1, FEATURE_DIM), np.float32)
+                })
+                with lock:
+                    statuses.append(status)
+
+            threads = [
+                threading.Thread(target=fire) for _ in range(8)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert 429 in statuses, statuses
+            assert 200 in statuses, statuses
+            text = _get(server.port, "/metrics")
+            assert 'edl_tpu_serving_requests_total{code="429"}' in text
+        finally:
+            server.stop()
+
+
+class TestSparseEndToEnd:
+    def test_deepfm_host_bundle_serves_through_row_service(
+        self, tmp_path
+    ):
+        """The acceptance path: a DeepFM host-tier bundle (row-service
+        export mode) serves over HTTP with rows pulled from a live
+        in-process HostRowService — and reflects row updates pushed
+        AFTER export (fresh rows, not baked ones)."""
+        import optax
+
+        from elasticdl_tpu.core.model_spec import get_model_spec
+        from elasticdl_tpu.core.train_state import init_train_state
+        from elasticdl_tpu.embedding.host_engine import (
+            HOST_ROWS_COLLECTION,
+            _nest_rows,
+            host_rows_template,
+        )
+        from elasticdl_tpu.embedding.optimizer import (
+            SGD,
+            HostOptimizerWrapper,
+        )
+        from elasticdl_tpu.embedding.row_service import HostRowService
+        from elasticdl_tpu.embedding.table import EmbeddingTable
+        from elasticdl_tpu.serving.export import export_serving_bundle
+        from elasticdl_tpu.testing.data import model_zoo_dir
+
+        spec = get_model_spec(
+            model_zoo_dir(), "deepfm.deepfm_host.custom_model"
+        )
+        from model_zoo.deepfm import deepfm_host
+
+        table_name = deepfm_host.TABLE_NAME
+        feature_key = deepfm_host.FEATURE_KEY
+        dim = deepfm_host.EMBEDDING_DIM
+
+        rng = np.random.RandomState(0)
+        ids = rng.randint(0, 500, (4, 10)).astype(np.int32)
+        batch = {
+            "features": {feature_key: ids},
+            "labels": np.zeros((4,), np.int32),
+            "mask": np.ones((4,), np.float32),
+        }
+        state = init_train_state(
+            spec.model, optax.adam(1e-3), batch, seed=0
+        )
+        bundle = tmp_path / "bundle"
+        export_serving_bundle(
+            str(bundle), spec.model, state, batch_example=batch,
+            model_def="deepfm.deepfm_host.custom_model",
+            host_id_keys={table_name: feature_key},
+        )
+        meta = json.loads((bundle / "metadata.json").read_text())
+        assert meta["host_serving"]["id_keys"] == {
+            table_name: feature_key
+        }
+        assert meta["self_contained"]
+
+        table = EmbeddingTable(table_name, dim)
+        service = HostRowService(
+            {table_name: table}, HostOptimizerWrapper(SGD(lr=0.5))
+        ).start()
+        server = None
+        try:
+            store = ModelStore(
+                str(bundle),
+                row_service_addr=f"localhost:{service.port}",
+                poll_seconds=60,
+            )
+            store.load_initial()
+            server = InferenceServer(
+                store, max_batch_size=8, batch_deadline_ms=2.0, port=0
+            ).start()
+
+            template = host_rows_template(spec.model, batch)
+
+            def reference(q_ids):
+                uniq, inverse = np.unique(q_ids, return_inverse=True)
+                rows = np.asarray(table.get(uniq), np.float32)
+                variables = {
+                    "params": state.params,
+                    HOST_ROWS_COLLECTION: _nest_rows(
+                        template, {table_name: rows}
+                    ),
+                }
+                return np.asarray(spec.model.apply(
+                    variables,
+                    {feature_key: inverse.reshape(q_ids.shape)
+                     .astype(np.int32)},
+                    training=False,
+                ))
+
+            q_ids = rng.randint(0, 500, (3, 10)).astype(np.int32)
+            status, out = _post(
+                server.port, {"features": {feature_key: q_ids}}
+            )
+            assert status == 200
+            np.testing.assert_allclose(
+                out["predictions"], reference(q_ids), atol=2e-2
+            )
+
+            # Push a row update through the service (training moved the
+            # table AFTER export) -> served predictions must move too.
+            touched = np.unique(q_ids)[:4]
+            service._push_row_grads({
+                "table": table_name,
+                "ids": touched,
+                "grads": np.full((len(touched), dim), 2.0, np.float32),
+            })
+            status, out_after = _post(
+                server.port, {"features": {feature_key: q_ids}}
+            )
+            assert status == 200
+            np.testing.assert_allclose(
+                out_after["predictions"], reference(q_ids), atol=2e-2
+            )
+            assert not np.allclose(
+                out_after["predictions"], out["predictions"]
+            )
+        finally:
+            if server is not None:
+                server.stop()
+            service.stop(0)
+
+
+@pytest.mark.slow
+def test_serving_soak_sustained_mixed_load(tmp_path):
+    """Soak: sustained mixed-size load through the HTTP front — every
+    request served exactly once, no stuck batches, occupancy > 1
+    somewhere, queue drained at the end."""
+    _export_dense_bundle(tmp_path / "v1", step=1)
+    store = ModelStore(str(tmp_path), poll_seconds=60)
+    store.load_initial()
+    registry = MetricsRegistry()
+    server = InferenceServer(
+        store, max_batch_size=16, batch_deadline_ms=3.0, port=0,
+        metrics_registry=registry,
+    ).start()
+    try:
+        statuses = []
+        lock = threading.Lock()
+        deadline = time.monotonic() + 5.0
+
+        def worker(seed):
+            rng = np.random.RandomState(seed)
+            while time.monotonic() < deadline:
+                n = int(rng.randint(1, 6))
+                status, out = _post(server.port, {
+                    "features":
+                        rng.rand(n, FEATURE_DIM).astype(np.float32)
+                })
+                with lock:
+                    statuses.append(status)
+                assert status != 200 or (
+                    np.asarray(out["predictions"]).shape[0] == n
+                )
+
+        threads = [
+            threading.Thread(target=worker, args=(s,))
+            for s in range(6)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert statuses and set(statuses) == {200}
+        snapshot = {
+            f["name"]: f for f in registry.snapshot()["families"]
+        }
+        occupancy = snapshot["edl_tpu_serving_batch_occupancy"]
+        series = occupancy["series"][0]
+        assert series["count"] > 0
+        assert series["sum"] / series["count"] >= 1.0
+        assert snapshot["edl_tpu_serving_queue_depth"][
+            "series"
+        ][0]["value"] == 0.0
+    finally:
+        server.stop()

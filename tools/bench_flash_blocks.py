@@ -3,8 +3,11 @@
 Round-4's block re-sweep ran at B8/H8/S1024/D64 while the d512 bench
 config moved to B16 — VERDICT r4 weak #5 asks for the sweep at the
 EXACT bench shape and a statement of whether the flash custom-calls
-(27.3% of the d512 step) are at the kernel's own roofline. This tool
-measures, per (block_q, block_k):
+(27.3% of the d512 step) are at the kernel's own roofline. The default
+shape is therefore DERIVED from ``bench_suite`` (the d512 flagship's
+batch + ``_TRANSFORMER_SIZES`` head geometry — H4/D128 since the
+round-5 head flip), so the sweep cannot silently drift off the bench
+shape again. This tool measures, per (block_q, block_k):
 
 - device ms of the fwd+bwd flash program (jit of value_and_grad over
   ``ops.flash_attention``, traced via benchlib.module_device_times —
@@ -40,8 +43,17 @@ def main():
     from elasticdl_tpu.ops.flash_attention import flash_attention
 
     enable_bench_compile_cache()
+    import bench_suite
+
+    sizes = bench_suite._TRANSFORMER_SIZES["transformer"]
+    default_shape = [
+        bench_suite.CONFIGS["transformer"][1],       # bench batch
+        sizes["n_heads"],
+        bench_suite.TRANSFORMER_SEQ,
+        sizes["d_model"] // sizes["n_heads"],        # head dim (128)
+    ]
     args = [int(a) for a in sys.argv[1:]]
-    b, h, s, d = (args + [16, 8, 1024, 64][len(args):])[:4]
+    b, h, s, d = (args + default_shape[len(args):])[:4]
 
     rng = np.random.RandomState(0)
     shape = (b, s, h, d)
