@@ -5,7 +5,7 @@
 PY ?= python
 
 .PHONY: test test-all test-tpu test-k8s native bench serve-bench dryrun \
-	clean lint metrics
+	clean lint metrics chaos-smoke chaos-soak
 
 # Scrape-and-pretty-print a master's /metrics (docs/observability.md).
 METRICS_ADDR ?= localhost:8080
@@ -47,6 +47,22 @@ bench: test-tpu
 # writes BENCH_SERVING.json.
 serve-bench:
 	$(PY) bench_serving.py
+
+# Deterministic chaos plan (kill + stall-row-shard + corrupt-checkpoint)
+# against the in-process cluster; exits nonzero if any recovery
+# invariant fails. Tier-1 safe (~15s on CPU). docs/chaos.md.
+CHAOS_SEED ?= 7
+chaos-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m elasticdl_tpu chaos run \
+		--seed $(CHAOS_SEED) --report CHAOS_r01.json
+
+# Randomized soak: N seed-derived plans; a failure prints the seed
+# that reproduces it (slow lane — not part of tier-1).
+CHAOS_ROUNDS ?= 5
+chaos-soak:
+	JAX_PLATFORMS=cpu $(PY) -m elasticdl_tpu chaos soak \
+		--seed $(CHAOS_SEED) --rounds $(CHAOS_ROUNDS) \
+		--report CHAOS_soak.json
 
 # Multi-chip sharding dry run on a virtual 8-device CPU mesh.
 dryrun:

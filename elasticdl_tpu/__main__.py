@@ -1,7 +1,8 @@
 """``python -m elasticdl_tpu`` → the CLI (reference setup.py:33-35
 console entry point ``elasticdl``): ``train | evaluate | predict |
-serve | clean`` (``serve`` = the online inference server,
-serving/server.py)."""
+serve | chaos | clean`` (``serve`` = the online inference server,
+serving/server.py; ``chaos`` = the fault-injection harness,
+chaos/runner.py)."""
 
 import sys
 

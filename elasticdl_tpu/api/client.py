@@ -33,7 +33,7 @@ from elasticdl_tpu.platform.k8s_client import (
 
 logger = get_logger("client")
 
-_SUBCOMMANDS = ("train", "evaluate", "predict", "serve", "clean")
+_SUBCOMMANDS = ("train", "evaluate", "predict", "serve", "chaos", "clean")
 
 
 def _master_manifests(args, mode: str):
@@ -146,8 +146,8 @@ def main(argv=None):
     argv = list(sys.argv[1:] if argv is None else argv)
     if not argv or argv[0] not in _SUBCOMMANDS:
         print(
-            "usage: elasticdl_tpu {train|evaluate|predict|serve|clean}"
-            " <flags>",
+            "usage: elasticdl_tpu "
+            "{train|evaluate|predict|serve|chaos|clean} <flags>",
             file=sys.stderr,
         )
         return 2
@@ -158,6 +158,12 @@ def main(argv=None):
         from elasticdl_tpu.serving.server import main as serve_main
 
         return serve_main(rest)
+    if mode == "chaos":
+        # Fault-injection harness (docs/chaos.md): runs against the
+        # in-process cluster, no job/k8s context — dispatch directly.
+        from elasticdl_tpu.chaos.runner import main as chaos_main
+
+        return chaos_main(rest)
     args = build_parser(mode).parse_args(rest)
     if mode == "clean":
         return _clean(args)

@@ -1,0 +1,29 @@
+"""Chaos plane: deterministic fault injection + recovery invariants.
+
+The subsystem that turns the paper's resilience claim into a testable
+property: ``faults`` (seed-deterministic schedules), ``interceptors``
+(injection hooks threaded through RPC, checkpointing, the instance
+manager, and the in-process cluster), ``invariants`` (exactly-once
+task accounting, row conservation, checkpoint monotonicity,
+loss-trajectory equivalence), and ``runner`` (the harness + the
+``elasticdl_tpu chaos`` CLI). See docs/chaos.md.
+"""
+
+from elasticdl_tpu.chaos.faults import (  # noqa: F401
+    FaultEvent,
+    FaultPlan,
+    default_plan,
+    randomized_plan,
+)
+from elasticdl_tpu.chaos.interceptors import (  # noqa: F401
+    ChaosKill,
+    FaultInjector,
+)
+from elasticdl_tpu.chaos.invariants import (  # noqa: F401
+    CheckpointMonotonicity,
+    CheckResult,
+    ExactlyOnceTaskAccounting,
+    LossTrajectoryEquivalence,
+    RowConservation,
+)
+from elasticdl_tpu.chaos.runner import ChaosRunner  # noqa: F401

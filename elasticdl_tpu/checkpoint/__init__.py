@@ -7,6 +7,7 @@ PS checkpoint (``elasticdl/pkg/ps/checkpoint.go``).
 from elasticdl_tpu.checkpoint.hooks import CheckpointHook, restore_from_dir
 from elasticdl_tpu.checkpoint.saver import CheckpointSaver
 from elasticdl_tpu.checkpoint.state_io import (
+    CorruptCheckpointError,
     named_leaves_from_state,
     restore_state_from_named_leaves,
 )
@@ -14,6 +15,7 @@ from elasticdl_tpu.checkpoint.state_io import (
 __all__ = [
     "CheckpointHook",
     "CheckpointSaver",
+    "CorruptCheckpointError",
     "named_leaves_from_state",
     "restore_from_dir",
     "restore_state_from_named_leaves",

@@ -22,16 +22,35 @@ import os
 import re
 import shutil
 import time
-from typing import Dict, Optional, Tuple
+from typing import Callable, Dict, Optional, Tuple
 
 import numpy as np
 
 from elasticdl_tpu.common import tensor_utils
 from elasticdl_tpu.common.hash_utils import int_to_id, string_to_id
 from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.checkpoint.state_io import (
+    CorruptCheckpointError,
+    validate_shard_payload,
+)
 from elasticdl_tpu.embedding.table import EmbeddingTable
 
 logger = get_logger(__name__)
+
+# ---- chaos seam (chaos/interceptors.py installs) -----------------------
+# _post_save_hook(checkpoint_dir, version, vdir): after a version dir is
+#   published (fault plans corrupt files here); _post_restore_hook(
+#   checkpoint_dir, version): after a successful restore (the version-
+#   monotonicity invariant checker observes restores here).
+_post_save_hook: Optional[Callable] = None
+_post_restore_hook: Optional[Callable] = None
+
+
+def set_chaos_hooks(post_save: Optional[Callable] = None,
+                    post_restore: Optional[Callable] = None):
+    global _post_save_hook, _post_restore_hook
+    _post_save_hook = post_save
+    _post_restore_hook = post_restore
 
 _VERSION_RE = re.compile(r"^version-(\d+)$")
 _SHARD_RE = re.compile(r"^variables-(\d+)-of-(\d+)\.ckpt$")
@@ -115,6 +134,8 @@ class CheckpointSaver:
             shutil.rmtree(vdir)
         os.rename(tmp, vdir)
         logger.info("Saved checkpoint version %s (%s shards)", version, n)
+        if _post_save_hook is not None:
+            _post_save_hook(self.checkpoint_dir, int(version), vdir)
         registry.histogram(
             "checkpoint_save_seconds", "Checkpoint save duration",
         ).observe(time.monotonic() - save_t0)
@@ -165,13 +186,50 @@ class CheckpointSaver:
         self, version: Optional[int] = None
     ) -> Tuple[int, Dict[str, np.ndarray], Dict[str, EmbeddingTable]]:
         """Read every shard of a version and merge — shard-count agnostic
-        (repartition restore, save_utils.py:206-259)."""
-        if version is None:
-            version = self.get_valid_latest_version()
-            if version is None:
-                raise FileNotFoundError(
-                    f"No valid checkpoint under {self.checkpoint_dir}"
+        (repartition restore, save_utils.py:206-259).
+
+        With no explicit ``version``, a version whose shard files fail
+        to decode (truncated/corrupted write — the shard-count validity
+        check cannot see inside files) is skipped with a warning and
+        the previous retained version restores instead: a replacement
+        worker must resume from the freshest *readable* state, not
+        crash-loop on a torn file. An explicit ``version`` raises
+        ``CorruptCheckpointError`` — the caller asked for that one."""
+        if version is not None:
+            return self._restore_version(version)
+        candidates = [
+            v for v in reversed(self.list_versions())
+            if self.is_valid_version(v)
+        ]
+        if not candidates:
+            raise FileNotFoundError(
+                f"No valid checkpoint under {self.checkpoint_dir}"
+            )
+        from elasticdl_tpu.observability import default_registry
+
+        for i, v in enumerate(candidates):
+            try:
+                return self._restore_version(v)
+            except CorruptCheckpointError as exc:
+                default_registry().counter(
+                    "checkpoint_corrupt_versions_total",
+                    "Checkpoint versions skipped at restore because a "
+                    "shard file failed to decode",
+                ).inc()
+                older = len(candidates) - i - 1
+                logger.error(
+                    "Checkpoint version %d is corrupt (%s); falling "
+                    "back to %s older version(s)", v, exc, older,
                 )
+        raise FileNotFoundError(
+            f"Every retained checkpoint version under "
+            f"{self.checkpoint_dir} is corrupt "
+            f"(tried {candidates})"
+        )
+
+    def _restore_version(
+        self, version: int
+    ) -> Tuple[int, Dict[str, np.ndarray], Dict[str, EmbeddingTable]]:
         vdir = _version_dir(self.checkpoint_dir, version)
         if not self.is_valid_version(version):
             raise FileNotFoundError(f"Invalid checkpoint version {vdir}")
@@ -180,8 +238,18 @@ class CheckpointSaver:
         for fname in sorted(os.listdir(vdir)):
             if not _SHARD_RE.match(fname):
                 continue
-            with open(os.path.join(vdir, fname), "rb") as f:
-                payload = tensor_utils.loads(f.read())
+            path = os.path.join(vdir, fname)
+            try:
+                with open(path, "rb") as f:
+                    payload = tensor_utils.loads(f.read())
+            except Exception as exc:
+                # msgpack raises assorted types on truncated/garbled
+                # bytes; all mean the same thing here.
+                raise CorruptCheckpointError(
+                    f"cannot decode {path}: "
+                    f"{type(exc).__name__}: {exc}"
+                ) from exc
+            validate_shard_payload(payload, path)
             dense.update(payload.get("dense", {}))
             for tname, slices in payload.get("embeddings", {}).items():
                 # An empty (0, D) slice still carries the row dim; a shard
@@ -211,6 +279,8 @@ class CheckpointSaver:
                     embeddings[tname] = table
                 if slices.ids.size:
                     table.set(slices.ids, slices.values)
+        if _post_restore_hook is not None:
+            _post_restore_hook(self.checkpoint_dir, int(version))
         return int(version), dense, embeddings
 
     # ---- GC ------------------------------------------------------------

@@ -15,6 +15,47 @@ from typing import Dict
 import jax
 import numpy as np
 
+
+class CorruptCheckpointError(RuntimeError):
+    """A checkpoint file exists but cannot be decoded into a valid
+    shard payload (truncated write, bit rot, chaos injection). Restore
+    treats the whole version as unusable and falls back to the
+    previous retained version (saver.CheckpointSaver.restore)."""
+
+
+def validate_shard_payload(payload, path: str = ""):
+    """Structural check on one decoded shard file. msgpack happily
+    decodes *some* corrupted byte streams into non-payload values
+    (e.g. a leading ``\\x00`` becomes the int 0), so decode success
+    alone is not integrity — the shape of the payload is."""
+    where = f" ({path})" if path else ""
+    if not isinstance(payload, dict):
+        raise CorruptCheckpointError(
+            f"shard payload is {type(payload).__name__}, not dict{where}"
+        )
+    meta = payload.get("meta")
+    if not isinstance(meta, dict):
+        raise CorruptCheckpointError(f"shard payload lacks meta{where}")
+    for key in ("version", "shard", "num_shards"):
+        if not isinstance(meta.get(key), int):
+            raise CorruptCheckpointError(
+                f"shard meta lacks int {key!r}{where}"
+            )
+    dense = payload.get("dense", {})
+    if not isinstance(dense, dict):
+        raise CorruptCheckpointError(f"shard dense is not a dict{where}")
+    for name, arr in dense.items():
+        if not isinstance(arr, np.ndarray):
+            raise CorruptCheckpointError(
+                f"dense leaf {name!r} decoded as "
+                f"{type(arr).__name__}, not ndarray{where}"
+            )
+    if not isinstance(payload.get("embeddings", {}), dict):
+        raise CorruptCheckpointError(
+            f"shard embeddings is not a dict{where}"
+        )
+    return payload
+
 # Non-pytree callables the state carries (struct.field(pytree_node=
 # False)) — everything else a TrainState SUBCLASS adds (e.g.
 # SparseTrainState's tables/slot_tables/table_steps) must checkpoint,

@@ -10,9 +10,23 @@ ndarrays (eval raw outputs) ride the same encoding as checkpoints.
 Server: ``RpcServer(addr, {service_name: {method: handler}})``.
 Client: ``RpcStub(addr, service_name).call(method, **fields)``.
 Handlers take and return plain dicts. Errors raise ``RpcError`` client-side.
+
+Transient transport failures (UNAVAILABLE / DEADLINE_EXCEEDED) retry
+inside ``RpcStub.call`` with jittered exponential backoff and a small
+attempt cap, counted by ``edl_tpu_rpc_retries_total`` — a server
+restart blip must not surface as a hard job failure. Layers with their
+own (longer) retry budget, e.g. the row-service client riding out a
+pod relaunch, construct stubs with ``max_retries=0``.
+
+Chaos seam: ``set_chaos_hooks`` installs client/server interceptors
+(``chaos/interceptors.py``) that can delay, drop, or error any call on
+a scripted schedule; ``None`` hooks (the default) cost one attribute
+read per call.
 """
 
+import random as _random
 import threading
+import time
 from concurrent import futures
 from typing import Callable, Dict, Optional
 
@@ -26,6 +40,12 @@ _CHANNEL_OPTIONS = [
     ("grpc.max_receive_message_length", GRPC.MAX_RECEIVE_MESSAGE_LENGTH),
 ]
 
+# Codes worth a client-side retry: the transport (not the handler)
+# failed, and every control RPC here is safe to re-send — get_task
+# re-asks the dispatcher, reports are idempotent per task id at the
+# servicer, row pushes dedup by (client, seq).
+RETRYABLE_CODES = ("UNAVAILABLE", "DEADLINE_EXCEEDED")
+
 
 class RpcError(RuntimeError):
     """Client-side RPC failure; ``code`` is the grpc StatusCode name
@@ -37,6 +57,28 @@ class RpcError(RuntimeError):
         self.code = code
 
 
+# ---- chaos injection seam (chaos/interceptors.py installs) -------------
+#
+# _client_hook(service, method, request) -> None
+#   runs in RpcStub.call before each send attempt; may sleep (delay
+#   fault) or raise (RpcError for drop faults — retried like a real
+#   transport failure — or chaos.ChaosKill to simulate pod death).
+# _server_hook(tag, service, method, request) -> None | (code, detail)
+#   runs in the handler wrap; may sleep; a returned (code, detail)
+#   aborts the call with that grpc status.
+
+_client_hook: Optional[Callable] = None
+_server_hook: Optional[Callable] = None
+
+
+def set_chaos_hooks(client: Optional[Callable] = None,
+                    server: Optional[Callable] = None):
+    """Install (or, with Nones, remove) the chaos interceptors."""
+    global _client_hook, _server_hook
+    _client_hook = client
+    _server_hook = server
+
+
 def _serialize(obj: dict) -> bytes:
     return tensor_utils.dumps(obj)
 
@@ -46,9 +88,14 @@ def _deserialize(data: bytes) -> dict:
 
 
 class _GenericService(grpc.GenericRpcHandler):
-    def __init__(self, service_name: str, handlers: Dict[str, Callable]):
+    def __init__(self, service_name: str, handlers: Dict[str, Callable],
+                 tag: str = ""):
         self._service_name = service_name
         self._handlers = handlers
+        # Chaos identity: several servers of the SAME service can run in
+        # one process (e.g. N row-service shards in tests); the tag lets
+        # a fault plan target one of them ("rowservice/1").
+        self._tag = tag
 
     def service(self, handler_call_details):
         # Path format: /<service_name>/<method>
@@ -61,6 +108,18 @@ class _GenericService(grpc.GenericRpcHandler):
             return None
 
         def unary_unary(request: dict, context):
+            hook = _server_hook
+            if hook is not None:
+                verdict = hook(
+                    self._tag, self._service_name, method, request
+                )
+                if verdict is not None:
+                    code, detail = verdict
+                    context.abort(
+                        getattr(grpc.StatusCode, code,
+                                grpc.StatusCode.UNKNOWN),
+                        detail,
+                    )
             try:
                 response = handler(request)
                 return response if response is not None else {}
@@ -83,12 +142,14 @@ class RpcServer:
         addr: str,
         services: Dict[str, Dict[str, Callable]],
         max_workers: int = 64,
+        tag: str = "",
     ):
-        """``services`` maps service name -> {method name -> handler}."""
+        """``services`` maps service name -> {method name -> handler}.
+        ``tag`` identifies this server instance to chaos fault plans."""
         self._server = grpc.server(
             futures.ThreadPoolExecutor(max_workers=max_workers),
             handlers=[
-                _GenericService(name, handlers)
+                _GenericService(name, handlers, tag=tag)
                 for name, handlers in services.items()
             ],
             options=_CHANNEL_OPTIONS,
@@ -112,10 +173,27 @@ def build_channel(addr: str) -> grpc.Channel:
     return grpc.insecure_channel(addr, options=_CHANNEL_OPTIONS)
 
 
-class RpcStub:
-    """Client for one service on one channel; thread-safe."""
+def _retry_counter():
+    from elasticdl_tpu.observability import default_registry
 
-    def __init__(self, target, service_name: str):
+    return default_registry().counter(
+        "rpc_retries_total",
+        "Transient RPC failures retried by RpcStub.call",
+        ["service", "method", "code"],
+    )
+
+
+class RpcStub:
+    """Client for one service on one channel; thread-safe.
+
+    ``max_retries`` re-send attempts on RETRYABLE_CODES with jittered
+    exponential backoff (base doubling to cap, ×[0.5, 1.5) jitter so a
+    worker fleet doesn't retry in lockstep). 0 disables — callers with
+    their own retry policy (row_service._call_with_retry) must not
+    multiply budgets."""
+
+    def __init__(self, target, service_name: str, max_retries: int = 2,
+                 backoff_base: float = 0.05, backoff_cap: float = 2.0):
         if isinstance(target, str):
             self._channel = build_channel(target)
             self._owns_channel = True
@@ -123,6 +201,9 @@ class RpcStub:
             self._channel = target
             self._owns_channel = False
         self._service_name = service_name
+        self._max_retries = int(max_retries)
+        self._backoff_base = float(backoff_base)
+        self._backoff_cap = float(backoff_cap)
         self._methods = {}
         self._lock = threading.Lock()
 
@@ -137,14 +218,35 @@ class RpcStub:
             return self._methods[name]
 
     def call(self, method: str, timeout: Optional[float] = None, **fields):
-        try:
-            return self._method(method)(fields, timeout=timeout)
-        except grpc.RpcError as exc:
-            raise RpcError(
-                f"{self._service_name}.{method} failed: "
-                f"{exc.code().name}: {exc.details()}",
-                code=exc.code().name,
-            ) from exc
+        delay = self._backoff_base
+        attempt = 0
+        while True:
+            try:
+                hook = _client_hook
+                if hook is not None:
+                    # May raise RpcError (injected drop — retried below
+                    # like a real one) or ChaosKill (BaseException:
+                    # simulated pod death, never caught here).
+                    hook(self._service_name, method, fields)
+                return self._method(method)(fields, timeout=timeout)
+            except grpc.RpcError as exc:
+                err = RpcError(
+                    f"{self._service_name}.{method} failed: "
+                    f"{exc.code().name}: {exc.details()}",
+                    code=exc.code().name,
+                )
+                err.__cause__ = exc
+            except RpcError as exc:
+                err = exc
+            if (err.code not in RETRYABLE_CODES
+                    or attempt >= self._max_retries):
+                raise err
+            attempt += 1
+            _retry_counter().labels(
+                self._service_name, method, err.code
+            ).inc()
+            time.sleep(delay * (0.5 + _random.random()))
+            delay = min(delay * 2.0, self._backoff_cap)
 
     def close(self):
         if self._owns_channel:

@@ -228,16 +228,18 @@ class HostRowService:
         self._restore_latest()
         return self
 
-    def _checkpoint(self, version: int):
+    def _checkpoint(self, version: int, blocking: bool = False) -> bool:
         """ONE lock acquisition across the whole snapshot so rows,
         optimizer slots, and step counters are captured at the same
         version; the file write happens outside (pushes keep flowing
-        during IO). A single writer at a time: overlapping triggers
-        skip (their version is covered by the next interval)."""
+        during IO). A single writer at a time: overlapping interval
+        triggers skip (their version is covered by the next interval)
+        while the drain path (checkpoint_now) blocks for its turn.
+        Returns whether a write happened."""
         from elasticdl_tpu.embedding.table import EmbeddingTable
 
-        if not self._ckpt_writer_free.acquire(blocking=False):
-            return
+        if not self._ckpt_writer_free.acquire(blocking=blocking):
+            return False
         try:
             snapshot = {}
             with self._lock:
@@ -248,8 +250,24 @@ class HostRowService:
                         dtype=rows.dtype if rows.size else np.float32,
                     )
             self._saver.save(version, {}, embeddings=snapshot)
+            return True
         finally:
             self._ckpt_writer_free.release()
+
+    def checkpoint_now(self) -> bool:
+        """Synchronous checkpoint at the current push count — the
+        graceful-drain write (SIGTERM grace period / scripted shard
+        relaunch): rows pushed since the last interval save must not
+        be lost to a planned restart. Unlike the interval trigger this
+        WAITS for any in-flight interval write (skipping here would
+        silently drop the freshest pushes — the exact loss this method
+        exists to prevent). Returns False when no saver is
+        configured."""
+        if self._saver is None:
+            return False
+        with self._lock:
+            version = self._push_count
+        return self._checkpoint(version, blocking=True)
 
     def _restore_latest(self):
         try:
@@ -275,9 +293,13 @@ class HostRowService:
 
     # ---- lifecycle / checkpoint ---------------------------------------
 
-    def start(self, addr: str = "localhost:0") -> "HostRowService":
+    def start(self, addr: str = "localhost:0",
+              tag: str = "") -> "HostRowService":
+        """``tag`` identifies this shard to chaos fault plans (e.g.
+        ``rowservice/0``) — several shards of the same service can run
+        in one test process and a plan must be able to stall just one."""
         self._server = RpcServer(
-            addr, {SERVICE_NAME: self.handlers()}
+            addr, {SERVICE_NAME: self.handlers()}, tag=tag
         ).start()
         logger.info("Row service on port %d", self._server.port)
         return self
@@ -558,7 +580,10 @@ def make_remote_engine(
     addrs = [a.strip() for a in addr.split(",") if a.strip()]
     if not addrs:
         raise ValueError("empty row-service address")
-    stubs = [RpcStub(a, SERVICE_NAME) for a in addrs]
+    # max_retries=0: _call_with_retry owns the (much longer) retry
+    # budget here — stacking the stub's own backoff under it would
+    # multiply attempts.
+    stubs = [RpcStub(a, SERVICE_NAME, max_retries=0) for a in addrs]
     infos = [
         _call_with_retry(stub, "table_info", retries, backoff_secs)[
             "tables"
@@ -697,7 +722,7 @@ def main(argv=None):
             args.checkpoint_dir, args.checkpoint_steps,
             args.keep_checkpoint_max,
         )
-    service.start(args.addr)
+    service.start(args.addr, tag=f"rowservice/{args.shard_id}")
     logger.info("Row service serving on %s", args.addr)
     if args.metrics_port >= 0:
         # A row-service pod reports to no master, so its registry

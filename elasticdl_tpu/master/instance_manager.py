@@ -32,6 +32,24 @@ logger = get_logger("instance_manager")
 # Exit code meaning "killed" (preemption / OOM), reference :250-271.
 _EXIT_KILLED = 137
 
+# ---- chaos seam (chaos/interceptors.py installs) -----------------------
+# _chaos_observer(event, **info) with events "kill_worker" (a straggler
+# kill was issued), "worker_dead" (recovery started: tasks re-queued)
+# and "worker_relaunched" (replacement started) — the chaos plane times
+# kill→relaunch recovery latency off these without the manager knowing
+# chaos exists.
+_chaos_observer: Optional[Callable] = None
+
+
+def set_chaos_observer(fn: Optional[Callable]):
+    global _chaos_observer
+    _chaos_observer = fn
+
+
+def _observe(event: str, **info):
+    if _chaos_observer is not None:
+        _chaos_observer(event, **info)
+
 
 def classify_pod_event(event) -> Optional[dict]:
     """Normalize a k8s watch event (V1Pod or dict) to
@@ -299,6 +317,7 @@ class InstanceManager:
         if self._multihost:
             self._handle_dead_worker_multihost(worker_id)
             return
+        _observe("worker_dead", worker_id=worker_id)
         requeued = self._task_d.recover_tasks(worker_id)
         logger.info(
             "Worker %d died; re-queued %s task(s)", worker_id, requeued
@@ -320,6 +339,7 @@ class InstanceManager:
             self._relaunch_count += 1
             new_id = next(self._next_worker_id)
         self._start_worker(new_id)
+        _observe("worker_relaunched", worker_id=worker_id, new_id=new_id)
         if self._on_worker_relaunch is not None:
             self._on_worker_relaunch(worker_id, new_id)
 
@@ -378,6 +398,7 @@ class InstanceManager:
             name = self._worker_pods.get(worker_id)
         if name is None:
             return
+        _observe("kill_worker", worker_id=worker_id)
         result = self._client.delete_pod(name)
         if result is None:
             with self._lock:

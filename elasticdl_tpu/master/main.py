@@ -147,6 +147,11 @@ class Master:
         self._server = None
         self.instance_manager = None
         self._k8s_client = k8s_client
+        # SIGTERM grace path (main() installs the handler): the run
+        # loop exits at the next poll tick and stop() tears the job
+        # down in order — workers get THEIR SIGTERMs (pod deletion) and
+        # checkpoint + hand tasks back inside their own grace windows.
+        self._stop_requested = False
 
     # ---- assembly -------------------------------------------------------
 
@@ -357,11 +362,22 @@ class Master:
             self.instance_manager.start_row_service()
             self.instance_manager.start_workers()
 
+    def request_stop(self):
+        """Ask the run loop to exit at the next tick (SIGTERM path).
+        Signal-handler safe: sets a flag, no locks, no teardown here."""
+        self._stop_requested = True
+
     def run(self, poll_secs: float = 5.0):
         """Sleep until the dispatcher drains (reference master.py:218-238);
         each tick, kill stragglers (3× mean task time, :487-509)."""
         try:
             while not self.task_dispatcher.finished():
+                if self._stop_requested:
+                    logger.warning(
+                        "stop requested (SIGTERM); tearing the job "
+                        "down gracefully with tasks still pending"
+                    )
+                    break
                 time.sleep(poll_secs)
                 for task_id, worker_id in self.servicer.find_timeout_tasks():
                     logger.warning(
@@ -418,9 +434,26 @@ def main(argv=None):
             logger.warning("k8s unavailable (%s); running master-only", exc)
     master = Master(args, k8s_client=k8s_client)
     master.prepare()
+    # Graceful pod eviction: without a handler, SIGTERM kills the
+    # master mid-poll and the workers' pods linger ownerless with
+    # in-flight work; with it, run() exits at the next tick and stop()
+    # deletes worker pods (each then runs its own SIGTERM checkpoint +
+    # task hand-back) inside the master's grace period.
+    import signal
+
+    try:
+        signal.signal(
+            signal.SIGTERM, lambda *_: master.request_stop()
+        )
+    except ValueError:
+        pass  # not the main thread (embedded use)
     code = master.run()
     if master.tb_service is not None:
-        while master.tb_service.keep_running():
+        # The post-training TensorBoard keep-alive must not outlive a
+        # SIGTERM: the handler swallows further signals, so looping
+        # here would burn the whole grace period and end in SIGKILL.
+        while (not master._stop_requested
+               and master.tb_service.keep_running()):
             time.sleep(10)
         master.tb_service.close()
     return code

@@ -159,6 +159,13 @@ class BatchingPredictor:
         self._queue: List[_Request] = []
         self._cond = threading.Condition()
         self._stop = False
+        # Draining (SIGTERM grace): new submits are refused with
+        # QueueFullError (clients back off exactly like load shed)
+        # while queued work keeps flushing. _busy marks a popped batch
+        # still inside model.predict — the queue empties BEFORE the
+        # predict call, so drain must wait on both.
+        self._draining = False
+        self._busy = False
         self._thread: Optional[threading.Thread] = None
 
         from elasticdl_tpu.observability import default_registry
@@ -235,6 +242,9 @@ class BatchingPredictor:
             )
         request = _Request(features, n)
         with self._cond:
+            if self._draining:
+                self._m_shed.inc()
+                raise self.QueueFullError("server draining (SIGTERM)")
             if len(self._queue) >= self.max_queue:
                 self._m_shed.inc()
                 raise self.QueueFullError(
@@ -301,6 +311,10 @@ class BatchingPredictor:
                     if full or now >= deadline:
                         batch = self._queue[:take]
                         del self._queue[:take]
+                        # Atomic with the pop: drain watches
+                        # (queue empty AND not busy), so the popped
+                        # batch must read as busy before the lock drops.
+                        self._busy = True
                         self._m_flushes.labels(
                             reason="size" if full else "deadline"
                         ).inc()
@@ -368,10 +382,15 @@ class BatchingPredictor:
 
     def _loop(self):
         while True:
-            batch = self._take_batch()
+            batch = self._take_batch()  # sets _busy with the pop
             if not batch:
                 return
-            self._run_batch(batch)
+            try:
+                self._run_batch(batch)
+            finally:
+                with self._cond:
+                    self._busy = False
+                    self._cond.notify_all()
 
     def start(self) -> "BatchingPredictor":
         if self._thread is None:
@@ -382,13 +401,46 @@ class BatchingPredictor:
             self._thread.start()
         return self
 
-    def stop(self):
+    def stop(self, join_timeout: float = 5.0) -> bool:
+        """Stop the batcher; returns False if its thread (an in-flight
+        predict call) outlived ``join_timeout``."""
         with self._cond:
             self._stop = True
             self._cond.notify_all()
-        if self._thread is not None:
-            self._thread.join(timeout=5)
-            self._thread = None
+        thread, self._thread = self._thread, None
+        if thread is not None:
+            thread.join(timeout=join_timeout)
+            return not thread.is_alive()
+        return True
+
+    def drain(self, timeout: float = 30.0) -> bool:
+        """Graceful SIGTERM path: refuse new submits, flush every
+        queued micro-batch INCLUDING the one mid-predict, then stop
+        the batcher. Returns False if the work didn't finish inside
+        ``timeout`` (the batcher is stopped regardless — remaining
+        requests get their error when their handler times out)."""
+        deadline = time.monotonic() + float(timeout)
+        with self._cond:
+            self._draining = True
+            self._cond.notify_all()
+        drained = True
+        while True:
+            with self._cond:
+                # The queue empties when the batcher POPS the final
+                # batch; _busy covers the predict call still running
+                # on it.
+                if not self._queue and not self._busy:
+                    break
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    drained = False
+                    break
+                self._cond.wait(timeout=min(remaining, 0.05))
+        # Whatever grace is left bounds the final thread join (a last
+        # predict may still be between the busy-flag drop and loop
+        # exit).
+        remaining = max(0.1, deadline - time.monotonic())
+        return self.stop(join_timeout=remaining) and drained
 
     def record_status(self, code: int):
         self._m_requests.labels(code=str(code)).inc()
@@ -602,6 +654,28 @@ class InferenceServer:
         self.predictor.stop()
         self.store.stop()
 
+    def drain(self, grace: float = 25.0) -> bool:
+        """Graceful SIGTERM shutdown: stop accepting connections,
+        flush in-flight micro-batches (new submits shed with 429 so
+        the balancer retries elsewhere), then tear down. k8s default
+        termination grace is 30s — keep ``grace`` under it so exit
+        beats the KILL."""
+        logger.info("draining inference server (grace %.1fs)", grace)
+        if self._httpd is not None:
+            # Stop the accept loop; handler threads for already-
+            # accepted requests keep running and block in submit().
+            self._httpd.shutdown()
+        drained = self.predictor.drain(timeout=grace)
+        if self._httpd is not None:
+            self._httpd.server_close()
+            self._httpd = None
+        self.store.stop()
+        logger.info(
+            "inference server drained (%s)",
+            "clean" if drained else "grace expired with queued work",
+        )
+        return drained
+
 
 def main(argv=None) -> int:
     """``elasticdl_tpu serve`` entry: serve an export directory.
@@ -639,6 +713,11 @@ def main(argv=None) -> int:
     parser.add_argument("--poll_seconds", type=float, default=2.0)
     parser.add_argument("--retain_versions", type=int, default=1)
     parser.add_argument("--request_timeout", type=float, default=30.0)
+    parser.add_argument(
+        "--drain_grace", type=float, default=25.0,
+        help="SIGTERM drain budget for in-flight micro-batches; keep "
+             "under the pod's terminationGracePeriodSeconds",
+    )
     args = parser.parse_args(argv)
 
     from elasticdl_tpu.serving.model_store import ModelStore
@@ -681,7 +760,23 @@ def main(argv=None) -> int:
         args.model_dir, server.port, args.max_batch_size,
         args.batch_deadline_ms,
     )
-    server.wait()
+    # Graceful pod eviction: SIGTERM stops the accept loop, flushes
+    # in-flight micro-batches, then exits well inside the k8s
+    # termination grace — without this, eviction drops every queued
+    # request on the floor mid-predict.
+    import signal
+
+    stop_evt = threading.Event()
+    try:
+        signal.signal(signal.SIGTERM, lambda *_: stop_evt.set())
+        signal.signal(signal.SIGINT, lambda *_: stop_evt.set())
+    except ValueError:
+        # Not the main thread (embedded/test use): callers drive
+        # server.drain() themselves.
+        server.wait()
+        return 0
+    stop_evt.wait()
+    server.drain(grace=args.drain_grace)
     return 0
 
 

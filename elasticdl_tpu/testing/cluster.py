@@ -49,7 +49,32 @@ class MiniCluster:
         metrics_port: Optional[int] = None,
         metrics_report_secs: float = 0.0,
         metrics_ttl_secs: float = 600.0,
+        fault_injector=None,
+        checkpoint_async: bool = True,
     ):
+        # Chaos plane (chaos/interceptors.FaultInjector): over RPC the
+        # injector's process-global hooks cover every call already; on
+        # the direct-call path its per-RPC callbacks are merged into
+        # worker_callbacks below so both transports inject the same
+        # plan. checkpoint_async=False forces synchronous checkpoint
+        # writes — chaos replay needs corrupt-at-save events ordered
+        # deterministically against worker progress.
+        self.fault_injector = fault_injector
+        if fault_injector is not None and not use_rpc:
+            chaos_cbs = fault_injector.in_process_callbacks()
+            merged = dict(chaos_cbs)
+            for name, cb in (worker_callbacks or {}).items():
+                if name in merged:
+                    chaos_cb = merged[name]
+
+                    def both(request, _user=cb, _chaos=chaos_cb):
+                        _chaos(request)
+                        _user(request)
+
+                    merged[name] = both
+                else:
+                    merged[name] = cb
+            worker_callbacks = merged
         self.spec = get_model_spec(model_zoo, model_def)
         if mesh is not None:
             # Same wiring as worker/main.py MESH strategy: mesh-aware
@@ -164,6 +189,7 @@ class MiniCluster:
                     checkpoint_dir=checkpoint_dir,
                     checkpoint_steps=checkpoint_steps,
                     host_tables=getattr(runner, "host_tables", None),
+                    async_save=checkpoint_async,
                 )
             self.workers.append(
                 Worker(

@@ -12,6 +12,11 @@ TPU tunnel (and hang when it is unavailable).
 
 import os
 
+# grpc's C-core INFO logs (GOAWAY notices on every server teardown)
+# splice into pytest's dot-progress lines and corrupt the plain-text
+# test output the CI lane parses; only errors are worth the noise.
+os.environ.setdefault("GRPC_VERBOSITY", "ERROR")
+
 # The TPU kernel-correctness lane (`make test-tpu`, tests marked `tpu`)
 # must run on the REAL chip — compiled, non-interpret — so it skips the
 # CPU forcing below and keeps the default (axon) platform.

@@ -1,0 +1,414 @@
+"""Chaos plane tests (ISSUE 3 tentpole).
+
+Unit level: plan determinism/serialization, injector decision logic,
+each invariant checker caught red-handed on a synthetic violation.
+Integration level: the canonical kill + stall-row-shard +
+corrupt-checkpoint plan drains with all four invariants passing, two
+same-seed runs render byte-identical reports, the lost-task regression
+(recovery deliberately skipped) is caught by the exactly-once checker,
+and a corrupt-LATEST-checkpoint kill is caught by the loss-equivalence
+checker (silent training loss must not pass).
+"""
+
+import numpy as np
+import pytest
+
+from elasticdl_tpu.chaos import (
+    ChaosKill,
+    ChaosRunner,
+    CheckpointMonotonicity,
+    ExactlyOnceTaskAccounting,
+    FaultEvent,
+    FaultInjector,
+    FaultPlan,
+    RowConservation,
+    default_plan,
+    randomized_plan,
+)
+from elasticdl_tpu.chaos.runner import render_report
+from elasticdl_tpu.common.constants import TaskType
+from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+
+
+# ---- plans --------------------------------------------------------------
+
+
+class TestFaultPlans:
+    def test_same_seed_same_plan_bytes(self):
+        assert default_plan(7).to_json() == default_plan(7).to_json()
+        assert (randomized_plan(42).to_json()
+                == randomized_plan(42).to_json())
+
+    def test_json_roundtrip(self):
+        plan = default_plan(3)
+        again = FaultPlan.from_json(plan.to_json())
+        assert again.to_dict() == plan.to_dict()
+
+    def test_unknown_fields_and_kinds_rejected(self):
+        with pytest.raises(ValueError, match="unknown fault kind"):
+            FaultEvent(kind="meteor_strike")
+        with pytest.raises(ValueError, match="unknown FaultEvent"):
+            FaultEvent.from_dict({"kind": "kill_worker", "wat": 1})
+
+    def test_randomized_plans_vary_with_seed(self):
+        texts = {randomized_plan(s).to_json() for s in range(8)}
+        assert len(texts) > 1
+
+
+# ---- injector decision logic -------------------------------------------
+
+
+class TestFaultInjector:
+    def test_kill_fires_on_nth_get_task_once(self):
+        plan = FaultPlan(events=[FaultEvent(
+            kind="kill_worker", at_call=3,
+        )], seed=1)
+        injector = FaultInjector(plan)
+        request = {"worker_id": 0}
+        injector.client_hook("elasticdl_tpu.Master", "get_task", request)
+        injector.client_hook("elasticdl_tpu.Master", "get_task", request)
+        with pytest.raises(ChaosKill):
+            injector.client_hook(
+                "elasticdl_tpu.Master", "get_task", request
+            )
+        # max_fires=1: the replacement worker's calls survive.
+        for _ in range(5):
+            injector.client_hook(
+                "elasticdl_tpu.Master", "get_task", {"worker_id": 1}
+            )
+        assert [e["kind"] for e in injector.injected] == ["kill_worker"]
+        assert injector.injected[0]["worker_id"] == 0
+
+    def test_kill_filters_by_victim_worker_id(self):
+        plan = FaultPlan(events=[FaultEvent(
+            kind="kill_worker", worker_id=2, at_call=1,
+        )])
+        injector = FaultInjector(plan)
+        injector.client_hook("Svc", "get_task", {"worker_id": 0})
+        with pytest.raises(ChaosKill):
+            injector.client_hook("Svc", "get_task", {"worker_id": 2})
+
+    def test_drop_window_and_cap(self):
+        from elasticdl_tpu.comm.rpc import RpcError
+
+        plan = FaultPlan(events=[FaultEvent(
+            kind="blackhole", target="Svc", method="ping",
+            at_call=2, duration_calls=2, max_fires=2,
+        )])
+        injector = FaultInjector(plan)
+        injector.client_hook("Svc", "ping", {})          # call 1: ok
+        for _ in range(2):                               # calls 2-3 drop
+            with pytest.raises(RpcError):
+                injector.client_hook("Svc", "ping", {})
+        injector.client_hook("Svc", "ping", {})          # capped: ok
+        assert len(injector.injected) == 2
+
+    def test_probabilistic_decisions_replay_from_seed(self):
+        def run():
+            plan = FaultPlan(events=[FaultEvent(
+                kind="rpc_drop", target="Svc", probability=0.5,
+                max_fires=0,
+            )], seed=9)
+            injector = FaultInjector(plan)
+            fired = []
+            from elasticdl_tpu.comm.rpc import RpcError
+
+            for i in range(32):
+                try:
+                    injector.client_hook("Svc", "m", {})
+                    fired.append(0)
+                except RpcError:
+                    fired.append(1)
+            return fired
+
+        first = run()
+        assert sum(first) > 0
+        assert run() == first
+
+    def test_stall_matches_only_its_shard_tag(self):
+        plan = FaultPlan(events=[FaultEvent(
+            kind="stall_shard", shard=1, at_call=1, delay_secs=0.0,
+        )])
+        injector = FaultInjector(plan)
+        assert injector.server_hook(
+            "rowservice/0", "RowService", "pull_rows", {}
+        ) is None
+        injector.server_hook(
+            "rowservice/1", "RowService", "pull_rows", {}
+        )
+        assert injector.injected and (
+            injector.injected[0]["tag"] == "rowservice/1"
+        )
+
+
+# ---- invariant checkers caught red-handed ------------------------------
+
+
+def _dispatcher(records=32, per_task=16):
+    return TaskDispatcher(
+        training_shards={"f": (0, records)},
+        records_per_task=per_task, shuffle=False,
+    )
+
+
+class TestInvariantCheckers:
+    def test_exactly_once_passes_clean_run(self):
+        d = _dispatcher()
+        while True:
+            task = d.get(0)
+            if task is None:
+                break
+            d.report(task.task_id, True)
+        result = ExactlyOnceTaskAccounting(
+            d, {TaskType.TRAINING: 32}
+        ).check()
+        assert result.passed, result.details
+
+    def test_exactly_once_catches_lost_task(self):
+        d = _dispatcher()
+        stuck = d.get(0)            # leased, never reported, never
+        assert stuck is not None    # recovered: the lost-task bug
+        task = d.get(1)
+        d.report(task.task_id, True)
+        result = ExactlyOnceTaskAccounting(
+            d, {TaskType.TRAINING: 32}
+        ).check()
+        assert not result.passed
+        assert "did not drain" in result.details
+        assert "LOST" in result.details
+
+    def test_exactly_once_catches_double_count(self):
+        d = _dispatcher()
+        while True:
+            task = d.get(0)
+            if task is None:
+                break
+            d.report(task.task_id, True)
+        d.counters.add_completed(TaskType.TRAINING, 16)  # the bug
+        result = ExactlyOnceTaskAccounting(
+            d, {TaskType.TRAINING: 32}
+        ).check()
+        assert not result.passed and "DOUBLE" in result.details
+
+    def test_row_conservation_catches_lost_rows(self):
+        from elasticdl_tpu.embedding.table import EmbeddingTable
+
+        table = EmbeddingTable("t", 4)
+        table.get([1, 2, 3])
+        checker = RowConservation()
+        checker.snapshot("kill-1", {"t": table})
+        shrunk = EmbeddingTable("t", 4)
+        shrunk.get([1, 3])  # row 2 vanished across the relaunch
+        result = checker.check({"t": shrunk})
+        assert not result.passed and "lost" in result.details
+        ok = RowConservation()
+        ok.snapshot("kill-1", {"t": table})
+        assert ok.check({"t": table}).passed
+
+    def test_monotonicity_catches_backwards_and_future(self):
+        checker = CheckpointMonotonicity()
+        checker.on_save("/c", 2)
+        checker.on_save("/c", 4)
+        checker.on_save("/c", 4)  # idempotent republish: allowed
+        assert checker.check().passed
+        checker.on_save("/c", 3)
+        assert not checker.check().passed
+        future = CheckpointMonotonicity()
+        future.on_save("/c", 2)
+        future.on_restore("/c", 6)
+        result = future.check()
+        assert not result.passed and "newer than last save" in (
+            result.details
+        )
+
+
+# ---- instance-manager observer seam ------------------------------------
+
+
+class _FakeK8sClient:
+    def __init__(self):
+        self.deleted = []
+
+    def create_pod(self, manifest):
+        pass
+
+    def delete_pod(self, name, **kw):
+        self.deleted.append(name)
+        return True
+
+
+def test_instance_manager_recovery_timed_through_observer():
+    from elasticdl_tpu.master.instance_manager import InstanceManager
+    from elasticdl_tpu.platform.k8s_client import get_worker_pod_name
+
+    injector = FaultInjector(FaultPlan())
+    injector.install()
+    try:
+        mgr = InstanceManager(
+            _dispatcher(), _FakeK8sClient(), job_name="j",
+            image_name="img",
+            worker_command=lambda wid: ["run", str(wid)],
+            num_workers=2,
+        )
+        mgr.start_workers()
+        mgr.kill_worker(0)
+        event = {
+            "type": "DELETED",
+            "object": {
+                "metadata": {
+                    "name": get_worker_pod_name("j", 0),
+                    "labels": {
+                        "elasticdl-tpu-replica-type": "worker",
+                        "elasticdl-tpu-replica-index": "0",
+                    },
+                },
+                "status": {"phase": "", "exit_code": None},
+            },
+        }
+        mgr._event_cb(event)
+    finally:
+        injector.uninstall()
+    assert len(injector.recoveries) == 1
+    assert injector.recoveries[0]["worker_id"] == 0
+    assert injector.recoveries[0]["new_id"] == 2  # fresh id, not 0
+
+
+# ---- end-to-end ---------------------------------------------------------
+
+
+def _runner(plan, workdir, **kw):
+    defaults = dict(
+        model="sparse", records=64, minibatch_size=8,
+        num_minibatches_per_task=2, use_rpc=True, twin=True,
+        join_timeout=90.0,
+    )
+    defaults.update(kw)
+    return ChaosRunner(plan, workdir=str(workdir), **defaults)
+
+
+def test_acceptance_plan_all_invariants_pass(tmp_path):
+    """ISSUE 3 acceptance: kill-worker + stall-row-shard +
+    corrupt-checkpoint completes with all four invariant checkers
+    passing."""
+    report = _runner(default_plan(7), tmp_path / "w").run()
+    assert report["passed"], report
+    counts = report["fault_counts"]
+    assert counts.get("kill_worker") == 1
+    assert counts.get("stall_shard", 0) >= 1
+    assert counts.get("corrupt_checkpoint") == 1
+    assert counts.get("rpc_drop", 0) >= 1  # stub retry rode it out
+    names = {v["name"]: v["passed"] for v in report["invariants"]}
+    assert names == {
+        "exactly_once_task_accounting": True,
+        "embedding_row_conservation": True,
+        "checkpoint_version_monotonicity": True,
+        "loss_trajectory_equivalence": True,
+    }
+    assert report["job"]["kills"] == 1
+    assert report["schedule"]  # the deterministic fault record
+
+
+def test_same_seed_reports_are_byte_identical(tmp_path):
+    """The determinism contract behind `chaos run --seed N` replay:
+    two runs of one seed render identical report bytes (schedules
+    included)."""
+    first = _runner(
+        default_plan(11), tmp_path / "a", twin=False
+    ).run()
+    second = _runner(
+        default_plan(11), tmp_path / "b", twin=False
+    ).run()
+    assert render_report(first) == render_report(second)
+
+
+def test_lost_task_regression_is_caught(tmp_path):
+    """The checker-disabled hook: kill a worker mid-lease and SKIP the
+    dispatcher recovery — the exactly-once checker must name the lost
+    task instead of the job silently under-training."""
+    plan = FaultPlan(events=[FaultEvent(
+        kind="kill_worker", method="report_task_result", at_call=1,
+    )], seed=5)
+    report = _runner(
+        plan, tmp_path / "w", records=32, twin=False,
+        debug_disable_recovery=True, join_timeout=6.0,
+    ).run()
+    assert not report["passed"]
+    verdict = {
+        v["name"]: v for v in report["invariants"]
+    }["exactly_once_task_accounting"]
+    assert not verdict["passed"]
+    assert "did not drain" in verdict["details"]
+    assert "LOST" in verdict["details"]
+
+
+def test_corrupt_latest_checkpoint_caught_by_equivalence(tmp_path):
+    """Corrupting the checkpoint recovery restores from silently loses
+    a completed task's training (the task is accounted done and never
+    re-runs). Accounting stays green — loss-trajectory equivalence is
+    the checker that catches it, via the corrupt-version fallback."""
+    plan = FaultPlan(events=[
+        # Corrupt the SECOND save (the newest at kill time)...
+        FaultEvent(kind="corrupt_checkpoint", target="state",
+                   at_save=2, corrupt_mode="truncate"),
+        # ...then kill right after task 2 completes: restore falls
+        # back to the task-1 checkpoint, task 2 never re-runs.
+        FaultEvent(kind="kill_worker", at_call=3),
+    ], seed=13)
+    report = _runner(plan, tmp_path / "w", records=64).run()
+    assert not report["passed"]
+    names = {v["name"]: v for v in report["invariants"]}
+    assert names["exactly_once_task_accounting"]["passed"]
+    equivalence = names["loss_trajectory_equivalence"]
+    assert not equivalence["passed"]
+    assert "version" in equivalence["details"] or (
+        "diverged" in equivalence["details"]
+    )
+
+
+def test_minicluster_in_process_injection(tmp_path):
+    """The no-RPC path: MiniCluster(fault_injector=...) threads the
+    plan through InProcessMaster callbacks."""
+    from elasticdl_tpu.testing.cluster import MiniCluster
+    from elasticdl_tpu.testing.data import (
+        create_mnist_record_file,
+        model_zoo_dir,
+    )
+
+    train = create_mnist_record_file(str(tmp_path / "t.rec"), 32, seed=1)
+    injector = FaultInjector(FaultPlan(events=[FaultEvent(
+        kind="kill_worker", at_call=2,
+    )], seed=3))
+    cluster = MiniCluster(
+        model_zoo=model_zoo_dir(),
+        model_def="mnist.mnist_functional.custom_model",
+        training_data=train,
+        minibatch_size=16,
+        num_minibatches_per_task=1,
+        fault_injector=injector,
+    )
+    with pytest.raises(ChaosKill):
+        cluster.workers[0].run()
+    assert not cluster.finished
+    assert injector.injected[0]["kind"] == "kill_worker"
+    # Standard recovery drains the job.
+    cluster.dispatcher.recover_tasks(0)
+    from elasticdl_tpu.testing.in_process_master import InProcessMaster
+    from elasticdl_tpu.worker.worker import Worker
+
+    Worker(
+        worker_id=1,
+        master_client=InProcessMaster(cluster.servicer, worker_id=1),
+        model_spec=cluster.spec,
+        data_reader=cluster.train_reader,
+        minibatch_size=16,
+    ).run()
+    assert cluster.finished
+
+
+@pytest.mark.slow
+def test_randomized_soak_round_passes(tmp_path):
+    """One soak round end to end: a survivable randomized plan drains
+    with the invariants green; failures reproduce from the seed."""
+    plan = randomized_plan(2026)
+    report = _runner(plan, tmp_path / "w").run()
+    assert report["passed"], report

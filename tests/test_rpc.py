@@ -1,0 +1,236 @@
+"""comm/rpc.py transport semantics (ISSUE 3 satellites).
+
+Previously untested: ``wait_for_channel_ready`` timeout/unready paths,
+``RpcError`` code propagation through ``_GenericService``, and the
+(new) jittered-backoff retry in ``RpcStub.call`` with its
+``edl_tpu_rpc_retries_total`` counter.
+"""
+
+import socket
+
+import pytest
+
+from elasticdl_tpu.comm import rpc as rpc_mod
+from elasticdl_tpu.comm.rpc import (
+    RpcError,
+    RpcServer,
+    RpcStub,
+    set_chaos_hooks,
+    wait_for_channel_ready,
+)
+from elasticdl_tpu.observability import default_registry
+
+
+def _free_unused_port() -> int:
+    """A port nothing listens on (bound then released)."""
+    s = socket.socket()
+    s.bind(("localhost", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _retries_value(service: str, method: str, code: str) -> float:
+    return default_registry().counter(
+        "rpc_retries_total",
+        "Transient RPC failures retried by RpcStub.call",
+        ["service", "method", "code"],
+    ).labels(service, method, code).value
+
+
+@pytest.fixture
+def echo_server():
+    def echo(request):
+        return {"echo": request.get("value")}
+
+    def boom(request):
+        raise ValueError("handler exploded")
+
+    server = RpcServer(
+        "localhost:0", {"Echo": {"echo": echo, "boom": boom}}
+    ).start()
+    yield server
+    server.stop(0)
+
+
+class TestWaitForChannelReady:
+    def test_ready_channel_returned_and_usable(self, echo_server):
+        channel = wait_for_channel_ready(
+            f"localhost:{echo_server.port}", timeout=10, retries=1
+        )
+        stub = RpcStub(channel, "Echo")
+        assert stub.call("echo", value=7) == {"echo": 7}
+        channel.close()
+
+    def test_unready_address_times_out(self):
+        port = _free_unused_port()
+        with pytest.raises(TimeoutError, match="not ready"):
+            wait_for_channel_ready(
+                f"localhost:{port}", timeout=0.2, retries=2
+            )
+
+    def test_retries_budget_is_respected(self):
+        """Each retry opens a fresh channel; total wait ~= retries x
+        timeout, so a 2x0.2s budget must return well under a second
+        rather than the default 300s."""
+        import time
+
+        port = _free_unused_port()
+        t0 = time.monotonic()
+        with pytest.raises(TimeoutError):
+            wait_for_channel_ready(
+                f"localhost:{port}", timeout=0.2, retries=2
+            )
+        assert time.monotonic() - t0 < 5.0
+
+
+class TestErrorCodePropagation:
+    def test_handler_exception_surfaces_as_internal(self, echo_server):
+        stub = RpcStub(
+            f"localhost:{echo_server.port}", "Echo", max_retries=0
+        )
+        with pytest.raises(RpcError) as info:
+            stub.call("boom")
+        assert info.value.code == "INTERNAL"
+        # The handler's type and message ride the status detail.
+        assert "ValueError" in str(info.value)
+        assert "handler exploded" in str(info.value)
+        stub.close()
+
+    def test_unknown_method_is_unimplemented(self, echo_server):
+        stub = RpcStub(
+            f"localhost:{echo_server.port}", "Echo", max_retries=0
+        )
+        with pytest.raises(RpcError) as info:
+            stub.call("no_such_method")
+        assert info.value.code == "UNIMPLEMENTED"
+        stub.close()
+
+    def test_unknown_service_is_unimplemented(self, echo_server):
+        stub = RpcStub(
+            f"localhost:{echo_server.port}", "NotEcho", max_retries=0
+        )
+        with pytest.raises(RpcError) as info:
+            stub.call("echo")
+        assert info.value.code == "UNIMPLEMENTED"
+        stub.close()
+
+    def test_stopped_server_is_unavailable(self):
+        server = RpcServer(
+            "localhost:0", {"Echo": {"echo": lambda r: r}}
+        ).start()
+        port = server.port
+        server.stop(0)
+        stub = RpcStub(
+            f"localhost:{port}", "Echo", max_retries=0
+        )
+        with pytest.raises(RpcError) as info:
+            stub.call("echo", timeout=5)
+        assert info.value.code == "UNAVAILABLE"
+        stub.close()
+
+
+class TestStubRetry:
+    """Jittered-backoff retry on transient codes (ISSUE 3 satellite):
+    UNAVAILABLE / DEADLINE_EXCEEDED retry up to max_retries with the
+    edl_tpu_rpc_retries_total counter ticking; permanent codes surface
+    immediately."""
+
+    def _flaky_hook(self, failures: int, code: str = "UNAVAILABLE"):
+        state = {"left": failures}
+
+        def hook(service, method, request):
+            if state["left"] > 0:
+                state["left"] -= 1
+                raise RpcError(f"injected {code}", code=code)
+
+        return hook
+
+    def test_transient_blip_retried_to_success(self, echo_server):
+        stub = RpcStub(
+            f"localhost:{echo_server.port}", "Echo",
+            max_retries=3, backoff_base=0.01,
+        )
+        before = _retries_value("Echo", "echo", "UNAVAILABLE")
+        set_chaos_hooks(client=self._flaky_hook(2))
+        try:
+            assert stub.call("echo", value=1) == {"echo": 1}
+        finally:
+            set_chaos_hooks(None, None)
+        assert _retries_value("Echo", "echo", "UNAVAILABLE") == before + 2
+        stub.close()
+
+    def test_retry_cap_exhausts_and_raises(self, echo_server):
+        stub = RpcStub(
+            f"localhost:{echo_server.port}", "Echo",
+            max_retries=2, backoff_base=0.01,
+        )
+        before = _retries_value("Echo", "echo", "UNAVAILABLE")
+        set_chaos_hooks(client=self._flaky_hook(99))
+        try:
+            with pytest.raises(RpcError) as info:
+                stub.call("echo", value=1)
+        finally:
+            set_chaos_hooks(None, None)
+        assert info.value.code == "UNAVAILABLE"
+        assert _retries_value("Echo", "echo", "UNAVAILABLE") == before + 2
+        stub.close()
+
+    def test_permanent_code_never_retried(self, echo_server):
+        stub = RpcStub(
+            f"localhost:{echo_server.port}", "Echo",
+            max_retries=3, backoff_base=0.01,
+        )
+        before = _retries_value("Echo", "echo", "INTERNAL")
+        set_chaos_hooks(client=self._flaky_hook(99, code="INTERNAL"))
+        try:
+            with pytest.raises(RpcError) as info:
+                stub.call("echo", value=1)
+        finally:
+            set_chaos_hooks(None, None)
+        assert info.value.code == "INTERNAL"
+        assert _retries_value("Echo", "echo", "INTERNAL") == before
+        stub.close()
+
+    def test_real_unavailable_retries_then_raises(self):
+        """No hook: a dead port produces genuine UNAVAILABLE statuses
+        and the retry loop burns its budget on them."""
+        port = _free_unused_port()
+        stub = RpcStub(
+            f"localhost:{port}", "Echo",
+            max_retries=1, backoff_base=0.01,
+        )
+        before = _retries_value("Echo", "echo", "UNAVAILABLE")
+        with pytest.raises(RpcError) as info:
+            stub.call("echo", timeout=2)
+        assert info.value.code == "UNAVAILABLE"
+        assert _retries_value("Echo", "echo", "UNAVAILABLE") == before + 1
+        stub.close()
+
+
+class TestServerChaosHook:
+    """Server-side hook seam: a verdict aborts with the given code, a
+    None proceeds — this is the path chaos stall/abort events ride."""
+
+    def test_server_hook_abort_and_passthrough(self, echo_server):
+        calls = []
+
+        def server_hook(tag, service, method, request):
+            calls.append((tag, service, method))
+            if request.get("value") == "die":
+                return ("FAILED_PRECONDITION", "chaos said no")
+            return None
+
+        stub = RpcStub(
+            f"localhost:{echo_server.port}", "Echo", max_retries=0
+        )
+        set_chaos_hooks(server=server_hook)
+        try:
+            assert stub.call("echo", value=1) == {"echo": 1}
+            with pytest.raises(RpcError) as info:
+                stub.call("echo", value="die")
+        finally:
+            set_chaos_hooks(None, None)
+        assert info.value.code == "FAILED_PRECONDITION"
+        assert ("", "Echo", "echo") in calls
+        stub.close()

@@ -736,3 +736,68 @@ def test_serving_soak_sustained_mixed_load(tmp_path):
         ][0]["value"] == 0.0
     finally:
         server.stop()
+
+
+class TestGracefulDrain:
+    """SIGTERM drain (ISSUE 3 satellite): stop accepting, flush
+    in-flight micro-batches, then exit — pod eviction must not drop
+    queued work."""
+
+    def test_drain_flushes_queued_and_sheds_new(self):
+        model = RecordingPredictor(delay=0.05)
+        predictor = BatchingPredictor(
+            FakeStore(model), max_batch_size=4,
+            batch_deadline_ms=20.0,
+            metrics_registry=MetricsRegistry(),
+        ).start()
+        try:
+            results, errors = [None] * 3, []
+
+            def call(i):
+                try:
+                    results[i], _ = predictor.submit(
+                        _features(2), timeout=10.0
+                    )
+                except Exception as exc:
+                    errors.append(exc)
+
+            threads = [
+                threading.Thread(target=call, args=(i,))
+                for i in range(3)
+            ]
+            for t in threads:
+                t.start()
+            time.sleep(0.01)  # let them enqueue
+            assert predictor.drain(timeout=10.0)
+            for t in threads:
+                t.join(timeout=10.0)
+            # Every queued request flushed before the batcher stopped.
+            assert not errors
+            assert all(r is not None for r in results)
+            # New work is refused with the load-shed signal (HTTP 429).
+            with pytest.raises(BatchingPredictor.QueueFullError,
+                               match="draining"):
+                predictor.submit(_features(1))
+        finally:
+            predictor.stop()
+
+    def test_server_drain_closes_http(self):
+        import urllib.error
+        import urllib.request
+
+        server = InferenceServer(
+            FakeStore(RecordingPredictor()), port=0,
+            metrics_registry=MetricsRegistry(),
+        ).start()
+        port = server.port
+        with urllib.request.urlopen(
+            f"http://localhost:{port}/healthz", timeout=5
+        ) as resp:
+            assert resp.status == 200
+        assert server.drain(grace=5.0)
+        with pytest.raises(
+            (urllib.error.URLError, ConnectionError, OSError)
+        ):
+            urllib.request.urlopen(
+                f"http://localhost:{port}/healthz", timeout=2
+            )
