@@ -5,7 +5,7 @@
 PY ?= python
 
 .PHONY: test test-all test-tpu test-k8s native bench serve-bench dryrun \
-	clean lint metrics chaos-smoke chaos-soak
+	clean lint metrics chaos-smoke chaos-soak trace-smoke
 
 # Scrape-and-pretty-print a master's /metrics (docs/observability.md).
 METRICS_ADDR ?= localhost:8080
@@ -13,9 +13,20 @@ metrics:
 	$(PY) tools/dump_metrics.py $(METRICS_ADDR)
 
 # Fast lane (<4 min): everything not marked slow. conftest.py
-# auto-marks the heavy zoo/multi-process/bench suites.
+# auto-marks the heavy zoo/multi-process/bench suites. The tracing
+# smoke (trace-smoke below) runs inside this lane too, as
+# tests/test_tracing.py::test_trace_smoke_end_to_end.
 test:
 	$(PY) -m pytest tests/ -q -m "not slow"
+
+# Distributed-tracing smoke: 2-worker in-process job with the flight
+# recorder on → Perfetto trace_event JSON, schema-checked (one task
+# tree must cross master → worker → row-service). docs/observability.md.
+TRACE_OUT ?= TRACE.json
+trace-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m elasticdl_tpu trace \
+		--out $(TRACE_OUT) --records 32 --num_workers 2
+	$(PY) tools/check_trace.py $(TRACE_OUT)
 
 # Full suite (what the driver/judge runs).
 test-all:

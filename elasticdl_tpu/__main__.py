@@ -1,8 +1,9 @@
 """``python -m elasticdl_tpu`` → the CLI (reference setup.py:33-35
 console entry point ``elasticdl``): ``train | evaluate | predict |
-serve | chaos | clean`` (``serve`` = the online inference server,
-serving/server.py; ``chaos`` = the fault-injection harness,
-chaos/runner.py)."""
+serve | chaos | trace | clean`` (``serve`` = the online inference
+server, serving/server.py; ``chaos`` = the fault-injection harness,
+chaos/runner.py; ``trace`` = the distributed-tracing smoke →
+Perfetto JSON, observability/trace_export.py)."""
 
 import sys
 

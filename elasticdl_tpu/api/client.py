@@ -1,5 +1,6 @@
 """The ``elasticdl_tpu`` CLI (reference elasticdl/python/elasticdl/client.py
-+ api.py): ``train | evaluate | predict | serve | clean`` subcommands.
++ api.py): ``train | evaluate | predict | serve | chaos | trace |
+clean`` subcommands.
 
 - ``--distribution_strategy=Local``: run the whole job in-process via
   LocalExecutor (reference api.py:20-23).
@@ -33,7 +34,8 @@ from elasticdl_tpu.platform.k8s_client import (
 
 logger = get_logger("client")
 
-_SUBCOMMANDS = ("train", "evaluate", "predict", "serve", "chaos", "clean")
+_SUBCOMMANDS = ("train", "evaluate", "predict", "serve", "chaos",
+                "trace", "clean")
 
 
 def _master_manifests(args, mode: str):
@@ -147,7 +149,7 @@ def main(argv=None):
     if not argv or argv[0] not in _SUBCOMMANDS:
         print(
             "usage: elasticdl_tpu "
-            "{train|evaluate|predict|serve|chaos|clean} <flags>",
+            "{train|evaluate|predict|serve|chaos|trace|clean} <flags>",
             file=sys.stderr,
         )
         return 2
@@ -164,6 +166,14 @@ def main(argv=None):
         from elasticdl_tpu.chaos.runner import main as chaos_main
 
         return chaos_main(rest)
+    if mode == "trace":
+        # Distributed-tracing demo/smoke: traced in-process job →
+        # Perfetto JSON + critical-path report (docs/observability.md).
+        from elasticdl_tpu.observability.trace_export import (
+            main as trace_main,
+        )
+
+        return trace_main(rest)
     args = build_parser(mode).parse_args(rest)
     if mode == "clean":
         return _clean(args)

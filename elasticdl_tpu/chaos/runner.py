@@ -85,6 +85,7 @@ class ChaosRunner:
         join_timeout: float = 120.0,
         include_timings: bool = False,
         debug_disable_recovery: bool = False,
+        flight_recorder_spans: int = 512,
     ):
         if model not in ("sparse", "dense"):
             raise ValueError(f"unknown chaos model flavor {model!r}")
@@ -109,6 +110,9 @@ class ChaosRunner:
         # the exactly-once checker demonstrably catches the lost task
         # (tests/test_chaos.py).
         self.debug_disable_recovery = bool(debug_disable_recovery)
+        # Last-N-spans ring attached to FAILED reports (observability/
+        # tracing.py) — every red chaos run carries its own timeline.
+        self.flight_recorder_spans = max(1, int(flight_recorder_spans))
         os.makedirs(workdir, exist_ok=True)
 
     # ---- data / model assembly -----------------------------------------
@@ -428,12 +432,24 @@ class ChaosRunner:
         )
         harness_error = None
         summary = None
+        # Flight recorder for the faulted run: every red run ships its
+        # own timeline. Installing it cannot perturb determinism (span
+        # ids are urandom, never wall-clock, and the injector ignores
+        # the _trace_ctx field), and the dump is attached ONLY to
+        # failed reports — green same-seed runs stay byte-identical.
+        from elasticdl_tpu.observability import tracing
+
+        recorder = tracing.FlightRecorder(
+            capacity=self.flight_recorder_spans
+        )
         injector.install()
+        tracing.install_recorder(recorder)
         try:
             summary = self._run_job("faulted", injector, checkers)
         except ChaosRunError as exc:
             harness_error = str(exc)
         finally:
+            tracing.uninstall_recorder()
             injector.uninstall()
         verdicts = []
         if summary is not None:
@@ -469,6 +485,16 @@ class ChaosRunner:
         }
         if harness_error is not None:
             report["harness_error"] = harness_error
+        if not passed:
+            # Dump the last-N-spans ring into the red report: the
+            # failed invariant arrives with the timeline that led to it
+            # (which task stalled, which RPC retried, which checkpoint
+            # write preceded the kill). Green reports never carry it,
+            # so same-seed byte-identity is untouched.
+            report["flight_recorder"] = {
+                "capacity": recorder.capacity,
+                "spans": [_round_span(s) for s in recorder.snapshot()],
+            }
         if self.include_timings:
             # Wall-clock section: excluded by default so same-seed runs
             # are byte-identical.
@@ -504,6 +530,16 @@ class _LateBoundAccounting:
                 "job never produced a dispatcher to audit",
             )
         return self._inner.check()
+
+
+def _round_span(span: dict) -> dict:
+    """Flight-recorder span for the (red) report: timestamps rebased
+    nowhere (monotonic, process-relative) but rounded for readability;
+    ids kept so the tree is reconstructable with critical_path.py."""
+    out = dict(span)
+    out["t0"] = round(float(span.get("t0", 0.0)), 6)
+    out["dur"] = round(float(span.get("dur", 0.0)), 6)
+    return out
 
 
 def _round_summary(summary: Optional[dict]) -> Optional[dict]:

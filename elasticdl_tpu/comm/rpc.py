@@ -22,6 +22,20 @@ Chaos seam: ``set_chaos_hooks`` installs client/server interceptors
 (``chaos/interceptors.py``) that can delay, drop, or error any call on
 a scripted schedule; ``None`` hooks (the default) cost one attribute
 read per call.
+
+Tracing seam (``observability/tracing.py``, same cost discipline):
+when a flight recorder is installed, ``RpcStub.call`` opens a client
+span per call, injects its context as a ``_trace_ctx`` request field,
+and records a span per backoff sleep (so retries are visible as their
+own intervals); the server handler wrap pops ``_trace_ctx`` and opens
+the server span as its child. With no recorder installed the whole
+machinery is one module-global ``None`` check.
+
+Client-side latency telemetry: ``edl_tpu_rpc_client_seconds`` (one
+histogram observation per send *attempt*, labeled service/method) and
+``edl_tpu_rpc_inflight`` (gauge) — attempt-scoped on purpose, so a
+call that spent 3s in backoff sleeps and 2ms on the wire reads as
+retries + fast attempts, not as a slow server.
 """
 
 import random as _random
@@ -34,6 +48,7 @@ import grpc
 
 from elasticdl_tpu.common import tensor_utils
 from elasticdl_tpu.common.constants import GRPC
+from elasticdl_tpu.observability import tracing as _tracing
 
 _CHANNEL_OPTIONS = [
     ("grpc.max_send_message_length", GRPC.MAX_SEND_MESSAGE_LENGTH),
@@ -87,6 +102,22 @@ def _deserialize(data: bytes) -> dict:
     return tensor_utils.loads(data)
 
 
+# Trace track per service: server spans land on the role's Perfetto
+# process row. Unknown services trace under their own name.
+_SERVICE_ROLES = {
+    "elasticdl_tpu.Master": "master",
+    "RowService": "rowservice",
+}
+
+
+def _server_trace_identity(service_name: str, tag: str):
+    role = _SERVICE_ROLES.get(service_name, service_name)
+    # Tags look like "rowservice/1": the part after the slash is the
+    # shard/instance; a bare tag (or none) is instance 0.
+    instance = tag.rsplit("/", 1)[-1] if tag else "0"
+    return role, instance or "0"
+
+
 class _GenericService(grpc.GenericRpcHandler):
     def __init__(self, service_name: str, handlers: Dict[str, Callable],
                  tag: str = ""):
@@ -108,26 +139,46 @@ class _GenericService(grpc.GenericRpcHandler):
             return None
 
         def unary_unary(request: dict, context):
-            hook = _server_hook
-            if hook is not None:
-                verdict = hook(
-                    self._tag, self._service_name, method, request
+            # Always strip the trace context (handlers must never see
+            # it as a payload field); open the server span as its child
+            # only when this process records.
+            wire_ctx = (
+                request.pop("_trace_ctx", None)
+                if isinstance(request, dict) else None
+            )
+            if _tracing.enabled():
+                role, instance = _server_trace_identity(
+                    self._service_name, self._tag
                 )
-                if verdict is not None:
-                    code, detail = verdict
-                    context.abort(
-                        getattr(grpc.StatusCode, code,
-                                grpc.StatusCode.UNKNOWN),
-                        detail,
+                span = _tracing.server_span(
+                    f"serve/{method}", wire_ctx, role, instance,
+                    service=self._service_name,
+                )
+            else:
+                span = _tracing.NULL_SPAN
+            with span:
+                hook = _server_hook
+                if hook is not None:
+                    verdict = hook(
+                        self._tag, self._service_name, method, request
                     )
-            try:
-                response = handler(request)
-                return response if response is not None else {}
-            except Exception as exc:  # surface handler errors to the client
-                context.abort(
-                    grpc.StatusCode.INTERNAL,
-                    f"{type(exc).__name__}: {exc}",
-                )
+                    if verdict is not None:
+                        code, detail = verdict
+                        span.set(error=code)
+                        context.abort(
+                            getattr(grpc.StatusCode, code,
+                                    grpc.StatusCode.UNKNOWN),
+                            detail,
+                        )
+                try:
+                    response = handler(request)
+                    return response if response is not None else {}
+                except Exception as exc:
+                    # surface handler errors to the client
+                    context.abort(
+                        grpc.StatusCode.INTERNAL,
+                        f"{type(exc).__name__}: {exc}",
+                    )
 
         return grpc.unary_unary_rpc_method_handler(
             unary_unary,
@@ -183,6 +234,29 @@ def _retry_counter():
     )
 
 
+def _client_metrics():
+    """(latency histogram, in-flight gauge) for RpcStub.call. Fetched
+    per call (like _retry_counter) so a test's registry reset can't
+    leave a stale family behind; the registry lookup is a dict hit."""
+    from elasticdl_tpu.observability import default_registry
+
+    registry = default_registry()
+    return (
+        registry.histogram(
+            "rpc_client_seconds",
+            "RPC client send-attempt latency (per attempt: excludes "
+            "backoff sleeps, so retried calls read as N fast attempts "
+            "rather than one slow server)",
+            ["service", "method"],
+        ),
+        registry.gauge(
+            "rpc_inflight",
+            "RPC send attempts currently in flight",
+            ["service", "method"],
+        ),
+    )
+
+
 class RpcStub:
     """Client for one service on one channel; thread-safe.
 
@@ -205,6 +279,13 @@ class RpcStub:
         self._backoff_base = float(backoff_base)
         self._backoff_cap = float(backoff_cap)
         self._methods = {}
+        # method -> (latency series, inflight series): labels are fixed
+        # for a stub's lifetime, so resolve the registry families and
+        # label tuples once instead of on every hot-path call. Keyed to
+        # the registry generation so a test's registry.reset() doesn't
+        # leave the stub observing into detached series forever.
+        self._method_metrics = {}
+        self._metrics_generation = -1
         self._lock = threading.Lock()
 
     def _method(self, name: str):
@@ -217,36 +298,92 @@ class RpcStub:
                 )
             return self._methods[name]
 
+    def _metrics_for(self, method: str):
+        from elasticdl_tpu.observability import default_registry
+
+        generation = default_registry().generation
+        if generation != self._metrics_generation:
+            with self._lock:
+                self._method_metrics = {}
+                self._metrics_generation = generation
+        series = self._method_metrics.get(method)
+        if series is None:
+            latency, inflight = _client_metrics()
+            series = (
+                latency.labels(self._service_name, method),
+                inflight.labels(self._service_name, method),
+            )
+            with self._lock:
+                self._method_metrics[method] = series
+        return series
+
     def call(self, method: str, timeout: Optional[float] = None, **fields):
-        delay = self._backoff_base
-        attempt = 0
-        while True:
-            try:
-                hook = _client_hook
-                if hook is not None:
-                    # May raise RpcError (injected drop — retried below
-                    # like a real one) or ChaosKill (BaseException:
-                    # simulated pod death, never caught here).
-                    hook(self._service_name, method, fields)
-                return self._method(method)(fields, timeout=timeout)
-            except grpc.RpcError as exc:
-                err = RpcError(
-                    f"{self._service_name}.{method} failed: "
-                    f"{exc.code().name}: {exc.details()}",
-                    code=exc.code().name,
-                )
-                err.__cause__ = exc
-            except RpcError as exc:
-                err = exc
-            if (err.code not in RETRYABLE_CODES
-                    or attempt >= self._max_retries):
-                raise err
-            attempt += 1
-            _retry_counter().labels(
-                self._service_name, method, err.code
-            ).inc()
-            time.sleep(delay * (0.5 + _random.random()))
-            delay = min(delay * 2.0, self._backoff_cap)
+        traced = _tracing.enabled()
+        if traced:
+            call_span = _tracing.span(
+                f"rpc/{method}", service=self._service_name
+            )
+        else:
+            call_span = _tracing.NULL_SPAN
+        m_latency, m_inflight = self._metrics_for(method)
+        with call_span:
+            if traced:
+                ctx = call_span.ctx()
+                if ctx is not None:
+                    # Propagated next to the payload; the server wrap
+                    # strips it before the handler runs.
+                    fields["_trace_ctx"] = ctx
+            delay = self._backoff_base
+            attempt = 0
+            while True:
+                attempt_t0 = time.monotonic()
+                m_inflight.inc()
+                try:
+                    try:
+                        hook = _client_hook
+                        if hook is not None:
+                            # May raise RpcError (injected drop —
+                            # retried below like a real one) or
+                            # ChaosKill (BaseException: simulated pod
+                            # death, never caught here).
+                            hook(self._service_name, method, fields)
+                        result = self._method(method)(
+                            fields, timeout=timeout
+                        )
+                        m_latency.observe(
+                            time.monotonic() - attempt_t0
+                        )
+                        return result
+                    except grpc.RpcError as exc:
+                        err = RpcError(
+                            f"{self._service_name}.{method} failed: "
+                            f"{exc.code().name}: {exc.details()}",
+                            code=exc.code().name,
+                        )
+                        err.__cause__ = exc
+                    except RpcError as exc:
+                        err = exc
+                    m_latency.observe(time.monotonic() - attempt_t0)
+                finally:
+                    m_inflight.dec()
+                if (err.code not in RETRYABLE_CODES
+                        or attempt >= self._max_retries):
+                    if traced:
+                        call_span.set(error=err.code, attempts=attempt + 1)
+                    raise err
+                attempt += 1
+                _retry_counter().labels(
+                    self._service_name, method, err.code
+                ).inc()
+                # The backoff sleep is its own span so a retried call
+                # reads as [attempt][backoff][attempt], not one opaque
+                # interval (and server time stays distinguishable from
+                # client-side waiting).
+                with _tracing.span(
+                    "rpc.backoff", code=err.code, attempt=attempt
+                ) if traced else _tracing.NULL_SPAN:
+                    time.sleep(delay * (0.5 + _random.random()))
+                delay = min(delay * 2.0, self._backoff_cap)
 
     def close(self):
         if self._owns_channel:

@@ -233,6 +233,13 @@ def add_train_params(parser):
                         help="Master Prometheus endpoint (/metrics + "
                              "/healthz): port to serve on; 0 picks an "
                              "ephemeral port, -1 (default) disables")
+    parser.add_argument("--flight_recorder", type=int, default=0,
+                        help="Install a distributed-tracing flight "
+                             "recorder of this many spans in the "
+                             "master (collected worker spans + its own "
+                             "are served on /traces next to /metrics; "
+                             "see docs/observability.md). 0 (default) "
+                             "= tracing off")
     parser.add_argument("--metrics_report_secs", type=pos_float,
                         default=15.0,
                         help="How often each worker piggybacks a metrics "

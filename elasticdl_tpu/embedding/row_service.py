@@ -33,6 +33,7 @@ import numpy as np
 from elasticdl_tpu.common.log_utils import get_logger
 from elasticdl_tpu.comm.rpc import RpcError, RpcServer, RpcStub
 from elasticdl_tpu.embedding.host_engine import HostEmbeddingEngine
+from elasticdl_tpu.observability import tracing
 
 logger = get_logger("row_service")
 
@@ -151,8 +152,13 @@ class HostRowService:
         t0 = time.monotonic()
         table = self._tables[request["table"]]
         ids = np.asarray(request["ids"], np.int64)
-        with self._lock:
-            rows = table.get(ids)
+        # Ambient span: nests under the RPC server span (role
+        # rowservice) so lock-wait + store time is attributable
+        # separately from wire/serde time; free with no recorder.
+        with tracing.span("row_pull", table=request["table"],
+                          rows=int(ids.size)):
+            with self._lock:
+                rows = table.get(ids)
         self._m_pulled.inc(ids.size)
         self._m_pull.observe(time.monotonic() - t0)
         return {"rows": np.asarray(rows, np.float32)}
@@ -186,26 +192,30 @@ class HostRowService:
         client = request.get("client", "")
         seq = int(request.get("seq", -1))
         ids = np.asarray(request["ids"], np.int64)
-        with self._lock:
-            if client and seq >= 0:
-                key = _client_key(client)
-                if seq <= self._applied_seq.get(key, -1):
-                    # Retried push whose first attempt DID apply before
-                    # the reply was lost (at-most-once semantics).
-                    self._m_dup.inc()
-                    return {"duplicate": True}
-            self._optimizer.apply_gradients(
-                table,
-                ids,
-                np.asarray(request["grads"], np.float32),
-            )
-            if client and seq >= 0:
-                # Record only AFTER apply succeeds: a failed apply must
-                # leave the seq unburned so the client's retry is not
-                # dropped as a duplicate (the gradient would be lost).
-                self._applied_seq[_client_key(client)] = seq
-            self._push_count += 1
-            version = self._push_count
+        with tracing.span("row_push", table=request["table"],
+                          rows=int(ids.size)):
+            with self._lock:
+                if client and seq >= 0:
+                    key = _client_key(client)
+                    if seq <= self._applied_seq.get(key, -1):
+                        # Retried push whose first attempt DID apply
+                        # before the reply was lost (at-most-once
+                        # semantics).
+                        self._m_dup.inc()
+                        return {"duplicate": True}
+                self._optimizer.apply_gradients(
+                    table,
+                    ids,
+                    np.asarray(request["grads"], np.float32),
+                )
+                if client and seq >= 0:
+                    # Record only AFTER apply succeeds: a failed apply
+                    # must leave the seq unburned so the client's retry
+                    # is not dropped as a duplicate (the gradient would
+                    # be lost).
+                    self._applied_seq[_client_key(client)] = seq
+                self._push_count += 1
+                version = self._push_count
         self._m_pushed.inc(ids.size)
         self._m_push.observe(time.monotonic() - t0)
         if (
@@ -705,6 +715,11 @@ def main(argv=None):
                              "(row_service_* pull/push metrics) as "
                              "Prometheus /metrics; 0 = ephemeral, "
                              "-1 (default) = disabled")
+    parser.add_argument("--flight_recorder", type=int, default=0,
+                        help="Install a span flight recorder of this "
+                             "many entries (served on /traces next to "
+                             "/metrics; tools/dump_metrics.py "
+                             "--traces); 0 (default) = tracing off")
     args = parser.parse_args(argv)
 
     module, _ = load_model_zoo_module(args.model_zoo, args.model_def)
@@ -724,10 +739,16 @@ def main(argv=None):
         )
     service.start(args.addr, tag=f"rowservice/{args.shard_id}")
     logger.info("Row service serving on %s", args.addr)
+    if args.flight_recorder > 0:
+        tracing.set_process_role("rowservice", str(args.shard_id))
+        tracing.install_recorder(
+            tracing.FlightRecorder(args.flight_recorder)
+        )
     if args.metrics_port >= 0:
         # A row-service pod reports to no master, so its registry
         # (row_service_* counters/latency) is scrapeable directly —
-        # without this its metrics would be write-only.
+        # without this its metrics would be write-only. /traces serves
+        # the flight recorder the same way when one is installed.
         from elasticdl_tpu.observability import (
             MetricsHTTPServer,
             default_registry,
@@ -737,6 +758,7 @@ def main(argv=None):
         MetricsHTTPServer(
             lambda: render_prometheus(default_registry().snapshot()),
             port=args.metrics_port,
+            traces=lambda: {"spans": tracing.recorder_spans()},
         ).start()
     service.wait()
 

@@ -138,6 +138,17 @@ class Master:
             ttl_secs=metrics_ttl,
             summary_writer=tb_service,
         )
+        # Distributed tracing (observability/tracing.py): with a
+        # recorder installed, dispatch spans + collected worker spans
+        # serve on /traces next to /metrics.
+        recorder_spans = int(getattr(args, "flight_recorder", 0) or 0)
+        if recorder_spans > 0:
+            from elasticdl_tpu.observability import tracing
+
+            tracing.set_process_role("master")
+            tracing.install_recorder(
+                tracing.FlightRecorder(recorder_spans)
+            )
         self.servicer = MasterServicer(
             self.task_dispatcher,
             self.evaluation_service,

@@ -7,6 +7,13 @@ timeout-based straggler detection (reference servicer.py:107-124).
 
 Handlers take/return plain dicts (see comm/rpc.py); ``InProcessMaster`` in
 testing/ calls them directly, the RpcServer serves them over gRPC.
+
+Tracing: over RPC each handler already runs under a ``serve/<method>``
+server span (comm/rpc.py); the dispatcher adds its own ``dispatch``
+span inside get_task, and the eval-metrics fold — the one handler
+doing real compute — gets an ``eval_report`` span here. Piggybacked
+worker spans ride the ``metrics`` snapshots and are popped into the
+plane's TraceCollector by ``MetricsPlane.ingest``.
 """
 
 import threading
@@ -16,6 +23,7 @@ from typing import Dict
 from elasticdl_tpu.common.constants import TaskType
 from elasticdl_tpu.common.log_utils import get_logger
 from elasticdl_tpu.common.task import Task
+from elasticdl_tpu.observability import tracing
 
 logger = get_logger("master_servicer")
 
@@ -112,9 +120,17 @@ class MasterServicer:
     def report_evaluation_metrics(self, request: dict) -> dict:
         if self._eval_service is None:
             return {"accepted": False}
-        ok = self._eval_service.report_evaluation_metrics(
-            request["model_outputs"], request["labels"]
-        )
+        # The one handler that does real compute (metric fold over raw
+        # output arrays) — span it so a slow eval fold is attributable
+        # in the task timeline rather than reading as RPC time.
+        outputs = request["model_outputs"]
+        rows = getattr(outputs, "shape", None)
+        with tracing.span(
+            "eval_report", outputs=int(rows[0]) if rows else len(outputs),
+        ):
+            ok = self._eval_service.report_evaluation_metrics(
+                outputs, request["labels"]
+            )
         return {"accepted": ok}
 
     def report_version(self, request: dict) -> dict:

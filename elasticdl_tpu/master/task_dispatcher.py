@@ -82,9 +82,13 @@ class TaskDispatcher:
         # scrape; reading a list length needs no lock) + dispatch
         # outcome counters. Families are idempotent on the shared
         # registry; set_function re-binds to the newest dispatcher.
-        from elasticdl_tpu.observability import default_registry
+        from elasticdl_tpu.observability import default_registry, tracing
 
         registry = metrics_registry or default_registry()
+        # Dispatch spans join the pulling task's trace (the RPC server
+        # span — or, in-process, the worker's own task span — is the
+        # ambient parent); free with no recorder installed.
+        self._trace = tracing.Tracer("master")
         # weakref: the registry is process-global and outlives
         # dispatchers; a strong closure would pin a drained job's task
         # lists and shard metadata for the process lifetime.
@@ -211,6 +215,16 @@ class TaskDispatcher:
     def get(self, worker_id: int) -> Optional[Task]:
         """Pop a task for a worker; None when nothing is available
         (the servicer converts None into a WAIT task while unfinished)."""
+        with self._trace.span("dispatch", worker=int(worker_id)) as sp:
+            task = self._get(worker_id)
+            if task is not None:
+                sp.set(task_id=int(task.task_id), type=str(task.type))
+            else:
+                # WAIT / drained polls would drown the dispatch stats.
+                sp.discard()
+            return task
+
+    def _get(self, worker_id: int) -> Optional[Task]:
         callbacks = []
         task = None
         with self._lock:
@@ -259,7 +273,7 @@ class TaskDispatcher:
         for cb in callbacks:
             cb()
         if callbacks:
-            return self.get(worker_id)
+            return self._get(worker_id)
         return task
 
     def _create_training_tasks_locked(self):

@@ -14,9 +14,18 @@ layer a shared measurement substrate instead:
                    the servicer merges them keyed by worker id, and
                    departed workers age out on elastic resize;
 - ``exposition``:  Prometheus text format over a stdlib-only HTTP
-                   endpoint (``/metrics`` + ``/healthz``) plus a bridge
-                   mirroring selected aggregates into the tfevents
-                   ``SummaryWriter`` so TensorBoard stays the human view.
+                   endpoint (``/metrics`` + ``/healthz`` + ``/traces``)
+                   plus a bridge mirroring selected aggregates into the
+                   tfevents ``SummaryWriter`` so TensorBoard stays the
+                   human view;
+- ``tracing``:     distributed spans into a bounded flight recorder,
+                   with trace context propagated through the RPC layer
+                   (``comm/rpc.py``) and collected over the same
+                   piggyback path as metrics snapshots;
+- ``trace_export``: Chrome/Perfetto ``trace_event`` JSON export + the
+                   ``elasticdl_tpu trace`` CLI;
+- ``critical_path``: per-step critical-path and straggler-attribution
+                   reports over collected span trees.
 
 Metric names follow ``edl_tpu_<layer>_<name>`` (docs/observability.md).
 """
@@ -32,4 +41,9 @@ from elasticdl_tpu.observability.exposition import (  # noqa: F401
 from elasticdl_tpu.observability.registry import (  # noqa: F401
     MetricsRegistry,
     default_registry,
+)
+from elasticdl_tpu.observability.tracing import (  # noqa: F401
+    FlightRecorder,
+    TraceCollector,
+    Tracer,
 )

@@ -72,28 +72,115 @@ class ClusterMetrics:
         # worker_id -> last snapshot at departure (counters still owed
         # to the aggregate until the id reappears and is reconciled).
         self._retired: Dict[int, dict] = {}
-        # Folded counter/histogram base from replaced workers.
-        self._retired_totals: Dict[str, float] = {}
-        self._retired_hist: Dict[str, list] = {}
+        # (worker_id, instance token) -> (totals, hist): the latest
+        # folded counter/histogram contribution of each replaced
+        # process generation. Keyed per generation and REPLACED (not
+        # added) on re-fold, so a stalled-but-alive old process
+        # alternating reports with its replacement stays bounded — the
+        # base always holds each generation's latest values exactly
+        # once, and a generation that reports again (its cumulative
+        # values now ride its live snapshot) drops its fold entry.
+        self._folds: Dict[tuple, tuple] = {}
+        # Memory bound under elastic churn: only the newest
+        # _MAX_FOLDS_PER_WORKER generations stay individually keyed;
+        # older ones (long dead — only a generation resurrected after
+        # that many successors could double count, and none can, since
+        # instance tokens die with their process) compact into one
+        # permanent base.
+        self._compacted_totals: Dict[str, float] = {}
+        self._compacted_hist: Dict[str, list] = {}
+        # Tokens whose fold was compacted (dict for insertion-order
+        # eviction): if such a generation turns out to be stalled-but-
+        # alive and reports again, its compacted contribution is
+        # cancelled approximately (see ingest) instead of double
+        # counting forever.
+        self._compacted_tokens: Dict[tuple, None] = {}
+
+    _MAX_FOLDS_PER_WORKER = 4
+    _MAX_COMPACTED_TOKENS = 4096
+
+    def _fold_locked(self, worker_id: int, snapshot: dict):
+        """Record a replaced generation's counters/histograms in the
+        base, replacing any earlier fold of the same generation, then
+        compact this worker's oldest generations past the cap."""
+        totals: Dict[str, float] = {}
+        hist: Dict[str, list] = {}
+        _accumulate(snapshot, totals, hist, include_gauges=False)
+        self._folds[(worker_id, snapshot["instance"])] = (totals, hist)
+        keys = [k for k in self._folds if k[0] == worker_id]
+        for oldest in keys[:-self._MAX_FOLDS_PER_WORKER]:
+            old_totals, old_hist = self._folds.pop(oldest)
+            for name, value in old_totals.items():
+                self._compacted_totals[name] = (
+                    self._compacted_totals.get(name, 0.0) + value
+                )
+            for name, (h_sum, h_count) in old_hist.items():
+                acc = self._compacted_hist.setdefault(name, [0.0, 0])
+                acc[0] += h_sum
+                acc[1] += h_count
+            self._compacted_tokens[oldest] = None
+            while len(self._compacted_tokens) > self._MAX_COMPACTED_TOKENS:
+                self._compacted_tokens.pop(
+                    next(iter(self._compacted_tokens))
+                )
 
     def ingest(self, worker_id: int, snapshot: dict,
                now: Optional[float] = None):
         if worker_id < 0 or not snapshot:
             return
         now = time.monotonic() if now is None else now
+        wid = int(worker_id)
+        token = snapshot.get("instance")
         with self._lock:
-            retired = self._retired.pop(int(worker_id), None)
+            retired = self._retired.pop(wid, None)
             if retired is not None:
                 old = retired.get("instance")
-                new = snapshot.get("instance")
-                if old and new and old != new:
-                    _accumulate(
-                        retired, self._retired_totals,
-                        self._retired_hist, include_gauges=False,
-                    )
+                if old and token and old != token:
+                    self._fold_locked(wid, retired)
                 # Same (or unknown) instance: the retired snapshot's
                 # values live on inside the new one — just un-retire.
-            self._snapshots[int(worker_id)] = (snapshot, now)
+            live = self._snapshots.get(wid)
+            if live is not None:
+                old = live[0].get("instance")
+                if old and token and old != token:
+                    # A relaunched worker reusing a still-live name
+                    # (died and came back inside the TTL, before the
+                    # master noticed): the dead process's counters must
+                    # fold into the base, not be silently overwritten —
+                    # the aggregate would regress — and its stale
+                    # snapshot must not survive the replacement's.
+                    self._fold_locked(wid, live[0])
+            if token:
+                # This generation's cumulative values now ride its live
+                # snapshot; an earlier fold of it (the stalled-old-
+                # process flap, or a fold-then-reappear) must not keep
+                # counting on top.
+                self._folds.pop((wid, token), None)
+                if (wid, token) in self._compacted_tokens:
+                    del self._compacted_tokens[(wid, token)]
+                    # A generation already compacted into the permanent
+                    # base turned out to be stalled-but-alive. Its
+                    # exact compacted amounts are gone; cancel with the
+                    # snapshot's CURRENT values (counters only grow, so
+                    # they bound the compacted ones) — the residual
+                    # error is one stall-window of growth, versus a
+                    # permanent full double count.
+                    neg_t: Dict[str, float] = {}
+                    neg_h: Dict[str, list] = {}
+                    _accumulate(snapshot, neg_t, neg_h,
+                                include_gauges=False)
+                    for name, value in neg_t.items():
+                        self._compacted_totals[name] = (
+                            self._compacted_totals.get(name, 0.0)
+                            - value
+                        )
+                    for name, (h_sum, h_count) in neg_h.items():
+                        acc = self._compacted_hist.setdefault(
+                            name, [0.0, 0]
+                        )
+                        acc[0] -= h_sum
+                        acc[1] -= h_count
+            self._snapshots[wid] = (snapshot, now)
 
     def remove_worker(self, worker_id: int):
         """Immediate removal (master recovered the worker's tasks /
@@ -127,12 +214,21 @@ class ClusterMetrics:
 
     def aggregate(self) -> Dict[str, float]:
         """Sum counters/gauges and mean histograms across live workers,
-        plus retired workers' counters/histograms (gauges excluded) —
-        the scalar view the TensorBoard bridge mirrors."""
+        plus retired/replaced generations' counters/histograms (gauges
+        excluded) — the scalar view the TensorBoard bridge mirrors."""
         live = self.snapshots()
         with self._lock:
-            totals = dict(self._retired_totals)
-            hist = {k: list(v) for k, v in self._retired_hist.items()}
+            totals = dict(self._compacted_totals)
+            hist = {
+                k: list(v) for k, v in self._compacted_hist.items()
+            }
+            for fold_totals, fold_hist in self._folds.values():
+                for name, value in fold_totals.items():
+                    totals[name] = totals.get(name, 0.0) + value
+                for name, (h_sum, h_count) in fold_hist.items():
+                    acc = hist.setdefault(name, [0.0, 0])
+                    acc[0] += h_sum
+                    acc[1] += h_count
             retired = list(self._retired.values())
         for snapshot in retired:
             _accumulate(snapshot, totals, hist, include_gauges=False)
@@ -153,8 +249,14 @@ class MetricsPlane:
 
     def __init__(self, registry: Optional[MetricsRegistry] = None,
                  ttl_secs: float = 60.0, summary_writer=None):
+        from elasticdl_tpu.observability.tracing import TraceCollector
+
         self.registry = registry or default_registry()
         self.cluster = ClusterMetrics(ttl_secs)
+        # Distributed-tracing collection: spans piggyback inside the
+        # same worker snapshots the cluster view merges (a "spans" key
+        # next to "families"); the collector dedups by span id.
+        self.traces = TraceCollector()
         # TensorboardService (write_dict_to_summary) or SummaryWriter
         # (add_scalars) — both are duck-typed below; None = no bridge.
         self._summary_writer = summary_writer
@@ -164,6 +266,9 @@ class MetricsPlane:
     # ---- ingest / render ----------------------------------------------
 
     def ingest(self, worker_id: int, snapshot: dict):
+        spans = snapshot.pop("spans", None) if snapshot else None
+        if spans:
+            self.traces.ingest(spans)
         self.cluster.ingest(worker_id, snapshot)
 
     def render(self) -> str:
@@ -171,11 +276,27 @@ class MetricsPlane:
             self.registry.snapshot(), self.cluster.snapshots()
         )
 
+    def trace_spans(self) -> list:
+        """Collected spans: piggybacked worker spans ∪ this process's
+        own flight-recorder ring (master dispatch spans never ride a
+        report RPC), deduped by span id."""
+        from elasticdl_tpu.observability import tracing
+
+        merged = tracing.TraceCollector()
+        merged.ingest(self.traces.spans())
+        merged.ingest(tracing.recorder_spans())
+        return merged.spans()
+
+    def render_traces(self) -> dict:
+        """JSON body for the ``/traces`` endpoint."""
+        return {"spans": self.trace_spans()}
+
     # ---- HTTP ----------------------------------------------------------
 
     def serve(self, port: int = 0, host: str = "") -> MetricsHTTPServer:
         self._http = MetricsHTTPServer(
-            self.render, port=port, host=host
+            self.render, port=port, host=host,
+            traces=self.render_traces,
         ).start()
         return self._http
 

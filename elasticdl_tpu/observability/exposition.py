@@ -11,9 +11,12 @@ stop appearing once the aggregator ages it out).
 ``MetricsHTTPServer`` serves ``/metrics`` and ``/healthz`` from a
 ``http.server.ThreadingHTTPServer`` on a daemon thread — no new
 dependency, ephemeral-port friendly (``port=0``), scrapeable by real
-Prometheus or ``tools/dump_metrics.py``.
+Prometheus or ``tools/dump_metrics.py``. With a ``traces`` callable it
+also serves ``/traces``: the process flight recorder / master trace
+collection as JSON, for ``tools/dump_metrics.py --traces``.
 """
 
+import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Optional
@@ -129,6 +132,14 @@ class _Handler(BaseHTTPRequestHandler):
     # Populated per-server via functools.partial-style subclassing in
     # MetricsHTTPServer.start().
     render: Callable[[], str] = staticmethod(lambda: "")
+    traces: Optional[Callable[[], dict]] = None
+
+    def _reply(self, body: bytes, content_type: str):
+        self.send_response(200)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
 
     def do_GET(self):  # noqa: N802 (BaseHTTPRequestHandler API)
         path = self.path.split("?", 1)[0]
@@ -138,20 +149,18 @@ class _Handler(BaseHTTPRequestHandler):
             except Exception as exc:
                 self.send_error(500, f"{type(exc).__name__}: {exc}")
                 return
-            self.send_response(200)
-            self.send_header("Content-Type", CONTENT_TYPE)
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
+            self._reply(body, CONTENT_TYPE)
+        elif path == "/traces" and type(self).traces is not None:
+            try:
+                body = json.dumps(type(self).traces()).encode("utf-8")
+            except Exception as exc:
+                self.send_error(500, f"{type(exc).__name__}: {exc}")
+                return
+            self._reply(body, "application/json")
         elif path == "/healthz":
-            body = b"ok\n"
-            self.send_response(200)
-            self.send_header("Content-Type", "text/plain; charset=utf-8")
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
+            self._reply(b"ok\n", "text/plain; charset=utf-8")
         else:
-            self.send_error(404, "try /metrics or /healthz")
+            self.send_error(404, "try /metrics, /traces, or /healthz")
 
     def log_message(self, fmt, *args):
         logger.debug("metrics http: " + fmt, *args)
@@ -166,8 +175,10 @@ class MetricsHTTPServer:
     """
 
     def __init__(self, render: Callable[[], str], port: int = 0,
-                 host: str = ""):
+                 host: str = "",
+                 traces: Optional[Callable[[], dict]] = None):
         self._render = render
+        self._traces = traces
         self._host = host
         self._requested_port = int(port)
         self._httpd: Optional[ThreadingHTTPServer] = None
@@ -176,6 +187,10 @@ class MetricsHTTPServer:
     def start(self) -> "MetricsHTTPServer":
         handler = type("_BoundHandler", (_Handler,), {
             "render": staticmethod(self._render),
+            "traces": (
+                staticmethod(self._traces)
+                if self._traces is not None else None
+            ),
         })
         self._httpd = ThreadingHTTPServer(
             (self._host, self._requested_port), handler

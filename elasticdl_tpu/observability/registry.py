@@ -196,6 +196,10 @@ class MetricsRegistry:
         # master tells "same process, counters continuous" from "new
         # process, counters restarted" by this token, not the id.
         self._instance = uuid.uuid4().hex
+        # Bumped on reset(): callers caching resolved series (hot-path
+        # instrumentation like RpcStub) compare this to notice the
+        # families were dropped and must be re-resolved.
+        self.generation = 0
 
     def _family(self, name: str, kind: str, help_text: str,
                 labelnames: Sequence[str],
@@ -256,6 +260,7 @@ class MetricsRegistry:
         with self._lock:
             self._families.clear()
             self._instance = uuid.uuid4().hex
+            self.generation += 1
 
 
 _DEFAULT = MetricsRegistry()

@@ -122,7 +122,7 @@ def _coerce_signature(features, signature):
 
 class _Request:
     __slots__ = ("features", "n", "event", "outputs", "error",
-                 "version", "enqueued_at", "cancelled")
+                 "version", "enqueued_at", "cancelled", "trace_ctx")
 
     def __init__(self, features, n: int):
         self.features = features
@@ -137,6 +137,11 @@ class _Request:
         # sustained overload that dead work is what keeps the server
         # from ever recovering goodput.
         self.cancelled = False
+        # (trace_id, span_id) of the submitting handler's request span
+        # when tracing is on — the batcher thread retro-records
+        # queue-wait / batch spans against it (spans can't ride the
+        # thread-local context across the handoff).
+        self.trace_ctx = None
 
 
 class BatchingPredictor:
@@ -152,7 +157,12 @@ class BatchingPredictor:
                  batch_deadline_ms: float = 5.0,
                  max_queue: int = 256,
                  metrics_registry=None):
+        from elasticdl_tpu.observability import tracing
+
         self._store = store
+        # Request / queue-wait / batch-assembly / predict spans when a
+        # flight recorder is installed; free otherwise.
+        self._tracer = tracing.Tracer("serving")
         self.max_batch_size = int(max_batch_size)
         self.batch_deadline = float(batch_deadline_ms) / 1e3
         self.max_queue = int(max_queue)
@@ -241,24 +251,34 @@ class BatchingPredictor:
                 f"size {limit}; split the request"
             )
         request = _Request(features, n)
-        with self._cond:
-            if self._draining:
-                self._m_shed.inc()
-                raise self.QueueFullError("server draining (SIGTERM)")
-            if len(self._queue) >= self.max_queue:
-                self._m_shed.inc()
-                raise self.QueueFullError(
-                    f"queue full ({self.max_queue} requests waiting)"
+        with self._tracer.span("request", n=n) as req_span:
+            if req_span.span_id is not None:
+                request.trace_ctx = (
+                    req_span.trace_id, req_span.span_id
                 )
-            self._queue.append(request)
-            self._cond.notify_all()
-        if not request.event.wait(timeout):
-            request.cancelled = True
-            raise TimeoutError("predict timed out")
-        self._m_latency.observe(time.monotonic() - request.enqueued_at)
-        if request.error is not None:
-            raise request.error
-        return request.outputs, request.version
+            with self._cond:
+                if self._draining:
+                    self._m_shed.inc()
+                    raise self.QueueFullError(
+                        "server draining (SIGTERM)"
+                    )
+                if len(self._queue) >= self.max_queue:
+                    self._m_shed.inc()
+                    raise self.QueueFullError(
+                        f"queue full ({self.max_queue} requests "
+                        "waiting)"
+                    )
+                self._queue.append(request)
+                self._cond.notify_all()
+            if not request.event.wait(timeout):
+                request.cancelled = True
+                raise TimeoutError("predict timed out")
+            self._m_latency.observe(
+                time.monotonic() - request.enqueued_at
+            )
+            if request.error is not None:
+                raise request.error
+            return request.outputs, request.version
 
     # ---- batcher side --------------------------------------------------
 
@@ -333,10 +353,39 @@ class BatchingPredictor:
             bucket *= 2
         return min(bucket, max(limit, n))
 
-    def _run_batch(self, batch: List[_Request]):
+    def _trace_batch(self, batch: List[_Request], record_wait: bool):
+        """Retro-record queue-wait spans (enqueue → pop, per request)
+        and return the ctx the shared batch spans should parent to (the
+        head request's); None when tracing is off."""
+        from elasticdl_tpu.observability import tracing
+
+        if not tracing.enabled():
+            return None
+        now = time.monotonic()
+        head_ctx = None
+        for request in batch:
+            if request.trace_ctx is None:
+                continue
+            trace_id, span_id = request.trace_ctx
+            if head_ctx is None:
+                head_ctx = request.trace_ctx
+            if record_wait:
+                tracing.record_span(
+                    "queue_wait", request.enqueued_at,
+                    now - request.enqueued_at,
+                    trace_id=trace_id, parent_id=span_id,
+                    role="serving",
+                )
+        return head_ctx
+
+    def _run_batch(self, batch: List[_Request], _record_wait=True):
+        from elasticdl_tpu.observability import tracing
+
         model = self._store.current()
         total = sum(r.n for r in batch)
+        head_ctx = self._trace_batch(batch, _record_wait)
         try:
+            assembly_t0 = time.monotonic()
             structure0 = batch[0].features
             for request in batch[1:]:
                 if not _tree_leaves_equal_structure(
@@ -354,8 +403,26 @@ class BatchingPredictor:
             features = _pad_tree(features, target, total)
             self._m_padded.inc(target - total)
             t0 = time.monotonic()
+            if head_ctx is not None:
+                # Shared per-flush spans hang off the head request's
+                # tree (one batch serves many requests; attrs carry the
+                # occupancy so the share is readable).
+                tracing.record_span(
+                    "batch_assembly", assembly_t0, t0 - assembly_t0,
+                    trace_id=head_ctx[0], parent_id=head_ctx[1],
+                    role="serving", requests=len(batch),
+                    examples=int(total), bucket=int(target),
+                )
             outputs = model.predict(features)
-            self._m_batch_seconds.observe(time.monotonic() - t0)
+            predict_dur = time.monotonic() - t0
+            if head_ctx is not None:
+                tracing.record_span(
+                    "predict", t0, predict_dur,
+                    trace_id=head_ctx[0], parent_id=head_ctx[1],
+                    role="serving", requests=len(batch),
+                    examples=int(total), bucket=int(target),
+                )
+            self._m_batch_seconds.observe(predict_dur)
             self._m_batch_size.observe(total)
             lo = 0
             for request in batch:
@@ -369,9 +436,10 @@ class BatchingPredictor:
             if len(batch) > 1:
                 # Isolate the poison request: one bad payload (wrong
                 # structure, stray dtype) must not 500 the innocent
-                # requests sharing its flush.
+                # requests sharing its flush. (Queue-wait was already
+                # recorded for the shared flush — don't re-record.)
                 for request in batch:
-                    self._run_batch([request])
+                    self._run_batch([request], _record_wait=False)
                 return
             for request in batch:
                 request.error = exc
@@ -481,6 +549,16 @@ class _Handler(BaseHTTPRequestHandler):
                 200, body.encode("utf-8"),
                 "text/plain; version=0.0.4; charset=utf-8",
             )
+        elif path == "/traces":
+            # The process flight recorder (request / queue-wait /
+            # batch / predict spans) for dump_metrics --traces; empty
+            # until the server runs with --flight_recorder N.
+            from elasticdl_tpu.observability import tracing
+
+            body = json.dumps(
+                {"spans": tracing.recorder_spans()}
+            ).encode("utf-8")
+            self._reply(200, body, "application/json")
         elif path == "/healthz":
             ok = srv.store.current() is not None
             self._reply(
@@ -718,7 +796,22 @@ def main(argv=None) -> int:
         help="SIGTERM drain budget for in-flight micro-batches; keep "
              "under the pod's terminationGracePeriodSeconds",
     )
+    parser.add_argument(
+        "--flight_recorder", type=int, default=0,
+        help="Install a span flight recorder of this many entries "
+             "(request / queue-wait / batch-assembly / predict spans, "
+             "served on /traces next to /metrics; "
+             "tools/dump_metrics.py --traces). 0 (default) = off",
+    )
     args = parser.parse_args(argv)
+
+    if args.flight_recorder > 0:
+        from elasticdl_tpu.observability import tracing
+
+        tracing.set_process_role("serving")
+        tracing.install_recorder(
+            tracing.FlightRecorder(args.flight_recorder)
+        )
 
     from elasticdl_tpu.serving.model_store import ModelStore
 

@@ -202,6 +202,16 @@ def build_worker(args, master_client=None) -> Worker:
         master_client = MasterClient(
             args.master_addr, worker_id=args.worker_id
         )
+    recorder_spans = int(getattr(args, "flight_recorder", 0) or 0)
+    if recorder_spans > 0:
+        # Tracing on: step-phase spans into the process ring; they
+        # piggyback to the master on the same snapshot RPCs as metrics.
+        from elasticdl_tpu.observability import tracing
+
+        tracing.set_process_role("worker", str(args.worker_id))
+        tracing.install_recorder(
+            tracing.FlightRecorder(recorder_spans)
+        )
     import jax as _jax
 
     checkpoint_hook = None

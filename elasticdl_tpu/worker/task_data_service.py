@@ -14,6 +14,7 @@ elastic re-queue semantics, no pending-task bookkeeping).
 """
 
 import contextlib
+import sys
 import time
 from typing import Iterator, Optional, Tuple
 
@@ -34,8 +35,18 @@ _TASK_TYPE_TO_MODE = {
 class TaskDataService:
     def __init__(self, master_client, data_reader, dataset_fn,
                  minibatch_size: int, wait_sleep_secs: float = 2.0,
-                 prefetch_depth: int = 2, on_wait=None, metrics_fn=None):
+                 prefetch_depth: int = 2, on_wait=None, metrics_fn=None,
+                 on_metrics_delivered=None, tracer=None):
+        from elasticdl_tpu.observability import tracing
+
         self._master = master_client
+        # Root-span factory for the task timeline (the worker passes
+        # its own so spans land on the right worker track).
+        self._tracer = tracer or tracing.Tracer("worker")
+        # Called after a get_task that CARRIED a snapshot succeeds —
+        # the worker commits its span-ring cursor there, so spans
+        # offered on a failed RPC are re-offered instead of lost.
+        self._on_metrics_delivered = on_metrics_delivered
         # Zero-arg callable returning a (rate-limited) registry snapshot
         # to piggyback on get_task, or None. Without it an idle worker —
         # polling WAIT tasks between epochs — makes no reporting RPC and
@@ -77,56 +88,85 @@ class TaskDataService:
         max_failures = max(1, int(60.0 / max(self._wait_sleep_secs, 0.1)))
         rpc_failures = 0
         while True:
+            # One root span per task cycle — opened BEFORE get_task so
+            # the master's dispatch spans join the task's tree; cycles
+            # that turn out to be WAIT polls or failures are discarded
+            # (recording them would drown the latency stats). The span
+            # stays open across the yield: the worker consumes the
+            # batches on this same thread, so its step-phase spans nest
+            # under the task.
+            span = self._tracer.span("task")
+            span.__enter__()
             try:
-                task, finished = self._master.get_task(
-                    metrics=self._metrics_fn() if self._metrics_fn else None
-                )
-            except RpcError as exc:
-                rpc_failures += 1
-                logger.warning(
-                    "get_task RPC failed (%d/%d): %s",
-                    rpc_failures, max_failures, exc,
-                )
-                if rpc_failures >= max_failures:
-                    logger.warning(
-                        "master unreachable; treating job as finished"
+                try:
+                    metrics = (
+                        self._metrics_fn() if self._metrics_fn else None
                     )
-                    return
-                # _wait (not sleep): multi-host workers must keep
-                # ticking the barrier during the backoff or they strand
-                # peers mid-collective.
-                self._wait()
-                continue
-            rpc_failures = 0
-            if task is None:
-                if finished:
-                    return
-                self._wait()
-                continue
-            if task.type == TaskType.WAIT:
-                self._wait()
-                continue
-            if task.type == TaskType.TRAIN_END_CALLBACK:
-                yield task, None
-                continue
-            mode = _TASK_TYPE_TO_MODE.get(task.type)
-            if mode is None:
-                logger.warning("Unknown task type %s; skipping", task.type)
-                self._master.report_task_result(
-                    task.task_id, err_reason=f"unknown type {task.type}"
+                    task, finished = self._master.get_task(
+                        metrics=metrics
+                    )
+                    if metrics and self._on_metrics_delivered:
+                        self._on_metrics_delivered()
+                except RpcError as exc:
+                    span.discard()
+                    rpc_failures += 1
+                    logger.warning(
+                        "get_task RPC failed (%d/%d): %s",
+                        rpc_failures, max_failures, exc,
+                    )
+                    if rpc_failures >= max_failures:
+                        logger.warning(
+                            "master unreachable; treating job as finished"
+                        )
+                        return
+                    # _wait (not sleep): multi-host workers must keep
+                    # ticking the barrier during the backoff or they
+                    # strand peers mid-collective.
+                    self._wait()
+                    continue
+                rpc_failures = 0
+                if task is None:
+                    if finished:
+                        span.discard()
+                        return
+                    span.discard()
+                    self._wait()
+                    continue
+                if task.type == TaskType.WAIT:
+                    span.discard()
+                    self._wait()
+                    continue
+                span.set(task_id=int(task.task_id), type=str(task.type))
+                if task.type == TaskType.TRAIN_END_CALLBACK:
+                    yield task, None
+                    continue
+                mode = _TASK_TYPE_TO_MODE.get(task.type)
+                if mode is None:
+                    logger.warning(
+                        "Unknown task type %s; skipping", task.type
+                    )
+                    self._master.report_task_result(
+                        task.task_id,
+                        err_reason=f"unknown type {task.type}",
+                    )
+                    continue
+                batches = batch_records(
+                    self._reader.read_records(task),
+                    self._minibatch_size,
+                    self._dataset_fn,
+                    mode,
+                    self._reader.metadata,
                 )
-                continue
-            batches = batch_records(
-                self._reader.read_records(task),
-                self._minibatch_size,
-                self._dataset_fn,
-                mode,
-                self._reader.metadata,
-            )
-            ctx = (
-                prefetch(batches, self._prefetch_depth)
-                if self._prefetch_depth > 0
-                else contextlib.nullcontext(batches)
-            )
-            with ctx as batches:
-                yield task, batches
+                ctx = (
+                    prefetch(batches, self._prefetch_depth)
+                    if self._prefetch_depth > 0
+                    else contextlib.nullcontext(batches)
+                )
+                with ctx as batches:
+                    yield task, batches
+            finally:
+                # Real exc_info (not Nones): an exception escaping the
+                # loop body must tag the task span with its error attr,
+                # or a crashed task reads as a fast successful one in
+                # /traces and skews the critical-path stats.
+                span.__exit__(*sys.exc_info())

@@ -89,6 +89,21 @@ class Worker:
         self.last_batch = None
         self._train_step = None
         self._eval_step = build_eval_step()
+        # Tracing (observability/tracing.py): step-phase spans into the
+        # process flight recorder when one is installed; free otherwise
+        # (Tracer.span is one module-global read). Recorded spans ride
+        # the same piggybacked snapshots as metrics, incrementally via
+        # the ring cursor.
+        from elasticdl_tpu.observability import tracing
+
+        self._tracer = tracing.Tracer("worker", str(worker_id))
+        # Ring cursor of the last spans CONFIRMED delivered to the
+        # master, plus the cursor offered on the in-flight snapshot —
+        # committed only when the carrying RPC succeeds, so a failed
+        # report re-offers its spans on the next one (the collector
+        # dedups by span id, so an ambiguous failure resends harmlessly).
+        self._trace_cursor = 0
+        self._trace_cursor_offered = 0
         self._task_data = TaskDataService(
             master_client, data_reader, model_spec.dataset_fn,
             minibatch_size, prefetch_depth=prefetch_depth,
@@ -97,6 +112,8 @@ class Worker:
             # view: snapshots ride get_task too, not just the report
             # RPCs (rate-limited inside _metrics_snapshot).
             metrics_fn=self._metrics_snapshot,
+            on_metrics_delivered=self._metrics_delivered,
+            tracer=self._tracer,
         )
         self.last_metrics = None
         # Periodic sharded checkpoint (reference PS saves inside
@@ -247,12 +264,56 @@ class Worker:
 
     def _metrics_snapshot(self) -> Optional[dict]:
         """Registry snapshot for piggybacking, rate-limited to one per
-        metrics_report_secs; None between reports."""
+        metrics_report_secs; None between reports. When a flight
+        recorder is installed, the spans recorded since the last
+        CONFIRMED delivery ride along under a ``spans`` key (the
+        master's MetricsPlane pops them into its TraceCollector); the
+        cursor commits in _metrics_delivered, so spans offered on an
+        RPC that failed are re-offered on the next report instead of
+        being lost with the outage they describe."""
+        from elasticdl_tpu.observability import tracing
+
         now = time.monotonic()
         if now - self._last_metrics_report < self._metrics_report_secs:
             return None
         self._last_metrics_report = now
-        return self._metrics.snapshot()
+        snapshot = self._metrics.snapshot()
+        spans, self._trace_cursor_offered = tracing.spans_since(
+            self._trace_cursor
+        )
+        if spans:
+            snapshot["spans"] = spans
+        return snapshot
+
+    def _metrics_delivered(self):
+        """The RPC carrying the last snapshot succeeded — its spans
+        reached the master; advance the ring cursor past them."""
+        self._trace_cursor = self._trace_cursor_offered
+
+    def _report_task(self, task_id: int, err_reason: str = ""):
+        """report_task_result with the metrics/span piggyback and the
+        span-cursor delivery commit."""
+        snap = self._metrics_snapshot()
+        accepted = self._master.report_task_result(
+            task_id, err_reason=err_reason, metrics=snap
+        )
+        if snap is not None:
+            self._metrics_delivered()
+        return accepted
+
+    def _traced_batches(self, batches):
+        """Yield from ``batches`` with each blocking ``next()`` under a
+        ``fetch`` span — the input-wait phase of the step timeline
+        (decode / prefetch / row pull-ahead latency the device sits
+        idle for)."""
+        it = iter(batches)
+        sentinel = object()
+        while True:
+            with self._tracer.span("fetch"):
+                batch = next(it, sentinel)
+            if batch is sentinel:
+                return
+            yield batch
 
     @staticmethod
     def _batch_nbytes(batch) -> int:
@@ -404,7 +465,7 @@ class Worker:
             PreparedBatch = ()  # isinstance() no-match sentinel
         count = 0
         try:
-            for batch in batches:
+            for batch in self._traced_batches(batches):
                 raw = (
                     batch.raw if isinstance(batch, PreparedBatch)
                     else batch
@@ -416,12 +477,13 @@ class Worker:
                     # the steps it names.
                     self._profiler.observe_step(int(self.state.step))
                 step_t0 = time.monotonic()
-                with self._timing.record("batch_process"):
-                    if self._profiler is not None:
-                        with self._profiler.annotation("train_step"):
+                with self._tracer.span("device_step", kind="train"):
+                    with self._timing.record("batch_process"):
+                        if self._profiler is not None:
+                            with self._profiler.annotation("train_step"):
+                                self._process_train_batch(batch)
+                        else:
                             self._process_train_batch(batch)
-                    else:
-                        self._process_train_batch(batch)
                 self._m_step.labels("train").observe(
                     time.monotonic() - step_t0
                 )
@@ -433,11 +495,15 @@ class Worker:
                 version = int(self.state.step)
                 if version % self._version_report_steps == 0:
                     with self._timing.record("report_version"):
+                        snap = self._metrics_snapshot()
                         self._master.report_version(
-                            version, metrics=self._metrics_snapshot()
+                            version, metrics=snap
                         )
-                with self._timing.record("checkpoint"):
-                    self._checkpoint.maybe_save(self.state)
+                        if snap is not None:
+                            self._metrics_delivered()
+                with self._tracer.span("checkpoint"):
+                    with self._timing.record("checkpoint"):
+                        self._checkpoint.maybe_save(self.state)
         finally:
             if prepared_iter is not None:
                 prepared_iter.close()
@@ -478,23 +544,26 @@ class Worker:
             self._profiler.observe_step(int(self.state.step))
         stacked = stack_batches(batch_list)
         step_t0 = time.monotonic()
-        with self._timing.record("batch_process"):
-            for attempt in range(MAX_MINIBATCH_RETRY_NUM):
-                try:
-                    self.state, metrics = self._multi_step(
-                        self.state, stacked
+        with self._tracer.span(
+            "device_step", kind="train_fused", batches=len(batch_list)
+        ):
+            with self._timing.record("batch_process"):
+                for attempt in range(MAX_MINIBATCH_RETRY_NUM):
+                    try:
+                        self.state, metrics = self._multi_step(
+                            self.state, stacked
+                        )
+                        break
+                    except jax.errors.JaxRuntimeError:
+                        logger.warning(
+                            "fused task step failed (attempt %d):\n%s",
+                            attempt + 1, traceback.format_exc(),
+                        )
+                else:
+                    raise RuntimeError(
+                        f"Fused task failed after "
+                        f"{MAX_MINIBATCH_RETRY_NUM} retries"
                     )
-                    break
-                except jax.errors.JaxRuntimeError:
-                    logger.warning(
-                        "fused task step failed (attempt %d):\n%s",
-                        attempt + 1, traceback.format_exc(),
-                    )
-            else:
-                raise RuntimeError(
-                    f"Fused task failed after "
-                    f"{MAX_MINIBATCH_RETRY_NUM} retries"
-                )
         self.last_metrics = {"loss": metrics["loss"][-1]}
         self._m_step.labels("train_fused").observe(
             time.monotonic() - step_t0
@@ -514,9 +583,10 @@ class Worker:
             > prev // self._version_report_steps
         ):
             with self._timing.record("report_version"):
-                self._master.report_version(
-                    version, metrics=self._metrics_snapshot()
-                )
+                snap = self._metrics_snapshot()
+                self._master.report_version(version, metrics=snap)
+                if snap is not None:
+                    self._metrics_delivered()
         with self._timing.record("checkpoint"):
             self._checkpoint.maybe_save(self.state)
         return len(batch_list)
@@ -565,7 +635,8 @@ class Worker:
 
                 self._await_turn(multihost.STEP_FORWARD)
             step_t0 = time.monotonic()
-            preds = self._eval_step(self.state, batch)
+            with self._tracer.span("device_step", kind="eval"):
+                preds = self._eval_step(self.state, batch)
             self._m_step.labels("eval").observe(time.monotonic() - step_t0)
             real = int(np.sum(batch["mask"]))
             self._m_examples.labels(task.type).inc(real)
@@ -587,7 +658,8 @@ class Worker:
 
                 self._await_turn(multihost.STEP_FORWARD)
             step_t0 = time.monotonic()
-            preds = self._eval_step(self.state, batch)
+            with self._tracer.span("device_step", kind="predict"):
+                preds = self._eval_step(self.state, batch)
             self._m_step.labels("predict").observe(
                 time.monotonic() - step_t0
             )
@@ -667,16 +739,13 @@ class Worker:
                     self._run_train_end_callbacks()
                     callbacks_ok = True
                     self._m_tasks.labels(task.type, "ok").inc()
-                    self._master.report_task_result(
-                        task.task_id, metrics=self._metrics_snapshot()
-                    )
+                    self._report_task(task.task_id)
                 except Exception as exc:
                     if not callbacks_ok:
                         self._m_tasks.labels(task.type, "error").inc()
-                    self._master.report_task_result(
+                    self._report_task(
                         task.task_id,
                         err_reason=f"callback: {type(exc).__name__}: {exc}",
-                        metrics=self._metrics_snapshot(),
                     )
                 continue
             if self._stop_requested:
@@ -708,9 +777,8 @@ class Worker:
                         "final checkpoint on preemption failed: %s", exc
                     )
                 self._m_tasks.labels(task.type, "preempted").inc()
-                self._master.report_task_result(
-                    task.task_id, err_reason="preempted (SIGTERM)",
-                    metrics=self._metrics_snapshot(),
+                self._report_task(
+                    task.task_id, err_reason="preempted (SIGTERM)"
                 )
                 break
             # Counts the processing outcome, not the report RPC's: a
@@ -730,9 +798,7 @@ class Worker:
                         self._process_predict_task(task, batches)
                 processed_ok = True
                 self._m_tasks.labels(task.type, "ok").inc()
-                self._master.report_task_result(
-                    task.task_id, metrics=self._metrics_snapshot()
-                )
+                self._report_task(task.task_id)
             except Exception as exc:
                 if self._multihost_sync:
                     # A failed step after winning a barrier tick leaves
@@ -753,10 +819,9 @@ class Worker:
                 # err_reason would read as success at the master).
                 if not processed_ok:
                     self._m_tasks.labels(task.type, "error").inc()
-                self._master.report_task_result(
+                self._report_task(
                     task.task_id,
                     err_reason=f"{type(exc).__name__}: {exc}",
-                    metrics=self._metrics_snapshot(),
                 )
         if not self._stop_requested:
             # A stopping worker must not drain: the barrier drains only

@@ -10,6 +10,8 @@ and a corrupt-LATEST-checkpoint kill is caught by the loss-equivalence
 checker (silent training loss must not pass).
 """
 
+import json
+
 import numpy as np
 import pytest
 
@@ -339,6 +341,13 @@ def test_lost_task_regression_is_caught(tmp_path):
     assert not verdict["passed"]
     assert "did not drain" in verdict["details"]
     assert "LOST" in verdict["details"]
+    # A red report carries its own timeline: the faulted run's flight
+    # recorder (last-N spans) is attached, and the dump is JSON-clean.
+    dump = report["flight_recorder"]
+    assert dump["capacity"] == 512
+    names = {s["name"] for s in dump["spans"]}
+    assert "task" in names and "device_step" in names
+    json.dumps(dump)
 
 
 def test_corrupt_latest_checkpoint_caught_by_equivalence(tmp_path):

@@ -147,6 +147,37 @@ def test_render_prometheus_golden():
     assert render_prometheus(reg.snapshot()) == GOLDEN
 
 
+def test_exposition_escaping_golden_file(tmp_path):
+    """Label values with ``\\``, ``"``, and newlines (and HELP text
+    with both) must render escaped per exposition format 0.0.4 — an
+    unescaped task name would corrupt the whole scrape. Pinned against
+    a checked-in golden file so any renderer change shows as a diff."""
+    import pathlib
+
+    reg = MetricsRegistry()
+    c = reg.counter("escape_total",
+                    'help with \\ backslash and\nnewline', ["task"])
+    c.labels('quoted "name"').inc(1)
+    c.labels('back\\slash').inc(2)
+    c.labels('multi\nline').inc(3)
+    c.labels('all three: \\ " \n!').inc(4)
+    h = reg.histogram("escape_seconds", "latency", ["op"],
+                      buckets=(0.5,))
+    h.labels('pull "fast"\n').observe(0.25)
+    text = render_prometheus(reg.snapshot())
+    golden = (
+        pathlib.Path(__file__).parent / "golden"
+        / "exposition_escaping.txt"
+    ).read_text()
+    assert text == golden
+    # Every sample line survives as ONE line (raw newlines would split
+    # them) and the values parse back out of the escaped text.
+    lines = [l for l in text.splitlines() if not l.startswith("#")]
+    assert len(lines) == 8
+    for line in lines:
+        assert line.rstrip().rsplit(" ", 1)[1].replace(".", "").isdigit()
+
+
 def test_render_prometheus_worker_labels():
     master = MetricsRegistry()
     master.gauge("master_up", "m").set(1)
@@ -241,6 +272,212 @@ def test_aggregate_monotonic_across_departures():
     assert agg["edl_tpu_examples_total"] == 140.0
     assert agg["edl_tpu_lat_count"] == 1.0
     assert "edl_tpu_inflight" not in agg
+
+
+def test_relaunch_under_same_name_does_not_resurrect_stale_snapshot():
+    """Elastic resize relaunch semantics: a worker that dies and comes
+    back under the SAME worker id (new registry instance token) must
+    not resurrect its dead predecessor's snapshot — not via the TTL
+    path, and not when the replacement reports before the master even
+    noticed the death."""
+    # Path 1: death noticed via TTL aging.
+    cluster = ClusterMetrics(ttl_secs=10.0)
+    reg = MetricsRegistry()
+    reg.counter("examples_total", "").inc(100)
+    reg.gauge("inflight", "").set(7)
+    cluster.ingest(0, reg.snapshot(), now=100.0)
+    assert cluster.snapshots(now=120.0) == {}  # aged out
+    fresh = MetricsRegistry()
+    fresh.counter("examples_total", "").inc(2)
+    cluster.ingest(0, fresh.snapshot(), now=121.0)
+    live = cluster.snapshots(now=121.0)
+    # The live view is the replacement's snapshot, not the stale one.
+    (series,) = [
+        s for f in live[0]["families"]
+        if f["name"] == "edl_tpu_examples_total" for s in f["series"]
+    ]
+    assert series["value"] == 2.0
+    agg = cluster.aggregate()
+    # ...but the dead process's counters fold into the monotonic base.
+    assert agg["edl_tpu_examples_total"] == 102.0
+    # Its point-in-time gauges do NOT linger.
+    assert "edl_tpu_inflight" not in agg
+
+    # Path 2: the replacement reports while the stale snapshot is
+    # still live (died and relaunched inside the TTL) — the aggregate
+    # must stay monotonic instead of silently dropping to 2.
+    cluster2 = ClusterMetrics(ttl_secs=1e9)
+    reg2 = MetricsRegistry()
+    reg2.counter("examples_total", "").inc(100)
+    cluster2.ingest(0, reg2.snapshot(), now=100.0)
+    fresh2 = MetricsRegistry()
+    fresh2.counter("examples_total", "").inc(2)
+    cluster2.ingest(0, fresh2.snapshot(), now=101.0)
+    assert cluster2.aggregate()["edl_tpu_examples_total"] == 102.0
+    # And the rendered per-worker series show only the live snapshot.
+    text = render_prometheus(None, cluster2.snapshots(now=101.0))
+    assert 'edl_tpu_examples_total{worker="0"} 2' in text
+    assert "100" not in text
+
+
+def test_alternating_generations_stay_bounded():
+    """A stalled-but-alive old process alternating reports with its
+    replacement under one worker id (the chaos stall regime) must not
+    inflate the aggregate: each generation's fold is REPLACED, not
+    re-added, and a generation that reports again drops its fold (its
+    cumulative values ride the live snapshot)."""
+    cluster = ClusterMetrics(ttl_secs=1e9)
+    reg_a = MetricsRegistry()
+    reg_a.counter("examples_total", "").inc(100)
+    reg_b = MetricsRegistry()
+    reg_b.counter("examples_total", "").inc(5)
+    for round_no in range(4):
+        cluster.ingest(0, reg_a.snapshot(), now=100.0 + 2 * round_no)
+        cluster.ingest(0, reg_b.snapshot(), now=101.0 + 2 * round_no)
+        # Live B + folded A, each at its LATEST value (A gained one
+        # example per round) — never A+B+A+... compounding.
+        assert cluster.aggregate()["edl_tpu_examples_total"] == (
+            105.0 + round_no
+        )
+        reg_a.counter("examples_total", "").inc(1)  # A still training
+    cluster.ingest(0, reg_a.snapshot(), now=200.0)
+    assert cluster.aggregate()["edl_tpu_examples_total"] == pytest.approx(
+        104.0 + 5.0  # live A (104 now) + folded B
+    )
+
+
+def test_fold_ledger_compacts_under_elastic_churn():
+    """Long elastic jobs relaunch the same worker id many times; only
+    the newest few generations stay individually keyed (bounded
+    memory), older ones compact into the permanent base — totals stay
+    exact either way."""
+    cluster = ClusterMetrics(ttl_secs=1e9)
+    for gen in range(6):
+        reg = MetricsRegistry()
+        reg.counter("examples_total", "").inc(10)
+        cluster.ingest(0, reg.snapshot(), now=float(gen))
+    # 5 replaced generations + 1 live, each worth 10.
+    assert cluster.aggregate()["edl_tpu_examples_total"] == 60.0
+    assert len(cluster._folds) <= ClusterMetrics._MAX_FOLDS_PER_WORKER
+    assert cluster._compacted_totals["edl_tpu_examples_total"] == 10.0
+
+
+def test_compacted_generation_resurrection_cancels():
+    """A generation compacted into the permanent base that turns out
+    to be stalled-but-alive (reports again) must cancel its compacted
+    contribution — the residual error is bounded by its stall-window
+    growth, never a permanent full double count."""
+    cluster = ClusterMetrics(ttl_secs=1e9)
+    cluster._MAX_FOLDS_PER_WORKER = 1  # force compaction quickly
+    reg_a = MetricsRegistry()
+    reg_a.counter("examples_total", "").inc(10)
+    snap_a = reg_a.snapshot()
+    cluster.ingest(0, snap_a, now=1.0)
+    cluster.ingest(0, _snap(examples_total=10), now=2.0)  # B folds A
+    cluster.ingest(0, _snap(examples_total=10), now=3.0)  # C: A compacts
+    assert cluster._compacted_totals["edl_tpu_examples_total"] == 10.0
+    # A wakes and reports again, having grown by 2 during the stall.
+    reg_a.counter("examples_total", "").inc(2)
+    cluster.ingest(0, reg_a.snapshot(), now=4.0)
+    # Exact would be A12 + B10 + C10 = 32; the cancel leaves only the
+    # 2-example stall growth as undercount — not 42 (double-counted A).
+    assert cluster.aggregate()["edl_tpu_examples_total"] == 30.0
+
+
+def test_print_spans_groups_interleaved_traces():
+    """Two traces whose roots interleave in time still render as one
+    block per trace."""
+    import io
+
+    from tools.dump_metrics import print_spans
+
+    spans = [
+        {"span_id": f"{t}{i}", "trace_id": f"tr{t}", "parent_id": None,
+         "name": f"root{t}{i}", "role": "worker", "instance": "0",
+         "t0": float(i * 2 + t), "dur": 0.1, "attrs": {}}
+        for i in range(2) for t in range(2)  # interleaved starts
+    ]
+    buf = io.StringIO()
+    print_spans(spans, out=buf)
+    text = buf.getvalue()
+    assert text.count("trace tr0") == 1
+    assert text.count("trace tr1") == 1
+
+
+def test_metrics_plane_collects_piggybacked_spans():
+    """Worker snapshots may carry a ``spans`` key next to
+    ``families``; the plane pops it into its TraceCollector (the
+    cluster metrics view never sees it) and /traces-style rendering
+    merges the local flight recorder in, deduped."""
+    from elasticdl_tpu.observability import tracing
+
+    plane = MetricsPlane(registry=MetricsRegistry())
+    snapshot = _snap(steps_total=1)
+    snapshot["spans"] = [
+        {"span_id": "a", "name": "task", "trace_id": "t"},
+        {"span_id": "b", "name": "device_step", "trace_id": "t",
+         "parent_id": "a"},
+    ]
+    plane.ingest(0, snapshot)
+    assert "spans" not in snapshot  # popped before the cluster view
+    assert {s["span_id"] for s in plane.traces.spans()} == {"a", "b"}
+    # Re-delivery (two in-process workers sharing one recorder) dedups.
+    plane.ingest(1, {"instance": "x", "families": [], "spans": [
+        {"span_id": "a", "name": "task", "trace_id": "t"},
+    ]})
+    assert len(plane.traces.spans()) == 2
+    # trace_spans merges the process flight recorder (master-local
+    # spans that never ride a report RPC).
+    rec = tracing.install_recorder(tracing.FlightRecorder(8))
+    try:
+        with tracing.Tracer("master").span("dispatch"):
+            pass
+    finally:
+        tracing.uninstall_recorder()
+    assert rec.snapshot()  # sanity
+    tracing.install_recorder(rec)
+    try:
+        names = {s["name"] for s in plane.trace_spans()}
+    finally:
+        tracing.uninstall_recorder()
+    assert names == {"task", "device_step", "dispatch"}
+
+
+def test_traces_endpoint_and_dump_metrics(capsys):
+    """/traces next to /metrics + ``tools/dump_metrics.py --traces``
+    pretty-printing the span tree of a live process."""
+    from tools.dump_metrics import main as dump_main
+
+    plane = MetricsPlane(registry=MetricsRegistry())
+    plane.ingest(0, {
+        "instance": "i", "families": [],
+        "spans": [
+            {"span_id": "root", "name": "task", "trace_id": "t",
+             "parent_id": None, "role": "worker", "instance": "0",
+             "t0": 1.0, "dur": 0.5, "attrs": {"task_id": 4}},
+            {"span_id": "kid", "name": "device_step", "trace_id": "t",
+             "parent_id": "root", "role": "worker", "instance": "0",
+             "t0": 1.1, "dur": 0.3, "attrs": {}},
+        ],
+    })
+    server = plane.serve(port=0)
+    try:
+        with urllib.request.urlopen(
+            f"http://localhost:{server.port}/traces"
+        ) as resp:
+            assert resp.status == 200
+            body = json.loads(resp.read())
+        assert {s["span_id"] for s in body["spans"]} == {"root", "kid"}
+        assert dump_main(
+            [f"localhost:{server.port}", "--traces"]
+        ) == 0
+        out = capsys.readouterr().out
+        assert "trace t" in out
+        assert "task  [worker/0]  500.000ms  task_id=4" in out
+        # The child renders indented under its parent.
+        assert "    device_step" in out
+    finally:
+        plane.stop()
 
 
 def test_aggregate_reconciles_reappearing_worker_id():

@@ -208,6 +208,75 @@ class TestStubRetry:
         stub.close()
 
 
+class TestClientMetrics:
+    """Per-method client latency histogram + in-flight gauge
+    (``edl_tpu_rpc_client_seconds`` / ``edl_tpu_rpc_inflight``):
+    attempt-scoped, so retried calls read as N fast attempts and the
+    backoff sleeps never inflate the latency series."""
+
+    @staticmethod
+    def _client_series(name, kind, service, method):
+        reg = default_registry()
+        family = (
+            reg.histogram(name, "", ["service", "method"])
+            if kind == "histogram"
+            else reg.gauge(name, "", ["service", "method"])
+        )
+        return family.labels(service, method)
+
+    def test_latency_per_attempt_and_inflight_returns_to_zero(
+        self, echo_server
+    ):
+        stub = RpcStub(
+            f"localhost:{echo_server.port}", "Echo",
+            max_retries=3, backoff_base=0.2,
+        )
+        hist = self._client_series(
+            "rpc_client_seconds", "histogram", "Echo", "echo"
+        )
+        gauge = self._client_series(
+            "rpc_inflight", "gauge", "Echo", "echo"
+        )
+        before_count, before_sum = hist.count, hist.sum
+        assert stub.call("echo", value=1) == {"echo": 1}
+        assert hist.count == before_count + 1
+        assert gauge.value == 0.0  # dec'd on the way out
+
+        # Two injected drops → three attempts observed, and the two
+        # ~0.1-0.2s backoff sleeps must NOT land in the attempt sum
+        # (that is what distinguishes backoff from server time).
+        state = {"left": 2}
+
+        def hook(service, method, request):
+            if state["left"] > 0:
+                state["left"] -= 1
+                raise RpcError("injected", code="UNAVAILABLE")
+
+        before_count, before_sum = hist.count, hist.sum
+        set_chaos_hooks(client=hook)
+        try:
+            assert stub.call("echo", value=2) == {"echo": 2}
+        finally:
+            set_chaos_hooks(None, None)
+        assert hist.count == before_count + 3
+        assert hist.sum - before_sum < 0.1
+        assert gauge.value == 0.0
+        stub.close()
+
+    def test_inflight_zero_after_failure(self):
+        port = _free_unused_port()
+        stub = RpcStub(
+            f"localhost:{port}", "Echo", max_retries=0,
+        )
+        gauge = self._client_series(
+            "rpc_inflight", "gauge", "Echo", "echo"
+        )
+        with pytest.raises(RpcError):
+            stub.call("echo", timeout=2)
+        assert gauge.value == 0.0
+        stub.close()
+
+
 class TestServerChaosHook:
     """Server-side hook seam: a verdict aborts with the given code, a
     None proceeds — this is the path chaos stall/abort events ride."""

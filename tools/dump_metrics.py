@@ -5,15 +5,21 @@ Usage::
 
     python tools/dump_metrics.py localhost:8080          # pretty table
     python tools/dump_metrics.py http://host:port --raw  # exposition text
+    python tools/dump_metrics.py localhost:8080 --traces # + span trees
     make metrics METRICS_ADDR=localhost:8080
 
 Works against any Prometheus text endpoint — the in-process test
 cluster (``MiniCluster(metrics_port=0)``), a real master started with
 ``--metrics_port``, or a row-service process wired to serve its own
-registry. Stdlib only (urllib), like the endpoint itself.
+registry. ``--traces`` additionally fetches the sibling ``/traces``
+endpoint (the flight recorder / master trace collection, served when
+the process runs with ``--flight_recorder N``) and pretty-prints each
+trace as an indented span tree with durations. Stdlib only (urllib),
+like the endpoint itself.
 """
 
 import argparse
+import json
 import re
 import sys
 import urllib.request
@@ -96,12 +102,73 @@ def pretty_print(text: str, out=None):
         out.write("\n")
 
 
+def traces_url(addr: str) -> str:
+    return normalize_url(addr).rsplit("/metrics", 1)[0] + "/traces"
+
+
+def fetch_traces(addr: str, timeout: float = 10.0) -> list:
+    """Span dicts from the process's /traces endpoint (flight recorder
+    or master trace collection)."""
+    with urllib.request.urlopen(
+        traces_url(addr), timeout=timeout
+    ) as resp:
+        return json.loads(resp.read().decode("utf-8")).get("spans", [])
+
+
+def print_spans(spans: list, out=None):
+    """Indented span trees, one block per trace, children under their
+    parents in start order."""
+    out = out if out is not None else sys.stdout
+    if not spans:
+        out.write("no spans recorded (is a flight recorder "
+                  "installed? --flight_recorder N)\n")
+        return
+    by_id = {s.get("span_id"): s for s in spans}
+    children = {}
+    roots = []
+    for s in sorted(spans, key=lambda s: float(s.get("t0", 0.0))):
+        parent = s.get("parent_id")
+        if parent and parent in by_id:
+            children.setdefault(parent, []).append(s)
+        else:
+            roots.append(s)
+
+    def emit(span, depth):
+        attrs = span.get("attrs") or {}
+        attr_text = (
+            "  " + " ".join(f"{k}={v}" for k, v in sorted(attrs.items()))
+            if attrs else ""
+        )
+        out.write(
+            f"{'  ' * depth}{span.get('name')}  "
+            f"[{span.get('role')}/{span.get('instance')}]  "
+            f"{float(span.get('dur', 0.0)) * 1e3:.3f}ms{attr_text}\n"
+        )
+        for child in children.get(span.get("span_id"), ()):
+            emit(child, depth + 1)
+
+    # One block per trace even when traces' roots interleave in time
+    # (multi-worker runs): group roots by trace id, traces ordered by
+    # their first root's start.
+    by_trace = {}
+    for root in roots:
+        by_trace.setdefault(root.get("trace_id"), []).append(root)
+    for trace, trace_roots in by_trace.items():
+        out.write(f"trace {trace}\n")
+        for root in trace_roots:
+            emit(root, 1)
+    out.write(f"({len(spans)} spans, {len(roots)} roots)\n")
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser("dump_metrics")
     parser.add_argument("addr", help="host:port or URL of the master "
                                      "metrics endpoint")
     parser.add_argument("--raw", action="store_true",
                         help="Print the exposition text verbatim")
+    parser.add_argument("--traces", action="store_true",
+                        help="Also fetch /traces and print the flight "
+                             "recorder as indented span trees")
     parser.add_argument("--timeout", type=float, default=10.0)
     args = parser.parse_args(argv)
     try:
@@ -113,6 +180,16 @@ def main(argv=None) -> int:
         sys.stdout.write(text)
     else:
         pretty_print(text)
+    if args.traces:
+        try:
+            spans = fetch_traces(args.addr, timeout=args.timeout)
+        except OSError as exc:
+            print(f"traces fetch failed: {exc} (endpoint serves "
+                  "/traces only when tracing is wired)",
+                  file=sys.stderr)
+            return 1
+        sys.stdout.write("\n---- traces ----\n")
+        print_spans(spans)
     return 0
 
 
