@@ -5,7 +5,8 @@
 PY ?= python
 
 .PHONY: test test-all test-tpu test-k8s native bench serve-bench dryrun \
-	clean lint metrics chaos-smoke chaos-soak trace-smoke
+	clean lint metrics chaos-smoke chaos-soak chaos-master-smoke \
+	trace-smoke
 
 # Scrape-and-pretty-print a master's /metrics (docs/observability.md).
 METRICS_ADDR ?= localhost:8080
@@ -66,6 +67,21 @@ CHAOS_SEED ?= 7
 chaos-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m elasticdl_tpu chaos run \
 		--seed $(CHAOS_SEED) --report CHAOS_r01.json
+
+# Master-crash drill (docs/fault_tolerance.md): two master kills
+# recovered by write-ahead journal replay, workers riding the outage
+# out and re-attaching under the bumped generation; all five
+# invariants (incl. master-restart equivalence) must pass, then fsck
+# audits the journal the run left behind. Fast-lane equivalent:
+# tests/test_chaos.py::test_master_kill_drill_all_invariants_pass.
+chaos-master-smoke:
+	workdir=$$(mktemp -d /tmp/edl_chaos_master.XXXXXX); \
+	JAX_PLATFORMS=cpu $(PY) -m elasticdl_tpu chaos run \
+		--seed $(CHAOS_SEED) --master_kill \
+		--workdir $$workdir \
+		--report CHAOS_master_r01.json \
+	&& $(PY) tools/check_journal.py $$workdir/r0/faulted/journal; \
+	rc=$$?; rm -rf $$workdir; exit $$rc
 
 # Randomized soak: N seed-derived plans; a failure prints the seed
 # that reproduces it (slow lane — not part of tier-1).

@@ -13,6 +13,7 @@ from elasticdl_tpu.chaos.faults import (  # noqa: F401
     FaultEvent,
     FaultPlan,
     default_plan,
+    master_kill_plan,
     randomized_plan,
 )
 from elasticdl_tpu.chaos.interceptors import (  # noqa: F401
@@ -24,6 +25,7 @@ from elasticdl_tpu.chaos.invariants import (  # noqa: F401
     CheckResult,
     ExactlyOnceTaskAccounting,
     LossTrajectoryEquivalence,
+    MasterRestartEquivalence,
     RowConservation,
 )
 from elasticdl_tpu.chaos.runner import ChaosRunner  # noqa: F401

@@ -28,6 +28,12 @@ Event kinds (ISSUE 3 tentpole):
                        ``duration_calls`` calls (a dead channel).
 - ``corrupt_checkpoint`` — truncate/garbage/delete a shard file of the
                        version written by the Nth matching save.
+- ``master_kill``    — simulate MASTER pod death at the Nth dispatch
+                       RPC (ISSUE 5 tentpole): the harness's restart
+                       seam rebuilds the master from its write-ahead
+                       journal (master/journal.py) while the worker
+                       rides the outage out on its RPC retry budget
+                       and re-attaches under the bumped generation.
 """
 
 import dataclasses
@@ -42,10 +48,11 @@ RPC_DELAY = "rpc_delay"
 STALL_SHARD = "stall_shard"
 BLACKHOLE = "blackhole"
 CORRUPT_CHECKPOINT = "corrupt_checkpoint"
+MASTER_KILL = "master_kill"
 
 KINDS = (
     KILL_WORKER, RPC_DROP, RPC_ERROR, RPC_DELAY, STALL_SHARD,
-    BLACKHOLE, CORRUPT_CHECKPOINT,
+    BLACKHOLE, CORRUPT_CHECKPOINT, MASTER_KILL,
 )
 
 # Site of an RPC fault: client = before the request leaves the stub
@@ -200,6 +207,51 @@ def default_plan(seed: int = 0,
     return FaultPlan(events=events, seed=int(seed))
 
 
+def master_kill_plan(seed: int = 0,
+                     master_service: str = "elasticdl_tpu.Master",
+                     num_row_service_shards: int = 1) -> FaultPlan:
+    """The master-crash acceptance schedule (ISSUE 5): kill the master
+    twice — once at a clean task boundary (a ``get_task``, nothing
+    leased by the reporting path) and once mid-lease (the worker's
+    ``report_task_result`` arrives at a master that just lost its
+    memory) — plus one transient RPC drop so the ordinary stub-retry
+    path is exercised alongside the restart ride-out. Both kills must
+    leave accounting exactly-once and the loss trajectory equal to the
+    fault-free twin: the first proves the journal replays the queue
+    state, the second proves a surviving lease + retried report
+    resolves without re-training. Trigger positions wobble with the
+    seed (same seed, same plan, byte for byte)."""
+    rng = random.Random(int(seed))
+    # Trigger positions assume the canonical job shape (>= 4 tasks:
+    # the default 64 records at 8x2 records/task). Kills are listed
+    # BEFORE the drop so their call counters see every attempt — an
+    # event only stops counting the call on which an earlier-listed
+    # event fired.
+    events = [
+        # Kill #1: at a dispatch boundary — the recovered master must
+        # hand out the exact task the dead one would have.
+        FaultEvent(
+            kind=MASTER_KILL, site="client", target=master_service,
+            method="get_task", at_call=3 + rng.randint(0, 1),
+        ),
+        # Kill #2: mid-lease — the worker trained the task, the report
+        # hits the fresh incarnation, which must accept it against the
+        # replayed lease (NOT re-queue it: re-training would diverge
+        # from the twin).
+        FaultEvent(
+            kind=MASTER_KILL, site="client", target=master_service,
+            method="report_task_result", at_call=3 + rng.randint(0, 1),
+        ),
+        # Transient blip alongside the restarts: the plain stub-retry
+        # path must coexist with generation fencing.
+        FaultEvent(
+            kind=RPC_DROP, site="client", target=master_service,
+            method="get_task", at_call=2, code="UNAVAILABLE",
+        ),
+    ]
+    return FaultPlan(events=events, seed=int(seed))
+
+
 def randomized_plan(seed: int,
                     master_service: str = "elasticdl_tpu.Master",
                     num_row_service_shards: int = 1,
@@ -253,6 +305,11 @@ def describe(plan: FaultPlan) -> str:
         if e.kind == KILL_WORKER:
             bits.append(f"victim={'any' if e.worker_id < 0 else e.worker_id}"
                         f" at get_task #{e.at_call}")
+        elif e.kind == MASTER_KILL:
+            bits.append(
+                f"at {e.method or 'get_task'} #{e.at_call} "
+                "(journal-replay restart)"
+            )
         elif e.kind == CORRUPT_CHECKPOINT:
             bits.append(f"dir~{e.target!r} save #{e.at_save}"
                         f" mode={e.corrupt_mode}")

@@ -32,6 +32,7 @@ from elasticdl_tpu.chaos.faults import (
     BLACKHOLE,
     CORRUPT_CHECKPOINT,
     KILL_WORKER,
+    MASTER_KILL,
     RPC_DELAY,
     RPC_DROP,
     RPC_ERROR,
@@ -85,6 +86,14 @@ class FaultInjector:
         # core): [{worker_id, new_id, latency_secs}].
         self.recoveries: List[dict] = []
         self._kill_times: Dict[int, float] = {}
+        # Master-restart seam (ISSUE 5): the harness registers a
+        # callable that plays the platform's restart-policy role —
+        # tear down the master, rebuild it from its write-ahead
+        # journal (master/journal.py), re-point the transport. Fired
+        # OUTSIDE the injector lock (it rebuilds dispatchers). The
+        # wall-clock log mirrors `recoveries` (timings-only section).
+        self._master_restart: Optional[callable] = None
+        self.master_restarts: List[dict] = []
         from elasticdl_tpu.observability import default_registry
 
         registry = metrics_registry or default_registry()
@@ -102,6 +111,10 @@ class FaultInjector:
         self._m_recovery_secs = registry.histogram(
             "chaos_recovery_seconds",
             "Kill→replacement-running recovery latency",
+        )
+        self._m_master_kills = registry.counter(
+            "chaos_master_kills_total",
+            "Simulated master deaths (journal-replay restarts)",
         )
 
     # ---- install / uninstall -------------------------------------------
@@ -128,6 +141,12 @@ class FaultInjector:
         rpc_mod.set_chaos_hooks(None, None)
         saver_mod.set_chaos_hooks(None, None)
         im_mod.set_chaos_observer(None)
+
+    def set_master_restart(self, fn: Optional[callable]):
+        """Register the master-restart seam (the chaos runner's
+        ``MiniCluster.restart_master``; in k8s the restart policy +
+        journal recovery in master/main.py play this role)."""
+        self._master_restart = fn
 
     def __enter__(self):
         return self.install()
@@ -181,7 +200,23 @@ class FaultInjector:
         action = None
         with self._lock:
             for idx, event in enumerate(self.plan.events):
-                if event.kind == KILL_WORKER:
+                if event.kind == MASTER_KILL:
+                    # Default boundary is get_task (a dispatch: the
+                    # journal tail ends on a dispatch record);
+                    # method="report_task_result" kills mid-lease so
+                    # the recovered master must resolve the retried
+                    # report against the replayed lease.
+                    kill_method = event.method or "get_task"
+                    if method != kill_method or (
+                        event.target and event.target != service
+                    ):
+                        continue
+                    if self._should_fire(idx, event):
+                        self._record(idx, event, method=method)
+                        self._m_master_kills.inc()
+                        action = ("master_kill", idx)
+                        break
+                elif event.kind == KILL_WORKER:
                     # Default boundary is get_task (a clean task
                     # boundary: nothing leased, loss-equivalent
                     # recovery); event.method can move the death to
@@ -226,10 +261,35 @@ class FaultInjector:
                         break
         if action is None:
             return
+        if isinstance(action, tuple) and action[0] == "master_kill":
+            # The master's memory dies HERE — whatever the journal
+            # holds is all the restart seam gets. The in-flight call
+            # then fails UNAVAILABLE (the dead master never answered);
+            # the worker's transport retry re-sends it against the
+            # recovered incarnation.
+            self._run_master_restart()
+            raise RpcError(
+                f"chaos: master killed during {service}.{method}",
+                code="UNAVAILABLE",
+            )
         if isinstance(action, tuple):
             time.sleep(action[1])
             return
         raise action
+
+    def _run_master_restart(self):
+        restart = self._master_restart
+        if restart is None:
+            logger.error(
+                "chaos: master_kill fired but no restart seam is "
+                "registered — the outage will never end"
+            )
+            return
+        t0 = time.monotonic()
+        restart()
+        self.master_restarts.append({
+            "latency_secs": time.monotonic() - t0,
+        })
 
     def server_hook(self, tag: str, service: str, method: str,
                     request: dict):
@@ -401,6 +461,9 @@ class FaultInjector:
                 "kind": counts
             },
             "edl_tpu_chaos_kills_total": counts.get(KILL_WORKER, 0),
+            "edl_tpu_chaos_master_kills_total": counts.get(
+                MASTER_KILL, 0
+            ),
             "edl_tpu_chaos_recoveries_total": len(self.recoveries),
             "edl_tpu_chaos_recovery_seconds": {
                 "count": len(self.recoveries)

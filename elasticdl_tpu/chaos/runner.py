@@ -8,7 +8,12 @@
    checkpoint, and instance-manager seams — where worker deaths are
    handled the way ``master/instance_manager.py`` handles a pod
    DELETED event: re-queue the dead worker's tasks, relaunch under a
-   NEW worker id, restore from the rolling checkpoint.
+   NEW worker id, restore from the rolling checkpoint. Plans with
+   ``master_kill`` events additionally run the MASTER over a
+   write-ahead journal (master/journal.py): each kill discards the
+   live master and recovers an equivalent one by journal replay
+   (``MiniCluster.restart_master``), audited by the
+   master-restart-equivalence invariant.
 
 Everything is sequential (one live worker at a time, synchronous row
 applies, synchronous checkpoint writes), so a plan replays the exact
@@ -39,9 +44,11 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from elasticdl_tpu.chaos.faults import (
+    MASTER_KILL,
     FaultPlan,
     default_plan,
     describe,
+    master_kill_plan,
     randomized_plan,
 )
 from elasticdl_tpu.chaos.interceptors import ChaosKill, FaultInjector
@@ -49,6 +56,7 @@ from elasticdl_tpu.chaos.invariants import (
     CheckpointMonotonicity,
     ExactlyOnceTaskAccounting,
     LossTrajectoryEquivalence,
+    MasterRestartEquivalence,
     RowConservation,
 )
 from elasticdl_tpu.common.constants import TaskType
@@ -113,6 +121,11 @@ class ChaosRunner:
         # Last-N-spans ring attached to FAILED reports (observability/
         # tracing.py) — every red chaos run carries its own timeline.
         self.flight_recorder_spans = max(1, int(flight_recorder_spans))
+        # master_kill plans need the write-ahead journal (the restart
+        # seam recovers from it) and the restart-equivalence checker.
+        self.master_kills_planned = sum(
+            1 for e in plan.events if e.kind == MASTER_KILL
+        )
         os.makedirs(workdir, exist_ok=True)
 
     # ---- data / model assembly -----------------------------------------
@@ -191,12 +204,19 @@ class ChaosRunner:
             checkpoint_steps=self.checkpoint_steps,
             checkpoint_async=False,
             fault_injector=injector,
+            # Journal only on faulted runs with master kills planned:
+            # the twin must model the never-crashed job, and journal
+            # writes never influence training either way.
+            journal_dir=(
+                os.path.join(self.workdir, subdir, "journal")
+                if injector is not None and self.master_kills_planned
+                else ""
+            ),
         )
 
     def _make_replacement(self, cluster, new_id: int, subdir: str,
                           injector, services):
         from elasticdl_tpu.checkpoint import CheckpointHook
-        from elasticdl_tpu.testing.in_process_master import InProcessMaster
         from elasticdl_tpu.worker.master_client import MasterClient
         from elasticdl_tpu.worker.worker import Worker
 
@@ -206,8 +226,10 @@ class ChaosRunner:
                 connect_timeout=10, retries=1,
             )
         else:
-            client = InProcessMaster(
-                cluster.servicer, worker_id=new_id,
+            # Registered with the cluster so a later master_kill
+            # restart rebinds this replacement too.
+            client = cluster.make_inprocess_client(
+                new_id,
                 callbacks=(
                     injector.in_process_callbacks()
                     if injector is not None else None
@@ -373,6 +395,29 @@ class ChaosRunner:
         cluster = None
         try:
             cluster = self._build_cluster(subdir, injector, services)
+            if injector is not None and self.master_kills_planned:
+                restart_checker = (
+                    checkers.get("master_restart") if checkers else None
+                )
+
+                def _restart_master(cluster=cluster,
+                                    checker=restart_checker):
+                    # The dead master's in-memory truth, captured for
+                    # the equivalence audit only — recovery itself
+                    # sees nothing but the journal.
+                    dead_state = cluster.dispatcher.export_state()
+                    old_generation = cluster.servicer.generation
+                    stats = cluster.restart_master()
+                    if checker is not None:
+                        checker.observe(
+                            dead_state,
+                            cluster.dispatcher.export_state(),
+                            old_generation,
+                            stats["generation"],
+                            stats["replayed"],
+                        )
+
+                injector.set_master_restart(_restart_master)
             row_conservation = (
                 checkers.get("rows") if checkers else None
             )
@@ -425,7 +470,14 @@ class ChaosRunner:
             expected_records={TaskType.TRAINING: self.records},
         )
         equivalence = LossTrajectoryEquivalence(baseline)
-        checkers = {"accounting": accounting, "rows": rows}
+        master_restart = (
+            MasterRestartEquivalence(self.master_kills_planned)
+            if self.master_kills_planned else None
+        )
+        checkers = {
+            "accounting": accounting, "rows": rows,
+            "master_restart": master_restart,
+        }
         logger.info(
             "chaos: faulted run, %d event(s):\n%s",
             len(self.plan.events), describe(self.plan),
@@ -461,6 +513,8 @@ class ChaosRunner:
             )
         verdicts.append(monotonic.check())
         verdicts.append(equivalence.check())
+        if master_restart is not None:
+            verdicts.append(master_restart.check())
         passed = harness_error is None and all(v.passed for v in verdicts)
         report = {
             "chaos_report_version": REPORT_VERSION,
@@ -502,6 +556,10 @@ class ChaosRunner:
                 "recoveries": [
                     {**r, "latency_secs": round(r["latency_secs"], 4)}
                     for r in injector.recoveries
+                ],
+                "master_restarts": [
+                    {**r, "latency_secs": round(r["latency_secs"], 4)}
+                    for r in injector.master_restarts
                 ],
             }
         return report
@@ -597,6 +655,12 @@ def main(argv=None) -> int:
     parser.add_argument("--plan", default="",
                         help="JSON fault-plan file; default: the "
                              "canonical seed-derived plan")
+    parser.add_argument("--master_kill", action="store_true",
+                        help="run: use the master-crash acceptance "
+                             "plan (two master kills recovered by "
+                             "journal replay — docs/fault_tolerance"
+                             ".md) instead of the canonical worker-"
+                             "fault plan")
     parser.add_argument("--report", default=DEFAULT_REPORT)
     parser.add_argument("--workdir", default="",
                         help="Scratch dir (default: a fresh tempdir, "
@@ -650,6 +714,11 @@ def main(argv=None) -> int:
         if args.command == "run":
             if args.plan:
                 plan = FaultPlan.load(args.plan)
+            elif args.master_kill:
+                plan = master_kill_plan(
+                    args.seed,
+                    num_row_service_shards=args.num_row_service_shards,
+                )
             else:
                 plan = default_plan(
                     args.seed,

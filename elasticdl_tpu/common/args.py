@@ -229,6 +229,23 @@ def add_train_params(parser):
                         default=5)
     parser.add_argument("--profile_steps", type=pos_int, default=5)
     parser.add_argument("--task_timeout_secs", type=pos_float, default=300.0)
+    parser.add_argument("--journal_dir", default="",
+                        help="Master write-ahead job-state journal "
+                             "directory (docs/fault_tolerance.md): "
+                             "dispatch/report events + periodic "
+                             "snapshots, replayed on master restart so "
+                             "task accounting survives the crash. "
+                             "Point at a volume that outlives the "
+                             "master pod; empty (default) disables")
+    parser.add_argument("--master_reattach_grace", type=pos_float,
+                        default=60.0,
+                        help="How long a worker rides out master "
+                             "unavailability before treating the job "
+                             "as finished. Size it to measured master "
+                             "recovery time (master_recovery_seconds "
+                             "on /metrics) when running with "
+                             "--journal_dir; the default matches the "
+                             "old hard-coded ~60s budget")
     parser.add_argument("--metrics_port", type=int, default=-1,
                         help="Master Prometheus endpoint (/metrics + "
                              "/healthz): port to serve on; 0 picks an "

@@ -61,6 +61,12 @@ class EvaluationJob:
         self._total_tasks = total_tasks
         self._completed_tasks = 0
         self.evaluation_metrics = EvaluationMetrics(metrics_fns)
+        # Task ids whose raw outputs already folded: the fold is a
+        # plain accumulate, so an at-least-once re-send (RpcStub
+        # retries DEADLINE_EXCEEDED; the worker's master-outage
+        # ride-out retries harder) must not count the same samples
+        # twice.
+        self._folded_tasks = set()
 
     def complete_task(self):
         self._completed_tasks += 1
@@ -71,7 +77,16 @@ class EvaluationJob:
             and self._completed_tasks >= self._total_tasks
         )
 
-    def report_evaluation_metrics(self, outputs, labels):
+    def report_evaluation_metrics(self, outputs, labels,
+                                  task_id: int = -1):
+        if task_id >= 0:
+            if task_id in self._folded_tasks:
+                logger.info(
+                    "eval task %d outputs already folded; ignoring "
+                    "duplicate report", task_id,
+                )
+                return
+            self._folded_tasks.add(task_id)
         self.evaluation_metrics.update(outputs, labels)
 
 
@@ -163,16 +178,37 @@ class EvaluationService:
 
     # ---- worker reports ------------------------------------------------
 
-    def report_evaluation_metrics(self, outputs, labels) -> bool:
+    def report_evaluation_metrics(self, outputs, labels,
+                                  task_id: int = -1) -> bool:
         with self._lock:
             if self._eval_job is None:
                 return False
-            self._eval_job.report_evaluation_metrics(outputs, labels)
+            self._eval_job.report_evaluation_metrics(
+                outputs, labels, task_id=task_id
+            )
             return True
 
-    def complete_task(self) -> Optional[Dict[str, float]]:
+    def complete_task(
+        self, model_version: int = -1
+    ) -> Optional[Dict[str, float]]:
+        """Count one finished eval task toward the current round.
+        ``model_version`` is the completed TASK's version: a completion
+        from a different round — e.g. a version-V task still draining
+        after a master restart opened a fresh round at V' — must not
+        count toward this one, or the round closes early on partial
+        data. -1 counts unconditionally (eval-only jobs and callers
+        predating versioned tasks)."""
         with self._lock:
             if self._eval_job is None:
+                return None
+            if (model_version >= 0
+                    and self._eval_job.model_version >= 0
+                    and model_version != self._eval_job.model_version):
+                logger.warning(
+                    "eval task @version %d completed but the current "
+                    "round is @version %d; not counting it",
+                    model_version, self._eval_job.model_version,
+                )
                 return None
             self._eval_job.complete_task()
             if not self._eval_job.finished():

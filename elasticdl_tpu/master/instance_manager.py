@@ -190,6 +190,70 @@ class InstanceManager:
         for worker_id in range(self._num_workers):
             self._start_worker(worker_id)
 
+    # ---- master-restart adoption (master/journal.py recovery) ----------
+
+    def adopt_workers(self, worker_ids):
+        """Track already-running worker pods instead of creating them
+        (a recovered master re-attaches to the job it crashed out of).
+        Pod names are reconstructed from the deterministic naming
+        scheme; ids that died during the outage produce watch events /
+        straggler timeouts against these names and recover through the
+        normal dead-worker path. The fresh-id counter advances past
+        every adopted id so relaunches never reuse one.
+
+        Known limitation: multihost gang-restart generations are not
+        journaled, so a master restart AFTER a gang restart
+        reconstructs suffix-less pod names that won't match the live
+        ``-gN`` pods — their death events would be discarded as
+        stale. Until generations persist, a recovered multihost
+        master is safer gang-restarting than adopting."""
+        if self._multihost:
+            logger.warning(
+                "adopting multihost workers after a master restart: "
+                "pre-crash gang-restart generations are unknown; if "
+                "the job had gang-restarted, adopted pod names will "
+                "not match and dead peers won't be detected"
+            )
+        with self._lock:
+            top = self._num_workers
+            for wid in worker_ids:
+                name = get_worker_pod_name(self._job_name, wid)
+                if self._multihost and self._generation:
+                    name = f"{name}-g{self._generation}"
+                self._worker_pods[int(wid)] = name
+                top = max(top, int(wid) + 1)
+            self._next_worker_id = itertools.count(top)
+        logger.info(
+            "adopted %d running worker pod(s) after master restart",
+            len(self._worker_pods),
+        )
+
+    def adopt_row_service(self):
+        """Track the (still-running) per-shard row-service pods after
+        a master restart; their stable Services already exist.
+
+        Same limitation as adopt_workers: pre-crash relaunch
+        generations are not journaled, so a shard that had already
+        been relaunched is tracked under its gen-0 name and its next
+        death event would be discarded as stale."""
+        if self._row_service_command is None:
+            return
+        logger.warning(
+            "adopting row-service pods after a master restart: "
+            "pre-crash relaunch generations are unknown; a shard "
+            "that had relaunched before the crash won't have its "
+            "next death detected"
+        )
+        with self._lock:
+            for shard in range(self._num_rs_shards):
+                self._row_service_pods[shard] = (
+                    get_row_service_pod_name(
+                        self._job_name,
+                        self._rs_generation.get(shard, 0),
+                        shard=shard,
+                    )
+                )
+
     # ---- row service (PS-pod lifecycle) --------------------------------
 
     def start_row_service(self):

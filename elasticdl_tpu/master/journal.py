@@ -1,0 +1,485 @@
+"""Write-ahead job-state journal: the master's crash-survival plane.
+
+The paper's fault-tolerance story (dynamic data sharding + task
+re-queue + pod relaunch) covers every role except the one that
+implements it: ``TaskDispatcher`` keeps ``_todo``/``_doing``, retry
+counts, epoch state, and the max-steps budget purely in memory, so a
+master crash used to mean a fresh job. This module closes that gap the
+same way the checkpoint plane covers worker state — durable,
+validated, replayable records:
+
+- **Format**: one append-only file of length-prefixed, CRC32-checksummed
+  msgpack records (``[u32 len][u32 crc][payload]``, little-endian).
+  A torn tail (crash mid-write) is *truncated, not fatal* — the same
+  philosophy as ``checkpoint/state_io.validate_shard_payload``: decode
+  success alone is not integrity, so every frame is checksummed and
+  every decoded record is shape-validated before replay trusts it.
+- **Records**: ``dispatch`` / ``report`` / ``create_tasks`` /
+  ``version`` events written through by ``TaskDispatcher`` and
+  ``MasterServicer``, plus periodic full-state ``snapshot`` records;
+  ``generation`` records fence master incarnations (strictly
+  increasing; every task dispatch and RPC response is stamped with the
+  current one so workers and late reports can be resolved against the
+  incarnation that produced them).
+- **Snapshots + compaction**: every ``snapshot_every`` state-mutating
+  records the journal captures the dispatcher's full exported state
+  and rewrites the file to ``[snapshot, tail…]`` — replay cost is
+  bounded by the snapshot cadence, not job length.
+- **Replay**: recovery re-runs the recorded operation sequence through
+  the *real* dispatcher state machine (``get``/``report``/
+  ``create_tasks`` with journaling detached), so the recovered
+  dispatcher is equivalent by construction — same todo order, same
+  task-id counter, same retry budgets, same counters — rather than a
+  parallel reimplementation that could drift.
+
+Exactly-once across the crash: tasks leased at crash time replay back
+into ``_doing`` and stay leased — the workers holding them ride out
+the outage on their RPC retry budget (``--master_reattach_grace``) and
+re-report against the recovered master. A report the pre-crash master
+had already applied is answered from the dispatcher's bounded
+recently-resolved ledger (the same idempotence path that absorbs
+at-least-once RPC retries); a report for a task the recovered master
+re-queued in the meantime is fenced (``accepted=False``) so the
+re-dispatched copy is the only one that counts.
+"""
+
+import os
+import struct
+import threading
+import zlib
+from typing import Callable, Dict, List, Optional
+
+from elasticdl_tpu.common import tensor_utils
+from elasticdl_tpu.common.log_utils import get_logger
+
+logger = get_logger("master_journal")
+
+JOURNAL_FILE = "journal.log"
+
+# Record types (the "t" field). KNOWN_TYPES gates replay: an unknown
+# type from a newer writer fails loudly instead of silently skewing
+# the reconstructed state.
+DISPATCH = "dispatch"
+REPORT = "report"
+CREATE_TASKS = "create_tasks"
+VERSION = "version"
+SNAPSHOT = "snapshot"
+GENERATION = "generation"
+
+KNOWN_TYPES = (DISPATCH, REPORT, CREATE_TASKS, VERSION, SNAPSHOT,
+               GENERATION)
+
+_HEADER = struct.Struct("<II")  # payload length, crc32(payload)
+
+
+class JournalFormatError(RuntimeError):
+    """A record *before* the tail failed validation — unlike a torn
+    tail (expected after a crash, silently truncated), mid-file
+    corruption means the journal cannot be trusted."""
+
+
+def _frame(payload: bytes) -> bytes:
+    return _HEADER.pack(len(payload), zlib.crc32(payload)) + payload
+
+
+def read_records(path: str):
+    """Yield ``(offset, end, record)`` for every intact frame; stop at
+    the first torn/corrupt frame (crash tail). The caller decides
+    whether to truncate (recovery) or report (fsck) — this reader
+    never raises on a bad tail, only on unreadable files."""
+    with open(path, "rb") as fh:
+        blob = fh.read()
+    offset = 0
+    while offset + _HEADER.size <= len(blob):
+        length, crc = _HEADER.unpack_from(blob, offset)
+        start = offset + _HEADER.size
+        payload = blob[start:start + length]
+        if len(payload) < length or zlib.crc32(payload) != crc:
+            return  # torn tail: partial frame or checksum mismatch
+        try:
+            record = tensor_utils.loads(payload)
+        except Exception:
+            return  # undecodable despite matching crc: treat as tail
+        if not isinstance(record, dict) or "t" not in record:
+            return
+        yield offset, start + length, record
+        offset = start + length
+
+
+def validate_record(record: dict) -> Optional[str]:
+    """Structural check on one decoded record (the journal's analogue
+    of ``state_io.validate_shard_payload``). Returns an error string
+    or None."""
+    rtype = record.get("t")
+    if rtype not in KNOWN_TYPES:
+        return f"unknown record type {rtype!r}"
+    if not isinstance(record.get("seq"), int):
+        return f"{rtype}: non-int seq"
+    if rtype == DISPATCH:
+        if not isinstance(record.get("task"), dict):
+            return "dispatch: task is not a dict"
+        for key in ("task_id", "worker_id", "generation"):
+            if not isinstance(record.get(key), int):
+                return f"dispatch: non-int {key}"
+    elif rtype == REPORT:
+        if not isinstance(record.get("task_id"), int):
+            return "report: non-int task_id"
+        if not isinstance(record.get("success"), bool):
+            return "report: non-bool success"
+    elif rtype == CREATE_TASKS:
+        if not isinstance(record.get("task_type"), str):
+            return "create_tasks: non-str task_type"
+    elif rtype == VERSION:
+        if not isinstance(record.get("model_version"), int):
+            return "version: non-int model_version"
+    elif rtype == GENERATION:
+        if not isinstance(record.get("generation"), int):
+            return "generation: non-int generation"
+    elif rtype == SNAPSHOT:
+        state = record.get("state")
+        if not isinstance(state, dict):
+            return "snapshot: state is not a dict"
+        for key in ("todo", "doing"):
+            if not isinstance(state.get(key), list):
+                return f"snapshot: state.{key} is not a list"
+        for key in ("task_id", "epochs_todo"):
+            if not isinstance(state.get(key), int):
+                return f"snapshot: state.{key} is not an int"
+    return None
+
+
+class MasterJournal:
+    """One job's journal: append with periodic snapshot/compaction,
+    replay with torn-tail truncation. Thread-safe (appends come from
+    dispatcher and servicer threads)."""
+
+    def __init__(self, journal_dir: str, snapshot_every: int = 64):
+        if not journal_dir:
+            raise ValueError("journal_dir must be non-empty")
+        self.journal_dir = journal_dir
+        self.snapshot_every = max(1, int(snapshot_every))
+        os.makedirs(journal_dir, exist_ok=True)
+        self.path = os.path.join(journal_dir, JOURNAL_FILE)
+        self._lock = threading.RLock()
+        self._fh = None
+        self._seq = 0
+        self._since_snapshot = 0
+        # Provider returning the dispatcher's exported state; called
+        # with the dispatcher lock already held (appends happen inside
+        # the dispatcher's critical sections), so it must be the
+        # lock-free variant (TaskDispatcher._export_state_locked).
+        self._snapshot_provider: Optional[Callable[[], dict]] = None
+        self.generation = 0
+        # Model-version high-water mark, tracked journal-side so
+        # compaction (which discards the raw VERSION records) can
+        # carry it inside the snapshot record.
+        self._model_version = 0
+
+    # ---- lifecycle -----------------------------------------------------
+
+    def has_state(self) -> bool:
+        """True when the journal holds at least one intact record —
+        i.e. a restarted master has something to recover."""
+        if not os.path.exists(self.path):
+            return False
+        for _offset, _end, _record in read_records(self.path):
+            return True
+        return False
+
+    def set_snapshot_provider(self, provider: Callable[[], dict]):
+        self._snapshot_provider = provider
+
+    def open_generation(self) -> int:
+        """Start (or resume) this master incarnation: scan for the
+        highest generation on disk, truncate any torn tail, fence with
+        generation+1, and open for append. Returns the new generation."""
+        with self._lock:
+            last_good_end = 0
+            max_gen = -1
+            if os.path.exists(self.path):
+                for _offset, end, record in read_records(self.path):
+                    last_good_end = end
+                    self._seq = max(self._seq, int(record.get("seq", 0)))
+                    if record["t"] == GENERATION:
+                        max_gen = max(
+                            max_gen, int(record.get("generation", -1))
+                        )
+                    elif record["t"] == VERSION:
+                        self._model_version = max(
+                            self._model_version,
+                            int(record.get("model_version", 0)),
+                        )
+                    elif record["t"] == SNAPSHOT:
+                        self._model_version = max(
+                            self._model_version,
+                            int(record.get("model_version", 0)),
+                        )
+                size = os.path.getsize(self.path)
+                if size > last_good_end:
+                    logger.warning(
+                        "journal %s: truncating torn tail "
+                        "(%d byte(s) past the last intact record)",
+                        self.path, size - last_good_end,
+                    )
+                    with open(self.path, "r+b") as fh:
+                        fh.truncate(last_good_end)
+            self.generation = max_gen + 1
+            self._fh = open(self.path, "ab")
+            self._append_locked(GENERATION, generation=self.generation)
+            return self.generation
+
+    def close(self):
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+
+    # ---- append --------------------------------------------------------
+
+    def _append_locked(self, rtype: str, **fields):
+        if self._fh is None:
+            raise RuntimeError(
+                "journal not open for append (call open_generation)"
+            )
+        self._seq += 1
+        record = {"t": rtype, "seq": self._seq, **fields}
+        self._fh.write(_frame(tensor_utils.dumps(record)))
+        self._fh.flush()
+        # fsync per record: exactly-once across NODE failure requires
+        # the record durable before the RPC response leaves (a flushed-
+        # but-unsynced report acked to the worker would re-train after
+        # power loss). Affordable here — the control plane appends at
+        # task granularity (seconds), not step granularity.
+        os.fsync(self._fh.fileno())
+
+    def append(self, rtype: str, **fields):
+        """Append one event record; dispatcher-originated state
+        mutations (dispatch/report) also advance the snapshot cadence
+        — those are the only appends guaranteed to run under the
+        dispatcher lock, which the snapshot provider requires."""
+        with self._lock:
+            if rtype == VERSION:
+                self._model_version = max(
+                    self._model_version,
+                    int(fields.get("model_version", 0)),
+                )
+            self._append_locked(rtype, **fields)
+            if rtype in (DISPATCH, REPORT):
+                self._since_snapshot += 1
+                if (self._snapshot_provider is not None
+                        and self._since_snapshot >= self.snapshot_every):
+                    self._snapshot_locked()
+
+    def _snapshot_locked(self):
+        state = self._snapshot_provider()
+        self._seq += 1
+        record = {
+            "t": SNAPSHOT, "seq": self._seq,
+            "generation": self.generation, "state": state,
+            # Compaction discards the raw VERSION records; the
+            # high-water mark must survive inside the snapshot.
+            "model_version": int(self._model_version),
+        }
+        # Compaction: the snapshot supersedes everything before it, so
+        # rewrite the file as [generation fence, snapshot] and keep
+        # appending — replay cost stays bounded by the cadence. The
+        # tmp+rename publish mirrors the checkpoint saver: a crash
+        # mid-compaction leaves either the old journal or the new one,
+        # never a half-written file.
+        tmp = self.path + ".tmp"
+        with open(tmp, "wb") as fh:
+            fence = {
+                "t": GENERATION, "seq": self._seq - 1,
+                "generation": self.generation,
+            }
+            fh.write(_frame(tensor_utils.dumps(fence)))
+            fh.write(_frame(tensor_utils.dumps(record)))
+            fh.flush()
+            os.fsync(fh.fileno())
+        if self._fh is not None:
+            self._fh.close()
+        os.replace(tmp, self.path)
+        self._fh = open(self.path, "ab")
+        self._since_snapshot = 0
+
+    # ---- replay --------------------------------------------------------
+
+    def replay_records(self) -> List[dict]:
+        """All intact records, torn tail dropped; raises
+        ``JournalFormatError`` only on structurally invalid records
+        *before* the tail (a bad frame is the tail by definition —
+        framing cannot resync past it)."""
+        if not os.path.exists(self.path):
+            return []
+        out = []
+        for _offset, _end, record in read_records(self.path):
+            err = validate_record(record)
+            if err:
+                raise JournalFormatError(f"{self.path}: {err}")
+            out.append(record)
+        return out
+
+    def recover_into(self, dispatcher) -> dict:
+        """Replay snapshot + tail into ``dispatcher`` (freshly
+        constructed with the same shard/epoch/seed config). Returns
+        ``{"replayed": n, "snapshot": bool, "model_version": v,
+        "generation": g, "known_workers": [...]}``.
+
+        The dispatcher must NOT have a journal attached yet — replay
+        drives its real ``get``/``report``/``create_tasks`` methods
+        and must not re-append what it reads.
+        """
+        if getattr(dispatcher, "_journal", None) is not None:
+            raise RuntimeError("detach the journal before replay")
+        records = self.replay_records()
+        # Only the latest snapshot matters; tail = records after it.
+        snap_idx = None
+        for i, record in enumerate(records):
+            if record["t"] == SNAPSHOT:
+                snap_idx = i
+        model_version = 0
+        generation = 0
+        known_workers = set()
+        replayed = 0
+        start = 0
+        if snap_idx is not None:
+            state = records[snap_idx]["state"]
+            dispatcher.restore_state(state)
+            generation = max(generation,
+                             int(records[snap_idx].get("generation", 0)))
+            model_version = max(
+                model_version,
+                int(records[snap_idx].get("model_version", 0)),
+            )
+            # Compaction dropped the pre-snapshot dispatch records;
+            # the snapshot's leases and version reports still name the
+            # workers this job had.
+            known_workers.update(
+                int(wid) for _tid, _task, wid in state.get("doing", [])
+            )
+            known_workers.update(
+                int(k) for k in state.get("worker_version", {})
+            )
+            replayed += 1
+            start = snap_idx + 1
+        for record in records[:start]:
+            # Pre-snapshot records still carry fencing/worker facts the
+            # snapshot state does not (generation high-water mark).
+            if record["t"] == GENERATION:
+                generation = max(generation, record["generation"])
+            elif record["t"] == VERSION:
+                model_version = max(model_version,
+                                    record["model_version"])
+        for record in records[start:]:
+            rtype = record["t"]
+            if rtype == GENERATION:
+                generation = max(generation, record["generation"])
+                continue
+            if rtype == VERSION:
+                model_version = max(model_version, record["model_version"])
+                replayed += 1
+                continue
+            if rtype == SNAPSHOT:
+                continue  # unreachable (snap_idx is the last one)
+            if rtype == CREATE_TASKS:
+                dispatcher.create_tasks(
+                    record["task_type"],
+                    model_version=record.get("model_version", -1),
+                )
+                replayed += 1
+                continue
+            if rtype == DISPATCH:
+                wid = record["worker_id"]
+                known_workers.add(wid)
+                task = dispatcher.get(wid)
+                want = record["task"]
+                if task is None or task.task_id != record["task_id"] or (
+                    (task.shard_name, task.start, task.end, task.type)
+                    != (want.get("shard_name"), want.get("start"),
+                        want.get("end"), want.get("type"))
+                ):
+                    # The state machine disagreed with the journal —
+                    # a bug or a journal from different job config.
+                    # Fail loudly; recovering wrong state silently
+                    # would double- or under-train.
+                    raise JournalFormatError(
+                        f"replay diverged at seq {record['seq']}: "
+                        f"journal dispatched task {record['task_id']} "
+                        f"({want.get('shard_name')}:{want.get('start')}-"
+                        f"{want.get('end')}), state machine produced "
+                        f"{task.task_id if task else None}"
+                    )
+                replayed += 1
+                continue
+            if rtype == REPORT:
+                dispatcher.report(
+                    record["task_id"], record["success"],
+                    err_reason=record.get("err_reason", ""),
+                )
+                replayed += 1
+        # Leases survive the crash: tasks in doing stay leased to the
+        # workers riding out the outage; their start clocks reset to
+        # replay time (dispatcher.get stamped time.time()), so the
+        # straggler deadline counts from recovery, and a worker that
+        # died DURING the outage is caught by the normal timeout path.
+        return {
+            "replayed": replayed,
+            "snapshot": snap_idx is not None,
+            "model_version": model_version,
+            "generation": generation,
+            "known_workers": sorted(known_workers),
+        }
+
+
+def recover_master_state(journal: "MasterJournal", dispatcher,
+                         servicer=None,
+                         metrics_registry=None) -> Dict:
+    """The full master-side recovery sequence: replay the journal into
+    the dispatcher, re-arm the servicer (model version high-water mark
+    + fresh straggler clocks for surviving leases), bump the
+    generation fence, re-attach the journal for write-through, and
+    publish recovery telemetry. Returns the replay stats dict with
+    ``recovery_seconds`` added.
+
+    Shared by ``master/main.py`` (process restart) and the chaos
+    restart seam (``testing/cluster.MiniCluster.restart_master``) so
+    the drill exercises the same code path production uses.
+    """
+    import time
+
+    from elasticdl_tpu.observability import default_registry, tracing
+
+    registry = metrics_registry or default_registry()
+    t0 = time.monotonic()
+    with tracing.Tracer("master").span("recover") as sp:
+        stats = journal.recover_into(dispatcher)
+        generation = journal.open_generation()
+        dispatcher.attach_journal(journal)
+        if servicer is not None:
+            servicer.model_version = max(
+                servicer.model_version, stats["model_version"]
+            )
+            servicer.generation = generation
+            servicer.seed_task_start_times(
+                list(dispatcher.doing_start_times())
+            )
+        sp.set(replayed=int(stats["replayed"]),
+               generation=int(generation))
+    elapsed = time.monotonic() - t0
+    stats["generation"] = generation
+    stats["recovery_seconds"] = elapsed
+    registry.histogram(
+        "master_recovery_seconds",
+        "Journal replay + re-arm latency on master restart",
+    ).observe(elapsed)
+    registry.counter(
+        "master_journal_replayed_records_total",
+        "Journal records replayed into recovered dispatchers",
+    ).inc(stats["replayed"])
+    logger.info(
+        "master recovered from %s: %d record(s) replayed "
+        "(snapshot=%s), generation %d, %d leased task(s) surviving",
+        journal.path, stats["replayed"], stats["snapshot"],
+        generation, len(dispatcher.doing_start_times()),
+    )
+    return stats

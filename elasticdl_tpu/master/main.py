@@ -100,6 +100,30 @@ class Master:
                 ms.max_steps, args.minibatch_size
             )
 
+        # Master crash recovery (master/journal.py): with --journal_dir
+        # the dispatcher writes every dispatch/report through a
+        # checksummed write-ahead journal; a restarted master replays
+        # snapshot + tail here — AFTER the deferred callback and
+        # max-steps config above, which replay depends on, and BEFORE
+        # the servicer exists, so it is born with the recovered state.
+        from elasticdl_tpu.master.journal import (
+            MasterJournal,
+            recover_master_state,
+        )
+
+        self._journal = None
+        self._recovery_stats = None
+        journal_dir = getattr(args, "journal_dir", "")
+        if journal_dir:
+            self._journal = MasterJournal(journal_dir)
+            if self._journal.has_state():
+                self._recovery_stats = recover_master_state(
+                    self._journal, self.task_dispatcher
+                )
+            else:
+                self._journal.open_generation()
+                self.task_dispatcher.attach_journal(self._journal)
+
         tb_service = None
         if getattr(args, "tensorboard_log_dir", ""):
             from elasticdl_tpu.master.tensorboard_service import (
@@ -154,7 +178,21 @@ class Master:
             self.evaluation_service,
             task_timeout_secs=getattr(args, "task_timeout_secs", 300.0),
             metrics_plane=self.metrics_plane,
+            journal=self._journal,
+            generation=(
+                self._journal.generation if self._journal else 0
+            ),
         )
+        if self._recovery_stats is not None:
+            # Re-arm the servicer with the recovered high-water marks:
+            # eval triggering continues from the journaled model
+            # version, and surviving leases get fresh straggler clocks.
+            self.servicer.model_version = self._recovery_stats[
+                "model_version"
+            ]
+            self.servicer.seed_task_start_times(
+                list(self.task_dispatcher.doing_start_times())
+            )
         self._server = None
         self.instance_manager = None
         self._k8s_client = k8s_client
@@ -367,11 +405,26 @@ class Master:
                 num_row_service_shards=self._num_row_service_shards(),
             )
             self.instance_manager.start_watch()
-            # Row service first (reference Master.prepare starts PS pods
-            # before workers, master.py:202-205); workers retry until it
-            # answers.
-            self.instance_manager.start_row_service()
-            self.instance_manager.start_workers()
+            if self._recovery_stats is not None:
+                # Recovered master: the job's pods are still running
+                # and their workers are riding out the outage on their
+                # reattach grace (worker/task_data_service.py) —
+                # re-creating them would 409 AND strand the survivors.
+                # Adopt the ids the journal saw; pods that actually
+                # died during the outage surface as watch events /
+                # straggler timeouts and recover through the normal
+                # paths.
+                self.instance_manager.adopt_workers(
+                    self._recovery_stats["known_workers"]
+                    or list(range(self._args.num_workers))
+                )
+                self.instance_manager.adopt_row_service()
+            else:
+                # Row service first (reference Master.prepare starts PS
+                # pods before workers, master.py:202-205); workers
+                # retry until it answers.
+                self.instance_manager.start_row_service()
+                self.instance_manager.start_workers()
 
     def request_stop(self):
         """Ask the run loop to exit at the next tick (SIGTERM path).
@@ -422,6 +475,11 @@ class Master:
             self.instance_manager.stop()
         if self._server is not None:
             self._server.stop(grace=2.0)
+        # After the server: an in-flight report draining through the
+        # grace period still writes through the journal; closing first
+        # would turn it into an INTERNAL error at the worker.
+        if self._journal is not None:
+            self._journal.close()
         # Keep serving TensorBoard after training like the reference
         # master (master.py:256-269) only in the CLI path (main()).
 

@@ -32,11 +32,19 @@ SERVICE_NAME = "elasticdl_tpu.Master"
 
 class MasterServicer:
     def __init__(self, task_dispatcher, evaluation_service=None,
-                 task_timeout_secs: float = 300.0, metrics_plane=None):
+                 task_timeout_secs: float = 300.0, metrics_plane=None,
+                 journal=None, generation: int = 0):
         from elasticdl_tpu.observability import MetricsPlane
 
         self._task_d = task_dispatcher
         self._eval_service = evaluation_service
+        # Master incarnation fence (master/journal.py): stamped on every
+        # get_task response so workers detect a restart and re-attach;
+        # reports carry the generation their task was dispatched under,
+        # and ones referencing a task the recovered master re-queued
+        # are fenced (accepted=False) instead of double-applied.
+        self._journal = journal
+        self.generation = int(generation)
         # Cluster telemetry: workers piggyback registry snapshots on the
         # RPCs below; the plane merges them keyed by worker id and ages
         # out workers that stop reporting (elastic resize / preemption).
@@ -45,8 +53,15 @@ class MasterServicer:
             "master_straggler_timeouts_total",
             "Tasks that blew the straggler deadline (factor x mean)",
         )
+        self._m_reattach = self.metrics_plane.registry.counter(
+            "master_worker_reattach_total",
+            "Workers that re-registered after a master restart "
+            "(their last-seen generation predates ours)",
+        )
         self._lock = threading.Lock()
         self._worker_liveness: Dict[int, float] = {}
+        # Workers already counted as re-attached to this generation.
+        self._reattached = set()
         # Task ids already counted as stragglers (pruned against the
         # doing set so re-queued ids can be counted again).
         self._straggler_counted = set()
@@ -76,46 +91,89 @@ class MasterServicer:
         if snapshot:
             self.metrics_plane.ingest(worker_id, snapshot)
 
+    def _note_worker_generation(self, worker_id: int, request: dict):
+        """Re-attach detection: a worker reporting a last-seen
+        generation below ours rode out a master restart."""
+        seen = request.get("generation")
+        if (seen is None or worker_id < 0
+                # seen < 0 = a fresh worker that never attached to any
+                # incarnation — an arrival, not a re-attach.
+                or int(seen) < 0 or int(seen) >= self.generation):
+            return
+        with self._lock:
+            fresh = worker_id not in self._reattached
+            self._reattached.add(worker_id)
+        if fresh:
+            self._m_reattach.inc()
+            logger.info(
+                "worker %d re-attached (knew generation %s, now %d)",
+                worker_id, seen, self.generation,
+            )
+
     def get_task(self, request: dict) -> dict:
         worker_id = int(request.get("worker_id", -1))
         self._record_liveness(worker_id)
         self._ingest_metrics(worker_id, request)
+        self._note_worker_generation(worker_id, request)
         task = self._task_d.get(worker_id)
         if task is not None:
             with self._lock:
                 self._task_start_times[task.task_id] = time.time()
-            return {"task": task.to_dict(), "finished": False}
+            return {"task": task.to_dict(), "finished": False,
+                    "generation": self.generation}
         if self._task_d.finished():
-            return {"task": None, "finished": True}
+            return {"task": None, "finished": True,
+                    "generation": self.generation}
         # Queue temporarily empty (doing tasks may re-queue on failure):
         # tell the worker to wait (reference servicer.py:60-68).
         wait = Task(task_id=-1, type=TaskType.WAIT)
-        return {"task": wait.to_dict(), "finished": False}
+        return {"task": wait.to_dict(), "finished": False,
+                "generation": self.generation}
 
     def report_task_result(self, request: dict) -> dict:
         task_id = int(request["task_id"])
         err_reason = request.get("err_reason", "")
         success = not err_reason
-        self._ingest_metrics(int(request.get("worker_id", -1)), request)
+        worker_id = int(request.get("worker_id", -1))
+        self._ingest_metrics(worker_id, request)
+        self._note_worker_generation(worker_id, request)
         with self._lock:
             start = self._task_start_times.pop(task_id, None)
-            if success and start is not None:
-                self._task_secs_sum += time.time() - start
-                self._task_count += 1
-        task, _worker, requeued = self._task_d.report(
+        # The duplicate flag is decided atomically with the report
+        # application (dispatcher lock): a ledger hit means the side
+        # effects below already ran on the first application — only
+        # the outcome is re-sent. A pre-check here would race a
+        # concurrent retry of the same report.
+        task, _worker, requeued, duplicate = self._task_d.apply_report(
             task_id, success, err_reason
         )
+        if (task is not None and success and start is not None
+                and not duplicate):
+            # First applications only: a straggler's late report (its
+            # task already requeued, outcome ledger-answered) would
+            # otherwise fold its pathological hold time into the mean
+            # the straggler deadline derives from.
+            with self._lock:
+                self._task_secs_sum += time.time() - start
+                self._task_count += 1
+        if task is None:
+            # Unknown AND not in the ledger: a report fenced to a dead
+            # generation whose task the recovered master re-queued (or
+            # a genuinely bogus id) — reject so the re-dispatched copy
+            # is the only one that counts.
+            return {"accepted": False, "fenced": True,
+                    "generation": self.generation}
         # An eval task counts toward its EvaluationJob when it succeeds OR
         # fails permanently (dropped after retry cap) — otherwise one bad
         # eval shard would wedge the evaluation service forever.
         if (
-            task is not None
+            not duplicate
             and not requeued
             and task.type == TaskType.EVALUATION
             and self._eval_service is not None
         ):
-            self._eval_service.complete_task()
-        return {"accepted": task is not None}
+            self._eval_service.complete_task(task.model_version)
+        return {"accepted": True, "generation": self.generation}
 
     def report_evaluation_metrics(self, request: dict) -> dict:
         if self._eval_service is None:
@@ -129,9 +187,12 @@ class MasterServicer:
             "eval_report", outputs=int(rows[0]) if rows else len(outputs),
         ):
             ok = self._eval_service.report_evaluation_metrics(
-                outputs, request["labels"]
+                outputs, request["labels"],
+                # Dedup key: the fold is a plain accumulate, so a
+                # retried send must not double-count its samples.
+                task_id=int(request.get("task_id", -1)),
             )
-        return {"accepted": ok}
+        return {"accepted": ok, "generation": self.generation}
 
     def report_version(self, request: dict) -> dict:
         version = int(request["model_version"])
@@ -139,11 +200,16 @@ class MasterServicer:
         self._record_liveness(worker_id)
         self._ingest_metrics(worker_id, request)
         with self._lock:
+            advanced = version > self.model_version
             self.model_version = max(self.model_version, version)
+        if advanced and self._journal is not None:
+            # Model-version high-water mark: recovery re-arms eval
+            # triggering and TensorBoard publishing from it.
+            self._journal.append("version", model_version=version)
         self._task_d.record_worker_version(worker_id, version)
         if self._eval_service is not None:
             self._eval_service.add_evaluation_task_if_needed(version)
-        return {"ok": True}
+        return {"ok": True, "generation": self.generation}
 
     # ---- liveness / straggler detection --------------------------------
 
@@ -183,6 +249,16 @@ class MasterServicer:
         if fresh:
             self._m_straggler.inc(len(fresh))
         return out
+
+    def seed_task_start_times(self, task_ids):
+        """Recovery: start the straggler clock now for every lease
+        that survived the master crash (the pre-crash start times died
+        with the old process; counting from recovery avoids instantly
+        timing out every surviving worker)."""
+        now = time.time()
+        with self._lock:
+            for tid in task_ids:
+                self._task_start_times[int(tid)] = now
 
     def remove_worker_metrics(self, worker_id: int):
         """Drop a departed worker from the cluster view immediately

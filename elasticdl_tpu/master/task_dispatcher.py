@@ -10,6 +10,7 @@ task_dispatcher.py:206-241). This mechanism — not checkpoint-restart — is
 what makes preemption cheap.
 """
 
+import collections
 import dataclasses
 import random
 import threading
@@ -22,6 +23,15 @@ from elasticdl_tpu.common.log_utils import get_logger
 from elasticdl_tpu.common.task import Task
 
 logger = get_logger("task_dispatcher")
+
+# Bounded ledger of recently resolved task ids → original outcome.
+# Serves two callers: at-least-once RPC retries (RpcStub re-sends a
+# report whose response was lost) and post-crash re-reports from
+# workers that rode out a master restart — both must get the original
+# outcome back instead of the "Unknown task id" path, or accounting
+# drifts. Sized to cover many report round-trips of in-flight retry
+# ambiguity without growing with job length.
+RESOLVED_LEDGER_SIZE = 512
 
 
 class JobCounters:
@@ -77,6 +87,15 @@ class TaskDispatcher:
         self._deferred_callbacks: List[Callable] = []
         self._worker_version: Dict[int, int] = {}
         self.counters = JobCounters()
+        # task_id -> (task, worker_id, requeued): the idempotent-report
+        # ledger (see RESOLVED_LEDGER_SIZE above). OrderedDict as a
+        # FIFO ring.
+        self._resolved = collections.OrderedDict()
+        # Write-ahead journal (master/journal.py); attached AFTER
+        # construction (attach_journal) so the constructor's initial
+        # create_tasks is part of the deterministic base state, not a
+        # journaled event — replay rebuilds it from the same config.
+        self._journal = None
 
         # Telemetry: queue health as pull-time gauges (evaluated per
         # scrape; reading a list length needs no lock) + dispatch
@@ -172,6 +191,11 @@ class TaskDispatcher:
                 self._todo = tasks + self._todo
             else:
                 self._todo.extend(tasks)
+            if self._journal is not None:
+                self._journal.append(
+                    "create_tasks", task_type=str(task_type),
+                    model_version=int(model_version),
+                )
             logger.info("Created %d %s tasks", len(tasks), task_type)
 
     def add_deferred_callback(self, callback: Callable):
@@ -259,6 +283,17 @@ class TaskDispatcher:
                 task.task_id = self._task_id
                 self._doing[task.task_id] = (task, worker_id, time.time())
                 self._m_dispatched.labels(task.type).inc()
+                if self._journal is not None:
+                    # Inside the lock, so the journal's event order
+                    # matches the state-mutation order exactly —
+                    # replay re-runs these ops through this same state
+                    # machine and must see the same interleaving.
+                    self._journal.append(
+                        "dispatch", task_id=int(task.task_id),
+                        worker_id=int(worker_id),
+                        generation=int(self._journal.generation),
+                        task=task.to_dict(),
+                    )
             elif (
                 not self._doing
                 and not self._epochs_pending_locked()
@@ -286,17 +321,49 @@ class TaskDispatcher:
         """Worker reports task completion (reference :286-350). Failed tasks
         re-queue at the front, up to MAX_TASK_RETRIES per shard range.
         Returns (task, worker_id, requeued)."""
+        task, worker_id, requeued, _duplicate = self.apply_report(
+            task_id, success, err_reason
+        )
+        return task, worker_id, requeued
+
+    def apply_report(
+        self, task_id: int, success: bool, err_reason: str = ""
+    ) -> Tuple[Optional[Task], int, bool, bool]:
+        """``report`` plus a ``duplicate`` flag, decided atomically
+        under the lock: True iff the outcome came from the resolved
+        ledger rather than a first application. The servicer needs
+        the distinction to run report side effects (eval
+        complete_task) exactly once even when at-least-once RPC
+        retries race each other."""
         callbacks = []
         requeued = False
         with self._lock:
             entry = self._doing.pop(task_id, None)
             if entry is None:
+                resolved = self._resolved.get(task_id)
+                if resolved is not None:
+                    # At-least-once RPC (or a re-report across a master
+                    # restart): the first application already counted
+                    # this task; hand back the original outcome instead
+                    # of re-applying or warning.
+                    logger.info(
+                        "Task %d already resolved; returning original "
+                        "outcome (duplicate report)", task_id,
+                    )
+                    return (*resolved, True)
                 logger.warning("Unknown task id %d reported", task_id)
-                return None, -1, False
+                return None, -1, False, False
             task, worker_id, _start = entry
             if success:
                 self.counters.add_completed(task.type, task.num_records)
                 self._m_completed.labels(task.type).inc()
+                # Clear the shard's burned retries: the map otherwise
+                # grows without bound across epochs, and next epoch's
+                # identical shard key would inherit this epoch's
+                # failures against its retry budget.
+                self._task_retry_count.pop(
+                    f"{task.shard_name}:{task.start}:{task.end}", None
+                )
             else:
                 key = f"{task.shard_name}:{task.start}:{task.end}"
                 # Graceful preemption hand-backs (SIGTERM before the
@@ -330,6 +397,18 @@ class TaskDispatcher:
                         "Task %d failed permanently after %d retries (%s)",
                         task_id, MAX_TASK_RETRIES, err_reason,
                     )
+            self._resolved[task_id] = (task, worker_id, requeued)
+            while len(self._resolved) > RESOLVED_LEDGER_SIZE:
+                self._resolved.popitem(last=False)
+            if self._journal is not None:
+                # Appended after the mutation completes (still inside
+                # the lock): a snapshot triggered by this append must
+                # capture the post-report state, and replay re-derives
+                # the requeue decision from the same inputs.
+                self._journal.append(
+                    "report", task_id=int(task_id),
+                    success=bool(success), err_reason=str(err_reason),
+                )
             todo_undroppable = [
                 t for t in self._todo
                 if not (
@@ -350,7 +429,7 @@ class TaskDispatcher:
         # (e.g. create_train_end_callback_task re-acquires the lock).
         for cb in callbacks:
             cb()
-        return task, worker_id, requeued
+        return task, worker_id, requeued, False
 
     def recover_tasks(self, worker_id: int):
         """Re-queue all doing tasks of a dead worker
@@ -398,3 +477,95 @@ class TaskDispatcher:
     def record_worker_version(self, worker_id: int, version: int):
         with self._lock:
             self._worker_version[worker_id] = version
+
+    # ---- journal (master/journal.py) -----------------------------------
+
+    def attach_journal(self, journal):
+        """Write dispatch/report/create_tasks through ``journal`` from
+        now on; wires the snapshot provider to the locked exporter
+        (appends run inside this dispatcher's critical sections)."""
+        with self._lock:
+            self._journal = journal
+        journal.set_snapshot_provider(self._export_state_locked)
+
+    def detach_journal(self):
+        with self._lock:
+            self._journal = None
+
+    def export_state(self) -> dict:
+        """Full serializable dispatcher state (journal snapshots and
+        the chaos master-restart equivalence audit)."""
+        with self._lock:
+            return self._export_state_locked()
+
+    def _export_state_locked(self) -> dict:
+        version, internal, gauss = self._rng.getstate()
+        return {
+            "todo": [t.to_dict() for t in self._todo],
+            "doing": [
+                [int(tid), t.to_dict(), int(wid)]
+                for tid, (t, wid, _s) in self._doing.items()
+            ],
+            "task_id": int(self._task_id),
+            "epochs_todo": int(self._epochs_todo),
+            "max_train_records": int(self._max_train_records),
+            "train_records_dispatched": int(
+                self._train_records_dispatched
+            ),
+            "retry": dict(self._task_retry_count),
+            "completed": dict(self.counters.total_records),
+            "failed": dict(self.counters.failed_records),
+            "worker_version": {
+                str(k): int(v) for k, v in self._worker_version.items()
+            },
+            "resolved": [
+                [int(tid), t.to_dict() if t is not None else None,
+                 int(wid), bool(rq)]
+                for tid, (t, wid, rq) in self._resolved.items()
+            ],
+            # Epoch-regeneration shuffle must continue the same
+            # sequence after recovery, or the replayed run diverges
+            # from a never-crashed one under shuffle=True.
+            "rng": [int(version), [int(x) for x in internal], gauss],
+            "deferred_pending": len(self._deferred_callbacks),
+        }
+
+    def restore_state(self, state: dict):
+        """Install a journal snapshot. Leased (doing) tasks stay
+        leased — the workers holding them survive the master crash and
+        re-report; their start clocks reset to now so the straggler
+        deadline counts from recovery."""
+        now = time.time()
+        with self._lock:
+            self._todo = [Task.from_dict(d) for d in state["todo"]]
+            self._doing = {
+                int(tid): (Task.from_dict(d), int(wid), now)
+                for tid, d, wid in state["doing"]
+            }
+            self._task_id = int(state["task_id"])
+            self._epochs_todo = int(state["epochs_todo"])
+            self._max_train_records = int(state["max_train_records"])
+            self._train_records_dispatched = int(
+                state["train_records_dispatched"]
+            )
+            self._task_retry_count = dict(state["retry"])
+            self.counters.total_records = dict(state["completed"])
+            self.counters.failed_records = dict(state["failed"])
+            self._worker_version = {
+                int(k): int(v)
+                for k, v in state.get("worker_version", {}).items()
+            }
+            self._resolved = collections.OrderedDict(
+                (int(tid),
+                 (Task.from_dict(d) if d is not None else None,
+                  int(wid), bool(rq)))
+                for tid, d, wid, rq in state.get("resolved", [])
+            )
+            rng = state.get("rng")
+            if rng:
+                self._rng.setstate((rng[0], tuple(rng[1]), rng[2]))
+            if state.get("deferred_pending", 0) == 0:
+                # The pre-crash dispatcher had already fired its
+                # deferred callbacks (train-end task created); firing
+                # the re-registered ones again would duplicate it.
+                self._deferred_callbacks = []

@@ -51,6 +51,7 @@ class MiniCluster:
         metrics_ttl_secs: float = 600.0,
         fault_injector=None,
         checkpoint_async: bool = True,
+        journal_dir: str = "",
     ):
         # Chaos plane (chaos/interceptors.FaultInjector): over RPC the
         # injector's process-global hooks cover every call already; on
@@ -100,7 +101,10 @@ class MiniCluster:
         self.predict_reader = (
             reader_of(prediction_data) if prediction_data else None
         )
-        self.dispatcher = TaskDispatcher(
+        # Kept for restart_master: a recovered dispatcher must be born
+        # from the IDENTICAL config (shards, sizing, seed) before the
+        # journal replays events into it.
+        self._dispatcher_config = dict(
             training_shards=(
                 self.train_reader.create_shards()
                 if self.train_reader else {}
@@ -117,12 +121,27 @@ class MiniCluster:
             num_epochs=num_epochs,
             shuffle=shuffle,
         )
+        self._eval_config = dict(
+            eval_steps=eval_steps,
+            eval_only=bool(validation_data and not training_data),
+        )
+        self.dispatcher = TaskDispatcher(**self._dispatcher_config)
+        # Master write-ahead journal (master/journal.py): dispatch /
+        # report events write through; restart_master() below replays
+        # them into a recovered master (the chaos master-kill seam).
+        self.journal_dir = journal_dir
+        self._journal = None
+        if journal_dir:
+            from elasticdl_tpu.master.journal import MasterJournal
+
+            self._journal = MasterJournal(journal_dir)
+            self._journal.open_generation()
+            self.dispatcher.attach_journal(self._journal)
         metrics_fns = (
             self.spec.eval_metrics_fn() if self.spec.eval_metrics_fn else {}
         )
         self.eval_service = EvaluationService(
-            self.dispatcher, metrics_fns, eval_steps=eval_steps,
-            eval_only=bool(validation_data and not training_data),
+            self.dispatcher, metrics_fns, **self._eval_config
         )
         # Telemetry: in-process tests share ONE process registry across
         # master and workers (production is one worker per process);
@@ -135,6 +154,10 @@ class MiniCluster:
         self.servicer = MasterServicer(
             self.dispatcher, self.eval_service,
             metrics_plane=self.metrics_plane,
+            journal=self._journal,
+            generation=(
+                self._journal.generation if self._journal else 0
+            ),
         )
         self.metrics_http = (
             self.metrics_plane.serve(port=metrics_port)
@@ -143,6 +166,11 @@ class MiniCluster:
 
         self._server = None
         self._use_rpc = use_rpc
+        # Every InProcessMaster handed out (constructor workers AND
+        # chaos replacement workers) registers here so restart_master
+        # can rebind them all to a recovered servicer — a client bound
+        # to the discarded one would keep mutating dead state.
+        self._inprocess_clients: List[InProcessMaster] = []
         if use_rpc:
             self._server = RpcServer(
                 "localhost:0", {SERVICE_NAME: self.servicer.handlers()}
@@ -173,9 +201,8 @@ class MiniCluster:
                     connect_timeout=10, retries=1,
                 )
             else:
-                client = InProcessMaster(
-                    self.servicer, worker_id=wid,
-                    callbacks=worker_callbacks,
+                client = self.make_inprocess_client(
+                    wid, callbacks=worker_callbacks
                 )
             runner = (
                 step_runner_factory() if step_runner_factory else None
@@ -213,6 +240,73 @@ class MiniCluster:
                     metrics_report_secs=metrics_report_secs,
                 )
             )
+
+    def make_inprocess_client(self, worker_id: int,
+                              callbacks=None) -> InProcessMaster:
+        """An InProcessMaster bound to the CURRENT servicer and
+        registered for restart_master rebinding. Replacement workers
+        (chaos relaunch) must use this instead of constructing one
+        directly, or a later master restart leaves them calling the
+        discarded servicer."""
+        client = InProcessMaster(
+            self.servicer, worker_id=worker_id, callbacks=callbacks
+        )
+        self._inprocess_clients.append(client)
+        return client
+
+    def restart_master(self):
+        """Simulated master crash + journal-replay recovery (the chaos
+        ``master_kill`` seam; requires ``journal_dir``).
+
+        The old dispatcher/servicer are DISCARDED exactly as a dead
+        process would lose them — recovery may only use what the
+        journal holds. A fresh dispatcher is built from the identical
+        config, ``recover_master_state`` replays snapshot + tail into
+        it (the same code path ``master/main.py`` runs on a real
+        restart), and the transport re-points: the gRPC server rebinds
+        the same port (the workers' channels reconnect, as they would
+        to a relaunched master pod behind a stable Service), while
+        in-process clients are rebound explicitly. Returns the replay
+        stats dict."""
+        from elasticdl_tpu.master.journal import recover_master_state
+
+        if self._journal is None:
+            raise RuntimeError(
+                "restart_master needs MiniCluster(journal_dir=...)"
+            )
+        port = self._server.port if self._server is not None else None
+        if self._server is not None:
+            self._server.stop(0)
+            self._server = None
+        self._journal.close()
+        dispatcher = TaskDispatcher(**self._dispatcher_config)
+        metrics_fns = (
+            self.spec.eval_metrics_fn()
+            if self.spec.eval_metrics_fn else {}
+        )
+        eval_service = EvaluationService(
+            dispatcher, metrics_fns, **self._eval_config
+        )
+        servicer = MasterServicer(
+            dispatcher, eval_service,
+            metrics_plane=self.metrics_plane,
+            journal=self._journal,
+        )
+        stats = recover_master_state(
+            self._journal, dispatcher, servicer=servicer
+        )
+        self.dispatcher = dispatcher
+        self.eval_service = eval_service
+        self.servicer = servicer
+        if self._use_rpc:
+            self._server = RpcServer(
+                f"localhost:{port}",
+                {SERVICE_NAME: self.servicer.handlers()},
+            ).start()
+        else:
+            for client in self._inprocess_clients:
+                client.rebind(self.servicer)
+        return stats
 
     def run(self) -> List[dict]:
         """Run all workers (threads if >1) to completion."""

@@ -3,6 +3,16 @@
 Counterpart of the reference's ``tests/in_process_master.py:5-33`` — the
 worker's master client becomes direct calls into ``MasterServicer``, with
 optional test callbacks interposed per RPC.
+
+Transport parity with ``comm/rpc.RpcStub``: retryable ``RpcError``s
+(UNAVAILABLE / DEADLINE_EXCEEDED — here only ever raised by chaos
+callbacks) get the same bounded re-send the stub gives real transport
+blips, minus the backoff sleeps (determinism); and the master's
+``generation`` stamp is tracked/echoed exactly like ``MasterClient``
+does, so the chaos master-restart drill exercises the same re-attach
+protocol on both transports. ``rebind`` is the restart seam: the chaos
+runner swaps in a recovered servicer mid-job, standing in for the
+worker's channel reconnecting to the relaunched master pod.
 """
 
 from typing import Optional, Tuple
@@ -10,6 +20,8 @@ from typing import Optional, Tuple
 import numpy as np
 
 from elasticdl_tpu.common.task import Task
+
+_MAX_RETRIES = 2  # mirrors RpcStub.call's default attempt cap
 
 
 class InProcessMaster:
@@ -19,14 +31,40 @@ class InProcessMaster:
         self._servicer = servicer
         self._worker_id = worker_id
         self._callbacks = callbacks or {}
+        self.last_generation = -1
+
+    def rebind(self, servicer):
+        """Point at a recovered master (chaos master-kill restart seam
+        — the in-process analogue of the gRPC channel reconnecting to
+        the relaunched master on the same address)."""
+        self._servicer = servicer
 
     def _call(self, name: str, request: dict) -> dict:
-        if name in self._callbacks:
-            self._callbacks[name](request)
-        return self._servicer.handlers()[name](request)
+        from elasticdl_tpu.comm.rpc import RETRYABLE_CODES, RpcError
+
+        attempt = 0
+        while True:
+            try:
+                if name in self._callbacks:
+                    self._callbacks[name](request)
+                resp = self._servicer.handlers()[name](request)
+                break
+            except RpcError as exc:
+                if exc.code not in RETRYABLE_CODES or (
+                    attempt >= _MAX_RETRIES
+                ):
+                    raise
+                attempt += 1
+        gen = resp.get("generation") if isinstance(resp, dict) else None
+        if gen is not None:
+            self.last_generation = max(self.last_generation, int(gen))
+        return resp
 
     def get_task(self, metrics=None) -> Tuple[Optional[Task], bool]:
-        request = {"worker_id": self._worker_id}
+        request = {
+            "worker_id": self._worker_id,
+            "generation": self.last_generation,
+        }
         if metrics:
             request["metrics"] = metrics
         resp = self._call("get_task", request)
@@ -39,18 +77,21 @@ class InProcessMaster:
             "task_id": task_id,
             "err_reason": err_reason,
             "worker_id": self._worker_id,
+            "generation": self.last_generation,
         }
         if metrics:
             request["metrics"] = metrics
         resp = self._call("report_task_result", request)
         return bool(resp.get("accepted"))
 
-    def report_evaluation_metrics(self, model_outputs, labels) -> bool:
+    def report_evaluation_metrics(self, model_outputs, labels,
+                                  task_id: int = -1) -> bool:
         resp = self._call(
             "report_evaluation_metrics",
             {
                 "model_outputs": np.asarray(model_outputs),
                 "labels": np.asarray(labels),
+                "task_id": int(task_id),
             },
         )
         return bool(resp.get("accepted"))

@@ -19,6 +19,7 @@ from elasticdl_tpu.master.servicer import SERVICE_NAME
 class MasterClient:
     def __init__(self, addr: str, worker_id: int,
                  connect_timeout: float = 300.0, retries: int = 3):
+        self._addr = addr
         # The channel is owned here (RpcStub only closes channels it
         # created itself) — close() must release it.
         self._channel = wait_for_channel_ready(
@@ -26,13 +27,47 @@ class MasterClient:
         )
         self._stub = RpcStub(self._channel, SERVICE_NAME)
         self._worker_id = worker_id
+        # Master incarnation fence (master/journal.py): responses stamp
+        # the master's generation; requests echo the last one seen so a
+        # recovered master can tell re-attaching survivors from fresh
+        # workers, and so reports are resolvable against the
+        # incarnation that dispatched their task. -1 = never attached.
+        # Survives reconnect() — the fence outlives any one channel.
+        self.last_generation = -1
+
+    def reconnect(self):
+        """Drop the channel and build a fresh one to the same address
+        (non-blocking: the next call fails fast if the master is still
+        down). Needed to re-attach to a RELAUNCHED master: a gRPC
+        channel whose reconnect attempts were refused for a few
+        seconds can wedge its subchannel permanently, while a fresh
+        channel to the restarted server connects immediately — the
+        worker's outage ride-out loops call this between retries."""
+        from elasticdl_tpu.comm.rpc import build_channel
+
+        try:
+            self._stub.close()
+            self._channel.close()
+        except Exception:  # a half-dead channel must not block retry
+            pass
+        self._channel = build_channel(self._addr)
+        self._stub = RpcStub(self._channel, SERVICE_NAME)
+
+    def _note_generation(self, resp: dict):
+        gen = resp.get("generation")
+        if gen is not None:
+            self.last_generation = max(self.last_generation, int(gen))
 
     def get_task(self, metrics: Optional[dict] = None,
                  ) -> Tuple[Optional[Task], bool]:
-        fields = {"worker_id": self._worker_id}
+        fields = {
+            "worker_id": self._worker_id,
+            "generation": self.last_generation,
+        }
         if metrics:
             fields["metrics"] = metrics
         resp = self._stub.call("get_task", **fields)
+        self._note_generation(resp)
         task = Task.from_dict(resp["task"]) if resp.get("task") else None
         return task, bool(resp.get("finished"))
 
@@ -42,20 +77,25 @@ class MasterClient:
             "task_id": task_id,
             "err_reason": err_reason,
             "worker_id": self._worker_id,
+            "generation": self.last_generation,
         }
         if metrics:
             # Piggybacked registry snapshot (observability/): the master
             # merges it into the cluster view keyed by worker id.
             fields["metrics"] = metrics
         resp = self._stub.call("report_task_result", **fields)
+        self._note_generation(resp)
         return bool(resp.get("accepted"))
 
-    def report_evaluation_metrics(self, model_outputs, labels) -> bool:
+    def report_evaluation_metrics(self, model_outputs, labels,
+                                  task_id: int = -1) -> bool:
         resp = self._stub.call(
             "report_evaluation_metrics",
             model_outputs=np.asarray(model_outputs),
             labels=np.asarray(labels),
+            task_id=int(task_id),
         )
+        self._note_generation(resp)
         return bool(resp.get("accepted"))
 
     def report_version(self, model_version: int,

@@ -36,7 +36,8 @@ class TaskDataService:
     def __init__(self, master_client, data_reader, dataset_fn,
                  minibatch_size: int, wait_sleep_secs: float = 2.0,
                  prefetch_depth: int = 2, on_wait=None, metrics_fn=None,
-                 on_metrics_delivered=None, tracer=None):
+                 on_metrics_delivered=None, tracer=None,
+                 master_reattach_grace: float = 60.0):
         from elasticdl_tpu.observability import tracing
 
         self._master = master_client
@@ -65,6 +66,13 @@ class TaskDataService:
         # participating in barrier ticks (a sleeping process would
         # strand its peers in a collective).
         self._on_wait = on_wait
+        # How long to ride out master unavailability before giving up
+        # (--master_reattach_grace): long enough to cover a master
+        # reschedule + journal replay, finite so a torn-down job lets
+        # workers exit. With a journaled master (master/journal.py)
+        # the recovered incarnation keeps our leases, so surviving the
+        # window means re-attaching with no work lost.
+        self._reattach_grace = max(float(master_reattach_grace), 0.1)
 
     def _wait(self):
         if self._on_wait is not None:
@@ -81,12 +89,11 @@ class TaskDataService:
         """
         from elasticdl_tpu.comm.rpc import RpcError
 
-        # ~60s of master unavailability before giving up: long enough to
-        # ride out a master reschedule/GC pause, finite so a torn-down
-        # job lets workers exit. (A relaunched master gets fresh workers
-        # with its address anyway.)
-        max_failures = max(1, int(60.0 / max(self._wait_sleep_secs, 0.1)))
+        max_failures = max(1, int(
+            self._reattach_grace / max(self._wait_sleep_secs, 0.1)
+        ))
         rpc_failures = 0
+        last_generation = getattr(self._master, "last_generation", None)
         while True:
             # One root span per task cycle — opened BEFORE get_task so
             # the master's dispatch spans join the task's tree; cycles
@@ -116,14 +123,39 @@ class TaskDataService:
                     )
                     if rpc_failures >= max_failures:
                         logger.warning(
-                            "master unreachable; treating job as finished"
+                            "master unreachable for the full reattach "
+                            "grace (%.0fs); treating job as finished",
+                            self._reattach_grace,
                         )
                         return
                     # _wait (not sleep): multi-host workers must keep
                     # ticking the barrier during the backoff or they
                     # strand peers mid-collective.
                     self._wait()
+                    # Fresh channel per retry (MasterClient.reconnect):
+                    # a channel whose reconnects were refused for a few
+                    # seconds can wedge permanently; re-attaching to a
+                    # RELAUNCHED master needs a rebuild.
+                    reconnect = getattr(self._master, "reconnect", None)
+                    if reconnect is not None:
+                        reconnect()
                     continue
+                generation = getattr(
+                    self._master, "last_generation", None
+                )
+                if (generation is not None
+                        and last_generation is not None
+                        and generation > last_generation
+                        and last_generation >= 0):
+                    # The master restarted while we held our state:
+                    # the journaled incarnation kept our leases, so
+                    # this is a re-attach, not a fresh job.
+                    logger.warning(
+                        "re-attached to restarted master (generation "
+                        "%d -> %d) after %d failed poll(s)",
+                        last_generation, generation, rpc_failures,
+                    )
+                last_generation = generation
                 rpc_failures = 0
                 if task is None:
                     if finished:

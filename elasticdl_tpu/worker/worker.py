@@ -72,6 +72,7 @@ class Worker:
         prefetch_depth: int = 2,
         metrics_registry=None,
         metrics_report_secs: float = 15.0,
+        master_reattach_grace: float = 60.0,
     ):
         self._id = worker_id
         self._master = master_client
@@ -114,6 +115,7 @@ class Worker:
             metrics_fn=self._metrics_snapshot,
             on_metrics_delivered=self._metrics_delivered,
             tracer=self._tracer,
+            master_reattach_grace=master_reattach_grace,
         )
         self.last_metrics = None
         # Periodic sharded checkpoint (reference PS saves inside
@@ -157,6 +159,15 @@ class Worker:
         # dedicated RPC.
         from elasticdl_tpu.observability import default_registry
 
+        # Reporting RPCs ride out master unavailability for the same
+        # grace window the task stream uses (_master_call below): the
+        # stub's own retry budget covers blips of a few seconds, but a
+        # master restart (journal replay, pod reschedule) outlasts it,
+        # and a crashed report would kill the worker exactly when its
+        # lease is the thing the recovered master is waiting on.
+        self._master_reattach_grace = max(
+            float(master_reattach_grace), 0.1
+        )
         self._metrics = metrics_registry or default_registry()
         self._metrics_report_secs = float(metrics_report_secs)
         self._last_metrics_report = 0.0
@@ -290,12 +301,50 @@ class Worker:
         reached the master; advance the ring cursor past them."""
         self._trace_cursor = self._trace_cursor_offered
 
+    def _master_call(self, fn, description: str):
+        """Run a master RPC, riding out transient unavailability up to
+        the reattach grace — the reporting-side mirror of the task
+        stream's get_task ride-out (task_data_service.py). The stub's
+        bounded retry absorbs blips; this absorbs a master restart. A
+        non-retryable code or an exhausted grace re-raises (the task
+        loop's error handling takes over)."""
+        from elasticdl_tpu.comm.rpc import RETRYABLE_CODES, RpcError
+
+        deadline = time.monotonic() + self._master_reattach_grace
+        while True:
+            try:
+                return fn()
+            except RpcError as exc:
+                if (exc.code not in RETRYABLE_CODES
+                        or time.monotonic() >= deadline):
+                    raise
+                logger.warning(
+                    "%s failed (%s); retrying while the master "
+                    "recovers", description, exc,
+                )
+                # _wait_tick, not sleep: multi-host workers must keep
+                # participating in barrier ticks during the ride-out
+                # or they strand peers mid-collective. (If a stop was
+                # requested, WorkerStopped propagates and _run's
+                # handler exits the task loop — a stopping worker
+                # gives up reporting through an outage.)
+                self._wait_tick(2.0)
+                # Fresh channel per retry: a channel refused for a few
+                # seconds can wedge; reconnecting is what actually
+                # re-attaches to the relaunched master.
+                reconnect = getattr(self._master, "reconnect", None)
+                if reconnect is not None:
+                    reconnect()
+
     def _report_task(self, task_id: int, err_reason: str = ""):
         """report_task_result with the metrics/span piggyback and the
         span-cursor delivery commit."""
         snap = self._metrics_snapshot()
-        accepted = self._master.report_task_result(
-            task_id, err_reason=err_reason, metrics=snap
+        accepted = self._master_call(
+            lambda: self._master.report_task_result(
+                task_id, err_reason=err_reason, metrics=snap
+            ),
+            f"report_task_result({task_id})",
         )
         if snap is not None:
             self._metrics_delivered()
@@ -496,8 +545,11 @@ class Worker:
                 if version % self._version_report_steps == 0:
                     with self._timing.record("report_version"):
                         snap = self._metrics_snapshot()
-                        self._master.report_version(
-                            version, metrics=snap
+                        self._master_call(
+                            lambda s=snap: self._master.report_version(
+                                version, metrics=s
+                            ),
+                            f"report_version({version})",
                         )
                         if snap is not None:
                             self._metrics_delivered()
@@ -584,7 +636,12 @@ class Worker:
         ):
             with self._timing.record("report_version"):
                 snap = self._metrics_snapshot()
-                self._master.report_version(version, metrics=snap)
+                self._master_call(
+                    lambda: self._master.report_version(
+                        version, metrics=snap
+                    ),
+                    f"report_version({version})",
+                )
                 if snap is not None:
                     self._metrics_delivered()
         with self._timing.record("checkpoint"):
@@ -644,9 +701,15 @@ class Worker:
             outputs_acc.append(self._local_rows(preds)[:real])
             labels_acc.append(np.asarray(batch["labels"])[:real])
         if outputs_acc:
-            self._master.report_evaluation_metrics(
-                np.concatenate(outputs_acc, axis=0),
-                np.concatenate(labels_acc, axis=0),
+            outputs = np.concatenate(outputs_acc, axis=0)
+            labels = np.concatenate(labels_acc, axis=0)
+            self._master_call(
+                # task_id keys the master-side dedup: the fold is an
+                # accumulate, and this call retries through outages.
+                lambda: self._master.report_evaluation_metrics(
+                    outputs, labels, task_id=int(task.task_id)
+                ),
+                "report_evaluation_metrics",
             )
 
     def _process_predict_task(self, task, batches):

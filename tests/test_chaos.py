@@ -23,8 +23,10 @@ from elasticdl_tpu.chaos import (
     FaultEvent,
     FaultInjector,
     FaultPlan,
+    MasterRestartEquivalence,
     RowConservation,
     default_plan,
+    master_kill_plan,
     randomized_plan,
 )
 from elasticdl_tpu.chaos.runner import render_report
@@ -40,6 +42,8 @@ class TestFaultPlans:
         assert default_plan(7).to_json() == default_plan(7).to_json()
         assert (randomized_plan(42).to_json()
                 == randomized_plan(42).to_json())
+        assert (master_kill_plan(7).to_json()
+                == master_kill_plan(7).to_json())
 
     def test_json_roundtrip(self):
         plan = default_plan(3)
@@ -127,6 +131,31 @@ class TestFaultInjector:
         assert sum(first) > 0
         assert run() == first
 
+    def test_master_kill_restarts_then_fails_unavailable(self):
+        from elasticdl_tpu.comm.rpc import RpcError
+
+        plan = FaultPlan(events=[FaultEvent(
+            kind="master_kill", at_call=2,
+        )], seed=1)
+        injector = FaultInjector(plan)
+        restarts = []
+        injector.set_master_restart(lambda: restarts.append(1))
+        request = {"worker_id": 0}
+        injector.client_hook("elasticdl_tpu.Master", "get_task", request)
+        assert not restarts
+        # The Nth dispatch: restart seam runs, THEN the in-flight call
+        # fails UNAVAILABLE (the dead master never answered) so the
+        # transport retry lands on the recovered incarnation.
+        with pytest.raises(RpcError) as exc:
+            injector.client_hook(
+                "elasticdl_tpu.Master", "get_task", request
+            )
+        assert exc.value.code == "UNAVAILABLE"
+        assert restarts == [1]
+        # max_fires=1: later dispatches pass through.
+        injector.client_hook("elasticdl_tpu.Master", "get_task", request)
+        assert [e["kind"] for e in injector.injected] == ["master_kill"]
+
     def test_stall_matches_only_its_shard_tag(self):
         plan = FaultPlan(events=[FaultEvent(
             kind="stall_shard", shard=1, at_call=1, delay_secs=0.0,
@@ -206,6 +235,31 @@ class TestInvariantCheckers:
         ok = RowConservation()
         ok.snapshot("kill-1", {"t": table})
         assert ok.check({"t": table}).passed
+
+    def test_master_restart_equivalence_catches_divergence(self):
+        state = {"todo": [], "doing": [[1, {}, 0]], "task_id": 4,
+                 "completed": {"training": 32}}
+        ok = MasterRestartEquivalence(expected_restarts=1)
+        ok.observe(state, dict(state), 0, 1, replayed=5)
+        assert ok.check().passed
+        # worker_version is advisory and excluded from the comparison.
+        noisy = MasterRestartEquivalence(expected_restarts=1)
+        noisy.observe(
+            {**state, "worker_version": {"0": 4}},
+            {**state, "worker_version": {}}, 0, 1, replayed=5,
+        )
+        assert noisy.check().passed
+        bad = MasterRestartEquivalence(expected_restarts=1)
+        bad.observe(state, {**state, "task_id": 3}, 0, 1, replayed=5)
+        result = bad.check()
+        assert not result.passed and "task_id" in result.details
+        stuck_gen = MasterRestartEquivalence(expected_restarts=1)
+        stuck_gen.observe(state, dict(state), 1, 1, replayed=5)
+        assert not stuck_gen.check().passed
+        never = MasterRestartEquivalence(expected_restarts=2)
+        never.observe(state, dict(state), 0, 1, replayed=5)
+        result = never.check()
+        assert not result.passed and "never fired" in result.details
 
     def test_monotonicity_catches_backwards_and_future(self):
         checker = CheckpointMonotonicity()
@@ -372,6 +426,92 @@ def test_corrupt_latest_checkpoint_caught_by_equivalence(tmp_path):
     assert "version" in equivalence["details"] or (
         "diverged" in equivalence["details"]
     )
+
+
+def test_master_kill_drill_all_invariants_pass(tmp_path):
+    """ISSUE 5 acceptance (the fast-lane `make chaos-master-smoke`):
+    two master kills — one at a dispatch boundary, one mid-lease —
+    recovered by journal replay, with the worker riding the outages
+    out on its transport retry; every invariant including the new
+    master-restart equivalence must hold, and recovery must leave the
+    loss trajectory equal to the fault-free twin (no task lost, none
+    re-trained)."""
+    report = _runner(master_kill_plan(7), tmp_path / "w").run()
+    assert report["passed"], report
+    assert report["fault_counts"].get("master_kill") == 2
+    assert report["fault_counts"].get("rpc_drop", 0) >= 1
+    names = {v["name"]: v["passed"] for v in report["invariants"]}
+    assert names == {
+        "exactly_once_task_accounting": True,
+        "embedding_row_conservation": True,
+        "checkpoint_version_monotonicity": True,
+        "loss_trajectory_equivalence": True,
+        "master_restart_equivalence": True,
+    }
+    assert report["metrics"]["edl_tpu_chaos_master_kills_total"] == 2
+    # The journal left behind passes fsck (torn tails impossible here,
+    # but fsck also audits seq/generation/dispatch monotonicity).
+    from tools.check_journal import check_journal
+
+    assert check_journal(
+        str(tmp_path / "w" / "faulted" / "journal")
+    ) == []
+
+
+def test_master_kill_same_seed_reports_byte_identical(tmp_path):
+    first = _runner(
+        master_kill_plan(11), tmp_path / "a", twin=False,
+    ).run()
+    second = _runner(
+        master_kill_plan(11), tmp_path / "b", twin=False,
+    ).run()
+    assert render_report(first) == render_report(second)
+
+
+def test_minicluster_master_restart_in_process(tmp_path):
+    """The no-RPC restart seam: a mid-job restart_master() on the
+    direct-call path rebinds InProcessMaster to the recovered
+    servicer; the same worker drains the job with exactly-once
+    accounting."""
+    from elasticdl_tpu.testing.cluster import MiniCluster
+    from elasticdl_tpu.testing.data import (
+        create_mnist_record_file,
+        model_zoo_dir,
+    )
+
+    train = create_mnist_record_file(str(tmp_path / "t.rec"), 64, seed=1)
+    kill_calls = []
+
+    def maybe_kill(request):
+        kill_calls.append(1)
+        if len(kill_calls) == 3:
+            stats = cluster.restart_master()
+            assert stats["generation"] == 1
+
+    cluster = MiniCluster(
+        model_zoo=model_zoo_dir(),
+        model_def="mnist.mnist_functional.custom_model",
+        training_data=train,
+        minibatch_size=16,
+        num_minibatches_per_task=1,
+        journal_dir=str(tmp_path / "journal"),
+        worker_callbacks={"get_task": maybe_kill},
+    )
+    old_dispatcher = cluster.dispatcher
+    # A replacement-style client created through the cluster registry
+    # must be rebound by the restart too (chaos kill_worker +
+    # master_kill plans relaunch workers this way).
+    extra_client = cluster.make_inprocess_client(7)
+    cluster.run()
+    assert cluster.dispatcher is not old_dispatcher  # restart happened
+    assert cluster.finished
+    assert extra_client._servicer is cluster.servicer  # rebound
+    result = ExactlyOnceTaskAccounting(
+        cluster.dispatcher, {TaskType.TRAINING: 64}
+    ).check()
+    assert result.passed, result.details
+    assert cluster.workers[0]._master.last_generation == 1
+    cluster.stop()
 
 
 def test_minicluster_in_process_injection(tmp_path):

@@ -165,6 +165,122 @@ class TestInProcessMaster:
         assert (t.task_id, 7) in timeouts
 
 
+class TestStragglerRequeue:
+    def test_timeout_requeue_end_to_end(self):
+        """The timeout-factor path whole (ISSUE 5 satellite): a slow
+        worker holds a task past factor × average_task_secs, the task
+        is re-queued to a peer, and the original's late report is
+        answered from the resolved ledger without double-counting."""
+        import time
+
+        servicer, d, _ = make_servicer(records=40, per_task=10)
+        slow = InProcessMaster(servicer, worker_id=0)
+        fast = InProcessMaster(servicer, worker_id=1)
+        # Three quick completions establish a real (tiny) mean.
+        for _ in range(2):
+            t, _ = fast.get_task()
+            fast.report_task_result(t.task_id)
+        held, _ = slow.get_task()
+        t, _ = fast.get_task()
+        fast.report_task_result(t.task_id)
+        assert servicer.average_task_secs() < 1.0  # mean is live now
+        time.sleep(0.05)
+        # A deadline far beyond the hold time: nothing times out
+        # (in-process task means are microseconds, so the factor must
+        # be astronomical to out-scale the 50ms hold)...
+        assert not servicer.find_timeout_tasks(factor=1e9)
+        # ...but the held task blows a deadline scaled to the mean.
+        timeouts = servicer.find_timeout_tasks(factor=0.0)
+        assert (held.task_id, 0) in timeouts
+        # Master run-loop reaction (main.py, no k8s): recover_tasks.
+        d.recover_tasks(0)
+        requeued, _ = fast.get_task()
+        assert (requeued.start, requeued.end) == (held.start, held.end)
+        assert requeued.task_id != held.task_id
+        fast.report_task_result(requeued.task_id)
+        # The straggler finally reports its fenced lease: resolved
+        # from the ledger (as a requeue), NOT counted again — and its
+        # pathological hold time must not inflate the task-time mean
+        # the straggler deadline is derived from.
+        count_before = servicer._task_count
+        assert slow.report_task_result(held.task_id)
+        assert servicer._task_count == count_before
+        assert d.counters.total_records[TaskType.TRAINING] == 40
+        assert d.finished()
+
+    def test_preempted_handback_does_not_burn_retries(self):
+        servicer, d, _ = make_servicer(records=10, per_task=10)
+        master = InProcessMaster(servicer, worker_id=0)
+        t, _ = master.get_task()
+        master.report_task_result(t.task_id,
+                                  err_reason="preempted (SIGTERM)")
+        assert not d._task_retry_count.get(f"f1:{t.start}:{t.end}")
+
+
+class TestGenerationFencing:
+    def test_client_tracks_generation_and_counts_reattach(self):
+        servicer, d, _ = make_servicer(records=20, per_task=10)
+        servicer.generation = 3
+        master = InProcessMaster(servicer, worker_id=0)
+        assert master.last_generation == -1
+        t, _ = master.get_task()
+        assert master.last_generation == 3
+        # A fresh worker is an arrival, not a re-attach.
+        assert not servicer._reattached
+        # Simulate surviving a restart: the servicer's generation
+        # moved past what the client knew.
+        servicer.generation = 4
+        master.report_task_result(t.task_id)
+        assert 0 in servicer._reattached
+        assert master.last_generation == 4
+
+    def test_duplicate_eval_metrics_fold_once(self):
+        """The eval fold is a plain accumulate; a re-sent report (lost
+        response, outage ride-out retry) must not double its samples."""
+        servicer, d, ev = make_servicer(
+            records=10, per_task=10, eval_records=10, eval_steps=1
+        )
+        master = InProcessMaster(servicer, worker_id=0)
+        master.report_version(1)
+        task, _ = master.get_task()
+        assert task.type == TaskType.EVALUATION
+        outputs = np.full((10, 1), 0.5, np.float32)
+        labels = np.zeros((10,), np.int32)
+        for _ in range(2):  # the retry re-sends the same task's fold
+            master.report_evaluation_metrics(
+                outputs, labels, task_id=task.task_id
+            )
+        assert sum(
+            o.shape[0]
+            for o in ev._eval_job.evaluation_metrics._outputs
+        ) == 10
+        master.report_task_result(task.task_id)
+        assert ev.completed_results[1]["mean_out"] == pytest.approx(0.5)
+
+    def test_stale_round_eval_completion_not_counted(self):
+        """A version-V eval task still draining after a master restart
+        opened a round at V' must not close V' early on partial data."""
+        servicer, d, ev = make_servicer(
+            records=10, per_task=10, eval_records=20, eval_steps=1
+        )
+        master = InProcessMaster(servicer, worker_id=0)
+        master.report_version(1)  # opens round @1 with 2 tasks
+        t1, _ = master.get_task()
+        assert t1.type == TaskType.EVALUATION and t1.model_version == 1
+        assert ev.complete_task(model_version=3) is None  # stale: ignored
+        assert ev._eval_job is not None  # round @1 still open
+        assert ev._eval_job._completed_tasks == 0
+        master.report_task_result(t1.task_id)  # @1: counted
+        assert ev._eval_job._completed_tasks == 1
+
+    def test_fenced_report_rejected(self):
+        servicer, d, _ = make_servicer(records=10, per_task=10)
+        resp = servicer.report_task_result(
+            {"task_id": 777, "worker_id": 0, "generation": 0}
+        )
+        assert not resp["accepted"] and resp["fenced"]
+
+
 class TestRpcTransport:
     @pytest.fixture
     def server_and_client(self):

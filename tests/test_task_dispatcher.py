@@ -116,6 +116,76 @@ class TestTaskDispatcher:
         task, worker, requeued = d.report(9999, True)
         assert task is None and worker == -1 and not requeued
 
+    def test_duplicate_report_returns_original_outcome(self):
+        """At-least-once RPC: RpcStub retries DEADLINE_EXCEEDED, so a
+        report whose response was lost is re-sent — it must resolve to
+        the original outcome, not the unknown-id path."""
+        d = make_dispatcher(records=20, per_task=10)
+        t = d.get(0)
+        first = d.report(t.task_id, True)
+        again = d.report(t.task_id, True)
+        assert again == first
+        assert again[0].task_id == t.task_id and not again[2]
+        # Re-reported failure resolves to its requeued outcome too.
+        t2 = d.get(0)
+        _, _, requeued = d.report(t2.task_id, False, err_reason="x")
+        assert requeued
+        dup = d.report(t2.task_id, False, err_reason="x")
+        assert dup[2] and dup[0].task_id == t2.task_id
+        # Counters unchanged by the duplicates: exactly-once held.
+        assert d.counters.total_records[TaskType.TRAINING] == 10
+
+    def test_apply_report_flags_duplicates_atomically(self):
+        """The servicer gates report side effects (eval complete_task)
+        on this flag; it must come from the same locked decision as
+        the application, not a separate pre-check."""
+        d = make_dispatcher(records=20, per_task=10)
+        t = d.get(0)
+        assert d.apply_report(t.task_id, True)[3] is False
+        assert d.apply_report(t.task_id, True)[3] is True
+        # Unknown id: neither applied nor a duplicate.
+        assert d.apply_report(9999, True) == (None, -1, False, False)
+
+    def test_resolved_ledger_is_bounded(self):
+        from elasticdl_tpu.master.task_dispatcher import (
+            RESOLVED_LEDGER_SIZE,
+        )
+
+        d = make_dispatcher(records=10 * (RESOLVED_LEDGER_SIZE + 50),
+                            per_task=10)
+        first = d.get(0)
+        d.report(first.task_id, True)
+        for _ in range(RESOLVED_LEDGER_SIZE + 10):
+            t = d.get(0)
+            d.report(t.task_id, True)
+        assert len(d._resolved) <= RESOLVED_LEDGER_SIZE
+        # The oldest entry aged out: duplicate now reads unknown.
+        task, worker, requeued = d.report(first.task_id, True)
+        assert task is None and worker == -1 and not requeued
+
+    def test_retry_count_cleared_on_success(self):
+        """Regression: the retry map grew unboundedly across epochs,
+        and a shard that eventually succeeded carried burned retries
+        into the next epoch's identical shard key."""
+        d = make_dispatcher(records=10, per_task=10, epochs=2)
+        t = d.get(0)
+        d.report(t.task_id, False, err_reason="flaky")
+        t = d.get(0)
+        assert d._task_retry_count  # burned one retry
+        d.report(t.task_id, True)
+        assert not d._task_retry_count  # cleared on success
+        # Epoch 2's identical shard gets the FULL budget again.
+        for _ in range(MAX_TASK_RETRIES):
+            t = d.get(0)
+            d.report(t.task_id, False, err_reason="flaky again")
+        t = d.get(0)
+        assert t is not None  # would be None had retries carried over
+        d.report(t.task_id, True)
+        assert d.finished()
+        assert TaskType.TRAINING not in (
+            {k: v for k, v in d.counters.failed_records.items() if v}
+        )
+
     def test_report_returns_requeued_flag(self):
         d = make_dispatcher(records=10, per_task=10)
         t = d.get(0)
