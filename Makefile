@@ -6,7 +6,7 @@ PY ?= python
 
 .PHONY: test test-all test-tpu test-k8s native bench serve-bench dryrun \
 	clean lint metrics chaos-smoke chaos-soak chaos-master-smoke \
-	trace-smoke
+	trace-smoke serve-fleet-smoke
 
 # Scrape-and-pretty-print a master's /metrics (docs/observability.md).
 METRICS_ADDR ?= localhost:8080
@@ -59,6 +59,16 @@ bench: test-tpu
 # writes BENCH_SERVING.json.
 serve-bench:
 	$(PY) bench_serving.py
+
+# Serving-fleet chaos drill (docs/serving.md "Fleet"): in-process
+# router + 2 replicas (hot-row caches) + live row service under
+# seeded mixed-priority load; one replica is hard-killed mid-run.
+# Exits nonzero unless availability holds across the kill, the
+# caches served rows, the router detected the dead replica, and the
+# drain settled clean.
+serve-fleet-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m elasticdl_tpu.chaos.serving_drill \
+		--seed $(CHAOS_SEED) --report SERVE_FLEET_DRILL.json
 
 # Deterministic chaos plan (kill + stall-row-shard + corrupt-checkpoint)
 # against the in-process cluster; exits nonzero if any recovery

@@ -1,7 +1,8 @@
 """``python -m elasticdl_tpu`` → the CLI (reference setup.py:33-35
 console entry point ``elasticdl``): ``train | evaluate | predict |
-serve | chaos | trace | clean`` (``serve`` = the online inference
-server, serving/server.py; ``chaos`` = the fault-injection harness,
+serve | route | chaos | trace | clean`` (``serve`` = the online
+inference server, serving/server.py; ``route`` = the serving-fleet
+router, serving/router.py; ``chaos`` = the fault-injection harness,
 chaos/runner.py; ``trace`` = the distributed-tracing smoke →
 Perfetto JSON, observability/trace_export.py)."""
 

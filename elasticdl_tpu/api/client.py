@@ -1,6 +1,6 @@
 """The ``elasticdl_tpu`` CLI (reference elasticdl/python/elasticdl/client.py
-+ api.py): ``train | evaluate | predict | serve | chaos | trace |
-clean`` subcommands.
++ api.py): ``train | evaluate | predict | serve | route | chaos |
+trace | clean`` subcommands.
 
 - ``--distribution_strategy=Local``: run the whole job in-process via
   LocalExecutor (reference api.py:20-23).
@@ -11,6 +11,9 @@ clean`` subcommands.
 - ``serve``: run the online inference server over an exported bundle
   directory (serving/server.py; the reference delegated this to TF
   Serving — here it is native, see docs/serving.md).
+- ``route``: run the serving-fleet router in front of N ``serve``
+  replicas (serving/router.py: least-loaded/consistent-hash routing,
+  adaptive request hedging, tiered shedding).
 - ``clean``: delete every pod/service of a job (reference
   ``elasticdl clean``).
 """
@@ -34,8 +37,8 @@ from elasticdl_tpu.platform.k8s_client import (
 
 logger = get_logger("client")
 
-_SUBCOMMANDS = ("train", "evaluate", "predict", "serve", "chaos",
-                "trace", "clean")
+_SUBCOMMANDS = ("train", "evaluate", "predict", "serve", "route",
+                "chaos", "trace", "clean")
 
 
 def _master_manifests(args, mode: str):
@@ -149,7 +152,8 @@ def main(argv=None):
     if not argv or argv[0] not in _SUBCOMMANDS:
         print(
             "usage: elasticdl_tpu "
-            "{train|evaluate|predict|serve|chaos|trace|clean} <flags>",
+            "{train|evaluate|predict|serve|route|chaos|trace|clean} "
+            "<flags>",
             file=sys.stderr,
         )
         return 2
@@ -160,6 +164,12 @@ def main(argv=None):
         from elasticdl_tpu.serving.server import main as serve_main
 
         return serve_main(rest)
+    if mode == "route":
+        # Fleet front-end over N serve replicas: routing policies,
+        # request hedging, tiered shedding (docs/serving.md "Fleet").
+        from elasticdl_tpu.serving.router import main as route_main
+
+        return route_main(rest)
     if mode == "chaos":
         # Fault-injection harness (docs/chaos.md): runs against the
         # in-process cluster, no job/k8s context — dispatch directly.

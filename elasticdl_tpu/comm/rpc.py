@@ -269,9 +269,11 @@ class RpcStub:
     def __init__(self, target, service_name: str, max_retries: int = 2,
                  backoff_base: float = 0.05, backoff_cap: float = 2.0):
         if isinstance(target, str):
+            self._target = target
             self._channel = build_channel(target)
             self._owns_channel = True
         else:
+            self._target = None
             self._channel = target
             self._owns_channel = False
         self._service_name = service_name
@@ -297,6 +299,25 @@ class RpcStub:
                     response_deserializer=_deserialize,
                 )
             return self._methods[name]
+
+    def reconnect(self):
+        """Drop the channel and build a fresh one to the same target —
+        the same remedy MasterClient.reconnect applies on the worker's
+        master ride-out: a gRPC channel whose connection attempts were
+        REFUSED for a few seconds (server not up yet, or relaunching)
+        can wedge its subchannel permanently, while a fresh channel to
+        the now-listening server connects immediately. Long external
+        retry loops (row_service._call_with_retry) call this between
+        attempts. No-op for stubs wrapping a caller-owned channel."""
+        if not self._owns_channel or self._target is None:
+            return
+        with self._lock:
+            try:
+                self._channel.close()
+            except Exception:  # a half-dead channel must not block retry
+                pass
+            self._channel = build_channel(self._target)
+            self._methods = {}
 
     def _metrics_for(self, method: str):
         from elasticdl_tpu.observability import default_registry

@@ -118,6 +118,17 @@ class HostRowService:
         self._lock = threading.RLock()
         self._server: Optional[RpcServer] = None
         self._push_count = 0
+        # Per-table monotonic update counter: bumped under the lock on
+        # every APPLIED push (duplicates don't count — they changed
+        # nothing). Serving-side hot-row caches poll this via the
+        # ``table_versions`` RPC: an unchanged counter proves every
+        # cached row is still current, a changed one invalidates the
+        # table's cache entries. Not persisted: a restarted service
+        # reports 0 again, and caches compare by != (not <), so the
+        # reset reads as "changed" and flushes them — safe.
+        self._table_versions: Dict[str, int] = {
+            name: 0 for name in tables
+        }
         self._checkpoint_steps = 0
         self._saver = None
         self._ckpt_writer_free = threading.Semaphore(1)
@@ -135,6 +146,7 @@ class HostRowService:
     def handlers(self):
         return {
             "table_info": self._table_info,
+            "table_versions": self._table_versions_handler,
             "pull_rows": self._pull_rows,
             "push_row_grads": self._push_row_grads,
             "export_rows": self._export_rows,
@@ -147,6 +159,19 @@ class HostRowService:
                 for name, table in self._tables.items()
             }
         }
+
+    def _table_versions_handler(self, request: dict) -> dict:
+        """Monotonic per-table update counters — the serving cache's
+        invalidation signal. One tiny fixed-size reply regardless of
+        table size, so a cache can poll it far cheaper than re-pulling
+        rows."""
+        with self._lock:
+            return {"versions": dict(self._table_versions)}
+
+    def table_version(self, table: str) -> int:
+        """In-process accessor (tests / local tables)."""
+        with self._lock:
+            return self._table_versions[table]
 
     def _pull_rows(self, request: dict) -> dict:
         t0 = time.monotonic()
@@ -208,6 +233,7 @@ class HostRowService:
                     ids,
                     np.asarray(request["grads"], np.float32),
                 )
+                self._table_versions[request["table"]] += 1
                 if client and seq >= 0:
                     # Record only AFTER apply succeeds: a failed apply
                     # must leave the seq unburned so the client's retry
@@ -369,6 +395,12 @@ def _call_with_retry(stub: RpcStub, method: str, retries: int,
                 method, attempt + 1, retries, delay,
             )
             time.sleep(delay)
+            # Fresh channel per retry: a channel whose connects were
+            # refused while the service was (re)starting can wedge
+            # permanently in-container; the retry budget (~4 min) must
+            # actually span a pod relaunch, not spin on a dead channel
+            # (same fix as the worker's master ride-out, PR 5).
+            stub.reconnect()
             delay = min(delay * 2, 30.0)
 
 
@@ -395,6 +427,15 @@ class _RemoteTable:
             table=self.name, ids=np.asarray(ids, np.int64),
         )
         return np.asarray(resp["rows"], np.float32)
+
+    def pull_version(self) -> int:
+        """This table's monotonic update counter on the service — the
+        hot-row cache's invalidation probe (serving/model_store.py).
+        One small RPC, no row payload."""
+        resp = _call_with_retry(
+            self._stub, "table_versions", self._retries, self._backoff,
+        )
+        return int(resp["versions"][self.name])
 
     def export_range(self, lo: int, hi: int, stride: int = 1,
                      offset: int = 0) -> np.ndarray:
@@ -514,6 +555,15 @@ class _ShardedTable:
 
         _scatter_by_home(self._pool, len(self._shards), ids, pull)
         return out
+
+    def pull_version(self) -> int:
+        """Sum of the shards' counters: any shard applying a push
+        changes the sum, and counters only grow per-process, so an
+        unchanged sum means no shard changed. (A shard RESTART resets
+        its counter and can lower the sum — still a change unless every
+        other shard's growth exactly cancels it, which the cache's
+        != comparison treats identically to growth anyway.)"""
+        return sum(s.pull_version() for s in self._shards)
 
     def export_dense(self, vocab: int, chunk: int = 65536) -> np.ndarray:
         """Each shard exports ONLY its owned rows (strided

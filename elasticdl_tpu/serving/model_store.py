@@ -30,7 +30,8 @@ import json
 import os
 import threading
 import time
-from typing import Callable, Dict, List, Optional
+from collections import OrderedDict
+from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -49,6 +50,218 @@ def _np_features(features):
     return jax.tree.map(np.asarray, features)
 
 
+class HotRowCache:
+    """Per-replica LRU over ``(table, id) -> row`` with version-based
+    invalidation — the layer that takes the row-service round trip off
+    the hot sparse-predict path.
+
+    The row service stays the single source of truth (Elastic Model
+    Aggregation's parameter-service-centric shape, arXiv 2204.03211);
+    this cache only memoizes reads in front of it. Freshness contract:
+    every ``version_check_secs`` each table's monotonic update counter
+    (``table_versions`` RPC — one tiny reply, no row payload) is
+    compared against the counter recorded when the cache was filled; a
+    changed counter drops ALL of that table's entries, so the next
+    resolve re-pulls. The periodic probe runs on a BACKGROUND thread —
+    the serving batcher never blocks on an invalidation RPC, so a warm
+    resolve touches no socket at all. ``version_check_secs=0`` probes
+    inline on every resolve instead — read-your-writes at the price of
+    one small RPC per request (still far cheaper than re-pulling row
+    blocks); ``version_check_secs<0`` disables checking (pure LRU, for
+    immutable/offline tables).
+
+    Thread-safe: the serving batcher is single-threaded today, but the
+    cache is also probed by ``/metrics`` pull-gauges and shared across
+    bundle versions (ModelStore hands ONE cache to every loader, so a
+    hot reload keeps the warm rows)."""
+
+    def __init__(self, capacity: int = 100_000,
+                 version_check_secs: float = 0.05,
+                 metrics_registry=None):
+        self.capacity = int(capacity)
+        self.version_check_secs = float(version_check_secs)
+        self._lock = threading.Lock()
+        self._rows: "OrderedDict[Tuple[str, int], np.ndarray]" = \
+            OrderedDict()
+        self._versions: Dict[str, int] = {}
+        # Per-table invalidation epoch: bumped whenever the update
+        # counter moves. Fills are epoch-guarded (see put_many) — a
+        # pull that STRADDLES a push must not insert its stale rows
+        # after the probe already invalidated, or they would outlive
+        # the bounded-staleness contract (until the NEXT push).
+        self._epochs: Dict[str, int] = {}
+        self._probe_tables: Dict = {}
+        self._probe_stop = threading.Event()
+        self._probe_thread: Optional[threading.Thread] = None
+
+        from elasticdl_tpu.observability import default_registry
+
+        registry = metrics_registry or default_registry()
+        self._m_hits = registry.counter(
+            "serving_row_cache_hits_total",
+            "Unique rows served from the hot-row cache",
+        )
+        self._m_misses = registry.counter(
+            "serving_row_cache_misses_total",
+            "Unique rows pulled from the row service on cache miss",
+        )
+        self._m_evictions = registry.counter(
+            "serving_row_cache_evictions_total",
+            "Rows evicted by LRU capacity pressure",
+        )
+        self._m_invalidations = registry.counter(
+            "serving_row_cache_invalidations_total",
+            "Rows dropped because a table's update counter moved",
+        )
+        import weakref
+
+        self_ref = weakref.ref(self)
+        registry.gauge(
+            "serving_row_cache_rows",
+            "Rows currently resident in the hot-row cache",
+        ).set_function(
+            lambda: float(len(self_ref()._rows)) if self_ref() else 0.0
+        )
+
+    def __len__(self) -> int:
+        return len(self._rows)
+
+    # ---- invalidation --------------------------------------------------
+
+    def maybe_check_versions(self, tables: Dict):
+        """Freshness hook, called by the resolver per resolve. With a
+        positive interval it only (re)arms the background probe thread
+        against the CURRENT table set and returns — the hot path never
+        blocks on an invalidation RPC. With interval 0 it probes
+        inline (read-your-writes)."""
+        if self.version_check_secs < 0:
+            return
+        if self.version_check_secs == 0:
+            self._check_versions(tables)
+            return
+        self._probe_tables = tables
+        if self._probe_thread is None:
+            with self._lock:
+                if self._probe_thread is not None:
+                    return
+                self._probe_thread = threading.Thread(
+                    target=self._probe_loop, daemon=True,
+                    name="row-cache-versions",
+                )
+            self._probe_thread.start()
+
+    def _probe_loop(self):
+        while not self._probe_stop.wait(self.version_check_secs):
+            try:
+                self._check_versions(self._probe_tables)
+            except Exception:
+                logger.exception("row cache version probe loop failed")
+
+    def stop(self):
+        self._probe_stop.set()
+        thread = self._probe_thread
+        if thread is not None:
+            thread.join(timeout=5)
+
+    def _check_versions(self, tables: Dict):
+        """Poll each table's update counter; drop a table's entries
+        when its counter moved. Tables without a ``pull_version``
+        (in-process fakes) never invalidate. A failed probe
+        invalidates too — when the row plane is unreachable we cannot
+        prove freshness, and the subsequent pull will surface the real
+        error through the existing retry path."""
+        for name, table in tables.items():
+            probe = getattr(table, "pull_version", None)
+            if probe is None:
+                continue
+            try:
+                version = int(probe())
+            except Exception:
+                logger.warning(
+                    "row cache version probe failed for table %s; "
+                    "invalidating", name, exc_info=True,
+                )
+                version = None
+            with self._lock:
+                if name in self._versions \
+                        and self._versions.get(name) != version:
+                    self._epochs[name] = \
+                        self._epochs.get(name, 0) + 1
+                    dropped = [
+                        key for key in self._rows if key[0] == name
+                    ]
+                    for key in dropped:
+                        del self._rows[key]
+                    if dropped:
+                        self._m_invalidations.inc(len(dropped))
+                if version is None:
+                    self._versions.pop(name, None)
+                else:
+                    self._versions[name] = version
+
+    # ---- lookup / fill -------------------------------------------------
+
+    def get_many(self, table: str, ids: np.ndarray,
+                 out: np.ndarray) -> np.ndarray:
+        """Fill ``out[i]`` for every cached id; returns the boolean
+        miss mask. Hits are refreshed to MRU."""
+        miss = np.zeros(len(ids), bool)
+        with self._lock:
+            for i, raw_id in enumerate(ids):
+                key = (table, int(raw_id))
+                row = self._rows.get(key)
+                if row is None:
+                    miss[i] = True
+                else:
+                    self._rows.move_to_end(key)
+                    out[i] = row
+        hits = int(len(ids) - miss.sum())
+        if hits:
+            self._m_hits.inc(hits)
+        if miss.any():
+            self._m_misses.inc(int(miss.sum()))
+        return miss
+
+    def table_epoch(self, table: str) -> int:
+        """Read BEFORE pulling rows; pass to ``put_many`` so a fill
+        whose pull straddled an invalidation is dropped."""
+        with self._lock:
+            return self._epochs.get(table, 0)
+
+    def put_many(self, table: str, ids: np.ndarray, rows: np.ndarray,
+                 epoch: Optional[int] = None):
+        if self.capacity <= 0:
+            return
+        evicted = 0
+        with self._lock:
+            if epoch is not None \
+                    and self._epochs.get(table, 0) != epoch:
+                # The rows were pulled before an invalidation landed:
+                # they may predate the push that caused it. Dropping
+                # the fill costs one re-pull; caching stale rows
+                # would cost correctness until the NEXT push.
+                return
+            for raw_id, row in zip(ids, rows):
+                # Copy: the caller's block is a mutable scratch buffer.
+                self._rows[(table, int(raw_id))] = np.array(
+                    row, np.float32
+                )
+                self._rows.move_to_end((table, int(raw_id)))
+            while len(self._rows) > self.capacity:
+                self._rows.popitem(last=False)
+                evicted += 1
+        if evicted:
+            self._m_evictions.inc(evicted)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "rows": len(self._rows),
+                "capacity": self.capacity,
+                "versions": dict(self._versions),
+            }
+
+
 class HostRowResolver:
     """Inference-time sparse-feature resolution against the row plane.
 
@@ -61,7 +274,9 @@ class HostRowResolver:
     DeepFM-style models servable without materializing the vocab."""
 
     def __init__(self, host_serving: dict, tables: Dict,
-                 feature_signature: Optional[dict] = None):
+                 feature_signature: Optional[dict] = None,
+                 row_cache: Optional[HotRowCache] = None,
+                 metrics_registry=None):
         self._id_keys = dict(host_serving["id_keys"])
         self._dims = {k: int(v)
                       for k, v in host_serving["tables"].items()}
@@ -87,29 +302,81 @@ class HostRowResolver:
                 spec["dtype"] if isinstance(spec, dict)
                 and "dtype" in spec else np.int32
             )
+        self._cache = row_cache
+
+        # The per-request round trip this resolver pays was invisible
+        # on /metrics until ISSUE 6 — these attribute it (and the
+        # cache's win) directly.
+        from elasticdl_tpu.observability import default_registry
+
+        registry = metrics_registry or default_registry()
+        self._m_resolve_seconds = registry.histogram(
+            "serving_row_resolve_seconds",
+            "Sparse-feature resolution latency per predict batch "
+            "(dedup + row fetch + bucket-pad)",
+        )
+        self._m_resolve_rows = registry.counter(
+            "serving_row_resolve_rows_total",
+            "Unique rows resolved, by source",
+            labelnames=("source",),
+        )
 
     def resolve(self, features: dict) -> dict:
         from elasticdl_tpu.embedding.host_engine import bucket_size
+        from elasticdl_tpu.observability import tracing
 
         if not isinstance(features, dict):
             raise TypeError(
                 "row-service bundles need dict features carrying the "
                 f"id keys {sorted(self._id_keys.values())}"
             )
+        t0 = time.monotonic()
+        cache_hits = 0
+        pulled = 0
         out = dict(features)
-        for table_name, key in self._id_keys.items():
-            raw = np.asarray(out[key])
-            uniq, inverse = np.unique(raw, return_inverse=True)
-            bucket = bucket_size(len(uniq))
-            dim = self._dims[table_name]
-            rows = np.zeros((bucket, dim), np.float32)
-            rows[: len(uniq)] = np.asarray(
-                self._tables[table_name].get(uniq), np.float32
+        with tracing.span("row_resolve") as resolve_span:
+            if self._cache is not None:
+                self._cache.maybe_check_versions(self._tables)
+            for table_name, key in self._id_keys.items():
+                raw = np.asarray(out[key])
+                uniq, inverse = np.unique(raw, return_inverse=True)
+                bucket = bucket_size(len(uniq))
+                dim = self._dims[table_name]
+                rows = np.zeros((bucket, dim), np.float32)
+                if self._cache is not None:
+                    block = rows[: len(uniq)]
+                    miss = self._cache.get_many(table_name, uniq, block)
+                    if miss.any():
+                        epoch = self._cache.table_epoch(table_name)
+                        fetched = np.asarray(
+                            self._tables[table_name].get(uniq[miss]),
+                            np.float32,
+                        )
+                        block[miss] = fetched
+                        self._cache.put_many(
+                            table_name, uniq[miss], fetched,
+                            epoch=epoch,
+                        )
+                    cache_hits += int(len(uniq) - miss.sum())
+                    pulled += int(miss.sum())
+                else:
+                    rows[: len(uniq)] = np.asarray(
+                        self._tables[table_name].get(uniq), np.float32
+                    )
+                    pulled += len(uniq)
+                out[key] = inverse.reshape(raw.shape).astype(
+                    self._id_dtypes[table_name]
+                )
+                out[self._prefix + table_name] = rows
+            resolve_span.set(
+                cache_hits=cache_hits, pulled=pulled,
+                tables=len(self._id_keys),
             )
-            out[key] = inverse.reshape(raw.shape).astype(
-                self._id_dtypes[table_name]
-            )
-            out[self._prefix + table_name] = rows
+        if cache_hits:
+            self._m_resolve_rows.labels(source="cache").inc(cache_hits)
+        if pulled:
+            self._m_resolve_rows.labels(source="pull").inc(pulled)
+        self._m_resolve_seconds.observe(time.monotonic() - t0)
         return out
 
 
@@ -160,13 +427,17 @@ class ServedModel:
 
 def load_served_model(bundle_dir: str, model=None,
                       row_tables: Optional[Dict] = None,
-                      row_service_addr: str = "") -> ServedModel:
+                      row_service_addr: str = "",
+                      row_cache: Optional[HotRowCache] = None,
+                      metrics_registry=None) -> ServedModel:
     """Load one bundle directory into a ``ServedModel``.
 
     ``row_tables`` / ``row_service_addr``: the row source for bundles
     exported in row-service mode (``metadata.host_serving``); exactly
     one is required for those, ignored for dense bundles. ``model`` is
-    the flax-module fallback for non-self-contained dense bundles."""
+    the flax-module fallback for non-self-contained dense bundles.
+    ``row_cache``: an optional shared ``HotRowCache`` the resolver
+    consults before pulling rows."""
     with open(os.path.join(bundle_dir, META_FILE)) as f:
         meta = json.load(f)
     resolver = None
@@ -185,6 +456,8 @@ def load_served_model(bundle_dir: str, model=None,
         resolver = HostRowResolver(
             host_serving, row_tables,
             feature_signature=meta.get("feature_signature"),
+            row_cache=row_cache,
+            metrics_registry=metrics_registry,
         )
     predictor = load_predictor(bundle_dir, model=model)
     return ServedModel(
@@ -209,15 +482,28 @@ class ModelStore:
                  retain: int = 1,
                  poll_seconds: float = 2.0,
                  loader: Optional[Callable[[str], ServedModel]] = None,
+                 row_cache_capacity: int = 0,
+                 row_cache_version_check_secs: float = 0.05,
                  metrics_registry=None):
         self.root = root
         self._retain = max(0, int(retain))
         self._poll_seconds = float(poll_seconds)
+        # ONE cache shared across every version this store loads: a
+        # hot reload must not cold-start the row working set.
+        self.row_cache: Optional[HotRowCache] = None
+        if row_cache_capacity > 0:
+            self.row_cache = HotRowCache(
+                row_cache_capacity,
+                version_check_secs=row_cache_version_check_secs,
+                metrics_registry=metrics_registry,
+            )
         if loader is None:
             def loader(path):
                 return load_served_model(
                     path, model=model, row_tables=row_tables,
                     row_service_addr=row_service_addr,
+                    row_cache=self.row_cache,
+                    metrics_registry=metrics_registry,
                 )
         self._loader = loader
         self._lock = threading.Lock()
@@ -403,3 +689,5 @@ class ModelStore:
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None
+        if self.row_cache is not None:
+            self.row_cache.stop()

@@ -151,11 +151,21 @@ class BatchingPredictor:
     ``QueueFullError`` is the load-shed signal (HTTP 429)."""
 
     class QueueFullError(RuntimeError):
-        pass
+        """Load-shed signal (HTTP 429). ``retry_after`` is the
+        suggested client backoff in seconds (the 429's Retry-After
+        header); ``tier`` names which shed tier fired."""
+
+        def __init__(self, message: str, tier: str = "capacity",
+                     retry_after: float = 1.0):
+            super().__init__(message)
+            self.tier = tier
+            self.retry_after = float(retry_after)
 
     def __init__(self, store, max_batch_size: int = 64,
                  batch_deadline_ms: float = 5.0,
                  max_queue: int = 256,
+                 hedge_shed_frac: float = 0.5,
+                 low_shed_frac: float = 0.75,
                  metrics_registry=None):
         from elasticdl_tpu.observability import tracing
 
@@ -166,6 +176,13 @@ class BatchingPredictor:
         self.max_batch_size = int(max_batch_size)
         self.batch_deadline = float(batch_deadline_ms) / 1e3
         self.max_queue = int(max_queue)
+        # Tiered shedding (ISSUE 6): under pressure, drop the cheapest
+        # traffic first — hedged retries (the router re-issues them
+        # speculatively; the primary attempt is still in flight
+        # elsewhere), then best-effort low-priority requests, and only
+        # at a full queue everything. Fractions of max_queue.
+        self.hedge_shed_frac = float(hedge_shed_frac)
+        self.low_shed_frac = float(low_shed_frac)
         self._queue: List[_Request] = []
         self._cond = threading.Condition()
         self._stop = False
@@ -206,7 +223,8 @@ class BatchingPredictor:
         )
         self._m_shed = registry.counter(
             "serving_load_shed_total",
-            "Requests shed with 429 because the queue was full",
+            "Requests shed with 429, by tier (hedge < low < capacity)",
+            labelnames=("tier",),
         )
         self._m_padded = registry.counter(
             "serving_padded_examples_total",
@@ -236,8 +254,40 @@ class BatchingPredictor:
 
     # ---- client side ---------------------------------------------------
 
-    def submit(self, features, timeout: float = 30.0):
-        """Enqueue one request; returns (outputs, model_version)."""
+    def _shed_locked(self, priority: str, hedge: bool):
+        """Raise QueueFullError when the queue's current depth crosses
+        the tier this request belongs to. Called under ``_cond``."""
+        depth = len(self._queue)
+        if self._draining:
+            raise self.QueueFullError(
+                "server draining (SIGTERM)", tier="draining",
+                retry_after=2.0,
+            )
+        if depth >= self.max_queue:
+            raise self.QueueFullError(
+                f"queue full ({self.max_queue} requests waiting)",
+                tier="capacity", retry_after=2.0,
+            )
+        if hedge and depth >= self.hedge_shed_frac * self.max_queue:
+            raise self.QueueFullError(
+                f"hedge shed at queue depth {depth}", tier="hedge",
+                retry_after=1.0,
+            )
+        if priority == "low" \
+                and depth >= self.low_shed_frac * self.max_queue:
+            raise self.QueueFullError(
+                f"low-priority shed at queue depth {depth}",
+                tier="low", retry_after=1.0,
+            )
+
+    def submit(self, features, timeout: float = 30.0,
+               priority: str = "normal", hedge: bool = False):
+        """Enqueue one request; returns (outputs, model_version).
+
+        ``priority`` ("high"/"normal"/"low") and ``hedge`` (a router's
+        speculative second attempt) select the shed tier: hedges shed
+        first, then low-priority, then — only at a full queue —
+        everything."""
         model = self._store.current()
         if model is None:
             raise RuntimeError("no model loaded")
@@ -257,17 +307,11 @@ class BatchingPredictor:
                     req_span.trace_id, req_span.span_id
                 )
             with self._cond:
-                if self._draining:
-                    self._m_shed.inc()
-                    raise self.QueueFullError(
-                        "server draining (SIGTERM)"
-                    )
-                if len(self._queue) >= self.max_queue:
-                    self._m_shed.inc()
-                    raise self.QueueFullError(
-                        f"queue full ({self.max_queue} requests "
-                        "waiting)"
-                    )
+                try:
+                    self._shed_locked(priority, hedge)
+                except self.QueueFullError as exc:
+                    self._m_shed.labels(tier=exc.tier).inc()
+                    raise
                 self._queue.append(request)
                 self._cond.notify_all()
             if not request.event.wait(timeout):
@@ -519,24 +563,28 @@ class _Handler(BaseHTTPRequestHandler):
 
     protocol_version = "HTTP/1.1"
 
-    def _reply(self, code: int, body: bytes, content_type: str):
+    def _reply(self, code: int, body: bytes, content_type: str,
+               headers=()):
         self.send_response(code)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
+        for name, value in headers:
+            self.send_header(name, value)
         self.end_headers()
         self.wfile.write(body)
 
-    def _reply_error(self, code: int, message: str, as_msgpack: bool):
+    def _reply_error(self, code: int, message: str, as_msgpack: bool,
+                     headers=()):
         srv = type(self).server_ref
         srv.predictor.record_status(code)
         if as_msgpack:
             from elasticdl_tpu.common import tensor_utils
 
             body = tensor_utils.dumps({"error": message})
-            self._reply(code, body, MSGPACK_CONTENT_TYPE)
+            self._reply(code, body, MSGPACK_CONTENT_TYPE, headers)
         else:
             body = json.dumps({"error": message}).encode("utf-8")
-            self._reply(code, body, "application/json")
+            self._reply(code, body, "application/json", headers)
 
     def do_GET(self):  # noqa: N802 (BaseHTTPRequestHandler API)
         srv = type(self).server_ref
@@ -622,12 +670,27 @@ class _Handler(BaseHTTPRequestHandler):
                 400, f"bad request: {exc}", as_msgpack=as_msgpack
             )
             return
+        # Router-set scheduling hints: X-Priority selects the shed
+        # tier, X-Hedge marks a speculative second attempt (shed
+        # first under pressure — its primary is in flight elsewhere).
+        priority = self.headers.get("X-Priority", "normal").lower()
+        if priority not in ("high", "normal", "low"):
+            priority = "normal"
+        hedge = self.headers.get("X-Hedge", "") == "1"
         try:
             outputs, version = srv.predictor.submit(
-                features, timeout=srv.request_timeout
+                features, timeout=srv.request_timeout,
+                priority=priority, hedge=hedge,
             )
         except BatchingPredictor.QueueFullError as exc:
-            self._reply_error(429, str(exc), as_msgpack=as_msgpack)
+            self._reply_error(
+                429, str(exc), as_msgpack=as_msgpack,
+                headers=(
+                    ("Retry-After",
+                     str(max(1, int(round(exc.retry_after))))),
+                    ("X-Shed-Tier", exc.tier),
+                ),
+            )
             return
         except TimeoutError as exc:
             self._reply_error(504, str(exc), as_msgpack=as_msgpack)
@@ -677,6 +740,8 @@ class InferenceServer:
                  batch_deadline_ms: float = 5.0, max_queue: int = 256,
                  port: int = 8500, host: str = "",
                  request_timeout: float = 30.0,
+                 hedge_shed_frac: float = 0.5,
+                 low_shed_frac: float = 0.75,
                  metrics_registry=None):
         from elasticdl_tpu.observability import default_registry
 
@@ -685,6 +750,8 @@ class InferenceServer:
         self.predictor = BatchingPredictor(
             store, max_batch_size=max_batch_size,
             batch_deadline_ms=batch_deadline_ms, max_queue=max_queue,
+            hedge_shed_frac=hedge_shed_frac,
+            low_shed_frac=low_shed_frac,
             metrics_registry=self.registry,
         )
         self.request_timeout = float(request_timeout)
@@ -792,6 +859,29 @@ def main(argv=None) -> int:
     parser.add_argument("--retain_versions", type=int, default=1)
     parser.add_argument("--request_timeout", type=float, default=30.0)
     parser.add_argument(
+        "--row_cache_capacity", type=int, default=0,
+        help="Hot-row LRU size (rows) for row-service bundles: caches "
+             "(table, id) -> row so warm sparse predicts skip the "
+             "row-service round trip. 0 (default) = no cache",
+    )
+    parser.add_argument(
+        "--row_cache_version_check_ms", type=float, default=50.0,
+        help="How often the cache re-checks the row service's "
+             "per-table update counters (bounded staleness). 0 = "
+             "check every request (read-your-writes); negative = "
+             "never (immutable tables)",
+    )
+    parser.add_argument(
+        "--hedge_shed_frac", type=float, default=0.5,
+        help="Queue fraction past which hedged (speculative) requests "
+             "shed with 429",
+    )
+    parser.add_argument(
+        "--low_shed_frac", type=float, default=0.75,
+        help="Queue fraction past which low-priority requests shed "
+             "with 429",
+    )
+    parser.add_argument(
         "--drain_grace", type=float, default=25.0,
         help="SIGTERM drain budget for in-flight micro-batches; keep "
              "under the pod's terminationGracePeriodSeconds",
@@ -837,6 +927,11 @@ def main(argv=None) -> int:
         row_service_addr=args.row_service_addr,
         retain=args.retain_versions,
         poll_seconds=args.poll_seconds,
+        row_cache_capacity=args.row_cache_capacity,
+        row_cache_version_check_secs=(
+            args.row_cache_version_check_ms / 1e3
+            if args.row_cache_version_check_ms >= 0 else -1.0
+        ),
     )
     store.load_initial()
     store.start_polling()
@@ -847,6 +942,8 @@ def main(argv=None) -> int:
         max_queue=args.max_queue,
         port=args.port,
         request_timeout=args.request_timeout,
+        hedge_shed_frac=args.hedge_shed_frac,
+        low_shed_frac=args.low_shed_frac,
     ).start()
     logger.info(
         "Serving %s on :%d (max_batch=%d, deadline=%.1fms)",

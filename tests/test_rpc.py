@@ -303,3 +303,38 @@ class TestServerChaosHook:
         assert info.value.code == "FAILED_PRECONDITION"
         assert ("", "Echo", "echo") in calls
         stub.close()
+
+
+class TestStubReconnect:
+    def test_reconnect_recovers_from_prebind_refusals(self):
+        """A stub created (and called) BEFORE its server listens must
+        recover once it does — in-container, a channel whose connects
+        were refused can wedge permanently, so long retry loops
+        (row_service._call_with_retry) rebuild it via reconnect()."""
+
+        def echo(request):
+            return {"echo": request.get("value")}
+
+        port = _free_unused_port()
+        stub = RpcStub(f"localhost:{port}", "Echo", max_retries=0)
+        with pytest.raises(RpcError):
+            stub.call("echo", value=1, timeout=2)
+        server = RpcServer(
+            f"localhost:{port}", {"Echo": {"echo": echo}}
+        ).start()
+        try:
+            stub.reconnect()
+            assert stub.call(
+                "echo", value=7, timeout=10
+            )["echo"] == 7
+        finally:
+            server.stop(0)
+
+    def test_reconnect_noop_on_wrapped_channel(self, echo_server):
+        from elasticdl_tpu.comm.rpc import build_channel
+
+        channel = build_channel(f"localhost:{echo_server.port}")
+        stub = RpcStub(channel, "Echo", max_retries=0)
+        stub.reconnect()  # must not close a channel it doesn't own
+        assert stub.call("echo", value=3, timeout=10)["echo"] == 3
+        channel.close()

@@ -1,0 +1,750 @@
+"""Serving fleet (ISSUE 6): router policies, hedging, tiered shedding,
+hot-row cache semantics, graceful drain.
+
+Policy/hedge/shed units drive ``RouterCore`` and the policies directly;
+the e2e tests run a real router over real ``InferenceServer`` replicas
+(fake predictors — no compile cost) and over a live in-process
+``HostRowService`` for the cache read-your-writes path.
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from elasticdl_tpu.observability import MetricsRegistry
+from elasticdl_tpu.serving.model_store import (
+    HostRowResolver,
+    HotRowCache,
+    ServedModel,
+)
+from elasticdl_tpu.serving.router import (
+    AdaptiveHedge,
+    ConsistentHashPolicy,
+    LeastLoadedPolicy,
+    Replica,
+    RouterServer,
+)
+from elasticdl_tpu.serving.server import BatchingPredictor, InferenceServer
+
+FEATURE_DIM = 4
+
+
+def _snap(registry):
+    return {f["name"]: f for f in registry.snapshot()["families"]}
+
+
+def _series_value(snap, family, **labels):
+    fam = snap.get(family)
+    if fam is None:
+        return 0.0
+    want = list(labels.values())
+    for series in fam["series"]:
+        if series["labels"] == want:
+            return series["value"]
+    return 0.0
+
+
+# ---- routing policies ------------------------------------------------
+
+
+class TestLeastLoaded:
+    def test_picks_emptier_replica(self):
+        replicas = [Replica("a:1", 0), Replica("b:1", 1)]
+        replicas[0].inflight = 3
+        replicas[1].inflight = 1
+        policy = LeastLoadedPolicy()
+        for _ in range(4):
+            assert policy.pick(replicas) is replicas[1]
+
+    def test_skips_unhealthy(self):
+        replicas = [Replica("a:1", 0), Replica("b:1", 1)]
+        replicas[0].healthy = False
+        policy = LeastLoadedPolicy()
+        assert policy.pick(replicas) is replicas[1]
+
+    def test_rotates_among_ties(self):
+        replicas = [Replica("a:1", 0), Replica("b:1", 1),
+                    Replica("c:1", 2)]
+        policy = LeastLoadedPolicy()
+        picked = {policy.pick(replicas).index for _ in range(6)}
+        assert len(picked) > 1  # idle fleet still spreads
+
+    def test_exclude_for_hedge(self):
+        replicas = [Replica("a:1", 0), Replica("b:1", 1)]
+        policy = LeastLoadedPolicy()
+        assert policy.pick(
+            replicas, exclude=(replicas[0],)
+        ) is replicas[1]
+        assert policy.pick(
+            replicas, exclude=(replicas[0], replicas[1])
+        ) is None
+
+
+class TestConsistentHash:
+    def test_stable_under_replica_removal(self):
+        """Removing one replica only remaps the keys that lived on it;
+        every other key keeps its replica (the property that preserves
+        per-replica cache affinity)."""
+        replicas = [Replica(f"host{i}:1", i) for i in range(4)]
+        policy = ConsistentHashPolicy(replicas)
+        keys = [f"user-{i}" for i in range(200)]
+        before = {k: policy.pick(replicas, key=k).index for k in keys}
+        assert len(set(before.values())) == 4  # all replicas used
+        replicas[2].healthy = False  # "remove" replica 2
+        after = {k: policy.pick(replicas, key=k).index for k in keys}
+        for key in keys:
+            if before[key] != 2:
+                assert after[key] == before[key], key
+            else:
+                assert after[key] != 2
+        # Same-key affinity is deterministic.
+        assert policy.pick(replicas, key="user-7").index == \
+            after["user-7"]
+
+    def test_falls_back_without_key(self):
+        replicas = [Replica("a:1", 0), Replica("b:1", 1)]
+        replicas[0].inflight = 5
+        policy = ConsistentHashPolicy(replicas)
+        assert policy.pick(replicas, key=None) is replicas[1]
+
+
+class TestAdaptiveHedge:
+    def test_pins_to_max_until_warm(self):
+        hedge = AdaptiveHedge(min_ms=5, max_ms=500, min_samples=10)
+        assert hedge.delay_secs() == 0.5
+        for _ in range(10):
+            hedge.observe(0.01)
+        assert abs(hedge.delay_secs() - 0.01) < 1e-9
+
+    def test_clamped(self):
+        hedge = AdaptiveHedge(min_ms=5, max_ms=50, min_samples=1)
+        hedge.observe(10.0)
+        assert hedge.delay_secs() == 0.05
+        for _ in range(100):
+            hedge.observe(1e-6)
+        assert hedge.delay_secs() == 0.005
+
+    def test_shed_responses_do_not_feed_the_window(self):
+        """Fast 429s are not service-time samples: a storm of them
+        must not collapse the hedge delay to its floor (which would
+        double attempt volume exactly during an overload)."""
+        from elasticdl_tpu.serving.router import RouterCore, _Attempt
+
+        core = RouterCore(
+            ["a:1", "b:1"], metrics_registry=MetricsRegistry(),
+            hedge_min_ms=5, hedge_max_ms=500,
+        )
+        for _ in range(50):
+            attempt = _Attempt(
+                core, core.replicas[0], b"", "t", "normal", False
+            )
+            core.replicas[0].inflight += 1
+            attempt.outcome = (429, b"", "application/json", "1")
+            attempt.elapsed = 0.001
+            core._finish_attempt(attempt)
+        # No 200s observed -> the window is empty and the delay stays
+        # pinned to max (shy), not collapsed to the 5ms floor.
+        assert core.hedge.delay_secs() == 0.5
+
+
+# ---- replica-side tiered shedding ------------------------------------
+
+
+class _RecordingPredictor:
+    def __init__(self, delay: float = 0.0):
+        self.delay = delay
+        self.calls = 0
+
+    def __call__(self, features):
+        self.calls += 1
+        if self.delay:
+            time.sleep(self.delay)
+        return np.asarray(features).sum(axis=1, keepdims=True)
+
+
+class _FakeStore:
+    def __init__(self, predictor, meta=None):
+        self._model = ServedModel(
+            "fake", 1, meta or {"batch_polymorphic": True}, predictor
+        )
+
+    def current(self):
+        return self._model
+
+    def versions(self):
+        return [1]
+
+    def stop(self):
+        pass
+
+
+def _stall_queue(predictor, depth):
+    """Park requests until the queue of a predictor whose batcher
+    thread was never started holds ``depth`` of them."""
+    for _ in range(depth - len(predictor._queue)):
+        threading.Thread(
+            target=lambda: _try_submit(predictor), daemon=True
+        ).start()
+    deadline = time.monotonic() + 5
+    while len(predictor._queue) < depth:
+        assert time.monotonic() < deadline, "queue never filled"
+        time.sleep(0.002)
+
+
+def _try_submit(predictor, **kw):
+    try:
+        predictor.submit(
+            np.ones((1, FEATURE_DIM), np.float32), timeout=1.0, **kw
+        )
+    except Exception:
+        pass
+
+
+class TestShedTiers:
+    def test_hedge_sheds_before_low_before_all(self):
+        registry = MetricsRegistry()
+        predictor = BatchingPredictor(
+            _FakeStore(_RecordingPredictor()), max_queue=8,
+            hedge_shed_frac=0.5, low_shed_frac=0.75,
+            metrics_registry=registry,
+        )  # batcher NOT started: queue depth is fully controlled
+        features = np.ones((1, FEATURE_DIM), np.float32)
+        _stall_queue(predictor, 4)  # depth 4 = 0.5 * 8
+        with pytest.raises(BatchingPredictor.QueueFullError) as exc:
+            predictor.submit(features, hedge=True)
+        assert exc.value.tier == "hedge"
+        _stall_queue(predictor, 6)  # depth 6 = 0.75 * 8
+        with pytest.raises(BatchingPredictor.QueueFullError) as exc:
+            predictor.submit(features, priority="low")
+        assert exc.value.tier == "low"
+        assert exc.value.retry_after >= 1.0
+        _stall_queue(predictor, 8)  # full
+        with pytest.raises(BatchingPredictor.QueueFullError) as exc:
+            predictor.submit(features, priority="high")
+        assert exc.value.tier == "capacity"
+        snap = _snap(registry)
+        for tier in ("hedge", "low", "capacity"):
+            assert _series_value(
+                snap, "edl_tpu_serving_load_shed_total", tier=tier
+            ) == 1.0
+
+    def test_normal_traffic_admitted_between_tiers(self):
+        predictor = BatchingPredictor(
+            _FakeStore(_RecordingPredictor()), max_queue=8,
+            metrics_registry=MetricsRegistry(),
+        )
+        _stall_queue(predictor, 6)
+        # Depth 6: hedges and low shed, normal still queues.
+        request_count = len(predictor._queue)
+        thread = threading.Thread(
+            target=lambda: _try_submit(predictor, priority="normal"),
+            daemon=True,
+        )
+        thread.start()
+        deadline = time.monotonic() + 2
+        while len(predictor._queue) <= request_count:
+            assert time.monotonic() < deadline
+            time.sleep(0.002)
+
+    def test_http_429_carries_retry_after_and_tier(self):
+        predictor_delay = _RecordingPredictor(delay=0.2)
+        server = InferenceServer(
+            _FakeStore(predictor_delay), port=0, max_batch_size=1,
+            batch_deadline_ms=0.0, max_queue=2,
+            metrics_registry=MetricsRegistry(),
+        ).start()
+        try:
+            import http.client
+
+            from elasticdl_tpu.common import tensor_utils
+
+            body = tensor_utils.dumps({
+                "features": np.ones((1, FEATURE_DIM), np.float32)
+            })
+            results = []
+            lock = threading.Lock()
+
+            def fire():
+                conn = http.client.HTTPConnection(
+                    "localhost", server.port, timeout=10
+                )
+                try:
+                    conn.request(
+                        "POST", "/v1/predict", body=body,
+                        headers={
+                            "Content-Type": "application/x-msgpack",
+                            "X-Priority": "low",
+                        },
+                    )
+                    resp = conn.getresponse()
+                    resp.read()
+                    with lock:
+                        results.append(
+                            (resp.status,
+                             resp.getheader("Retry-After"),
+                             resp.getheader("X-Shed-Tier"))
+                        )
+                finally:
+                    conn.close()
+
+            threads = [
+                threading.Thread(target=fire) for _ in range(10)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            shed = [r for r in results if r[0] == 429]
+            assert shed, results
+            for _, retry_after, tier in shed:
+                assert retry_after is not None
+                assert int(retry_after) >= 1
+                assert tier in ("low", "capacity", "draining")
+        finally:
+            server.stop()
+
+
+# ---- hot-row cache ---------------------------------------------------
+
+
+class _CountingTable:
+    """Table-like with a bumpable version (the remote-table duck
+    type)."""
+
+    def __init__(self, dim=3):
+        self.dim = dim
+        self.version = 0
+        self.pulls = []  # list of id arrays
+
+    def get(self, ids):
+        ids = np.asarray(ids)
+        self.pulls.append(ids.copy())
+        return np.stack([
+            np.full((self.dim,), float(i), np.float32) for i in ids
+        ]) if len(ids) else np.zeros((0, self.dim), np.float32)
+
+    def pull_version(self):
+        return self.version
+
+
+def _resolver(table, cache, registry=None):
+    return HostRowResolver(
+        {"id_keys": {"tbl": "ids"}, "tables": {"tbl": table.dim}},
+        {"tbl": table},
+        row_cache=cache,
+        metrics_registry=registry or MetricsRegistry(),
+    )
+
+
+class TestHotRowCache:
+    def test_warm_resolve_skips_row_pull(self):
+        registry = MetricsRegistry()
+        table = _CountingTable()
+        cache = HotRowCache(
+            capacity=100, version_check_secs=0,
+            metrics_registry=registry,
+        )
+        resolver = _resolver(table, cache, registry=registry)
+        features = {"ids": np.array([[1, 2, 3]], np.int64)}
+        out1 = resolver.resolve(dict(features))
+        assert len(table.pulls) == 1
+        out2 = resolver.resolve(dict(features))
+        # Warm: no second pull; identical rows.
+        assert len(table.pulls) == 1
+        np.testing.assert_array_equal(
+            out1["__host_rows__:tbl"], out2["__host_rows__:tbl"]
+        )
+        snap = _snap(registry)
+        assert snap["edl_tpu_serving_row_cache_hits_total"][
+            "series"][0]["value"] == 3.0
+        assert _series_value(
+            snap, "edl_tpu_serving_row_resolve_rows_total",
+            source="cache",
+        ) == 3.0
+        assert snap["edl_tpu_serving_row_resolve_seconds"][
+            "series"][0]["count"] == 2
+
+    def test_partial_hit_pulls_only_misses(self):
+        table = _CountingTable()
+        cache = HotRowCache(capacity=100, version_check_secs=0)
+        resolver = _resolver(table, cache)
+        resolver.resolve({"ids": np.array([[1, 2]], np.int64)})
+        resolver.resolve({"ids": np.array([[2, 5]], np.int64)})
+        assert [list(p) for p in table.pulls] == [[1, 2], [5]]
+
+    def test_version_bump_invalidates_read_your_writes(self):
+        """The satellite acceptance: a push that bumps the table
+        version makes the NEXT cached resolve re-pull."""
+        registry = MetricsRegistry()
+        table = _CountingTable()
+        cache = HotRowCache(
+            capacity=100, version_check_secs=0,
+            metrics_registry=registry,
+        )
+        resolver = _resolver(table, cache)
+        features = {"ids": np.array([[7, 8]], np.int64)}
+        resolver.resolve(dict(features))
+        resolver.resolve(dict(features))
+        assert len(table.pulls) == 1  # warm
+        table.version += 1  # the "push_row_grads happened" signal
+        resolver.resolve(dict(features))
+        assert len(table.pulls) == 2  # re-pulled
+        assert [list(p) for p in table.pulls][1] == [7, 8]
+        snap = _snap(registry)
+        assert snap["edl_tpu_serving_row_cache_invalidations_total"][
+            "series"][0]["value"] == 2.0
+
+    def test_lru_eviction_under_capacity(self):
+        registry = MetricsRegistry()
+        table = _CountingTable()
+        cache = HotRowCache(
+            capacity=2, version_check_secs=-1,
+            metrics_registry=registry,
+        )
+        resolver = _resolver(table, cache)
+        resolver.resolve({"ids": np.array([[1, 2]], np.int64)})
+        resolver.resolve({"ids": np.array([[3]], np.int64)})  # evicts 1
+        resolver.resolve({"ids": np.array([[1]], np.int64)})  # miss
+        assert [list(p) for p in table.pulls] == [[1, 2], [3], [1]]
+        snap = _snap(registry)
+        assert snap["edl_tpu_serving_row_cache_evictions_total"][
+            "series"][0]["value"] >= 1.0
+
+    def test_fill_straddling_invalidation_is_dropped(self):
+        """A pull that was in flight when an invalidation landed must
+        not insert its (possibly pre-push) rows afterwards — they
+        would outlive the bounded-staleness contract until the NEXT
+        push."""
+        table = _CountingTable()
+        cache = HotRowCache(
+            capacity=100, version_check_secs=0,
+            metrics_registry=MetricsRegistry(),
+        )
+        cache._check_versions({"tbl": table})  # records v0
+        epoch = cache.table_epoch("tbl")
+        stale_rows = np.ones((1, 3), np.float32)
+        table.version += 1  # push lands while the pull is in flight
+        cache._check_versions({"tbl": table})  # probe invalidates
+        cache.put_many("tbl", np.array([9]), stale_rows, epoch=epoch)
+        out = np.zeros((1, 3), np.float32)
+        assert cache.get_many("tbl", np.array([9]), out).all(), \
+            "stale fill was cached past an invalidation"
+        # A fill against the CURRENT epoch inserts normally.
+        cache.put_many("tbl", np.array([9]), stale_rows,
+                       epoch=cache.table_epoch("tbl"))
+        assert not cache.get_many("tbl", np.array([9]), out).any()
+
+    def test_uncached_resolver_still_counts_rows(self):
+        registry = MetricsRegistry()
+        table = _CountingTable()
+        resolver = HostRowResolver(
+            {"id_keys": {"tbl": "ids"}, "tables": {"tbl": table.dim}},
+            {"tbl": table},
+            metrics_registry=registry,
+        )
+        resolver.resolve({"ids": np.array([[4, 4, 9]], np.int64)})
+        snap = _snap(registry)
+        assert _series_value(
+            snap, "edl_tpu_serving_row_resolve_rows_total",
+            source="pull",
+        ) == 2.0  # deduped unique ids
+        assert snap["edl_tpu_serving_row_resolve_seconds"][
+            "series"][0]["count"] == 1
+
+
+class TestRowServiceVersions:
+    def test_push_bumps_version_duplicate_does_not(self):
+        from elasticdl_tpu.embedding.optimizer import (
+            SGD,
+            HostOptimizerWrapper,
+        )
+        from elasticdl_tpu.embedding.row_service import HostRowService
+        from elasticdl_tpu.embedding.table import EmbeddingTable
+
+        table = EmbeddingTable("tbl", 3)
+        service = HostRowService(
+            {"tbl": table}, HostOptimizerWrapper(SGD(lr=0.5)),
+            metrics_registry=MetricsRegistry(),
+        )
+        assert service.table_version("tbl") == 0
+        push = {
+            "table": "tbl", "ids": np.array([1, 2], np.int64),
+            "grads": np.ones((2, 3), np.float32),
+            "client": "c", "seq": 1,
+        }
+        service._push_row_grads(dict(push))
+        assert service.table_version("tbl") == 1
+        # Retried (duplicate) push applies nothing -> no bump.
+        service._push_row_grads(dict(push))
+        assert service.table_version("tbl") == 1
+        resp = service._table_versions_handler({})
+        assert resp["versions"] == {"tbl": 1}
+
+    def test_remote_and_sharded_pull_version(self):
+        from elasticdl_tpu.embedding.optimizer import (
+            SGD,
+            HostOptimizerWrapper,
+        )
+        from elasticdl_tpu.embedding.row_service import (
+            HostRowService,
+            make_remote_engine,
+        )
+        from elasticdl_tpu.embedding.table import EmbeddingTable
+
+        services = [
+            HostRowService(
+                {"tbl": EmbeddingTable("tbl", 3)},
+                HostOptimizerWrapper(SGD(lr=0.5)),
+                metrics_registry=MetricsRegistry(),
+            ).start()
+            for _ in range(2)
+        ]
+        try:
+            addr = ",".join(
+                f"localhost:{s.port}" for s in services
+            )
+            engine = make_remote_engine(
+                addr, id_keys={"tbl": "ids"}, retries=2,
+                backoff_secs=0.05,
+            )
+            sharded = engine.tables["tbl"]
+            assert sharded.pull_version() == 0
+            services[1]._push_row_grads({
+                "table": "tbl", "ids": np.array([4], np.int64),
+                "grads": np.ones((1, 3), np.float32),
+            })
+            assert sharded.pull_version() == 1
+        finally:
+            for s in services:
+                s.stop(0)
+
+
+# ---- router e2e over real replicas -----------------------------------
+
+
+def _start_replica(delay=0.0, registry=None, **kw):
+    return InferenceServer(
+        _FakeStore(_RecordingPredictor(delay=delay)), port=0,
+        batch_deadline_ms=1.0,
+        metrics_registry=registry or MetricsRegistry(), **kw
+    ).start()
+
+
+def _predict_via(port, body=None, headers=None, timeout=15):
+    import http.client
+
+    from elasticdl_tpu.common import tensor_utils
+
+    if body is None:
+        body = tensor_utils.dumps({
+            "features": np.ones((2, FEATURE_DIM), np.float32)
+        })
+    conn = http.client.HTTPConnection("localhost", port,
+                                      timeout=timeout)
+    try:
+        send = {"Content-Type": "application/x-msgpack"}
+        send.update(headers or {})
+        conn.request("POST", "/v1/predict", body=body, headers=send)
+        resp = conn.getresponse()
+        raw = resp.read()
+        return resp.status, (
+            tensor_utils.loads(raw) if resp.status == 200 else raw
+        )
+    finally:
+        conn.close()
+
+
+class TestRouterEndToEnd:
+    def test_routes_and_answers(self):
+        replicas = [_start_replica(), _start_replica()]
+        registry = MetricsRegistry()
+        router = RouterServer(
+            [f"localhost:{r.port}" for r in replicas], port=0,
+            metrics_registry=registry,
+        ).start()
+        try:
+            for _ in range(8):
+                status, out = _predict_via(router.port)
+                assert status == 200
+                np.testing.assert_allclose(
+                    np.asarray(out["predictions"]),
+                    np.full((2, 1), FEATURE_DIM, np.float32),
+                )
+            snap = _snap(registry)
+            assert _series_value(
+                snap, "edl_tpu_router_requests_total", code="200"
+            ) == 8.0
+            # Both replicas saw traffic (least-loaded tie rotation).
+            attempts = {
+                s["labels"][0]: s["value"]
+                for s in snap["edl_tpu_router_attempts_total"]["series"]
+            }
+            assert set(attempts) == {"0", "1"}
+        finally:
+            router.stop()
+            for r in replicas:
+                r.stop()
+
+    def test_replica_kill_mid_load_availability_holds(self):
+        """The chaos-drill property in fast-lane form: kill one of two
+        replicas under load; every request still answers 200."""
+        replicas = [_start_replica(), _start_replica()]
+        registry = MetricsRegistry()
+        router = RouterServer(
+            [f"localhost:{r.port}" for r in replicas], port=0,
+            metrics_registry=registry,
+            hedge_min_ms=5, hedge_max_ms=100, replica_timeout=5.0,
+        ).start()
+        try:
+            for _ in range(10):  # warm the hedge window
+                assert _predict_via(router.port)[0] == 200
+            replicas[0].stop()
+            codes = [
+                _predict_via(router.port)[0] for _ in range(20)
+            ]
+            assert codes.count(200) == 20, codes
+            snap = _snap(registry)
+            assert snap["edl_tpu_router_replica_unhealthy_total"][
+                "series"][0]["value"] >= 1.0
+        finally:
+            router.stop()
+            for r in replicas:
+                r.stop()
+
+    def test_hedge_slow_replica_loses_no_double_count(self):
+        """Hedging satellite: the slow replica's answer is discarded,
+        the fast one's returns, and the router counts ONE request."""
+        slow = _start_replica(delay=0.4)
+        fast = _start_replica()
+        registry = MetricsRegistry()
+        router = RouterServer(
+            [f"localhost:{slow.port}", f"localhost:{fast.port}"],
+            port=0, metrics_registry=registry,
+            hedge_min_ms=20, hedge_max_ms=40, replica_timeout=5.0,
+        ).start()
+        try:
+            # Close-loop a few so the hedge window warms, then measure.
+            statuses = []
+            t0 = time.monotonic()
+            for _ in range(6):
+                statuses.append(_predict_via(router.port)[0])
+            elapsed = time.monotonic() - t0
+            assert statuses == [200] * 6
+            # With hedging, no request pays the full 0.4s slow path
+            # once the router learns: total must be well under the
+            # 6 x 0.4s the slow replica alone would cost.
+            assert elapsed < 2.4, elapsed
+            snap = _snap(registry)
+            assert _series_value(
+                snap, "edl_tpu_router_requests_total", code="200"
+            ) == 6.0  # ONE count per request despite two attempts
+            assert _series_value(
+                snap, "edl_tpu_router_hedges_total", event="fired"
+            ) >= 1.0
+            won = _series_value(
+                snap, "edl_tpu_router_hedges_total", event="won"
+            )
+            assert won >= 1.0
+        finally:
+            router.stop()
+            slow.stop()
+            fast.stop()
+
+    def test_router_passthrough_models_endpoint(self):
+        import urllib.request
+
+        replica = _start_replica()
+        router = RouterServer(
+            [f"localhost:{replica.port}"], port=0,
+            metrics_registry=MetricsRegistry(),
+        ).start()
+        try:
+            with urllib.request.urlopen(
+                f"http://localhost:{router.port}/v1/models", timeout=5
+            ) as resp:
+                info = json.loads(resp.read())
+            assert info["current"] == 1
+        finally:
+            router.stop()
+            replica.stop()
+
+    def test_router_capacity_shed_with_retry_after(self):
+        import http.client
+
+        replica = _start_replica()
+        router = RouterServer(
+            [f"localhost:{replica.port}"], port=0,
+            metrics_registry=MetricsRegistry(),
+            replica_concurrency=1, hedge=False,
+        ).start()
+        try:
+            # Saturate the single admission slot with a parked request
+            # by stalling the replica: park the batcher behind a slow
+            # call.
+            core = router.core
+            with core._lock:
+                core._inflight_requests = 1  # simulate a parked route
+            conn = http.client.HTTPConnection(
+                "localhost", router.port, timeout=5
+            )
+            from elasticdl_tpu.common import tensor_utils
+
+            body = tensor_utils.dumps({
+                "features": np.ones((1, FEATURE_DIM), np.float32)
+            })
+            conn.request(
+                "POST", "/v1/predict", body=body,
+                headers={"Content-Type": "application/x-msgpack"},
+            )
+            resp = conn.getresponse()
+            resp.read()
+            assert resp.status == 429
+            assert int(resp.getheader("Retry-After")) >= 1
+            assert resp.getheader("X-Shed-Tier") == "capacity"
+            with core._lock:
+                core._inflight_requests = 0
+        finally:
+            router.stop()
+            replica.stop()
+
+
+class TestRouterDrain:
+    def test_drain_settles_inflight_and_refuses_new(self):
+        """Router SIGTERM satellite: in-flight (hedged) requests
+        settle inside the grace; new requests are refused."""
+        slow = _start_replica(delay=0.3)
+        router = RouterServer(
+            [f"localhost:{slow.port}"], port=0,
+            metrics_registry=MetricsRegistry(), hedge=False,
+        ).start()
+        port = router.port
+        results = {}
+
+        def inflight_request():
+            results["inflight"] = _predict_via(port, timeout=10)
+
+        thread = threading.Thread(target=inflight_request)
+        thread.start()
+        deadline = time.monotonic() + 5
+        while router.core._inflight_requests == 0:
+            assert time.monotonic() < deadline, "request never started"
+            time.sleep(0.005)
+        assert router.drain(grace=10.0) is True
+        thread.join(timeout=10)
+        assert results["inflight"][0] == 200
+        # The listener is gone: new connections are refused.
+        with pytest.raises(Exception):
+            _predict_via(port, timeout=2)
+        slow.stop()
+
+    def test_drain_while_idle_is_clean(self):
+        replica = _start_replica()
+        router = RouterServer(
+            [f"localhost:{replica.port}"], port=0,
+            metrics_registry=MetricsRegistry(),
+        ).start()
+        assert router.drain(grace=2.0) is True
+        replica.stop()

@@ -107,8 +107,12 @@ def predict_once(addr: str, features, timeout: float = 30.0):
 def run_load(addr: str, features, requests: int, concurrency: int,
              timeout: float = 30.0):
     """Closed-loop load: ``concurrency`` workers issue ``requests``
-    total predicts over persistent connections. Returns a dict with
-    latency percentiles (ms), throughput, and per-status counts."""
+    total predicts over persistent connections. ``features`` is one
+    payload tree or a LIST of them cycled across requests (distinct
+    ids exercise a serving-side row cache realistically). Returns a
+    dict with latency percentiles (ms), throughput, and per-status
+    counts."""
+    pool = features if isinstance(features, list) else [features]
     latencies = []
     statuses = {}
     lock = threading.Lock()
@@ -122,9 +126,11 @@ def run_load(addr: str, features, requests: int, concurrency: int,
                     if remaining[0] <= 0:
                         return
                     remaining[0] -= 1
+                    index = remaining[0]
+                payload = pool[index % len(pool)]
                 t0 = time.monotonic()
                 try:
-                    status, _ = conn.predict(features)
+                    status, _ = conn.predict(payload)
                 except (OSError, http.client.HTTPException):
                     # Transport failure (timeout, reset mid-shed):
                     # count it — a silently dead worker would shrink
@@ -151,7 +157,7 @@ def run_load(addr: str, features, requests: int, concurrency: int,
     for t in threads:
         t.join()
     elapsed = time.monotonic() - t0
-    leaf = features
+    leaf = pool[0]
     while isinstance(leaf, dict):  # first leaf carries the batch dim
         leaf = leaf[sorted(leaf)[0]]
     batch = int(np.shape(leaf)[0])
@@ -182,6 +188,12 @@ def main(argv=None) -> int:
     parser.add_argument("--timeout", type=float, default=30.0)
     parser.add_argument("--warmup", type=int, default=3,
                         help="untimed warmup requests (compile)")
+    parser.add_argument("--seed", type=int, default=0,
+                        help="base seed for synthetic payloads")
+    parser.add_argument("--payload_pool", type=int, default=1,
+                        help="distinct payloads cycled across "
+                             "requests (id diversity for row-cache "
+                             "benching)")
     parser.add_argument("--dump-latencies", action="store_true",
                         help="include the raw per-request latency "
                              "array (multi-process aggregation)")
@@ -192,11 +204,15 @@ def main(argv=None) -> int:
         print("server bundle records no feature_signature; re-export "
               "with a batch_example", file=sys.stderr)
         return 2
-    features = synth_features(signature, args.batch)
+    pool = [
+        synth_features(signature, args.batch,
+                       seed=args.seed + 1000 * i)
+        for i in range(max(1, args.payload_pool))
+    ]
     for _ in range(args.warmup):
-        predict_once(args.addr, features, timeout=args.timeout)
+        predict_once(args.addr, pool[0], timeout=args.timeout)
     result = run_load(
-        args.addr, features, args.requests, args.concurrency,
+        args.addr, pool, args.requests, args.concurrency,
         timeout=args.timeout,
     )
     if not args.dump_latencies:
