@@ -6,7 +6,7 @@ PY ?= python
 
 .PHONY: test test-all test-tpu test-k8s native bench serve-bench dryrun \
 	clean lint metrics chaos-smoke chaos-soak chaos-master-smoke \
-	trace-smoke serve-fleet-smoke
+	trace-smoke serve-fleet-smoke sparse-smoke sparse-bench
 
 # Scrape-and-pretty-print a master's /metrics (docs/observability.md).
 METRICS_ADDR ?= localhost:8080
@@ -69,6 +69,23 @@ serve-bench:
 serve-fleet-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m elasticdl_tpu.chaos.serving_drill \
 		--seed $(CHAOS_SEED) --report SERVE_FLEET_DRILL.json
+
+# Sparse-pipeline overlap pin (docs/sparse_path.md): run a pipelined
+# deepfm-host job over a real localhost row service with injected RPC
+# latency, then assert >=1 row_pull span overlaps a device_step span
+# wall-clock — a refactor that silently re-serializes the sparse path
+# fails here. Fast-lane equivalent:
+# tests/test_sparse_path.py::test_pipelined_job_overlaps_row_pulls.
+SPARSE_TRACE ?= TRACE_sparse.json
+sparse-smoke:
+	JAX_PLATFORMS=cpu $(PY) tools/bench_sparse_path.py --smoke \
+		--trace_out $(SPARSE_TRACE)
+	$(PY) tools/check_overlap.py $(SPARSE_TRACE)
+
+# Full serialized-vs-pipelined measurement (writes BENCH_SPARSE_PATH.json;
+# gate: pipelined per-batch p50 <= 0.7x serialized).
+sparse-bench:
+	JAX_PLATFORMS=cpu $(PY) tools/bench_sparse_path.py
 
 # Deterministic chaos plan (kill + stall-row-shard + corrupt-checkpoint)
 # against the in-process cluster; exits nonzero if any recovery

@@ -186,6 +186,14 @@ def add_train_params(parser):
     parser.add_argument("--prefetch_depth", type=non_neg_int, default=2,
                         help="Background batch-decode queue depth "
                              "(0 disables prefetching)")
+    parser.add_argument("--host_prefetch_depth", type=pos_int, default=2,
+                        help="Host-tier row pull-ahead depth: how many "
+                             "upcoming batches the sparse pipeline "
+                             "prepares (dedup + row pull + pad) while "
+                             "the current batch steps. Widens the "
+                             "async-apply staleness window to "
+                             "depth + 3 batches (docs/sparse_path.md); "
+                             "must be >= 1")
     parser.add_argument("--row_service_addr", default="",
                         help="Address(es) of the shared host-tier row "
                              "service (embedding/row_service.py) — "

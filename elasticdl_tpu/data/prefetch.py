@@ -5,6 +5,13 @@ The reference gets pipelining from ``tf.data`` prefetch
 thread plays that role: while the device executes step N, the thread
 reads records and runs the user ``dataset_fn`` for step N+1.
 
+Stages chain: ``staged(upstream, fn)`` runs ``fn`` over an upstream
+iterator on its own thread, so a pipeline like decode → prepare →
+device-place keeps every stage concurrently busy (the host-tier sparse
+path uses this for its ``jax.device_put`` stage — see
+``embedding/host_engine.prepared_batches``). Closing a downstream stage
+closes the whole chain.
+
 Producer exceptions re-raise in the consumer (a bad record must fail
 the task, not hang it). ``close()`` stops the producer even mid-queue —
 abandoned iterators (worker error paths) must not leak a blocked
@@ -14,17 +21,22 @@ explicit.
 
 import queue
 import threading
-from typing import Iterator
+from typing import Callable, Iterator, Optional
 
 _SENTINEL = object()
 
 
 class PrefetchIterator:
-    def __init__(self, source: Iterator, depth: int = 2):
+    def __init__(self, source: Iterator, depth: int = 2,
+                 upstream: Optional["PrefetchIterator"] = None):
+        # ``upstream``: a previous pipeline stage this iterator consumes
+        # (via ``source`` wrapping it); close() cascades to it so
+        # abandoning the last stage tears down the whole chain.
         self._queue: "queue.Queue" = queue.Queue(maxsize=max(depth, 1))
         self._stop = threading.Event()
         self._error = None
         self._done = False
+        self._upstream = upstream
         self._thread = threading.Thread(
             target=self._produce, args=(source,), daemon=True
         )
@@ -70,6 +82,11 @@ class PrefetchIterator:
 
     def close(self):
         self._stop.set()
+        # Tear down the chain upstream-first: our producer may be
+        # blocked in the upstream's __next__, and the upstream's close
+        # releases it (sentinel below).
+        if self._upstream is not None:
+            self._upstream.close()
         # Unblock a producer waiting on a full queue, then wait for it to
         # exit: a producer mid-read outliving its task would race the
         # next task's producer on the shared (non-thread-safe) reader.
@@ -79,6 +96,15 @@ class PrefetchIterator:
         except queue.Empty:
             pass
         self._thread.join(timeout=30.0)
+        # Release a consumer blocked in __next__ on the (now drained)
+        # queue — when this iterator feeds a later pipeline stage, that
+        # consumer is the downstream producer thread, which would
+        # otherwise sit in ``get()`` forever. One sentinel suffices:
+        # __next__ marks done on the first one.
+        try:
+            self._queue.put_nowait(_SENTINEL)
+        except queue.Full:
+            pass
 
     def __enter__(self):
         return self
@@ -89,3 +115,15 @@ class PrefetchIterator:
 
 def prefetch(source: Iterator, depth: int = 2) -> PrefetchIterator:
     return PrefetchIterator(source, depth)
+
+
+def staged(upstream: PrefetchIterator, fn: Callable,
+           depth: int = 1) -> PrefetchIterator:
+    """A further pipeline stage: apply ``fn`` to each item of
+    ``upstream`` on a dedicated thread, ``depth`` items ahead of the
+    consumer. Closing the returned iterator closes ``upstream`` too.
+    Items are processed in order; an ``fn`` failure re-raises in the
+    consumer like any producer error."""
+    return PrefetchIterator(
+        (fn(item) for item in upstream), depth=depth, upstream=upstream
+    )

@@ -236,10 +236,10 @@ def build_sparse_train_step(
             "packed_slots is single-mesh only (the row-sharded path "
             "keeps split tables)"
         )
-    if packed_slots and use_pallas == "always":
+    if packed_slots and use_pallas in ("always", "fused"):
         raise ValueError(
             "packed_slots uses the XLA gather/scatter path; the Pallas "
-            "row kernels operate on split tables"
+            "row kernels (serial and fused) operate on split tables"
         )
 
     def train_step(state: SparseTrainState, batch):
@@ -475,10 +475,10 @@ class DeviceSparseRunner:
                 "packed_slots is single-mesh only (row-sharded tables "
                 "keep the split layout)"
             )
-        if packed_slots and use_pallas == "always":
+        if packed_slots and use_pallas in ("always", "fused"):
             raise ValueError(
                 "packed_slots uses the XLA gather/scatter path; "
-                "use_pallas='always' pins the split-table kernels"
+                f"use_pallas={use_pallas!r} pins split-table kernels"
             )
         self.packed_slots = bool(packed_slots)
         self.specs = tuple(specs)
@@ -488,7 +488,7 @@ class DeviceSparseRunner:
         # TPU (CPU tests) only when a kernel path is forced.
         if interpret is None:
             interpret = (
-                use_pallas == "always"
+                use_pallas in ("always", "fused")
                 and jax.default_backend() != "tpu"
             )
         self.interpret = interpret

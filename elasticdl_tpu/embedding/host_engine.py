@@ -46,12 +46,21 @@ from elasticdl_tpu.embedding.combiner import RaggedIds, combine
 class PreparedBatch(NamedTuple):
     """A batch whose host half is already done (rows pulled, ids
     inverse-mapped): what ``HostStepRunner.iter_prepared`` yields so
-    pulls for batch N+1 can run while batch N's device step executes."""
+    pulls for batch N+1 can run while batch N's device step executes.
+
+    ``device_rows``/``device_batch`` are filled by the pipeline's
+    device-placement stage (``prepared_batches(place_rows=True)``):
+    the row blocks and batch already ``jax.device_put`` while the
+    previous batch steps, so the jit call consumes resident buffers
+    instead of paying the host→device copy on the critical path. None
+    (the default) means the step transfers them itself."""
 
     raw: dict       # the original batch (multihost dummies, init)
     batch: dict     # features with inverse maps substituted
     host_rows: dict
     uniques: dict
+    device_rows: Optional[dict] = None
+    device_batch: Optional[dict] = None
 
 MIN_BUCKET = 8
 
@@ -223,7 +232,7 @@ class HostEmbeddingEngine:
     """
 
     def __init__(self, tables: Dict, optimizer, id_keys: Dict[str, str],
-                 metrics_registry=None):
+                 metrics_registry=None, table_fanout: bool = True):
         # Serializes host-side table access: in-process multi-worker
         # jobs share ONE engine (threads), and neither the dict table
         # nor the C++ open-addressing row map (which rehashes on
@@ -256,6 +265,22 @@ class HostEmbeddingEngine:
         self.tables = tables
         self.optimizer = optimizer
         self.id_keys = id_keys
+        # table_fanout=False pins the serial per-table loop — the
+        # pre-fan-out shape (benchmark baseline; also an escape hatch
+        # if a store misdeclares concurrent_safe).
+        self.table_fanout = bool(table_fanout)
+        # Per-TABLE fan-out pool (lazy; only built for multi-table
+        # engines over concurrent-safe stores): prepare_batch pulls and
+        # apply_row_grads pushes fan out per table, so a DeepFM-style
+        # batch pays max(table pull/push), not sum. Sized for one wave
+        # of pulls AND one wave of pushes concurrently (the prefetch
+        # thread prepares batch N+1 while the applier pushes batch N's
+        # grads). This pool is DISTINCT from the sharded-client pool in
+        # row_service.py on purpose — a table-level task there would
+        # occupy a worker while waiting on its own shard sub-tasks
+        # (nested submission deadlocks a shared bounded pool).
+        self._table_pool = None
+        self._table_pool_lock = threading.Lock()
         # Telemetry: lookup/update latency, row traffic, and the dedup
         # ("cache hit") ratio — total vs unique ids per batch. Rows
         # materialized is a pull-time gauge over the live tables.
@@ -265,6 +290,30 @@ class HostEmbeddingEngine:
         self._m_lookup = registry.histogram(
             "embedding_lookup_seconds",
             "Host row pull + dedup + pad latency per batch",
+        )
+        # Phase split of the lookup monolith (matching dedup/row_pull/
+        # pad child spans are emitted inside prepare_batch): the
+        # critical-path report and dashboards can attribute INSIDE
+        # prepare — "lookup is slow" becomes "the pull RPC is slow" or
+        # "dedup is slow", which point at different fixes.
+        self._m_dedup = registry.histogram(
+            "embedding_dedup_seconds",
+            "np.unique dedup latency per table per batch",
+        )
+        self._m_pull = registry.histogram(
+            "embedding_row_pull_seconds",
+            "Row fetch (store get / pull RPC) latency per table per "
+            "batch",
+        )
+        self._m_pad = registry.histogram(
+            "embedding_pad_seconds",
+            "Bucket-pad + inverse-map assembly latency per table per "
+            "batch",
+        )
+        self._m_device_put = registry.histogram(
+            "embedding_device_put_seconds",
+            "Device placement latency per prepared batch (the "
+            "pipeline's jax.device_put stage)",
         )
         self._m_update = registry.histogram(
             "embedding_update_seconds",
@@ -312,17 +361,97 @@ class HostEmbeddingEngine:
         - host_rows — {table: (bucket, dim) float32}; rows[u:] are zero
           padding whose grads are dropped,
         - uniques — {table: (unique_ids, u)} for apply_row_grads.
+
+        Tracing: each table emits ``dedup`` / ``row_pull`` / ``pad``
+        phase spans. Called under an open span (the synchronous path,
+        where prepare runs inside ``device_step``) they become its
+        direct children, so the critical-path step breakdown names the
+        pull; called from a pipeline thread (no ambient span) they nest
+        under a fresh ``prepare_batch`` root — the span the overlap
+        checker (tools/check_overlap.py) matches against concurrent
+        device steps.
         """
+        from elasticdl_tpu.observability import tracing
+
         t0 = time.monotonic()
         try:
-            if self.concurrent_io:
-                return self._prepare_batch_locked(batch)
-            with self.lock:
-                return self._prepare_batch_locked(batch)
+            ctx = tracing.current_ctx()
+            if ctx is not None:
+                if self.concurrent_io:
+                    return self._prepare_batch_locked(batch, ctx)
+                with self.lock:
+                    return self._prepare_batch_locked(batch, ctx)
+            with tracing.span(
+                "prepare_batch", tables=len(self.id_keys)
+            ) as sp:
+                ctx = sp.ctx()
+                if self.concurrent_io:
+                    return self._prepare_batch_locked(batch, ctx)
+                with self.lock:
+                    return self._prepare_batch_locked(batch, ctx)
         finally:
             self._m_lookup.observe(time.monotonic() - t0)
 
-    def _prepare_batch_locked(self, batch):
+    def _get_table_pool(self):
+        from concurrent.futures import ThreadPoolExecutor
+
+        with self._table_pool_lock:
+            if self._table_pool is None:
+                self._table_pool = ThreadPoolExecutor(
+                    max_workers=min(2 * len(self.id_keys), 16),
+                    thread_name_prefix="table-fanout",
+                )
+                # Discarded engines (chaos relaunches build one per
+                # replacement worker) must not leak their pool threads
+                # for the process life; close() is explicit, the
+                # finalizer covers engines that are simply dropped.
+                weakref.finalize(
+                    self, self._table_pool.shutdown, wait=False
+                )
+            return self._table_pool
+
+    def close(self):
+        """Shut down the per-table fan-out pool (idempotent). Engines
+        are also finalizer-cleaned on GC; call this when discarding an
+        engine deterministically (worker teardown, tests)."""
+        with self._table_pool_lock:
+            pool, self._table_pool = self._table_pool, None
+        if pool is not None:
+            pool.shutdown(wait=False)
+
+    def _prepare_table(self, table_name, ids, ctx):
+        """One table's prepare: dedup → pull → pad, phase-timed. Pure
+        per-table work (no shared mutable state beyond the thread-safe
+        metrics/tables), so the fan-out path runs it on pool threads."""
+        from elasticdl_tpu.observability import tracing
+
+        ragged = isinstance(ids, RaggedIds)
+        raw = np.asarray(ids.ids if ragged else ids)
+        t0 = time.monotonic()
+        with tracing.child_span("dedup", ctx, table=table_name):
+            uniq, inverse = np.unique(raw, return_inverse=True)
+        t1 = time.monotonic()
+        self._m_dedup.observe(t1 - t0)
+        u = len(uniq)
+        self._m_ids.inc(raw.size)
+        self._m_unique.inc(u)
+        table = self.tables[table_name]
+        with tracing.child_span("row_pull", ctx, table=table_name,
+                                rows=u):
+            pulled = table.get(uniq)
+        t2 = time.monotonic()
+        self._m_pull.observe(t2 - t1)
+        with tracing.child_span("pad", ctx, table=table_name):
+            rows = np.zeros((bucket_size(u), table.dim), np.float32)
+            rows[:u] = pulled
+            inv = inverse.reshape(raw.shape).astype(np.int32)
+        self._m_pad.observe(time.monotonic() - t2)
+        feature = (
+            RaggedIds(ids=inv, weights=ids.weights) if ragged else inv
+        )
+        return feature, rows, (uniq, u)
+
+    def _prepare_batch_locked(self, batch, ctx=None):
         if not isinstance(batch["features"], dict):
             raise TypeError(
                 "host-tier batches need dict features (id_keys names the "
@@ -331,27 +460,52 @@ class HostEmbeddingEngine:
             )
         features = dict(batch["features"])
         host_rows, uniques = {}, {}
-        for table_name, key in self.id_keys.items():
-            ids = features[key]
-            ragged = isinstance(ids, RaggedIds)
-            raw = np.asarray(ids.ids if ragged else ids)
-            uniq, inverse = np.unique(raw, return_inverse=True)
-            u = len(uniq)
-            self._m_ids.inc(raw.size)
-            self._m_unique.inc(u)
-            bucket = bucket_size(u)
-            table = self.tables[table_name]
-            rows = np.zeros((bucket, table.dim), np.float32)
-            rows[:u] = table.get(uniq)
-            inv = inverse.reshape(raw.shape).astype(np.int32)
-            features[key] = (
-                RaggedIds(ids=inv, weights=ids.weights) if ragged else inv
-            )
-            host_rows[table_name] = rows
-            uniques[table_name] = (uniq, u)
+        items = list(self.id_keys.items())
+        if len(items) > 1 and self.concurrent_io and self.table_fanout:
+            # Parallel per-table fan-out: a multi-table batch pays
+            # max(table pull), not sum. Only over concurrent-safe
+            # stores (the RPC row plane) — a locked local store would
+            # serialize the futures on self.lock anyway, and this
+            # method already holds it then.
+            pool = self._get_table_pool()
+            futures = [
+                (name, key,
+                 pool.submit(self._prepare_table, name, features[key],
+                             ctx))
+                for name, key in items
+            ]
+            for name, key, future in futures:
+                feature, rows, uniq_u = future.result()
+                features[key] = feature
+                host_rows[name] = rows
+                uniques[name] = uniq_u
+        else:
+            for name, key in items:
+                feature, rows, uniq_u = self._prepare_table(
+                    name, features[key], ctx
+                )
+                features[key] = feature
+                host_rows[name] = rows
+                uniques[name] = uniq_u
         out = dict(batch)
         out["features"] = features
         return out, host_rows, uniques
+
+    def place_on_device(self, prepared: PreparedBatch) -> PreparedBatch:
+        """The pipeline's device-placement stage: ``jax.device_put``
+        the row blocks and the batch for an upcoming step while the
+        current one executes, so the jit call consumes already-resident
+        buffers (``device_rows``/``device_batch``)."""
+        from elasticdl_tpu.observability import tracing
+
+        t0 = time.monotonic()
+        with tracing.span("device_put", tables=len(prepared.host_rows)):
+            device_rows = jax.device_put(prepared.host_rows)
+            device_batch = jax.device_put(prepared.batch)
+        self._m_device_put.observe(time.monotonic() - t0)
+        return prepared._replace(
+            device_rows=device_rows, device_batch=device_batch
+        )
 
     def apply_row_grads(self, row_grads: dict, uniques: dict) -> None:
         """Scatter the step's row gradients into the host tables
@@ -367,30 +521,63 @@ class HostEmbeddingEngine:
             self._m_update.observe(time.monotonic() - t0)
 
     def _apply_row_grads_inner(self, row_grads, uniques):
-        for table_name, (uniq, u) in uniques.items():
-            grads = np.asarray(row_grads[table_name])[:u]
-            self._m_rows_updated.inc(u)
-            self.optimizer.apply_gradients(
-                self.tables[table_name], uniq, grads
-            )
+        items = list(uniques.items())
+        if len(items) > 1 and self.concurrent_io and self.table_fanout:
+            # Same max-not-sum fan-out as prepare: tables are disjoint
+            # row spaces, so cross-table applies commute; per-table
+            # FIFO is preserved because the (single) applier joins one
+            # batch's futures before starting the next batch's.
+            pool = self._get_table_pool()
+            futures = [
+                pool.submit(self._apply_table, name, uniq, u,
+                            row_grads[name])
+                for name, (uniq, u) in items
+            ]
+            for f in futures:
+                f.result()
+        else:
+            for name, (uniq, u) in items:
+                self._apply_table(name, uniq, u, row_grads[name])
 
-    def prepared_batches(self, batches: Iterable[dict], depth: int = 2):
+    def _apply_table(self, table_name, uniq, u, grads):
+        grads = np.asarray(grads)[:u]
+        self._m_rows_updated.inc(u)
+        self.optimizer.apply_gradients(
+            self.tables[table_name], uniq, grads
+        )
+
+    def prepared_batches(self, batches: Iterable[dict], depth: int = 2,
+                         place_rows: bool = False):
         """Double-buffered iterator of ``PreparedBatch``: rows for
         upcoming batches are pulled while the current batch trains
-        (data/prefetch.py plays the same role for record decode). NOTE:
-        a prefetched batch can read rows up to ``depth + 1``
-        apply_row_grads behind on ids it shares with in-flight batches —
-        the reference async PS pull's relaxed-consistency window
-        (async_sgd.md), widened by the prefetch depth. Returns a
-        PrefetchIterator; ``close()`` it (or use as a context manager)
-        when abandoning mid-stream. (``HostStepRunner.iter_prepared``
-        is a thin delegate — ONE pull-ahead implementation.)"""
-        from elasticdl_tpu.data.prefetch import prefetch
+        (data/prefetch.py plays the same role for record decode).
+        ``place_rows`` adds the device-placement stage: a second
+        pipeline thread ``jax.device_put``s each prepared batch's row
+        blocks (+batch) so the step consumes resident buffers.
 
-        return prefetch(
+        STALENESS WINDOW: a prefetched batch can read rows up to
+        ``depth + 1`` apply_row_grads behind on ids it shares with
+        in-flight batches — the reference async PS pull's
+        relaxed-consistency window (async_sgd.md), widened by the
+        prefetch depth. The device stage widens it by up to 2 more
+        batches (its queue slot plus the transfer in flight): with
+        ``place_rows`` the bound is ``depth + 3``. Shape unchanged —
+        only the count of in-flight batches a shared id's pull may
+        trail by.
+
+        Returns a PrefetchIterator; ``close()`` it (or use as a context
+        manager) when abandoning mid-stream — closing the last stage
+        tears down the whole chain. (``HostStepRunner.iter_prepared``
+        is a thin delegate — ONE pull-ahead implementation.)"""
+        from elasticdl_tpu.data.prefetch import prefetch, staged
+
+        prepared = prefetch(
             (PreparedBatch(b, *self.prepare_batch(b)) for b in batches),
             depth=depth,
         )
+        if not place_rows:
+            return prepared
+        return staged(prepared, self.place_on_device, depth=1)
 
 
 class HostStepRunner:
@@ -415,6 +602,11 @@ class HostStepRunner:
     - **Pull-ahead**: ``iter_prepared`` wraps a batch stream so rows
       for upcoming batches are pulled on a prefetch thread while the
       current batch trains; the Worker task loop uses it when present.
+    - **Device double-buffering**: a second pipeline stage
+      ``jax.device_put``s batch N+1's row blocks while batch N steps,
+      so the jit call consumes resident buffers (the host→device copy
+      leaves the critical path too). Staleness-window math on
+      ``prepared_batches``.
     """
 
     def __init__(self, engine: HostEmbeddingEngine,
@@ -480,11 +672,18 @@ class HostStepRunner:
         pull-ahead would reintroduce the stale-read window."""
         return self._async_apply
 
-    def iter_prepared(self, batches: Iterable[dict], depth: int = 2):
+    def iter_prepared(self, batches: Iterable[dict], depth: int = 2,
+                      place_rows: bool = True):
         """Pull-ahead iterator of ``PreparedBatch`` for the Worker task
         loop (delegates to the engine's prepared_batches — one
-        implementation); ``close()`` it when abandoning mid-stream."""
-        return self.engine.prepared_batches(batches, depth=depth)
+        implementation); ``close()`` it when abandoning mid-stream.
+        ``depth`` is the pull-ahead queue (--host_prefetch_depth);
+        ``place_rows`` (default on — this runner feeds a device step)
+        adds the device double-buffering stage, widening the staleness
+        window as documented on ``prepared_batches``."""
+        return self.engine.prepared_batches(
+            batches, depth=max(1, int(depth)), place_rows=place_rows
+        )
 
     @property
     def host_tables(self) -> Dict:
@@ -518,9 +717,17 @@ class HostStepRunner:
 
         def step(state, batch):
             if isinstance(batch, PreparedBatch):
-                prepared, host_rows, uniques = (
-                    batch.batch, batch.host_rows, batch.uniques
+                # Device-resident buffers when the pipeline's placement
+                # stage ran: the jit call then pays no host→device copy.
+                prepared = (
+                    batch.device_batch if batch.device_batch is not None
+                    else batch.batch
                 )
+                host_rows = (
+                    batch.device_rows if batch.device_rows is not None
+                    else batch.host_rows
+                )
+                uniques = batch.uniques
             else:
                 prepared, host_rows, uniques = engine.prepare_batch(batch)
             state, row_grads, metrics = host_step(
@@ -547,9 +754,13 @@ class HostStepRunner:
             # Eval must see every trained row: drain pending applies.
             self.flush()
             if isinstance(batch, PreparedBatch):
-                prepared, host_rows = batch.batch, batch.host_rows
-            else:
-                prepared, host_rows, _ = engine.prepare_batch(batch)
+                # A pull-ahead batch was prepared BEFORE the flush just
+                # above — its row block may predate applies that were
+                # still queued at pull time. Re-pull from the raw batch
+                # so eval reads post-flush rows (eval bypasses
+                # pull-ahead; exactness over overlap here).
+                batch = batch.raw
+            prepared, host_rows, _ = engine.prepare_batch(batch)
             return host_eval(state, prepared, host_rows)
 
         return step

@@ -204,6 +204,40 @@ def _pallas_sparse_apply(opt: RowOptimizer, table, slot_tables,
     return new_table, slot_tables
 
 
+def _fused_sparse_apply(opt: RowOptimizer, table, slot_tables,
+                        unique_ids, row_grads, interpret: bool = False):
+    """Route to the block-pipelined fused scatter-apply kernels
+    (ops/pallas_embedding fused_* — SGD + Momentum coverage). Callers
+    guarantee ``fused_apply_supported``."""
+    from elasticdl_tpu.ops import pallas_embedding as pe
+
+    if isinstance(opt, Momentum):
+        new_table, vel = pe.fused_momentum_scatter_apply(
+            table, slot_tables["momentum"], unique_ids, row_grads,
+            lr=opt.lr, momentum=opt.momentum, nesterov=opt.nesterov,
+            interpret=interpret,
+        )
+        return new_table, {**slot_tables, "momentum": vel}
+    new_table = pe.fused_sgd_scatter_apply(
+        table, unique_ids, row_grads, lr=opt.lr, interpret=interpret
+    )
+    return new_table, slot_tables
+
+
+def fused_apply_supported(opt: RowOptimizer, dim: int) -> bool:
+    """Whether the fused scatter-apply kernels cover (opt, dim):
+    lane-aligned rows and SGD / Momentum(+Nesterov) — the first fused
+    tier (Adam/Adagrad stay on the serial kernels or XLA). When this
+    says no, ``sparse_apply(use_pallas='fused')`` falls back to the XLA
+    gather→update→scatter path cleanly (no error): 'fused' means
+    'fuse where a kernel exists', unlike 'always' which is loud."""
+    from elasticdl_tpu.ops import pallas_embedding as pe
+
+    if not pe.dim_supported(dim):
+        return False
+    return isinstance(opt, (SGD, Momentum))
+
+
 def kernelizable(opt: RowOptimizer, dim: int) -> bool:
     """Whether the Pallas in-place kernels cover (opt, dim): lane-aligned
     rows and one of SGD / Momentum(+Nesterov) / Adagrad /
@@ -232,15 +266,33 @@ def sparse_apply(opt: RowOptimizer, table, slot_tables: Dict[str, "jnp.ndarray"]
     device-time measurement showed the per-row-DMA kernels lose to it
     at every size (the flat-view retiling copy plus ~0.05us/row DMA
     latency; see ops/pallas_embedding.py's dispatch note), overturning
-    round-2's wall-clock tiers. "always" pins the kernels (reference
+    round-2's wall-clock tiers — unless ``use_pallas_apply`` (the
+    fused-kernel sweep predicate) flips for this shape. "fused" takes
+    the block-pipelined fused scatter-apply kernels where they exist
+    (SGD/Momentum, lane-aligned dim) and falls back to XLA cleanly
+    everywhere else. "always" pins the serial kernels (reference
     -parity implementations, on-chip tested); "never" pins XLA
     explicitly.
     """
-    if use_pallas not in ("auto", "never", "always"):
+    if use_pallas not in ("auto", "never", "always", "fused"):
         raise ValueError(f"use_pallas={use_pallas!r}")
     from elasticdl_tpu.ops import pallas_embedding as pe
 
     dim = int(table.shape[1])
+    if use_pallas == "fused" and fused_apply_supported(opt, dim):
+        return _fused_sparse_apply(
+            opt, table, slot_tables, unique_ids, row_grads,
+            interpret=interpret,
+        )
+    if (
+        use_pallas == "auto"
+        and fused_apply_supported(opt, dim)
+        and pe.use_pallas_apply(dim, int(row_grads.shape[0]))
+    ):
+        return _fused_sparse_apply(
+            opt, table, slot_tables, unique_ids, row_grads,
+            interpret=interpret,
+        )
     if use_pallas == "always":
         # Fail with a clear message up front, not deep inside
         # pallas_call with an opaque input_output_aliases shape error

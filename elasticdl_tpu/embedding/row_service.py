@@ -623,6 +623,7 @@ class _ShardedOptimizer:
 def make_remote_engine(
     addr: str, id_keys: Dict[str, str],
     retries: int = 12, backoff_secs: float = 0.5,
+    table_fanout: bool = True,
 ) -> HostEmbeddingEngine:
     """Client-side engine over running `HostRowService` shard(s).
 
@@ -690,7 +691,9 @@ def make_remote_engine(
             [_RemoteOptimizer(s, retries, backoff_secs) for s in stubs],
             pool,
         )
-    engine = HostEmbeddingEngine(tables, optimizer, id_keys=id_keys)
+    engine = HostEmbeddingEngine(
+        tables, optimizer, id_keys=id_keys, table_fanout=table_fanout
+    )
     engine.remote = True  # server owns checkpointing (see HostStepRunner)
     return engine
 

@@ -253,6 +253,29 @@ def span(name: str, **attrs):
     return Span(rec, name, _new_id(), None, role, instance, attrs)
 
 
+def child_span(name: str, ctx: Optional[dict], **attrs):
+    """Span with an EXPLICIT parent context — for work fanned out to a
+    pool thread whose thread-local stack does not carry the caller's
+    open span (the in-process analogue of ``server_span``; e.g. the
+    host engine's per-table pull futures). Role/instance come from the
+    calling thread's innermost span when one is open (same-thread
+    callers keep their track), else the process role. ``ctx`` of None
+    starts a fresh trace."""
+    rec = _RECORDER
+    if rec is None:
+        return NULL_SPAN
+    stack = getattr(_local, "stack", None)
+    if stack:
+        role, instance = stack[-1][2], stack[-1][3]
+    else:
+        role, instance = _PROCESS_ROLE
+    if ctx and ctx.get("trace_id"):
+        return Span(rec, name, str(ctx["trace_id"]),
+                    str(ctx.get("span_id") or "") or None,
+                    role, instance, attrs)
+    return Span(rec, name, _new_id(), None, role, instance, attrs)
+
+
 def server_span(name: str, wire_ctx: Optional[dict], role: str,
                 instance: str = "0", **attrs):
     """Server-side child of a propagated ``_trace_ctx`` (or a fresh

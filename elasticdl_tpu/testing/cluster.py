@@ -52,6 +52,8 @@ class MiniCluster:
         fault_injector=None,
         checkpoint_async: bool = True,
         journal_dir: str = "",
+        host_prefetch_depth: int = 2,
+        version_report_steps: int = 1,
     ):
         # Chaos plane (chaos/interceptors.FaultInjector): over RPC the
         # injector's process-global hooks cover every call already; on
@@ -238,6 +240,10 @@ class MiniCluster:
                     checkpoint_dir_for_init=checkpoint_dir_for_init,
                     fuse_task_steps=fuse_task_steps,
                     metrics_report_secs=metrics_report_secs,
+                    host_prefetch_depth=host_prefetch_depth,
+                    # SSP mapping (--get_model_steps): the master
+                    # observes every N-th version only.
+                    version_report_steps=version_report_steps,
                 )
             )
 

@@ -273,6 +273,7 @@ def build_worker(args, master_client=None) -> Worker:
         profiler=profiler_from_args(args),
         fuse_task_steps=getattr(args, "fuse_task_steps", False),
         prefetch_depth=getattr(args, "prefetch_depth", 2),
+        host_prefetch_depth=getattr(args, "host_prefetch_depth", 2),
         metrics_report_secs=getattr(args, "metrics_report_secs", 15.0),
         master_reattach_grace=getattr(
             args, "master_reattach_grace", 60.0

@@ -70,6 +70,7 @@ class Worker:
         profiler=None,
         fuse_task_steps: bool = False,
         prefetch_depth: int = 2,
+        host_prefetch_depth: int = 2,
         metrics_registry=None,
         metrics_report_secs: float = 15.0,
         master_reattach_grace: float = 60.0,
@@ -135,6 +136,11 @@ class Worker:
         # reporting/checkpointing then happen at task granularity.
         self._fuse_task_steps = fuse_task_steps
         self._multi_step = None
+        # Host-tier row pull-ahead depth (--host_prefetch_depth): how
+        # far iter_prepared runs ahead of the device step. Validated
+        # >= 1 (0 would disable the pull-ahead the runner's pull_ahead
+        # property promised).
+        self._host_prefetch_depth = max(1, int(host_prefetch_depth))
         # Multi-host SPMD + dynamic sharding need a step-alignment
         # barrier: every process runs the SAME compiled program the same
         # number of times (collectives span processes), but each pulls
@@ -507,7 +513,8 @@ class Worker:
             from elasticdl_tpu.embedding.host_engine import PreparedBatch
 
             prepared_iter = self._step_runner.iter_prepared(
-                itertools.chain([first], batches)
+                itertools.chain([first], batches),
+                depth=self._host_prefetch_depth,
             )
             batches = prepared_iter
         else:

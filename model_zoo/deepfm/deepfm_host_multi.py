@@ -1,0 +1,142 @@
+"""DeepFM with THREE host-tier embedding tables (field-group split).
+
+Production recsys models shard their sparse features over many tables
+(user / item / context field groups), and the sparse-path pipeline's
+per-table fan-out (`embedding/host_engine.py`) exists exactly for this
+shape: a batch pays max(table pull), not the sum, and row-grad pushes
+fan out the same way. This variant splits the frappe record's 10 id
+columns into three field groups, each on its own host table — the
+multi-table benchmark workload for `tools/bench_sparse_path.py` and a
+zoo example of wiring several `HostEmbedding` tables.
+
+Same frappe-record dataset contract as deepfm_host: each group is a
+column slice of ``feature_ids``. Id VALUES may repeat across groups
+(they index the same [0, MAX_ID) range) — the tables are independent
+row spaces because they are separate tables, not because the ids are
+disjoint.
+"""
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from elasticdl_tpu.common import tensor_utils
+from elasticdl_tpu.common.constants import Mode
+from elasticdl_tpu.embedding import (
+    HostEmbedding,
+    HostEmbeddingEngine,
+    HostStepRunner,
+)
+from elasticdl_tpu.embedding.optimizer import SGD
+from elasticdl_tpu.ops import masked_sigmoid_cross_entropy
+
+MAX_ID = 5500
+EMBEDDING_DIM = 16
+# Field groups: {table: (feature key, column slice of feature_ids)}.
+FIELD_GROUPS = {
+    "host_emb_user": ("ids_user", (0, 4)),
+    "host_emb_item": ("ids_item", (4, 7)),
+    "host_emb_ctx": ("ids_ctx", (7, 10)),
+}
+host_serving_vocab = {name: MAX_ID for name in FIELD_GROUPS}
+
+
+class HostDeepFMMulti(nn.Module):
+    embedding_dim: int = EMBEDDING_DIM
+    hidden: tuple = (64, 32)
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, features, training=False):
+        groups = [
+            HostEmbedding(name, self.embedding_dim)(features[key])
+            for name, (key, _) in FIELD_GROUPS.items()
+        ]
+        emb = jnp.concatenate(groups, axis=1)  # (B, 10, D)
+        emb = emb.astype(self.compute_dtype)
+        sum_emb = jnp.sum(emb, axis=1)
+        sum_sq = jnp.sum(emb * emb, axis=1)
+        second_order = 0.5 * jnp.sum(
+            sum_emb * sum_emb - sum_sq, axis=1, keepdims=True
+        )
+        deep = emb.reshape((emb.shape[0], -1))
+        for width in self.hidden:
+            deep = nn.relu(nn.Dense(width, dtype=self.compute_dtype)(deep))
+        deep = nn.Dense(1, dtype=self.compute_dtype)(deep)
+        logits = second_order.astype(jnp.float32) + deep.astype(jnp.float32)
+        return logits[..., 0]
+
+
+def custom_model():
+    return HostDeepFMMulti()
+
+
+def _make_tables():
+    from elasticdl_tpu.native.row_store import make_host_table
+
+    return {
+        name: make_host_table(name, EMBEDDING_DIM)
+        for name in FIELD_GROUPS
+    }
+
+
+def make_host_runner(
+    row_lr: float = 0.05, remote_addr: str = ""
+) -> HostStepRunner:
+    id_keys = {name: key for name, (key, _) in FIELD_GROUPS.items()}
+    if remote_addr:
+        from elasticdl_tpu.embedding import make_remote_engine
+
+        return HostStepRunner(
+            make_remote_engine(remote_addr, id_keys=id_keys)
+        )
+    from elasticdl_tpu.native.row_store import make_host_optimizer
+
+    engine = HostEmbeddingEngine(
+        _make_tables(), make_host_optimizer(SGD(lr=row_lr)),
+        id_keys=id_keys,
+    )
+    return HostStepRunner(engine)
+
+
+def make_row_service():
+    from elasticdl_tpu.embedding import HostRowService
+    from elasticdl_tpu.native.row_store import make_host_optimizer
+
+    return HostRowService(
+        _make_tables(), make_host_optimizer(SGD(lr=0.05))
+    )
+
+
+def loss(labels, predictions, mask):
+    return masked_sigmoid_cross_entropy(labels, predictions, mask)
+
+
+def optimizer(lr=0.001):
+    return optax.adam(lr)
+
+
+def dataset_fn(records, mode, metadata):
+    ids, labels = [], []
+    for payload in records:
+        rec = tensor_utils.loads(payload)
+        ids.append(np.asarray(rec["feature_ids"], np.int32))
+        labels.append(int(rec.get("label", 0)))
+    all_ids = np.stack(ids)
+    features = {
+        key: all_ids[:, lo:hi]
+        for _, (key, (lo, hi)) in FIELD_GROUPS.items()
+    }
+    labels = np.asarray(labels, np.int32)
+    if mode == Mode.PREDICTION:
+        return features, np.zeros_like(labels)
+    return features, labels
+
+
+def eval_metrics_fn():
+    return {
+        "auc_proxy": lambda labels, outputs: float(
+            np.mean((outputs > 0) == (labels > 0))
+        )
+    }

@@ -99,3 +99,54 @@ def test_task_data_service_prefetches(tmp_path):
     results = cluster.run()
     assert cluster.finished
     assert results[0]["trained_batches"] == 6
+
+
+def test_staged_pipeline_maps_in_order_and_overlaps():
+    """staged() runs fn on its own thread over the upstream stage;
+    items arrive transformed, in order."""
+    from elasticdl_tpu.data.prefetch import staged
+
+    inner = prefetch(iter(range(6)), depth=2)
+    outer = staged(inner, lambda x: x * 10, depth=1)
+    with outer:
+        assert list(outer) == [0, 10, 20, 30, 40, 50]
+
+
+def test_staged_close_cascades_to_upstream():
+    """Closing the last stage must tear down the WHOLE chain — the
+    upstream producer thread must not outlive the abandoned pipeline
+    (it would race the next task's reader)."""
+    from elasticdl_tpu.data.prefetch import staged
+
+    started = threading.Event()
+
+    def gen():
+        for i in range(1000):
+            started.set()
+            yield i
+            time.sleep(0.001)
+
+    inner = prefetch(gen(), depth=2)
+    outer = staged(inner, lambda x: x + 1, depth=1)
+    started.wait(timeout=5)
+    assert next(iter(outer)) == 1
+    outer.close()
+    inner._thread.join(timeout=5)
+    assert not inner._thread.is_alive()
+    assert not outer._thread.is_alive()
+
+
+def test_staged_fn_error_reraises_in_consumer():
+    from elasticdl_tpu.data.prefetch import staged
+
+    inner = prefetch(iter(range(4)), depth=2)
+
+    def boom(x):
+        if x == 2:
+            raise RuntimeError("stage died")
+        return x
+
+    outer = staged(inner, boom, depth=1)
+    with pytest.raises(RuntimeError, match="stage died"):
+        with outer:
+            list(outer)

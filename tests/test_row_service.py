@@ -688,3 +688,96 @@ def test_shard_layout_guard(tmp_path):
     with pytest.raises(SystemExit, match="shard 0/1"):
         validate_shard_layout(legacy, shard=0, num_shards=2)
     validate_shard_layout(legacy, shard=0, num_shards=1)  # unchanged: ok
+
+
+def test_sharded_table_concurrent_pull_while_push_disjoint_masks():
+    """PR 7 satellite: the _ShardedTable/_ShardedOptimizer fan-out on
+    the shard pool must stay correct under concurrent pull-while-push
+    on DISJOINT id masks — a prefetching pull in flight while the async
+    applier pushes the previous step's grads (the exact overlap the
+    pipelined sparse path runs). Pulled ids never overlap pushed ids,
+    so every pulled value has exactly one correct answer."""
+    import threading
+
+    shards = [_start_shard(lr=1.0), _start_shard(lr=1.0),
+              _start_shard(lr=1.0)]
+    try:
+        addr = ",".join(f"localhost:{s.port}" for s in shards)
+        engine = make_remote_engine(addr, id_keys={"items": "ids"})
+        table = engine.tables["items"]
+        ref = EmbeddingTable("items", DIM)
+
+        # Disjoint masks spanning all 3 shards each: pulls read ids the
+        # pushes never touch.
+        pull_ids = np.arange(0, 30, dtype=np.int64)          # 0..29
+        push_ids = np.arange(100, 130, dtype=np.int64)       # 100..129
+        grads = np.ones((len(push_ids), DIM), np.float32)
+        errors = []
+        rounds = 8
+        barrier = threading.Barrier(2)
+
+        def puller():
+            try:
+                for _ in range(rounds):
+                    barrier.wait()
+                    got = table.get(pull_ids)
+                    np.testing.assert_array_equal(got, ref.get(pull_ids))
+            except Exception as exc:  # surfaced after join
+                errors.append(exc)
+
+        def pusher():
+            try:
+                for _ in range(rounds):
+                    barrier.wait()
+                    engine.optimizer.apply_gradients(
+                        table, push_ids, grads
+                    )
+            except Exception as exc:
+                errors.append(exc)
+
+        threads = [threading.Thread(target=puller),
+                   threading.Thread(target=pusher)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not errors, errors
+        # Every push landed exactly once per round on its home shard.
+        np.testing.assert_allclose(
+            table.get(push_ids),
+            np.asarray(ref.get(push_ids)) - rounds * 1.0 * grads,
+            rtol=1e-6,
+        )
+        # Placement held: pushed rows live on their id%3 home shards.
+        for s, svc in enumerate(shards):
+            ids, _ = svc._tables["items"].to_arrays()
+            assert all(int(i) % 3 == s for i in ids), (s, ids)
+    finally:
+        for s in shards:
+            s.stop(0)
+
+
+def test_sharded_export_dense_stride_interleave_n3_nondivisible():
+    """PR 7 satellite: export_dense over N=3 shards with a vocab that
+    divides by neither the shard count nor the chunk — the strided
+    export_range interleave must reassemble every row at its right
+    index (trained rows on their home shards, lazy init elsewhere)."""
+    shards = [_start_shard(), _start_shard(), _start_shard()]
+    try:
+        addr = ",".join(f"localhost:{s.port}" for s in shards)
+        engine = make_remote_engine(addr, id_keys={"items": "ids"})
+        table = engine.tables["items"]
+        vocab = 10  # 10 % 3 != 0, 10 % 4 != 0
+        trained = np.array([0, 1, 2, 5, 9], np.int64)
+        engine.optimizer.apply_gradients(
+            table, trained, np.ones((len(trained), DIM), np.float32)
+        )
+        dense = table.export_dense(vocab, chunk=4)
+        assert dense.shape == (vocab, DIM)
+        ref = EmbeddingTable("items", DIM)
+        want = np.asarray(ref.get(np.arange(vocab)), np.float32)
+        want[trained] -= 0.5  # default shard lr
+        np.testing.assert_allclose(dense, want, rtol=1e-6)
+    finally:
+        for s in shards:
+            s.stop(0)

@@ -1,0 +1,535 @@
+"""The pipelined sparse hot path (docs/sparse_path.md): parallel
+per-table fan-out in prepare_batch, device double-buffering, the fused
+Pallas scatter-apply, the eval staleness fix, and the overlap pin
+(fast-lane equivalent of ``make sparse-smoke``).
+"""
+
+import time
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from elasticdl_tpu.embedding.combiner import RaggedIds  # noqa: F401
+from elasticdl_tpu.embedding.host_engine import (
+    HostEmbedding,
+    HostEmbeddingEngine,
+    HostStepRunner,
+    PreparedBatch,
+)
+from elasticdl_tpu.embedding.optimizer import (
+    SGD,
+    Adagrad,
+    HostOptimizerWrapper,
+    Momentum,
+    init_slot_tables,
+    sparse_apply,
+)
+from elasticdl_tpu.embedding.table import EmbeddingTable
+from elasticdl_tpu.ops import pallas_embedding as pe
+from tools.check_overlap import find_overlaps
+
+VOCAB = 500
+DIM = 8
+FIELDS = 4
+
+
+class TinyHostModel(nn.Module):
+    @nn.compact
+    def __call__(self, features, training=False):
+        emb = HostEmbedding("items", DIM)(features["item_ids"])
+        x = emb.reshape((emb.shape[0], -1))
+        x = nn.relu(nn.Dense(16)(x))
+        return nn.Dense(1)(x)[..., 0]
+
+
+def loss_fn(labels, preds, mask):
+    per = optax.sigmoid_binary_cross_entropy(
+        preds, labels.astype(np.float32)
+    )
+    return (per * mask).sum() / jnp.maximum(mask.sum(), 1)
+
+
+def make_batch(rng, batch=16):
+    ids = rng.randint(0, VOCAB, (batch, FIELDS)).astype(np.int64)
+    labels = (ids[:, 0] % 2).astype(np.int32)
+    return {
+        "features": {"item_ids": ids},
+        "labels": labels,
+        "mask": np.ones((batch,), np.float32),
+    }
+
+
+# ---- parallel per-table fan-out -----------------------------------------
+
+
+class SlowConcurrentTable(EmbeddingTable):
+    """Row-service-shaped store: concurrent-safe, each pull pays an
+    RPC-like sleep."""
+
+    concurrent_safe = True
+    delay = 0.05
+
+    def get(self, ids):
+        time.sleep(self.delay)
+        return super().get(ids)
+
+
+class ConcurrentOpt(HostOptimizerWrapper):
+    concurrent_safe = True
+
+
+def _multi_table_engine(table_cls=EmbeddingTable, n=3):
+    tables = {f"t{i}": table_cls(f"t{i}", DIM) for i in range(n)}
+    return HostEmbeddingEngine(
+        tables, ConcurrentOpt(SGD(lr=0.5)),
+        id_keys={f"t{i}": f"ids{i}" for i in range(n)},
+    )
+
+
+def _multi_table_batch(rng, n=3, batch=8):
+    return {
+        "features": {
+            f"ids{i}": rng.randint(0, VOCAB, (batch, FIELDS)).astype(
+                np.int64
+            )
+            for i in range(n)
+        },
+        "labels": rng.randint(0, 2, (batch,)).astype(np.int32),
+        "mask": np.ones((batch,), np.float32),
+    }
+
+
+def test_multi_table_prepare_fans_out_pays_max_not_sum():
+    """3 tables x 50ms pull: the fan-out pool must land near
+    max(pull) = 50ms, not sum = 150ms."""
+    engine = _multi_table_engine(SlowConcurrentTable)
+    batch = _multi_table_batch(np.random.RandomState(0))
+    engine.prepare_batch(batch)  # warm the pool outside the timing
+    t0 = time.perf_counter()
+    engine.prepare_batch(batch)
+    elapsed = time.perf_counter() - t0
+    assert elapsed < 2.4 * SlowConcurrentTable.delay, elapsed
+
+
+def test_multi_table_prepare_matches_serial_exactly():
+    """Fan-out must not change results: inverse maps, row blocks, and
+    uniques identical to the single-table reference math per table."""
+    engine = _multi_table_engine(SlowConcurrentTable)
+    batch = _multi_table_batch(np.random.RandomState(1))
+    prepared, host_rows, uniques = engine.prepare_batch(batch)
+    for i in range(3):
+        name, key = f"t{i}", f"ids{i}"
+        raw = batch["features"][key]
+        uniq, u = uniques[name]
+        inv = prepared["features"][key]
+        assert np.array_equal(uniq[inv], raw)
+        ref = EmbeddingTable(name, DIM)
+        np.testing.assert_array_equal(host_rows[name][:u], ref.get(uniq))
+        assert np.all(host_rows[name][u:] == 0.0)
+
+
+def test_prepare_phase_metrics_recorded():
+    """The lookup monolith is split: dedup/row_pull/pad histograms
+    observe per table per batch (embedding_lookup_seconds stays as the
+    total)."""
+    from elasticdl_tpu.observability import MetricsRegistry
+
+    registry = MetricsRegistry()
+    tables = {"items": EmbeddingTable("items", DIM)}
+    engine = HostEmbeddingEngine(
+        tables, HostOptimizerWrapper(SGD(lr=0.5)),
+        id_keys={"items": "item_ids"}, metrics_registry=registry,
+    )
+    engine.prepare_batch(make_batch(np.random.RandomState(0)))
+    snap = {f["name"]: f for f in registry.snapshot()["families"]}
+    for family in ("embedding_lookup_seconds", "embedding_dedup_seconds",
+                   "embedding_row_pull_seconds", "embedding_pad_seconds"):
+        series = snap[f"edl_tpu_{family}"]["series"]
+        assert series and series[0]["count"] >= 1, family
+
+
+# ---- device double-buffering --------------------------------------------
+
+
+def _engine():
+    return HostEmbeddingEngine(
+        {"items": EmbeddingTable("items", DIM)},
+        HostOptimizerWrapper(SGD(lr=0.5)),
+        id_keys={"items": "item_ids"},
+    )
+
+
+def test_prepared_batches_place_rows_device_resident():
+    engine = _engine()
+    rng = np.random.RandomState(3)
+    batches = [make_batch(rng) for _ in range(3)]
+    with engine.prepared_batches(iter(batches), place_rows=True) as it:
+        seen = list(it)
+    assert len(seen) == 3
+    for pb in seen:
+        assert pb.device_rows is not None
+        rows = pb.device_rows["items"]
+        assert isinstance(rows, jax.Array)
+        np.testing.assert_array_equal(
+            np.asarray(rows), pb.host_rows["items"]
+        )
+        assert pb.device_batch is not None
+        np.testing.assert_array_equal(
+            np.asarray(pb.device_batch["features"]["item_ids"]),
+            pb.batch["features"]["item_ids"],
+        )
+
+
+def test_training_on_device_placed_batches_matches_host_path():
+    """A step fed device-resident PreparedBatches must produce the
+    same trajectory as one fed host-side prepares."""
+    batches = []
+    for s in range(4):
+        b = make_batch(np.random.RandomState(s))
+        ids = b["features"]["item_ids"]
+        b["features"]["item_ids"] = (ids % 50) + 100 * s  # disjoint
+        batches.append(b)
+    finals = {}
+    for place in (False, True):
+        runner = HostStepRunner(_engine(), async_apply=False)
+        state = runner.init_state(
+            TinyHostModel(), optax.sgd(0.1), batches[0]
+        )
+        step = runner.train_step(loss_fn)
+        it = runner.engine.prepared_batches(
+            iter(batches), place_rows=place
+        )
+        try:
+            for pb in it:
+                state, _ = step(state, pb)
+        finally:
+            it.close()
+        finals[place] = runner.engine.tables["items"].to_arrays()
+    np.testing.assert_array_equal(finals[False][0], finals[True][0])
+    np.testing.assert_allclose(finals[False][1], finals[True][1],
+                               rtol=0, atol=0)
+
+
+def test_iter_prepared_depth_clamped_and_places_rows():
+    runner = HostStepRunner(_engine())
+    batches = [make_batch(np.random.RandomState(7)) for _ in range(2)]
+    it = runner.iter_prepared(iter(batches), depth=0)  # clamps to 1
+    try:
+        pb = next(iter(it))
+        assert pb.device_rows is not None  # device stage on by default
+    finally:
+        it.close()
+
+
+# ---- eval staleness fix --------------------------------------------------
+
+
+def test_eval_sees_applied_rows_despite_stale_prepared_batch():
+    """Regression (PR 7 satellite): a PreparedBatch pulled BEFORE the
+    eval flush carries pre-flush rows; eval_step must re-pull so the
+    eval reads every applied row. Train → eval with the stale
+    PreparedBatch → predictions must equal a fresh-raw-batch eval."""
+    runner = HostStepRunner(_engine(), async_apply=True)
+    batch = make_batch(np.random.RandomState(5))
+    state = runner.init_state(TinyHostModel(), optax.sgd(0.1), batch)
+    step = runner.train_step(loss_fn)
+    # Pull rows BEFORE the training step applies its grads: this is
+    # exactly what the pull-ahead pipeline hands eval after a flush.
+    stale = PreparedBatch(batch, *runner.engine.prepare_batch(batch))
+    state, _ = step(state, batch)  # async apply enqueued
+    eval_step = runner.eval_step()
+    preds_stale_path = np.asarray(eval_step(state, stale))
+    preds_fresh = np.asarray(eval_step(state, batch))
+    np.testing.assert_allclose(preds_stale_path, preds_fresh,
+                               rtol=1e-6, atol=1e-6)
+    # And the rows really moved (the test would pass vacuously if the
+    # step changed nothing).
+    fresh_rows = runner.engine.prepare_batch(batch)[1]["items"]
+    assert not np.allclose(fresh_rows, stale.host_rows["items"])
+
+
+# ---- fused Pallas scatter-apply -----------------------------------------
+
+
+# Small-but-representative kernel shapes: dim 128 = one lane chunk
+# (keeps the unrolled interpret path fast); FN spans a partial
+# _APPLY_ROWS block so the OOR pad contract is exercised.
+FV, FD, FN = 64, 128, 11
+
+
+def _fused_fixture(seed=0):
+    rng = np.random.RandomState(seed)
+    table = jnp.asarray(rng.randn(FV, FD).astype(np.float32))
+    ids = np.unique(rng.randint(0, FV, FN))
+    uids = jnp.concatenate([
+        jnp.asarray(ids, jnp.int32),
+        jnp.full((FN - len(ids),), FV, jnp.int32),  # OOR pad sentinel
+    ])
+    grads = jnp.asarray(rng.randn(FN, FD).astype(np.float32))
+    return table, uids, grads
+
+
+def test_fused_sgd_matches_xla_sparse_apply():
+    table, uids, grads = _fused_fixture()
+    ref, _ = sparse_apply(
+        SGD(lr=0.1), table, {}, uids, grads, step=1, use_pallas="never"
+    )
+    got = pe.fused_sgd_scatter_apply(
+        table, uids, grads, lr=0.1, interpret=True
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-6, atol=1e-6)
+
+
+@pytest.mark.parametrize("nesterov", [False, True])
+def test_fused_momentum_matches_xla_sparse_apply(nesterov):
+    table, uids, grads = _fused_fixture(1)
+    opt = Momentum(lr=0.05, momentum=0.9, nesterov=nesterov)
+    slots = init_slot_tables(opt, FV, FD)
+    ref_t, ref_s = sparse_apply(
+        opt, table, slots, uids, grads, step=1, use_pallas="never"
+    )
+    got_t, got_v = pe.fused_momentum_scatter_apply(
+        table, slots["momentum"], uids, grads, lr=0.05, momentum=0.9,
+        nesterov=nesterov, interpret=True,
+    )
+    np.testing.assert_allclose(np.asarray(got_t), np.asarray(ref_t),
+                               rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(got_v), np.asarray(ref_s["momentum"]),
+        rtol=1e-6, atol=1e-6,
+    )
+
+
+def test_fused_routing_and_clean_fallbacks():
+    table, uids, grads = _fused_fixture(2)
+    ref, _ = sparse_apply(
+        SGD(lr=0.1), table, {}, uids, grads, step=1, use_pallas="never"
+    )
+    got, _ = sparse_apply(
+        SGD(lr=0.1), table, {}, uids, grads, step=1,
+        use_pallas="fused", interpret=True,
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-6, atol=1e-6)
+    # dim_supported says no -> clean XLA fallback, no error.
+    rng = np.random.RandomState(3)
+    t2 = jnp.asarray(rng.randn(FV, 20).astype(np.float32))
+    g2 = jnp.asarray(rng.randn(FN, 20).astype(np.float32))
+    got2, _ = sparse_apply(
+        SGD(lr=0.1), t2, {}, uids, g2, step=1, use_pallas="fused"
+    )
+    ref2, _ = sparse_apply(
+        SGD(lr=0.1), t2, {}, uids, g2, step=1, use_pallas="never"
+    )
+    np.testing.assert_allclose(np.asarray(got2), np.asarray(ref2))
+    # Optimizer without a fused kernel -> clean XLA fallback too.
+    opt = Adagrad(lr=0.1)
+    slots = init_slot_tables(opt, FV, FD)
+    got3, _ = sparse_apply(
+        opt, table, slots, uids, grads, step=1, use_pallas="fused"
+    )
+    ref3, _ = sparse_apply(
+        opt, table, slots, uids, grads, step=1, use_pallas="never"
+    )
+    np.testing.assert_allclose(np.asarray(got3), np.asarray(ref3))
+
+
+def test_fused_apply_is_autodiff_exempt():
+    table, uids, grads = _fused_fixture(4)
+    with pytest.raises(ValueError, match="autodiff-exempt"):
+        jax.grad(
+            lambda t: jnp.sum(pe.fused_sgd_scatter_apply(
+                t, uids, grads, lr=0.1, interpret=True
+            ))
+        )(table)
+
+
+def test_fused_auto_dispatch_stays_off():
+    """use_pallas_apply is the single sweep predicate: until an
+    on-chip measurement flips it, auto dispatch must keep XLA (the
+    lookup kernels' round-3 lesson)."""
+    assert pe.use_pallas_apply(256, 1024) is False
+
+
+def test_fused_excluded_under_packed_slots():
+    from elasticdl_tpu.embedding.device_sparse import (
+        DeviceSparseRunner,
+        TableSpec,
+    )
+
+    with pytest.raises(ValueError, match="packed_slots"):
+        DeviceSparseRunner(
+            (TableSpec("t", vocab=64, dim=256),), SGD(lr=0.1),
+            use_pallas="fused", packed_slots=True,
+        )
+
+
+def test_sparse_runner_fused_trajectory_matches_xla():
+    """Three jitted train steps through DeviceSparseRunner: the fused
+    scatter-apply path must reproduce the XLA trajectory (tables and
+    slots) exactly within float tolerance."""
+    from elasticdl_tpu.embedding.device_sparse import (
+        DeviceSparseRunner,
+        SparseEmbed,
+        TableSpec,
+    )
+
+    class M(nn.Module):
+        @nn.compact
+        def __call__(self, features, training=False):
+            e = SparseEmbed("tbl", 128)()
+            return nn.Dense(1)(e)[..., 0]
+
+    spec = TableSpec("tbl", vocab=64, dim=128, feature_key="ids")
+    rng = np.random.RandomState(0)
+    batch = {
+        "features": {"ids": rng.randint(0, 64, (8, 4)).astype(np.int32)},
+        "labels": rng.randint(0, 2, (8,)).astype(np.int32),
+        "mask": np.ones((8,), np.float32),
+    }
+    finals = {}
+    for up in ("never", "fused"):
+        runner = DeviceSparseRunner(
+            (spec,), Momentum(lr=0.05), use_pallas=up
+        )
+        state = runner.init_state(M(), optax.sgd(0.1), batch, seed=0)
+        step = runner.train_step(loss_fn)
+        for _ in range(3):
+            state, _ = step(state, batch)
+        finals[up] = (
+            np.asarray(state.tables["tbl"]),
+            np.asarray(state.slot_tables["tbl"]["momentum"]),
+        )
+    np.testing.assert_allclose(finals["fused"][0], finals["never"][0],
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(finals["fused"][1], finals["never"][1],
+                               rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.tpu
+def test_fused_apply_compiled_on_chip():
+    """Compiled (non-interpret) parity on the real chip — the
+    `make test-tpu` lane's half of the 'both interpret and compiled'
+    acceptance bullet."""
+    table, uids, grads = _fused_fixture(5)
+    ref, _ = sparse_apply(
+        SGD(lr=0.1), table, {}, uids, grads, step=1, use_pallas="never"
+    )
+    got = pe.fused_sgd_scatter_apply(table, uids, grads, lr=0.1)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+    opt = Momentum(lr=0.05, momentum=0.9)
+    slots = init_slot_tables(opt, FV, FD)
+    ref_t, ref_s = sparse_apply(
+        opt, table, slots, uids, grads, step=1, use_pallas="never"
+    )
+    got_t, got_v = pe.fused_momentum_scatter_apply(
+        table, slots["momentum"], uids, grads, lr=0.05, momentum=0.9
+    )
+    np.testing.assert_allclose(np.asarray(got_t), np.asarray(ref_t),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(got_v), np.asarray(ref_s["momentum"]),
+        rtol=1e-5, atol=1e-5,
+    )
+
+
+# ---- overlap checker + the fast-lane smoke ------------------------------
+
+
+def _event(name, trace_id, ts, dur):
+    return {
+        "ph": "X", "name": name, "ts": ts, "dur": dur, "pid": 1,
+        "tid": 1, "args": {"trace_id": trace_id, "span_id": name + trace_id},
+    }
+
+
+def test_find_overlaps_cross_tree_only():
+    # Same tree (nesting, the serialized shape): excluded.
+    events = [
+        _event("device_step", "a", 0.0, 100.0),
+        _event("row_pull", "a", 10.0, 50.0),
+    ]
+    assert find_overlaps(events) == []
+    # Different tree, overlapping wall-clock: the pipelined signal.
+    events.append(_event("row_pull", "b", 20.0, 50.0))
+    assert len(find_overlaps(events)) == 1
+    # Different tree but disjoint in time: serialized — no overlap.
+    assert find_overlaps([
+        _event("device_step", "a", 0.0, 10.0),
+        _event("row_pull", "b", 20.0, 5.0),
+    ]) == []
+
+
+def test_pipelined_job_overlaps_row_pulls(tmp_path):
+    """Fast-lane equivalent of ``make sparse-smoke``: a 1-worker
+    deepfm-host MiniCluster job over a REAL localhost row service with
+    injected RPC latency must show >=1 row_pull span overlapping a
+    device_step span from another trace tree, and the exported trace
+    must satisfy tools/check_overlap.py."""
+    from tools.bench_sparse_path import run_mode
+    from tools.check_overlap import check_overlap
+
+    out = str(tmp_path / "TRACE_sparse.json")
+    summary = run_mode(
+        "pipelined", str(tmp_path), delay_secs=0.02, records=32,
+        minibatch_size=8, num_minibatches_per_task=2, trace_out=out,
+    )
+    assert summary["trained_batches"] == 4
+    assert summary["row_pull_overlap_pairs"] >= 1, summary
+    assert check_overlap(out) == []
+
+
+# ---- --host_prefetch_depth threading ------------------------------------
+
+
+def test_host_prefetch_depth_flag_parses_and_validates():
+    from elasticdl_tpu.common.args import parse_worker_args
+
+    base = ["--worker_id", "0", "--model_zoo", "zoo",
+            "--model_def", "m.custom_model", "--minibatch_size", "8"]
+    assert parse_worker_args(base).host_prefetch_depth == 2  # default
+    assert parse_worker_args(
+        base + ["--host_prefetch_depth", "5"]
+    ).host_prefetch_depth == 5
+    with pytest.raises(SystemExit):  # pos_int: must be >= 1
+        parse_worker_args(base + ["--host_prefetch_depth", "0"])
+
+
+def test_worker_threads_depth_into_iter_prepared(tmp_path):
+    """The flag must actually reach iter_prepared — a Worker built with
+    host_prefetch_depth=N passes depth=N to the runner."""
+    from elasticdl_tpu.testing.cluster import MiniCluster
+    from elasticdl_tpu.testing.data import (
+        create_frappe_record_file,
+        model_zoo_dir,
+    )
+    from model_zoo.deepfm import deepfm_host
+
+    train = create_frappe_record_file(str(tmp_path / "t.rec"), 16, seed=3)
+    seen = {}
+    runner = deepfm_host.make_host_runner()
+    real = runner.iter_prepared
+
+    def spy(batches, depth=2, place_rows=True):
+        seen["depth"] = depth
+        return real(batches, depth=depth, place_rows=place_rows)
+
+    runner.iter_prepared = spy
+    cluster = MiniCluster(
+        model_zoo=model_zoo_dir(),
+        model_def="deepfm.deepfm_host.custom_model",
+        training_data=train,
+        minibatch_size=8,
+        num_minibatches_per_task=2,
+        step_runner_factory=lambda: runner,
+        host_prefetch_depth=4,
+    )
+    cluster.run()
+    assert cluster.finished
+    assert seen["depth"] == 4
