@@ -6,7 +6,8 @@ PY ?= python
 
 .PHONY: test test-all test-tpu test-k8s native bench serve-bench dryrun \
 	clean lint metrics chaos-smoke chaos-soak chaos-master-smoke \
-	trace-smoke serve-fleet-smoke sparse-smoke sparse-bench
+	trace-smoke serve-fleet-smoke sparse-smoke sparse-bench \
+	autoscale-smoke autoscale-bench
 
 # Scrape-and-pretty-print a master's /metrics (docs/observability.md).
 METRICS_ADDR ?= localhost:8080
@@ -86,6 +87,22 @@ sparse-smoke:
 # gate: pipelined per-batch p50 <= 0.7x serialized).
 sparse-bench:
 	JAX_PLATFORMS=cpu $(PY) tools/bench_sparse_path.py
+
+# Autoscale chaos drill (docs/elasticity.md): a job shrinks dp4->dp2 by
+# checkpointless live reshard, grows back, and loses its worker to a
+# hard kill while the grow barrier is pending. Exits nonzero unless
+# loss-trajectory equivalence vs a checkpoint-restart control, exactly-
+# once task accounting, and barrier liveness all hold. Fast-lane
+# equivalent: tests/test_autoscale.py::test_autoscale_drill_passes.
+autoscale-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m elasticdl_tpu.chaos.autoscale_drill \
+		--report AUTOSCALE_DRILL.json
+
+# Live-reshard vs checkpoint-restart resize downtime (writes
+# BENCH_AUTOSCALE.json; gate: live reshard >= 5x lower downtime per
+# direction on the in-process virtual CPU mesh).
+autoscale-bench:
+	JAX_PLATFORMS=cpu $(PY) bench_elasticity.py --scenario autoscale
 
 # Deterministic chaos plan (kill + stall-row-shard + corrupt-checkpoint)
 # against the in-process cluster; exits nonzero if any recovery

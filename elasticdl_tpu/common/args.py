@@ -278,6 +278,40 @@ def add_train_params(parser):
                              "keep this above the longest task, not just "
                              "a few report intervals; default is 2x "
                              "task_timeout_secs")
+    # Closed-loop elastic autoscaling (master/autoscaler.py;
+    # docs/elasticity.md): the master watches queue depth, worker step
+    # utilization, and p99 straggler attribution, and grows/shrinks the
+    # worker fleet between the bounds.
+    add_bool_param(parser, "--autoscale", False,
+                   "Enable the master's closed-loop autoscaler "
+                   "(k8s mode: scales worker pods between "
+                   "--autoscale_min_workers/--autoscale_max_workers)")
+    parser.add_argument("--autoscale_min_workers", type=pos_int,
+                        default=1)
+    parser.add_argument("--autoscale_max_workers", type=non_neg_int,
+                        default=0,
+                        help="0 = use --num_workers as the ceiling")
+    parser.add_argument("--autoscale_cooldown_secs", type=pos_float,
+                        default=60.0,
+                        help="Quiet period after any scale decision")
+    parser.add_argument("--autoscale_hysteresis_ticks", type=pos_int,
+                        default=3,
+                        help="Consecutive agreeing poll ticks required "
+                             "before a decision fires")
+    parser.add_argument("--autoscale_up_backlog_factor", type=pos_float,
+                        default=2.0,
+                        help="Scale up when todo depth exceeds this "
+                             "many tasks per live worker (and workers "
+                             "are saturated)")
+    parser.add_argument("--autoscale_up_utilization", type=pos_float,
+                        default=0.7,
+                        help="Minimum mean worker_step_utilization for "
+                             "scale-up (a starved fleet's backlog is an "
+                             "input problem, not a capacity problem)")
+    parser.add_argument("--autoscale_down_utilization", type=pos_float,
+                        default=0.3,
+                        help="Scale down when the queue is empty and "
+                             "mean utilization sits below this")
 
 
 def add_evaluate_params(parser):
@@ -337,12 +371,21 @@ def parse_worker_args(args=None):
 
 def build_arguments_from_parsed_result(args, filter_args=None):
     """Reserialize parsed args back into a CLI list for spawning child pods
-    (reference args.py build_arguments_from_parsed_result)."""
+    (reference args.py build_arguments_from_parsed_result).
+
+    None-valued optionals are SKIPPED, not stringified: an unset
+    ``--metrics_ttl_secs`` (default None = "derive from
+    task_timeout_secs") would otherwise re-serialize as the literal
+    string "None", which the worker parser's ``pos_float`` rejects —
+    omitting the flag reproduces the default-deriving behavior in the
+    child process."""
     items = vars(args).items()
     if filter_args:
         items = filter(lambda kv: kv[0] not in filter_args, items)
 
     def _to_pair(key, value):
+        if value is None:
+            return []
         if isinstance(value, bool):
             return [f"--{key}", "true" if value else "false"]
         return [f"--{key}", str(value)]

@@ -494,18 +494,25 @@ class DeviceSparseRunner:
         self.interpret = interpret
         self.mesh = mesh
         self.axis = axis
-        if mesh is not None:
-            n = mesh.shape[axis]
-            self.sharded_tables = frozenset(
-                s.name for s in self.specs
-                if s.vocab % n == 0
-                and s.vocab * s.dim * 4 > partition_threshold_bytes
-            )
-        else:
-            self.sharded_tables = frozenset()
+        self.partition_threshold_bytes = int(partition_threshold_bytes)
+        self.sharded_tables = self._sharded_tables_for(mesh)
         self._template = None
         self._state_shardings = None
         self._batch_shardings = None
+        self._abstract_batch = None
+
+    def _sharded_tables_for(self, mesh) -> frozenset:
+        """Which tables row-shard on ``mesh``: vocab divides the axis
+        and the table clears the size threshold. Re-derived on resize —
+        a table that divided dp4 may not divide dp3."""
+        if mesh is None:
+            return frozenset()
+        n = mesh.shape[self.axis]
+        return frozenset(
+            s.name for s in self.specs
+            if s.vocab % n == 0
+            and s.vocab * s.dim * 4 > self.partition_threshold_bytes
+        )
 
     def _table_sharding(self, name):
         spec = P(self.axis, None) if name in self.sharded_tables else P()
@@ -558,14 +565,20 @@ class DeviceSparseRunner:
             ),
         )()
         self._template = template
-        self._batch_shardings = jax.tree.map(
+        self._batch_shardings = self._batch_shardings_for(batch)
+        # Shape-only copy of the example batch so resize() can rebuild
+        # the batch shardings against the new mesh.
+        self._abstract_batch = jax.eval_shape(lambda b: b, batch)
+        return state
+
+    def _batch_shardings_for(self, batch):
+        return jax.tree.map(
             lambda leaf: NamedSharding(
                 self.mesh,
                 P(self.axis) if np.ndim(leaf) >= 1 else P(),
             ),
             batch,
         )
-        return state
 
     def place_state(self, state):
         """Re-place restored host arrays with the runner's shardings
@@ -575,6 +588,31 @@ class DeviceSparseRunner:
             return state
         shardings = self._state_shardings or self.state_shardings(state)
         return jax.device_put(state, shardings)
+
+    def resize(self, new_mesh, state=None):
+        """Checkpointless live reshard onto ``new_mesh``
+        (MeshRunner.resize's contract, sparse edition): every
+        row-sharded table's per-device row range changes — dp4 → dp2
+        doubles each shard — and the co-sharded slot tables move with
+        it, with no disk round trip. Compiled steps baked the old
+        shardings and must be rebuilt by the caller."""
+        from elasticdl_tpu.parallel import reshard as reshard_lib
+
+        self.mesh = new_mesh
+        self.sharded_tables = self._sharded_tables_for(new_mesh)
+        self._state_shardings = None
+        if self._abstract_batch is not None:
+            self._batch_shardings = self._batch_shardings_for(
+                self._abstract_batch
+            )
+        if state is None:
+            return None
+
+        def shardings_fn(abstract):
+            self._state_shardings = self.state_shardings(abstract)
+            return self._state_shardings
+
+        return reshard_lib.live_reshard(state, shardings_fn)
 
     def _jit_step(self, step):
         if self.mesh is None:

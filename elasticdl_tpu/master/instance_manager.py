@@ -449,6 +449,58 @@ class InstanceManager:
         if self._on_worker_relaunch is not None:
             self._on_worker_relaunch(worker_id, worker_id)
 
+    # ---- elastic scaling (master/autoscaler.py) -------------------------
+
+    def scale_up(self, count: int = 1) -> List[int]:
+        """Add ``count`` workers under fresh ids (the same id scheme
+        relaunches use — ids never recycle). Returns the new ids."""
+        new_ids = []
+        for _ in range(max(0, int(count))):
+            with self._lock:
+                if self._stopped:
+                    break
+                new_id = next(self._next_worker_id)
+            self._start_worker(new_id)
+            new_ids.append(new_id)
+        if new_ids:
+            logger.info("scaled up: started worker(s) %s", new_ids)
+        return new_ids
+
+    def drain_worker(self, worker_id: int) -> bool:
+        """Scale-down: remove ``worker_id`` WITHOUT relaunching it.
+
+        The pod is untracked before deletion, so its DELETED watch
+        event matches nothing and the ``_handle_dead_worker`` relaunch
+        path never fires — the one behavioral difference from a death.
+        Its in-flight tasks re-queue exactly once here: if the worker's
+        SIGTERM grace also hands a task back, the dispatcher's resolved
+        ledger answers that late report with the original requeue
+        outcome instead of double-queueing. Returns False when the id
+        is not live."""
+        with self._lock:
+            name = self._worker_pods.pop(worker_id, None)
+        if name is None:
+            return False
+        _observe("worker_drained", worker_id=worker_id)
+        # Fence BEFORE the pod deletion: the dying worker keeps polling
+        # through its SIGTERM grace, and a fresh lease taken after this
+        # point would have no death event to recover it (the DELETED
+        # event is deliberately ignored below).
+        fence = getattr(self._task_d, "fence_worker", None)
+        if fence is not None:
+            fence(worker_id)
+        try:
+            self._client.delete_pod(name)
+        except Exception as exc:
+            logger.warning("deleting drained pod %s failed: %s",
+                           name, exc)
+        requeued = self._task_d.recover_tasks(worker_id)
+        logger.info(
+            "drained worker %d (%s); re-queued %s task(s)",
+            worker_id, name, requeued,
+        )
+        return True
+
     # ---- straggler handling ---------------------------------------------
 
     def kill_worker(self, worker_id: int):

@@ -65,11 +65,24 @@ CREATE_TASKS = "create_tasks"
 VERSION = "version"
 SNAPSHOT = "snapshot"
 GENERATION = "generation"
+RESIZE = "resize"
 
 KNOWN_TYPES = (DISPATCH, REPORT, CREATE_TASKS, VERSION, SNAPSHOT,
-               GENERATION)
+               GENERATION, RESIZE)
 
 _HEADER = struct.Struct("<II")  # payload length, crc32(payload)
+
+
+def _pending_resize_from(record: dict) -> Optional[dict]:
+    """Pending-barrier state a RESIZE record (or append fields) leaves
+    behind: the begin fields while open, None once done. One helper so
+    open/append/replay cannot drift on the record shape."""
+    if record.get("done"):
+        return None
+    return {
+        k: record[k] for k in ("resize_id", "spec", "direction")
+        if k in record
+    }
 
 
 class JournalFormatError(RuntimeError):
@@ -135,6 +148,13 @@ def validate_record(record: dict) -> Optional[str]:
     elif rtype == GENERATION:
         if not isinstance(record.get("generation"), int):
             return "generation: non-int generation"
+    elif rtype == RESIZE:
+        if not isinstance(record.get("resize_id"), int):
+            return "resize: non-int resize_id"
+        if not isinstance(record.get("spec"), dict):
+            return "resize: spec is not a dict"
+        if not isinstance(record.get("done"), bool):
+            return "resize: non-bool done"
     elif rtype == SNAPSHOT:
         state = record.get("state")
         if not isinstance(state, dict):
@@ -174,6 +194,10 @@ class MasterJournal:
         # compaction (which discards the raw VERSION records) can
         # carry it inside the snapshot record.
         self._model_version = 0
+        # Pending resize barrier (master/servicer.py), tracked the
+        # same way: the open begin record must survive compaction so
+        # a recovered master can re-offer the directive.
+        self._pending_resize = None
 
     # ---- lifecycle -----------------------------------------------------
 
@@ -213,6 +237,11 @@ class MasterJournal:
                         self._model_version = max(
                             self._model_version,
                             int(record.get("model_version", 0)),
+                        )
+                        self._pending_resize = record.get("resize")
+                    elif record["t"] == RESIZE:
+                        self._pending_resize = _pending_resize_from(
+                            record
                         )
                 size = os.path.getsize(self.path)
                 if size > last_good_end:
@@ -263,6 +292,8 @@ class MasterJournal:
                     self._model_version,
                     int(fields.get("model_version", 0)),
                 )
+            elif rtype == RESIZE:
+                self._pending_resize = _pending_resize_from(fields)
             self._append_locked(rtype, **fields)
             if rtype in (DISPATCH, REPORT):
                 self._since_snapshot += 1
@@ -279,6 +310,9 @@ class MasterJournal:
             # Compaction discards the raw VERSION records; the
             # high-water mark must survive inside the snapshot.
             "model_version": int(self._model_version),
+            # Same for an open resize barrier (raw RESIZE records are
+            # compacted away with the rest of the prefix).
+            "resize": self._pending_resize,
         }
         # Compaction: the snapshot supersedes everything before it, so
         # rewrite the file as [generation fence, snapshot] and keep
@@ -342,6 +376,7 @@ class MasterJournal:
         known_workers = set()
         replayed = 0
         start = 0
+        pending_resize = None
         if snap_idx is not None:
             state = records[snap_idx]["state"]
             dispatcher.restore_state(state)
@@ -351,6 +386,7 @@ class MasterJournal:
                 model_version,
                 int(records[snap_idx].get("model_version", 0)),
             )
+            pending_resize = records[snap_idx].get("resize")
             # Compaction dropped the pre-snapshot dispatch records;
             # the snapshot's leases and version reports still name the
             # workers this job had.
@@ -377,6 +413,13 @@ class MasterJournal:
                 continue
             if rtype == VERSION:
                 model_version = max(model_version, record["model_version"])
+                replayed += 1
+                continue
+            if rtype == RESIZE:
+                # Barrier state, not dispatcher state: an open begin
+                # survives so the recovered servicer re-offers the
+                # directive; done closes it.
+                pending_resize = _pending_resize_from(record)
                 replayed += 1
                 continue
             if rtype == SNAPSHOT:
@@ -428,6 +471,7 @@ class MasterJournal:
             "model_version": model_version,
             "generation": generation,
             "known_workers": sorted(known_workers),
+            "resize": pending_resize,
         }
 
 
@@ -463,6 +507,11 @@ def recover_master_state(journal: "MasterJournal", dispatcher,
             servicer.seed_task_start_times(
                 list(dispatcher.doing_start_times())
             )
+            if stats.get("resize"):
+                # A master crash mid-resize: re-offer the journaled
+                # pending directive (acks are volatile; workers that
+                # applied it already re-ack idempotently).
+                servicer.rearm_resize(stats["resize"])
         sp.set(replayed=int(stats["replayed"]),
                generation=int(generation))
     elapsed = time.monotonic() - t0

@@ -193,8 +193,14 @@ class Master:
             self.servicer.seed_task_start_times(
                 list(self.task_dispatcher.doing_start_times())
             )
+            if self._recovery_stats.get("resize"):
+                # Crash mid-resize: re-offer the pending directive.
+                self.servicer.rearm_resize(
+                    self._recovery_stats["resize"]
+                )
         self._server = None
         self.instance_manager = None
+        self.autoscaler = None
         self._k8s_client = k8s_client
         # SIGTERM grace path (main() installs the handler): the run
         # loop exits at the next poll tick and stop() tears the job
@@ -425,6 +431,75 @@ class Master:
                 # retry until it answers.
                 self.instance_manager.start_row_service()
                 self.instance_manager.start_workers()
+        if getattr(self._args, "autoscale", False):
+            self._build_autoscaler()
+
+    def _build_autoscaler(self):
+        """Closed-loop autoscaling (master/autoscaler.py): pod scaling
+        through the InstanceManager when one exists; without k8s the
+        loop still runs (decision telemetry, barrier upkeep) but both
+        actions are no-ops — in-process mesh scaling is driven by the
+        drill/bench harnesses instead."""
+        from elasticdl_tpu.master.autoscaler import (
+            Autoscaler,
+            AutoscalePolicy,
+            master_signals,
+        )
+
+        args = self._args
+        max_workers = int(
+            getattr(args, "autoscale_max_workers", 0)
+            or getattr(args, "num_workers", 1)
+        )
+        policy = AutoscalePolicy(
+            min_workers=int(getattr(args, "autoscale_min_workers", 1)),
+            max_workers=max_workers,
+            scale_up_backlog_factor=float(
+                getattr(args, "autoscale_up_backlog_factor", 2.0)
+            ),
+            scale_up_utilization=float(
+                getattr(args, "autoscale_up_utilization", 0.7)
+            ),
+            scale_down_utilization=float(
+                getattr(args, "autoscale_down_utilization", 0.3)
+            ),
+            hysteresis_ticks=int(
+                getattr(args, "autoscale_hysteresis_ticks", 3)
+            ),
+            cooldown_secs=float(
+                getattr(args, "autoscale_cooldown_secs", 60.0)
+            ),
+        )
+        manager = self.instance_manager
+
+        def live_count():
+            if manager is not None:
+                return len(manager.live_workers)
+            return max(1, len(self.servicer.worker_liveness()))
+
+        def scale_up(_signals):
+            if manager is not None:
+                manager.scale_up(1)
+
+        def scale_down(_signals):
+            if manager is None:
+                return
+            live = manager.live_workers
+            if live:
+                # Drain the youngest worker (highest id): oldest
+                # workers hold the warmest compile caches.
+                victim = max(live)
+                manager.drain_worker(victim)
+                self.servicer.remove_worker_metrics(victim)
+
+        self.autoscaler = Autoscaler(
+            policy,
+            master_signals(
+                self.task_dispatcher, self.servicer,
+                self.metrics_plane, live_count,
+            ),
+            scale_up, scale_down,
+        )
 
     def request_stop(self):
         """Ask the run loop to exit at the next tick (SIGTERM path).
@@ -455,6 +530,19 @@ class Master:
                     # The relaunch comes back under a NEW worker id —
                     # drop the dead id's series now, not at the TTL.
                     self.servicer.remove_worker_metrics(worker_id)
+                # Resize-barrier upkeep: refresh membership from the
+                # live fleet so a worker that died mid-barrier (its
+                # tasks recovered above / by the watch path) cannot
+                # wedge it — its replacement acks under its own id.
+                if self.servicer.resize_status() is not None:
+                    live = (
+                        list(self.instance_manager.live_workers)
+                        if self.instance_manager is not None
+                        else list(self.servicer.worker_liveness())
+                    )
+                    self.servicer.maybe_complete_resize(live)
+                if self.autoscaler is not None:
+                    self.autoscaler.tick()
                 self.metrics_plane.publish_tensorboard(
                     self.servicer.model_version
                 )

@@ -18,7 +18,7 @@ plane's TraceCollector by ``MetricsPlane.ingest``.
 
 import threading
 import time
-from typing import Dict
+from typing import Dict, Optional
 
 from elasticdl_tpu.common.constants import TaskType
 from elasticdl_tpu.common.log_utils import get_logger
@@ -72,6 +72,32 @@ class MasterServicer:
         self._task_count = 0
         self._task_start_times: Dict[int, float] = {}
         self.model_version = 0
+        # ---- live-resize barrier (docs/elasticity.md) ----------------
+        # At most one pending resize: {resize_id, spec, direction,
+        # expected (worker-id set), acks {worker_id: status}, t0}.
+        # Directives piggyback on get_task responses (like the
+        # generation fence); workers apply at a task boundary and ack
+        # via report_resize; the barrier completes when every expected
+        # worker acked — membership shrinks via maybe_complete_resize
+        # when a worker dies mid-barrier (its replacement sees the
+        # still-pending directive on its first get_task). Journaled
+        # like dispatch: begin/done records survive a master crash, and
+        # a recovered master re-offers the pending directive (acks are
+        # volatile; the worker-side apply is idempotent by resize_id).
+        self._resize: Optional[dict] = None
+        self._next_resize_id = 0
+        self._m_resize = self.metrics_plane.registry.counter(
+            "master_resize_total",
+            "Live mesh-resize barriers begun", ["direction"],
+        )
+        self._m_resize_pending = self.metrics_plane.registry.gauge(
+            "master_resize_pending",
+            "1 while a resize barrier is awaiting worker acks",
+        )
+        self._m_resize_barrier = self.metrics_plane.registry.histogram(
+            "master_resize_barrier_seconds",
+            "Resize barrier latency: begin_resize to last worker ack",
+        )
 
     # ---- handler table -------------------------------------------------
 
@@ -81,6 +107,7 @@ class MasterServicer:
             "report_task_result": self.report_task_result,
             "report_evaluation_metrics": self.report_evaluation_metrics,
             "report_version": self.report_version,
+            "report_resize": self.report_resize,
             "ping": lambda req: {"ok": True},
         }
 
@@ -115,20 +142,26 @@ class MasterServicer:
         self._record_liveness(worker_id)
         self._ingest_metrics(worker_id, request)
         self._note_worker_generation(worker_id, request)
+        extra = {}
+        offer = self._resize_offer(worker_id)
+        if offer is not None:
+            # Piggybacked like the generation fence: WAIT responses
+            # carry it too, so an idle worker still joins the barrier.
+            extra["resize"] = offer
         task = self._task_d.get(worker_id)
         if task is not None:
             with self._lock:
                 self._task_start_times[task.task_id] = time.time()
             return {"task": task.to_dict(), "finished": False,
-                    "generation": self.generation}
+                    "generation": self.generation, **extra}
         if self._task_d.finished():
             return {"task": None, "finished": True,
-                    "generation": self.generation}
+                    "generation": self.generation, **extra}
         # Queue temporarily empty (doing tasks may re-queue on failure):
         # tell the worker to wait (reference servicer.py:60-68).
         wait = Task(task_id=-1, type=TaskType.WAIT)
         return {"task": wait.to_dict(), "finished": False,
-                "generation": self.generation}
+                "generation": self.generation, **extra}
 
     def report_task_result(self, request: dict) -> dict:
         task_id = int(request["task_id"])
@@ -210,6 +243,171 @@ class MasterServicer:
         if self._eval_service is not None:
             self._eval_service.add_evaluation_task_if_needed(version)
         return {"ok": True, "generation": self.generation}
+
+    # ---- live-resize barrier (docs/elasticity.md) ----------------------
+
+    def begin_resize(self, spec: dict, direction: str = "resize",
+                     expected_workers=None) -> int:
+        """Open a resize barrier: offer ``spec`` (parallel/reshard.py
+        ``mesh_spec`` dict) to every worker on its next get_task.
+        ``expected_workers`` seeds the barrier membership (defaults to
+        every worker the servicer has seen alive); the autoscaler tick
+        refreshes membership via ``maybe_complete_resize`` so a worker
+        killed mid-barrier cannot wedge it. Raises if a barrier is
+        already pending — resizes are serialized by design (two
+        in-flight target meshes would race on the workers)."""
+        with self._lock:
+            if self._resize is not None:
+                raise RuntimeError(
+                    f"resize {self._resize['resize_id']} is still "
+                    "pending; one barrier at a time"
+                )
+            self._next_resize_id += 1
+            resize_id = self._next_resize_id
+            if expected_workers is None:
+                expected_workers = list(self._worker_liveness)
+            expected = {int(w) for w in expected_workers}
+            self._resize = {
+                "resize_id": resize_id,
+                "spec": dict(spec),
+                "direction": str(direction),
+                "expected": expected,
+                "acks": {},
+                "t0": time.monotonic(),
+            }
+            if self._journal is not None:
+                # Inside the lock: a fast ack's done record must not
+                # land before this begin record.
+                self._journal.append(
+                    "resize", resize_id=int(resize_id), spec=dict(spec),
+                    direction=str(direction), done=False,
+                )
+            # Pending gauge set under the lock too: a worker ack on a
+            # server thread can complete the barrier the instant the
+            # lock drops, and its set(0) must not be overwritten by a
+            # late set(1) here.
+            self._m_resize_pending.set(1.0)
+        self._m_resize.labels(str(direction)).inc()
+        logger.info(
+            "resize %d (%s) begun: %s, awaiting %s",
+            resize_id, direction, spec, sorted(expected),
+        )
+        return resize_id
+
+    def rearm_resize(self, record: dict):
+        """Master-restart recovery: re-open the journaled pending
+        barrier. Acks are volatile (they died with the old master), so
+        the directive is re-offered to everyone; workers that already
+        applied it re-ack idempotently by resize_id. Membership is
+        UNKNOWN (``expected=None``) until the run-loop tick supplies
+        the live worker set — ack-driven completion is disabled so the
+        first re-ack cannot complete a fleet-wide barrier while peers
+        still await the re-offer."""
+        with self._lock:
+            resize_id = int(record["resize_id"])
+            self._next_resize_id = max(self._next_resize_id, resize_id)
+            self._resize = {
+                "resize_id": resize_id,
+                "spec": dict(record["spec"]),
+                "direction": str(record.get("direction", "resize")),
+                "expected": None,  # unknown until the tick refreshes
+                "acks": {},
+                "t0": time.monotonic(),
+            }
+            self._m_resize_pending.set(1.0)
+        logger.info("re-armed pending resize %d after master restart",
+                    resize_id)
+
+    def _resize_offer(self, worker_id: int) -> Optional[dict]:
+        with self._lock:
+            pending = self._resize
+            if pending is None or worker_id in pending["acks"]:
+                return None
+            return {"resize_id": pending["resize_id"],
+                    "spec": dict(pending["spec"])}
+
+    def report_resize(self, request: dict) -> dict:
+        """A worker finished applying (or noop-acked) a resize
+        directive. Fenced by resize_id: an ack for anything but the
+        pending barrier is rejected, so a late ack from before a master
+        restart or a superseded resize cannot complete the wrong one."""
+        worker_id = int(request.get("worker_id", -1))
+        resize_id = int(request.get("resize_id", -1))
+        self._record_liveness(worker_id)
+        self._ingest_metrics(worker_id, request)
+        self._note_worker_generation(worker_id, request)
+        with self._lock:
+            pending = self._resize
+            if pending is None or pending["resize_id"] != resize_id:
+                return {"accepted": False, "fenced": True,
+                        "generation": self.generation}
+            pending["acks"][worker_id] = str(
+                request.get("status", "applied")
+            )
+            # A worker that arrived after begin (elastic relaunch)
+            # joins the membership by acking; a re-armed barrier's
+            # membership stays unknown until the tick supplies it.
+            if pending["expected"] is not None:
+                pending["expected"].add(worker_id)
+        self.maybe_complete_resize()
+        return {"accepted": True, "generation": self.generation}
+
+    def maybe_complete_resize(self, live_workers=None) -> Optional[dict]:
+        """Complete the barrier iff every expected worker has acked.
+        Pass the CURRENT live worker set to shrink membership after a
+        mid-barrier death (the autoscaler tick / drill does); with no
+        argument the membership recorded at begin (plus late joiners)
+        decides, and a re-armed barrier (membership unknown) never
+        completes. Returns the completed barrier dict or None."""
+        with self._lock:
+            pending = self._resize
+            if pending is None:
+                return None
+            if live_workers is not None:
+                # Membership from the live fleet. An EMPTY live set
+                # completes the barrier: everyone who could apply is
+                # gone (job drained mid-barrier) — leaving it pending
+                # would wedge resize_status()/begin_resize forever.
+                expected = {int(w) for w in live_workers}
+            else:
+                expected = pending["expected"]
+                if not expected:
+                    # Begin-time membership unknown (re-armed barrier)
+                    # or empty: only the tick's live set may decide.
+                    return None
+            if expected - set(pending["acks"]):
+                return None
+            self._resize = None
+            elapsed = time.monotonic() - pending["t0"]
+            if self._journal is not None:
+                # Inside the lock, like begin: the done record must not
+                # be reorderable against a concurrent begin's record.
+                self._journal.append(
+                    "resize", resize_id=int(pending["resize_id"]),
+                    spec=dict(pending["spec"]),
+                    direction=str(pending["direction"]), done=True,
+                )
+            self._m_resize_pending.set(0.0)
+        pending["barrier_seconds"] = elapsed
+        self._m_resize_barrier.observe(elapsed)
+        logger.info(
+            "resize %d (%s) complete: %d ack(s) in %.3fs",
+            pending["resize_id"], pending["direction"],
+            len(pending["acks"]), elapsed,
+        )
+        return pending
+
+    def resize_status(self) -> Optional[dict]:
+        """Pending barrier (copy) or None — for the autoscaler tick
+        and tests."""
+        with self._lock:
+            if self._resize is None:
+                return None
+            out = dict(self._resize)
+            out["acks"] = dict(out["acks"])
+            if out["expected"] is not None:
+                out["expected"] = set(out["expected"])
+            return out
 
     # ---- liveness / straggler detection --------------------------------
 

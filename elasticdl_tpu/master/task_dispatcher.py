@@ -86,6 +86,14 @@ class TaskDispatcher:
         self._task_retry_count: Dict[str, int] = {}
         self._deferred_callbacks: List[Callable] = []
         self._worker_version: Dict[int, int] = {}
+        # Workers being drained (elastic scale-down): fenced out of
+        # dispatch so a dying pod cannot lease fresh work during its
+        # SIGTERM grace — its DELETED event is deliberately ignored by
+        # the instance manager, so a task leased post-drain would have
+        # no death event to recover it. Volatile on purpose: not
+        # journaled/exported (a fence only outlives its pod by the
+        # grace window, and replay equivalence must not depend on it).
+        self._fenced_workers = set()
         self.counters = JobCounters()
         # task_id -> (task, worker_id, requeued): the idempotent-report
         # ledger (see RESOLVED_LEDGER_SIZE above). OrderedDict as a
@@ -248,10 +256,19 @@ class TaskDispatcher:
                 sp.discard()
             return task
 
+    def fence_worker(self, worker_id: int):
+        """Stop dispatching to ``worker_id`` (drain_worker calls this
+        BEFORE deleting the pod). Its get_task polls see WAIT until the
+        pod dies."""
+        with self._lock:
+            self._fenced_workers.add(int(worker_id))
+
     def _get(self, worker_id: int) -> Optional[Task]:
         callbacks = []
         task = None
         with self._lock:
+            if worker_id in self._fenced_workers:
+                return None
             while True:
                 if not self._todo and self._epochs_pending_locked():
                     self._create_training_tasks_locked()
@@ -458,6 +475,12 @@ class TaskDispatcher:
                 and not self._doing
                 and not self._epochs_pending_locked()
             )
+
+    def queue_depths(self) -> Tuple[int, int]:
+        """(todo, doing) sizes for queue-health consumers (the
+        autoscaler's signals) — lock-free ``len`` reads, same pattern
+        as the ``master_task_queue_depth`` gauges above."""
+        return len(self._todo), len(self._doing)
 
     def doing_tasks_of(self, worker_id: int) -> List[int]:
         with self._lock:

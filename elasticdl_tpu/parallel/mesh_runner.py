@@ -46,6 +46,7 @@ class MeshRunner:
         param_rule=None,
         batch_rule=None,
         staleness_modulation: bool = False,
+        param_rule_factory=None,
     ):
         self.mesh = mesh if mesh is not None else mesh_lib.make_mesh()
         self.data_axis = data_axis
@@ -64,14 +65,48 @@ class MeshRunner:
         self.staleness_modulation = staleness_modulation
         # Auto-partition pass (reference ModelHandler 2MB rewrite,
         # model_handler.py:85-89): big embedding tables row-shard over the
-        # data axis, everything else replicates.
-        self.param_rule = (
-            param_rule
-            if param_rule is not None
-            else partition_lib.embedding_partition_rule(
-                axis=data_axis, axis_size=self.mesh.shape[data_axis]
+        # data axis, everything else replicates. Rules bake the mesh
+        # (axis sizes decide what divides), so ``resize`` needs a
+        # *factory* to re-derive them on the new mesh; a bare
+        # ``param_rule`` is kept as-is across resizes (its fit checks
+        # run against ``self.mesh`` at placement time).
+        if param_rule_factory is None and param_rule is None:
+            param_rule_factory = (
+                lambda m: partition_lib.embedding_partition_rule(
+                    axis=data_axis, axis_size=m.shape[data_axis]
+                )
             )
+        self._param_rule_factory = param_rule_factory
+        self.param_rule = (
+            param_rule_factory(self.mesh)
+            if param_rule_factory is not None else param_rule
         )
+        # Compiled-step memo keyed by (kind, loss-fn object, mesh): an
+        # autoscaler oscillates between a few mesh rungs, and a
+        # long-lived worker that has trained on a rung before must not
+        # re-trace/re-compile on returning to it — the rung's step
+        # programs stay warm for the process lifetime, making repeat
+        # resizes pay only the state movement. (Sharding derivation is
+        # deterministic per mesh, so a cached step's baked shardings
+        # match the re-derived ones structurally.) The accum path is
+        # NOT memoized: it carries a cross-call grad accumulator whose
+        # placement dies with its mesh.
+        self._step_memo = {}
+
+    def _mesh_memo_key(self):
+        return (
+            tuple(d.id for d in self.mesh.devices.flat),
+            tuple(self.mesh.axis_names),
+            tuple(self.mesh.devices.shape),
+        )
+
+    def _memoized(self, kind, fn_key, builder):
+        key = (kind, fn_key, self._mesh_memo_key())
+        step = self._step_memo.get(key)
+        if step is None:
+            step = builder()
+            self._step_memo[key] = step
+        return step
 
     # ---- sharding rules ------------------------------------------------
 
@@ -169,10 +204,47 @@ class MeshRunner:
         be committed whole to one device."""
         return jax.device_put(state, self._require_shardings())
 
+    def resize(self, new_mesh: Mesh, state=None):
+        """Checkpointless live reshard onto ``new_mesh``
+        (parallel/reshard.py): re-derive shardings with the partition
+        rules re-bound to the new mesh and move the state's shards
+        device-to-device — no disk round trip, no full host
+        materialization (host bounce only as backend fallback).
+        Returns the resharded state (or None when called pre-init,
+        which just re-targets the runner so ``init_state`` lands on
+        the new mesh).
+
+        Every compiled step this runner handed out baked the OLD
+        shardings and is dead after this call — the caller (Worker
+        resize path) must rebuild ``train_step`` / ``eval_step`` /
+        ``train_multi_step``. Call only at a step boundary; a partial
+        gradient-accumulation window does not survive (same loss as
+        checkpoint-restart, which it replaces)."""
+        from elasticdl_tpu.parallel import reshard as reshard_lib
+
+        self.mesh = new_mesh
+        if self._param_rule_factory is not None:
+            self.param_rule = self._param_rule_factory(new_mesh)
+        self._state_shardings = None
+        if state is None:
+            return None
+
+        def shardings_fn(abstract):
+            self._state_shardings = self.state_shardings(abstract)
+            return self._state_shardings
+
+        return reshard_lib.live_reshard(state, shardings_fn)
+
     def train_step(self, loss_fn: Callable) -> Callable:
         if self.accum_steps > 1:
             return self._accum_train_step(loss_fn)
-        return self._plain_train_step(loss_fn)
+        # Keyed on the function OBJECT (the memo entry pins it alive):
+        # an id() key could be recycled after gc and silently serve a
+        # step compiled for a different loss.
+        return self._memoized(
+            "train", loss_fn,
+            lambda: self._plain_train_step(loss_fn),
+        )
 
     def _plain_train_step(self, loss_fn: Callable) -> Callable:
         base_step = self._build_step(loss_fn)
@@ -329,6 +401,12 @@ class MeshRunner:
         program (core/step.build_multi_step, mesh edition — same
         default partial unroll). Only the plain (accum_steps == 1)
         path fuses — accumulation already carries cross-call state."""
+        return self._memoized(
+            ("multi", unroll), loss_fn,
+            lambda: self._build_multi_step(loss_fn, unroll),
+        )
+
+    def _build_multi_step(self, loss_fn: Callable, unroll: int):
         shardings = self._require_shardings()
         runner = self
 
@@ -379,6 +457,9 @@ class MeshRunner:
         )
 
     def eval_step(self) -> Callable:
+        return self._memoized("eval", None, self._build_eval_step)
+
+    def _build_eval_step(self) -> Callable:
         shardings = self._require_shardings()
         runner = self
 
@@ -419,19 +500,26 @@ def make_runner_for_spec(
     extras get the plain dp behavior.
     """
     mesh = mesh if mesh is not None else mesh_lib.make_mesh()
-    param_rule = None
+    param_rule_factory = None
     if getattr(spec, "param_sharding_rules", None) is not None:
-        fallback = partition_lib.embedding_partition_rule(
-            axis=data_axis, axis_size=mesh.shape[data_axis]
-        )
-        param_rule = rules_lib.regex_param_rule(
-            spec.param_sharding_rules(), mesh=mesh, fallback=fallback
-        )
+        # A factory, not a one-shot rule: live resize (MeshRunner.resize)
+        # re-derives the regex rules against the new mesh so a tp rule
+        # that fit the old mesh degrades (or re-engages) per-dim.
+        rules = spec.param_sharding_rules()
+
+        def param_rule_factory(m, rules=rules):
+            return rules_lib.regex_param_rule(
+                rules, mesh=m,
+                fallback=partition_lib.embedding_partition_rule(
+                    axis=data_axis, axis_size=m.shape[data_axis]
+                ),
+            )
+
     return MeshRunner(
         mesh=mesh,
         data_axis=data_axis,
         accum_steps=accum_steps,
-        param_rule=param_rule,
+        param_rule_factory=param_rule_factory,
         batch_rule=getattr(spec, "batch_sharding_rule", None),
         **kwargs,
     )

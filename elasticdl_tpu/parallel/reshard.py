@@ -1,0 +1,121 @@
+"""Checkpointless live resharding: move train state between meshes
+without a disk round trip.
+
+The repo's original elastic-resize path is checkpoint-restart
+(tests/test_elastic_mesh_resize.py): save sharded state to disk, tear
+the worker down, restore onto the new mesh, re-place, recompile.
+Correct, but every scale event costs seconds of dead hardware doing
+disk IO and state re-init that the accelerators never needed.
+
+This module is the live alternative (the ``match_partition_rules`` /
+``make_shard_and_gather_fns`` pattern from "Scaling with pjit on
+TPUv4", arxiv 2204.06514, adapted to our runner-owned partition
+rules): gather the current state's leaves to host memory on the OLD
+mesh, re-derive per-leaf shardings against the NEW mesh with the same
+partition rules the runner would use at init, and ``device_put`` the
+host leaves under the new shardings. Nothing touches disk; the only
+data movement is device→host→device of the state itself, and the
+sparse host tier (row service) is untouched — its rows never lived on
+the mesh.
+
+Semantics and caveats (docs/elasticity.md):
+
+- **Staleness**: the gather is a synchronization point — every leaf is
+  read after the last completed step, so the resharded state is
+  exactly the state a checkpoint at that step would have captured.
+  Callers must resize at a step boundary (the Worker does it at a
+  TASK boundary, where nothing is half-applied).
+- **Fencing**: resharding does not change ``state.step``; the master's
+  resize barrier (master/servicer.py) carries its own ``resize_id``
+  fence so a directive is applied at most once per worker.
+- **Compiled steps die with the old mesh**: every jitted function that
+  baked the old shardings must be rebuilt; ``MeshRunner.resize`` and
+  the Worker's resize path do this.
+"""
+
+from typing import Optional
+
+import jax
+import numpy as np
+
+from elasticdl_tpu.common.log_utils import get_logger
+
+logger = get_logger("reshard")
+
+
+def gather_to_host(state):
+    """Materialize every leaf of ``state`` (params + optimizer state +
+    step + batch_stats + rng — whatever the pytree holds) as host
+    numpy arrays, fully assembled across the old mesh's shards.
+
+    ``jax.device_get`` on a sharded array performs the cross-device
+    gather; the result carries no sharding, so it can be re-placed
+    under any mesh."""
+    return jax.device_get(state)
+
+
+def reshard_state(state, shardings):
+    """Place (host or device) state under ``shardings`` — the pytree
+    of NamedShardings for the NEW mesh. The one-call form of the
+    checkpoint path's restore + ``place_state``, minus the disk."""
+    return jax.device_put(state, shardings)
+
+
+def abstract_like(state):
+    """ShapeDtypeStruct pytree of ``state`` — the input the runners'
+    ``state_shardings`` derivations expect (same trick as
+    ``MeshRunner.init_state``'s eval_shape pass)."""
+    return jax.eval_shape(lambda s: s, state)
+
+
+def live_reshard(state, shardings_fn):
+    """Derive shardings for ``state``'s abstract shape via
+    ``shardings_fn`` (a runner's ``state_shardings``, already re-bound
+    to the new mesh) and re-place. Returns the resharded state.
+
+    Fast path: ``device_put`` straight from the old mesh's arrays to
+    the new shardings — the runtime moves shards device-to-device
+    (ICI-speed on TPU; shared-memory copies on the CPU test mesh)
+    without materializing the whole state on host. If the backend
+    rejects the cross-mesh transfer, fall back to the explicit
+    host bounce (gather → put), which is always legal."""
+    shardings = shardings_fn(abstract_like(state))
+    try:
+        return reshard_state(state, shardings)
+    except Exception as exc:  # pragma: no cover - backend-dependent
+        logger.warning(
+            "direct cross-mesh device_put failed (%s); falling back "
+            "to the host-bounce reshard", exc,
+        )
+        return reshard_state(gather_to_host(state), shardings)
+
+
+def mesh_spec(mesh) -> dict:
+    """Serializable description of a mesh for the resize directive
+    (master/servicer.py resize barrier): shape + axis names. The
+    receiving worker rebuilds it over its own ``jax.devices()``
+    prefix — device *identities* are process-local and never cross
+    the wire."""
+    return {
+        "shape": [int(s) for s in mesh.devices.shape],
+        "axes": [str(a) for a in mesh.axis_names],
+    }
+
+
+def mesh_from_spec(spec: dict, devices: Optional[list] = None):
+    """Build the directive's mesh on this process. ``spec`` is the
+    ``mesh_spec`` dict; uses the first prod(shape) local devices
+    unless an explicit device list is given."""
+    from elasticdl_tpu.parallel.mesh import make_mesh
+
+    shape = tuple(int(s) for s in spec["shape"])
+    axes = tuple(str(a) for a in spec["axes"])
+    need = int(np.prod(shape))
+    if devices is None:
+        devices = jax.devices()
+    if len(devices) < need:
+        raise ValueError(
+            f"resize directive needs {need} device(s) "
+            f"({shape} over {axes}); only {len(devices)} available"
+        )
+    return make_mesh(shape, axes, devices=devices[:need])

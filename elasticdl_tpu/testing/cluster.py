@@ -314,6 +314,16 @@ class MiniCluster:
                 client.rebind(self.servicer)
         return stats
 
+    def begin_resize(self, mesh, direction: str = "resize") -> int:
+        """Open a live-resize barrier offering ``mesh`` to every
+        worker (master/servicer.py; applied checkpointlessly via
+        parallel/reshard.py at each worker's next task boundary)."""
+        from elasticdl_tpu.parallel import reshard
+
+        return self.servicer.begin_resize(
+            reshard.mesh_spec(mesh), direction=direction
+        )
+
     def run(self) -> List[dict]:
         """Run all workers (threads if >1) to completion."""
         results = [None] * len(self.workers)

@@ -32,6 +32,8 @@ class InProcessMaster:
         self._worker_id = worker_id
         self._callbacks = callbacks or {}
         self.last_generation = -1
+        # Resize-directive passthrough, same contract as MasterClient.
+        self.pending_resize = None
 
     def rebind(self, servicer):
         """Point at a recovered master (chaos master-kill restart seam
@@ -68,6 +70,7 @@ class InProcessMaster:
         if metrics:
             request["metrics"] = metrics
         resp = self._call("get_task", request)
+        self.pending_resize = resp.get("resize")
         task = Task.from_dict(resp["task"]) if resp.get("task") else None
         return task, bool(resp.get("finished"))
 
@@ -104,6 +107,20 @@ class InProcessMaster:
         if metrics:
             request["metrics"] = metrics
         self._call("report_version", request)
+
+    def report_resize(self, resize_id: int,
+                      status: str = "applied") -> bool:
+        resp = self._call(
+            "report_resize",
+            {
+                "worker_id": self._worker_id,
+                "resize_id": int(resize_id),
+                "status": str(status),
+                "generation": self.last_generation,
+            },
+        )
+        self.pending_resize = None
+        return bool(resp.get("accepted"))
 
     def close(self):
         pass

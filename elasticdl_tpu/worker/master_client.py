@@ -34,6 +34,11 @@ class MasterClient:
         # incarnation that dispatched their task. -1 = never attached.
         # Survives reconnect() — the fence outlives any one channel.
         self.last_generation = -1
+        # Live-resize directive piggybacked on get_task responses
+        # (master/servicer.py resize barrier): the worker applies it at
+        # the next task boundary and acks via report_resize. Tracks the
+        # LATEST offer; absent from a response = none pending for us.
+        self.pending_resize = None
 
     def reconnect(self):
         """Drop the channel and build a fresh one to the same address
@@ -68,6 +73,7 @@ class MasterClient:
             fields["metrics"] = metrics
         resp = self._stub.call("get_task", **fields)
         self._note_generation(resp)
+        self.pending_resize = resp.get("resize")
         task = Task.from_dict(resp["task"]) if resp.get("task") else None
         return task, bool(resp.get("finished"))
 
@@ -107,6 +113,20 @@ class MasterClient:
         if metrics:
             fields["metrics"] = metrics
         self._stub.call("report_version", **fields)
+
+    def report_resize(self, resize_id: int,
+                      status: str = "applied") -> bool:
+        """Ack a resize directive (the barrier's worker side)."""
+        resp = self._stub.call(
+            "report_resize",
+            worker_id=self._worker_id,
+            resize_id=int(resize_id),
+            status=str(status),
+            generation=self.last_generation,
+        )
+        self._note_generation(resp)
+        self.pending_resize = None
+        return bool(resp.get("accepted"))
 
     def close(self):
         self._stub.close()

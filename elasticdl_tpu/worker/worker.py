@@ -181,6 +181,28 @@ class Worker:
             "worker_step_seconds",
             "Device step latency (host-observed)", ["kind"],
         )
+        # Saturation signal for the autoscaler (master/autoscaler.py):
+        # device-step seconds / wall seconds over each report window.
+        # ~1.0 = the device never waits (scaling up helps); ~0 = the
+        # worker is starved or idle (scaling down is safe).
+        self._m_step_util = self._metrics.gauge(
+            "worker_step_utilization",
+            "Device-step seconds / wall seconds over the report window",
+        )
+        self._util_step_secs = 0.0
+        self._util_window_t0 = time.monotonic()
+        # Live-resize support (docs/elasticity.md): the master's resize
+        # barrier piggybacks a directive on get_task; it is applied at
+        # a TASK boundary (nothing half-consumed, no device buffers in
+        # flight) and acked via report_resize. Idempotent by id — a
+        # recovered master may re-offer the one we already applied.
+        self._applied_resize_id = -1
+        self._in_task = False
+        self._resizing = False
+        self._m_resize = self._metrics.histogram(
+            "worker_resize_seconds",
+            "Live reshard latency: gather + re-place + step rebuild",
+        )
         self._m_examples = self._metrics.counter(
             "worker_examples_total",
             "Examples processed", ["task_type"],
@@ -279,6 +301,12 @@ class Worker:
 
     # ---- telemetry ------------------------------------------------------
 
+    def _observe_step(self, kind: str, seconds: float):
+        """Step-latency histogram + the utilization accumulator the
+        report-window gauge derives from."""
+        self._m_step.labels(kind).observe(seconds)
+        self._util_step_secs += seconds
+
     def _metrics_snapshot(self) -> Optional[dict]:
         """Registry snapshot for piggybacking, rate-limited to one per
         metrics_report_secs; None between reports. When a flight
@@ -294,6 +322,20 @@ class Worker:
         if now - self._last_metrics_report < self._metrics_report_secs:
             return None
         self._last_metrics_report = now
+        # Step utilization over the window just closing: device-step
+        # seconds since the last snapshot divided by the wall time the
+        # window spanned (clamped — host-observed step time can exceed
+        # a tiny window by scheduling noise). Sub-50ms windows (back-
+        # to-back RPCs, e.g. report then finished-poll) don't close:
+        # a degenerate window would zero the gauge the autoscaler
+        # reads; keep accumulating and let it hold its last value.
+        window = now - self._util_window_t0
+        if window >= 0.05:
+            self._m_step_util.set(
+                min(1.0, self._util_step_secs / window)
+            )
+            self._util_step_secs = 0.0
+            self._util_window_t0 = now
         snapshot = self._metrics.snapshot()
         spans, self._trace_cursor_offered = tracing.spans_since(
             self._trace_cursor
@@ -383,6 +425,113 @@ class Worker:
             return int(np.sum(np.asarray(mask) > 0))
         return self._minibatch_size
 
+    # ---- live resize (docs/elasticity.md) ------------------------------
+
+    def _maybe_apply_resize(self):
+        """Apply a pending resize directive, if any. Called only at
+        safe points — between tasks and while WAITing — so no task is
+        half-consumed and no prefetch/prepared iterator holds device
+        buffers on the dying mesh. A partial gradient-accumulation
+        window does not survive (same loss as the checkpoint-restart
+        path this replaces)."""
+        directive = getattr(self._master, "pending_resize", None)
+        ack = getattr(self._master, "report_resize", None)
+        if not directive or ack is None or self._resizing:
+            return
+        # Reentrancy guard: the ack rides _master_call, whose ride-out
+        # ticks _wait_tick — which checks for pending resizes.
+        self._resizing = True
+        try:
+            self._apply_resize(directive, ack)
+        finally:
+            self._resizing = False
+
+    def _apply_resize(self, directive, ack):
+        resize_id = int(directive.get("resize_id", -1))
+
+        def send_ack(status):
+            self._master_call(
+                lambda: ack(resize_id, status),
+                f"report_resize({resize_id})",
+            )
+
+        if resize_id == self._applied_resize_id:
+            # Re-offered (a recovered master's acks are volatile) —
+            # the local apply already happened; just re-join the
+            # barrier.
+            send_ack("applied")
+            return
+        runner = self._step_runner
+        if (
+            runner is None
+            or not hasattr(runner, "resize")
+            or self._multihost_sync
+        ):
+            # Nothing mesh-resident to reshard: plain-jit and host-tier
+            # runners keep dense state on one device and sparse rows in
+            # the row service; multi-host jobs resize by gang restart.
+            # Join the barrier as a no-op so it cannot hang on us.
+            self._applied_resize_id = resize_id
+            send_ack("noop")
+            return
+        from elasticdl_tpu.parallel import reshard as reshard_lib
+
+        t0 = time.monotonic()
+        try:
+            with self._tracer.span("resize", resize_id=resize_id):
+                new_mesh = reshard_lib.mesh_from_spec(directive["spec"])
+                # Mesh-aware model defs re-bake against the new mesh
+                # (sharding constraints name its axes); params are
+                # untouched, only apply_fn follows the rebuilt module.
+                # Re-bind BEFORE resharding: the shardings pytree the
+                # runner derives carries the state's static metadata,
+                # and the state fed to the rebuilt step must match it.
+                make_model = getattr(self._spec, "make_model", None)
+                if make_model is not None:
+                    self._spec.model = make_model(new_mesh)
+                    if self.state is not None and hasattr(
+                        self.state, "apply_fn"
+                    ):
+                        self.state = self.state.replace(
+                            apply_fn=self._spec.model.apply
+                        )
+                state = runner.resize(new_mesh, self.state)
+                if state is not None:
+                    self.state = state
+                    # Every compiled step baked the old shardings.
+                    self._m_compiles.inc()
+                    self._train_step = runner.train_step(self._spec.loss)
+                    self._eval_step = runner.eval_step()
+                    if self._multi_step is not None and hasattr(
+                        runner, "train_multi_step"
+                    ):
+                        self._multi_step = runner.train_multi_step(
+                            self._spec.loss
+                        )
+        except Exception as exc:
+            # A failed apply must not wedge the fleet's barrier: ack
+            # with status "failed" (the autoscaler sees it in the ack
+            # statuses) and keep training on the old mesh.
+            # _applied_resize_id is deliberately NOT recorded: if a
+            # recovered master re-offers this directive, the worker
+            # retries the apply (the failure may have been transient)
+            # instead of short-circuiting with a false "applied".
+            logger.error(
+                "resize %d failed; staying on the current mesh: %s\n%s",
+                resize_id, exc, traceback.format_exc(),
+            )
+            send_ack("failed")
+            return
+        elapsed = time.monotonic() - t0
+        self._m_resize.observe(elapsed)
+        self._applied_resize_id = resize_id
+        logger.info(
+            "live reshard %d applied in %.3fs (mesh %s, state %s)",
+            resize_id, elapsed, directive["spec"],
+            "resharded" if self.state is not None else "pre-init",
+        )
+        send_ack("applied")
+
     # ---- task processing ----------------------------------------------
 
     def _wait_tick(self, wait_secs: float = 2.0):
@@ -398,6 +547,12 @@ class Worker:
             # Idle worker: nothing to hand back; exit the task loop
             # (the post-loop path checkpoints whatever was trained).
             raise WorkerStopped()
+        if not self._in_task and not self._resizing:
+            # An idle worker must still join a resize barrier (WAIT
+            # responses carry the directive); mid-task ticks (report
+            # ride-out during processing) skip — resize only lands at
+            # task boundaries.
+            self._maybe_apply_resize()
         if (
             self._multihost_sync
             and self.state is not None
@@ -540,9 +695,7 @@ class Worker:
                                 self._process_train_batch(batch)
                         else:
                             self._process_train_batch(batch)
-                self._m_step.labels("train").observe(
-                    time.monotonic() - step_t0
-                )
+                self._observe_step("train", time.monotonic() - step_t0)
                 self._m_examples.labels(task.type).inc(
                     self._batch_examples(raw)
                 )
@@ -624,9 +777,7 @@ class Worker:
                         f"{MAX_MINIBATCH_RETRY_NUM} retries"
                     )
         self.last_metrics = {"loss": metrics["loss"][-1]}
-        self._m_step.labels("train_fused").observe(
-            time.monotonic() - step_t0
-        )
+        self._observe_step("train_fused", time.monotonic() - step_t0)
         self._m_examples.labels(TaskType.TRAINING).inc(
             sum(self._batch_examples(b) for b in batch_list)
         )
@@ -701,7 +852,7 @@ class Worker:
             step_t0 = time.monotonic()
             with self._tracer.span("device_step", kind="eval"):
                 preds = self._eval_step(self.state, batch)
-            self._m_step.labels("eval").observe(time.monotonic() - step_t0)
+            self._observe_step("eval", time.monotonic() - step_t0)
             real = int(np.sum(batch["mask"]))
             self._m_examples.labels(task.type).inc(real)
             self._m_h2d_bytes.inc(self._batch_nbytes(batch))
@@ -730,9 +881,7 @@ class Worker:
             step_t0 = time.monotonic()
             with self._tracer.span("device_step", kind="predict"):
                 preds = self._eval_step(self.state, batch)
-            self._m_step.labels("predict").observe(
-                time.monotonic() - step_t0
-            )
+            self._observe_step("predict", time.monotonic() - step_t0)
             real = int(np.sum(batch["mask"]))
             self._m_examples.labels(task.type).inc(real)
             self._m_h2d_bytes.inc(self._batch_nbytes(batch))
@@ -772,6 +921,12 @@ class Worker:
             trained_batches = self._task_loop()
         except WorkerStopped:
             logger.info("stop requested while idle; exiting task loop")
+        if not self._stop_requested:
+            # A directive that arrived WITH the finished response would
+            # otherwise never be acked (the task loop is over): apply
+            # it now — the state sits at a boundary, and the final
+            # checkpoint below then reflects the target mesh.
+            self._maybe_apply_resize()
         # Multi-host: save_final is a coordinated write — EVERY process
         # must join whenever peers do (even one that trained 0 batches:
         # it stepped the shared state via dummy ticks). Only a stopping
@@ -800,6 +955,10 @@ class Worker:
     def _task_loop(self) -> int:
         trained_batches = 0
         for task, batches in self._task_data.task_stream():
+            # Task boundary: the safe point to apply a pending resize
+            # directive (the task just pulled has consumed nothing and
+            # trains on the NEW mesh).
+            self._maybe_apply_resize()
             if task.type == TaskType.TRAIN_END_CALLBACK:
                 # Count the callback outcome once: a task whose report
                 # RPC fails after the callback succeeded must not land
@@ -856,6 +1015,7 @@ class Worker:
             # "ok" task (the except below re-reports it, and without
             # the flag it would land in both series).
             processed_ok = False
+            self._in_task = True
             try:
                 with self._timing.record("task_process"):
                     if task.type == TaskType.TRAINING:
@@ -867,9 +1027,11 @@ class Worker:
                     elif task.type == TaskType.PREDICTION:
                         self._process_predict_task(task, batches)
                 processed_ok = True
+                self._in_task = False
                 self._m_tasks.labels(task.type, "ok").inc()
                 self._report_task(task.task_id)
             except Exception as exc:
+                self._in_task = False
                 if self._multihost_sync:
                     # A failed step after winning a barrier tick leaves
                     # peers inside a collective we never joined —

@@ -1,0 +1,579 @@
+"""Closed-loop elastic autoscaling (ISSUE 8).
+
+Layers, bottom-up:
+- live reshard (parallel/reshard.py + MeshRunner.resize): state moves
+  between meshes checkpointlessly, values exact, per-rung compiled
+  steps memoized;
+- the resize barrier protocol (master/servicer.py): offer on get_task,
+  idempotent acks fenced by resize_id, membership refresh on worker
+  death, journal survival across a master crash;
+- InstanceManager scale-up/drain (the satellite: draining must not
+  trip the dead-worker relaunch path and must re-queue in-flight work
+  exactly once);
+- the Autoscaler policy loop (hysteresis, cooldown, bounds, vetoes);
+- the end-to-end drill (fast-lane twin of ``make autoscale-smoke``).
+"""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from elasticdl_tpu.master.autoscaler import (
+    AutoscalePolicy,
+    Autoscaler,
+    AutoscaleSignals,
+    utilization_from_snapshots,
+)
+from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+from elasticdl_tpu.parallel import reshard
+from elasticdl_tpu.parallel.mesh import make_mesh
+from elasticdl_tpu.parallel.mesh_runner import MeshRunner
+from elasticdl_tpu.testing.cluster import MiniCluster
+from elasticdl_tpu.testing.data import (
+    create_mnist_record_file,
+    model_zoo_dir,
+)
+
+MODEL_DEF = "mnist.mnist_functional.custom_model"
+
+
+def _mesh(n):
+    return make_mesh((n,), ("dp",), devices=jax.devices()[:n])
+
+
+# --------------------------------------------------------------- reshard
+
+
+class TestLiveReshard:
+    def _runner_and_state(self, n):
+        import flax.linen as nn
+        import optax
+
+        class Tiny(nn.Module):
+            @nn.compact
+            def __call__(self, x, training=False):
+                return nn.Dense(1)(nn.relu(nn.Dense(16)(x)))[..., 0]
+
+        rng = np.random.RandomState(0)
+        batch = {
+            "features": rng.rand(8, 4).astype(np.float32),
+            "labels": rng.rand(8).astype(np.float32),
+            "mask": np.ones((8,), np.float32),
+        }
+        runner = MeshRunner(mesh=_mesh(n))
+        state = runner.init_state(
+            Tiny(), optax.sgd(0.1, momentum=0.9), batch, seed=0
+        )
+        return runner, state, batch
+
+    def test_resize_preserves_values_and_moves_mesh(self):
+        runner, state, _batch = self._runner_and_state(4)
+        before = jax.device_get(state.params)
+        state = runner.resize(_mesh(2), state)
+        leaf = jax.tree_util.tree_leaves(state.params)[0]
+        assert dict(leaf.sharding.mesh.shape) == {"dp": 2}
+        # Optimizer state (ZeRO-sharded) moved too.
+        opt_leaf = jax.tree_util.tree_leaves(state.opt_state)[0]
+        assert dict(opt_leaf.sharding.mesh.shape) == {"dp": 2}
+        after = jax.device_get(state.params)
+        for a, b in zip(
+            jax.tree_util.tree_leaves(before),
+            jax.tree_util.tree_leaves(after),
+        ):
+            np.testing.assert_array_equal(a, b)
+
+    def test_resize_pre_init_retargets_runner(self):
+        runner, _state, _batch = self._runner_and_state(4)
+        fresh = MeshRunner(mesh=_mesh(4))
+        assert fresh.resize(_mesh(2), None) is None
+        assert dict(fresh.mesh.shape) == {"dp": 2}
+
+    def test_trajectory_equivalent_across_round_trip(self):
+        """dp4 -> dp2 -> dp4 live, vs an unresized dp4 control: same
+        per-step losses and final params (fp32 toy model — no bf16
+        reduction-noise amplification)."""
+
+        def loss_fn(labels, preds, mask):
+            import jax.numpy as jnp
+
+            per = (preds - labels) ** 2
+            return (per * mask).sum() / jnp.maximum(mask.sum(), 1)
+
+        def batches(n):
+            out = []
+            for s in range(n):
+                r = np.random.RandomState(100 + s)
+                out.append({
+                    "features": r.rand(8, 4).astype(np.float32),
+                    "labels": r.rand(8).astype(np.float32),
+                    "mask": np.ones((8,), np.float32),
+                })
+            return out
+
+        data = batches(6)
+        runner, state, _b = self._runner_and_state(4)
+        step = runner.train_step(loss_fn)
+        control = []
+        for b in data:
+            state, m = step(state, b)
+            control.append(float(m["loss"]))
+        control_params = jax.device_get(state.params)
+
+        runner2, state2, _b = self._runner_and_state(4)
+        step2 = runner2.train_step(loss_fn)
+        losses = []
+        for b in data[:2]:
+            state2, m = step2(state2, b)
+            losses.append(float(m["loss"]))
+        state2 = runner2.resize(_mesh(2), state2)
+        step2 = runner2.train_step(loss_fn)
+        for b in data[2:4]:
+            state2, m = step2(state2, b)
+            losses.append(float(m["loss"]))
+        state2 = runner2.resize(_mesh(4), state2)
+        step2 = runner2.train_step(loss_fn)
+        for b in data[4:]:
+            state2, m = step2(state2, b)
+            losses.append(float(m["loss"]))
+        np.testing.assert_allclose(losses, control, rtol=1e-5,
+                                   atol=1e-6)
+        for a, b in zip(
+            jax.tree_util.tree_leaves(control_params),
+            jax.tree_util.tree_leaves(jax.device_get(state2.params)),
+        ):
+            np.testing.assert_allclose(b, a, rtol=1e-5, atol=1e-6)
+
+    def test_step_memo_reused_on_return_to_known_mesh(self):
+        """An oscillating autoscaler must not recompile: returning to
+        a previously-trained mesh rung reuses the memoized step."""
+
+        def loss_fn(labels, preds, mask):
+            import jax.numpy as jnp
+
+            per = (preds - labels) ** 2
+            return (per * mask).sum() / jnp.maximum(mask.sum(), 1)
+
+        runner, state, batch = self._runner_and_state(4)
+        step4 = runner.train_step(loss_fn)
+        state, _ = step4(state, batch)
+        state = runner.resize(_mesh(2), state)
+        step2 = runner.train_step(loss_fn)
+        assert step2 is not step4
+        state, _ = step2(state, batch)
+        state = runner.resize(_mesh(4), state)
+        assert runner.train_step(loss_fn) is step4
+
+    def test_mesh_spec_round_trip(self):
+        mesh = _mesh(4)
+        spec = reshard.mesh_spec(mesh)
+        assert spec == {"shape": [4], "axes": ["dp"]}
+        rebuilt = reshard.mesh_from_spec(spec)
+        assert dict(rebuilt.shape) == {"dp": 4}
+
+    def test_mesh_from_spec_rejects_oversized(self):
+        with pytest.raises(ValueError, match="device"):
+            reshard.mesh_from_spec(
+                {"shape": [len(jax.devices()) + 1], "axes": ["dp"]}
+            )
+
+
+# ------------------------------------------------------- resize barrier
+
+
+def _servicer(records=64):
+    from elasticdl_tpu.master.servicer import MasterServicer
+
+    dispatcher = TaskDispatcher(
+        training_shards={"f": (0, records)}, records_per_task=16,
+        shuffle=False,
+    )
+    return MasterServicer(dispatcher), dispatcher
+
+
+class TestResizeBarrier:
+    SPEC = {"shape": [2], "axes": ["dp"]}
+
+    def test_offer_ack_complete(self):
+        servicer, _d = _servicer()
+        rid = servicer.begin_resize(self.SPEC, direction="shrink",
+                                    expected_workers=[0, 1])
+        resp = servicer.get_task({"worker_id": 0})
+        assert resp["resize"] == {"resize_id": rid, "spec": self.SPEC}
+        ack = servicer.report_resize(
+            {"worker_id": 0, "resize_id": rid, "status": "applied"}
+        )
+        assert ack["accepted"]
+        # Acked worker no longer sees the offer; barrier still pending
+        # on worker 1.
+        assert "resize" not in servicer.get_task({"worker_id": 0})
+        assert servicer.resize_status() is not None
+        servicer.report_resize({"worker_id": 1, "resize_id": rid})
+        assert servicer.resize_status() is None
+
+    def test_stale_ack_is_fenced(self):
+        servicer, _d = _servicer()
+        rid = servicer.begin_resize(self.SPEC, expected_workers=[0])
+        stale = servicer.report_resize(
+            {"worker_id": 0, "resize_id": rid - 1}
+        )
+        assert not stale["accepted"] and stale["fenced"]
+        assert servicer.resize_status() is not None
+
+    def test_second_begin_while_pending_raises(self):
+        servicer, _d = _servicer()
+        servicer.begin_resize(self.SPEC, expected_workers=[0])
+        with pytest.raises(RuntimeError, match="pending"):
+            servicer.begin_resize(self.SPEC, expected_workers=[0])
+
+    def test_membership_refresh_unwedges_dead_worker(self):
+        """Worker 0 dies mid-barrier; its replacement (id 2) acks; the
+        tick passes the live set and the barrier completes without 0."""
+        servicer, _d = _servicer()
+        rid = servicer.begin_resize(self.SPEC, expected_workers=[0, 1])
+        servicer.report_resize({"worker_id": 1, "resize_id": rid})
+        servicer.report_resize({"worker_id": 2, "resize_id": rid})
+        assert servicer.resize_status() is not None  # still awaits 0
+        done = servicer.maybe_complete_resize([1, 2])
+        assert done is not None and done["resize_id"] == rid
+        assert servicer.resize_status() is None
+
+    def test_empty_live_set_completes_drained_barrier(self):
+        """A barrier whose whole fleet departed (job drained) must
+        complete when the tick reports an empty live set — leaving it
+        pending would wedge begin_resize forever — while the no-arg
+        form stays conservative."""
+        servicer, _d = _servicer()
+        servicer.begin_resize(self.SPEC, expected_workers=[0])
+        assert servicer.maybe_complete_resize() is None
+        assert servicer.maybe_complete_resize([]) is not None
+        assert servicer.resize_status() is None
+
+    def test_rearm_reoffers_with_fresh_acks(self):
+        servicer, _d = _servicer()
+        rid = servicer.begin_resize(self.SPEC, expected_workers=[0])
+        record = {"resize_id": rid, "spec": self.SPEC,
+                  "direction": "shrink"}
+        fresh, _d2 = _servicer()
+        fresh.rearm_resize(record)
+        offer = fresh.get_task({"worker_id": 0}).get("resize")
+        assert offer == {"resize_id": rid, "spec": self.SPEC}
+        # Post-crash membership is UNKNOWN: the first re-ack must NOT
+        # complete a fleet-wide barrier while peers still await the
+        # re-offer — only the tick's live set may decide.
+        fresh.report_resize({"worker_id": 0, "resize_id": rid})
+        assert fresh.resize_status() is not None
+        assert fresh.maybe_complete_resize() is None
+        assert fresh.maybe_complete_resize([0, 1]) is None  # 1 missing
+        assert fresh.maybe_complete_resize([0]) is not None
+        assert fresh.resize_status() is None
+        # A later begin on the re-armed servicer keeps ids monotonic.
+        assert fresh.begin_resize(self.SPEC, expected_workers=[0]) > rid
+
+
+class TestResizeJournal:
+    def test_pending_resize_survives_master_restart(self, tmp_path):
+        train = create_mnist_record_file(
+            str(tmp_path / "t.rec"), 64, seed=3
+        )
+        cluster = MiniCluster(
+            model_zoo=model_zoo_dir(),
+            model_def=MODEL_DEF,
+            training_data=train,
+            minibatch_size=16,
+            num_minibatches_per_task=2,
+            journal_dir=str(tmp_path / "journal"),
+        )
+        rid = cluster.servicer.begin_resize(
+            {"shape": [2], "axes": ["dp"]}, direction="shrink",
+            expected_workers=[0],
+        )
+        cluster.restart_master()
+        pending = cluster.servicer.resize_status()
+        assert pending is not None and pending["resize_id"] == rid
+        # The recovered master re-offers; a (re-)ack completes it and
+        # journals done — a second restart sees nothing pending.
+        cluster.servicer.report_resize(
+            {"worker_id": 0, "resize_id": rid}
+        )
+        assert cluster.servicer.maybe_complete_resize([0]) is not None
+        cluster.restart_master()
+        assert cluster.servicer.resize_status() is None
+        cluster.stop()
+
+
+# --------------------------------------------------- instance manager
+
+
+class TestInstanceManagerScaling:
+    def _manager(self, dispatcher, n=2):
+        from tests.test_platform_k8s import FakeK8sClient
+
+        from elasticdl_tpu.master.instance_manager import InstanceManager
+
+        client = FakeK8sClient()
+        mgr = InstanceManager(
+            dispatcher, client, job_name="j", image_name="img",
+            worker_command=lambda wid: ["run", str(wid)],
+            num_workers=n,
+        )
+        return mgr, client
+
+    def test_scale_up_fresh_ids(self):
+        disp = TaskDispatcher(training_shards={"f": (0, 64)},
+                              records_per_task=16, shuffle=False)
+        mgr, client = self._manager(disp)
+        mgr.start_workers()
+        new_ids = mgr.scale_up(2)
+        assert new_ids == [2, 3]
+        assert set(mgr.live_workers) == {0, 1, 2, 3}
+        assert len(client.created) == 4
+
+    def test_drain_removes_without_relaunch_and_requeues_once(self):
+        """The scale-down satellite: draining a worker removes it from
+        live_workers WITHOUT tripping the dead-worker relaunch, and its
+        in-flight task re-queues exactly once."""
+        from tests.test_platform_k8s import _dead_event
+
+        disp = TaskDispatcher(training_shards={"f": (0, 64)},
+                              records_per_task=16, shuffle=False)
+        mgr, client = self._manager(disp)
+        mgr.start_workers()
+        leased = disp.get(worker_id=1)
+        assert leased is not None
+        requeues_before = disp._m_requeued.labels().value
+        assert mgr.drain_worker(1)
+        assert set(mgr.live_workers) == {0}
+        # The dying pod keeps polling through its SIGTERM grace but is
+        # fenced out of dispatch — a post-drain lease would have no
+        # death event to recover it.
+        assert disp.get(worker_id=1) is None
+        # No replacement pod was created (2 initial workers only).
+        assert len(client.created) == 2
+        # The in-flight task re-queued exactly once...
+        assert disp._m_requeued.labels().value == requeues_before + 1
+        assert disp.doing_tasks_of(1) == []
+        redispatched = disp.get(worker_id=0)
+        assert (redispatched.shard_name, redispatched.start) == (
+            leased.shard_name, leased.start,
+        )
+        # ...and the drained pod's own DELETED watch event (k8s
+        # deletion is async) neither relaunches nor re-queues again.
+        mgr._event_cb(_dead_event("j", 1))
+        assert set(mgr.live_workers) == {0}
+        assert len(client.created) == 2
+        assert disp._m_requeued.labels().value == requeues_before + 1
+        # The worker's own late report of the drained task is answered
+        # from the resolved ledger — no double-count.
+        task, _w, _r, duplicate = disp.apply_report(
+            leased.task_id, True
+        )
+        assert duplicate
+
+    def test_drain_unknown_worker_is_noop(self):
+        disp = TaskDispatcher(training_shards={"f": (0, 64)},
+                              records_per_task=16, shuffle=False)
+        mgr, _client = self._manager(disp)
+        mgr.start_workers()
+        assert not mgr.drain_worker(7)
+        assert set(mgr.live_workers) == {0, 1}
+
+
+# --------------------------------------------------------- policy loop
+
+
+class TestAutoscalerPolicy:
+    def _signals(self, **kw):
+        base = dict(queue_depth=0, doing=0, live_workers=2,
+                    step_utilization=0.5)
+        base.update(kw)
+        return AutoscaleSignals(**base)
+
+    def test_direction_rules(self):
+        p = AutoscalePolicy(min_workers=1, max_workers=4)
+        up = self._signals(queue_depth=10, step_utilization=0.9)
+        assert p.direction(up) == "up"
+        # Backlog but starved fleet: input-bound, more workers no help.
+        assert p.direction(self._signals(
+            queue_depth=10, step_utilization=0.1
+        )) == "hold"
+        # Fetch-dominated p99 vetoes too.
+        assert p.direction(self._signals(
+            queue_depth=10, step_utilization=0.9,
+            p99_dominant_phase="fetch",
+        )) == "hold"
+        assert p.direction(self._signals(
+            queue_depth=0, step_utilization=0.1
+        )) == "down"
+        # Bounds.
+        assert p.direction(self._signals(
+            queue_depth=10, step_utilization=0.9, live_workers=4
+        )) == "hold"
+        assert p.direction(self._signals(
+            queue_depth=0, step_utilization=0.1, live_workers=1
+        )) == "hold"
+        # A pending barrier holds everything.
+        assert p.direction(self._signals(
+            queue_depth=10, step_utilization=0.9, resize_pending=True
+        )) == "hold"
+        # No utilization signal yet: scale-down never fires blind.
+        assert p.direction(self._signals(
+            queue_depth=0, step_utilization=None
+        )) == "hold"
+
+    def test_hysteresis_cooldown_and_streak_reset(self):
+        clock = {"t": 0.0}
+        decisions = []
+        signals = {"s": self._signals(queue_depth=10,
+                                      step_utilization=0.9)}
+        scaler = Autoscaler(
+            AutoscalePolicy(hysteresis_ticks=3, cooldown_secs=60.0,
+                            max_workers=8),
+            lambda: signals["s"],
+            scale_up=lambda s: decisions.append("up"),
+            scale_down=lambda s: decisions.append("down"),
+            clock=lambda: clock["t"],
+        )
+        assert scaler.tick() is None      # streak 1
+        assert scaler.tick() is None      # streak 2
+        assert scaler.tick() == "up"      # streak 3: fires
+        assert decisions == ["up"]
+        # Cooldown: three more agreeing ticks do nothing inside 60s.
+        for _ in range(3):
+            clock["t"] += 1.0
+            scaler.tick()
+        assert decisions == ["up"]
+        # A HOLD tick resets the streak — after cooldown a fresh
+        # hysteresis window is required.
+        clock["t"] += 120.0
+        signals["s"] = self._signals()    # hold
+        assert scaler.tick() is None
+        signals["s"] = self._signals(queue_depth=10,
+                                     step_utilization=0.9)
+        assert scaler.tick() is None
+        assert scaler.tick() is None
+        assert scaler.tick() == "up"
+        assert decisions == ["up", "up"]
+
+    def test_utilization_from_snapshots(self):
+        assert utilization_from_snapshots({}) is None
+        snaps = {
+            0: {"families": [{
+                "name": "edl_tpu_worker_step_utilization",
+                "kind": "gauge",
+                "series": [{"value": 0.8}],
+            }]},
+            1: {"families": [{
+                "name": "edl_tpu_worker_step_utilization",
+                "kind": "gauge",
+                "series": [{"value": 0.4}],
+            }]},
+        }
+        assert utilization_from_snapshots(snaps) == pytest.approx(0.6)
+
+
+# ------------------------------------------------------------- end-to-end
+
+
+@pytest.fixture
+def mnist_train(tmp_path):
+    return create_mnist_record_file(str(tmp_path / "t.rec"), 192,
+                                    seed=3)
+
+
+def test_worker_applies_resize_at_task_boundary(mnist_train):
+    """Full protocol through MiniCluster: directive piggybacks on
+    get_task, the worker live-reshards between tasks, acks, the
+    barrier completes, and the job drains on the new mesh. Also pins
+    the worker_step_utilization gauge riding the piggybacked
+    snapshots (the autoscaler's saturation signal)."""
+    reports = {"n": 0}
+    box = {}
+
+    def on_report(request):
+        reports["n"] += 1
+        if reports["n"] == 2:
+            box["rid"] = box["cluster"].begin_resize(
+                _mesh(2), direction="shrink"
+            )
+
+    cluster = MiniCluster(
+        model_zoo=model_zoo_dir(),
+        model_def=MODEL_DEF,
+        training_data=mnist_train,
+        minibatch_size=16,
+        num_minibatches_per_task=2,
+        mesh=_mesh(4),
+        worker_callbacks={"report_task_result": on_report},
+    )
+    box["cluster"] = cluster
+    results = cluster.run()
+    assert cluster.finished
+    assert np.isfinite(results[0]["final_loss"])
+    leaf = jax.tree_util.tree_leaves(cluster.workers[0].state.params)[0]
+    assert dict(leaf.sharding.mesh.shape) == {"dp": 2}
+    assert cluster.servicer.resize_status() is None
+    util = utilization_from_snapshots(
+        cluster.metrics_plane.cluster.snapshots()
+    )
+    assert util is not None and 0.0 < util <= 1.0
+    cluster.stop()
+
+
+def test_directive_arriving_with_finished_response_still_acked(
+    mnist_train,
+):
+    """A resize begun on the job's LAST report rides the finished
+    get_task response; the worker applies and acks post-loop instead
+    of exiting with the barrier pending."""
+    total_tasks = 192 // 32
+    reports = {"n": 0}
+    box = {}
+
+    def on_report(request):
+        reports["n"] += 1
+        if reports["n"] == total_tasks:
+            box["rid"] = box["cluster"].begin_resize(
+                _mesh(2), direction="shrink"
+            )
+
+    cluster = MiniCluster(
+        model_zoo=model_zoo_dir(),
+        model_def=MODEL_DEF,
+        training_data=mnist_train,
+        minibatch_size=16,
+        num_minibatches_per_task=2,
+        mesh=_mesh(4),
+        worker_callbacks={"report_task_result": on_report},
+    )
+    box["cluster"] = cluster
+    cluster.run()
+    assert cluster.finished
+    assert "rid" in box
+    assert cluster.servicer.resize_status() is None
+    leaf = jax.tree_util.tree_leaves(cluster.workers[0].state.params)[0]
+    assert dict(leaf.sharding.mesh.shape) == {"dp": 2}
+    cluster.stop()
+
+
+def test_autoscale_drill_passes(tmp_path):
+    """Fast-lane twin of ``make autoscale-smoke``: shrink + grow + a
+    worker kill mid-grow-barrier; loss-trajectory equivalence vs the
+    checkpoint-restart control, exactly-once accounting, and barrier
+    liveness must all hold."""
+    from elasticdl_tpu.chaos.autoscale_drill import run_drill
+
+    report = run_drill(str(tmp_path / "drill"), records=128)
+    failed = [v for v in report["invariants"] if not v["passed"]]
+    assert report["passed"], failed
+    assert report["kills"] == 1
+    assert [r["direction"] for r in report["resizes"]] == [
+        "shrink", "grow",
+    ]
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(pytest.main([__file__, "-v"] + sys.argv[1:]))

@@ -127,6 +127,26 @@ class TestArgs:
         )
         assert args2.minibatch_size == 32
 
+    def test_reserialize_skips_none_valued_optionals(self):
+        """Regression: an unset --metrics_ttl_secs (default None =
+        derive from task_timeout_secs) used to reserialize as the
+        literal string "None", which the worker parser's pos_float
+        rejects — the master could not spawn workers."""
+        args = build_parser("train").parse_args([
+            "--model_zoo", "mz", "--model_def", "m.f",
+            "--minibatch_size", "8",
+        ])
+        assert args.metrics_ttl_secs is None
+        rebuilt = build_arguments_from_parsed_result(args)
+        assert "--metrics_ttl_secs" not in rebuilt
+        assert "None" not in rebuilt
+        # The child parser must accept the list and land on the same
+        # derive-at-runtime default.
+        args2 = build_parser("worker").parse_args(
+            rebuilt + ["--worker_id", "3"]
+        )
+        assert args2.metrics_ttl_secs is None
+
     def test_worker_requires_id(self):
         with pytest.raises(SystemExit):
             build_parser("worker").parse_args(

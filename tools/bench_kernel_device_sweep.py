@@ -13,9 +13,16 @@ Measures, at production-like sizes over a 1M-row table:
   - sparse_apply (Adagrad): use_pallas always vs never, with the table
     state DONATED and threaded between calls (without donation both
     paths degrade to full-table copies and the comparison is
-    meaningless — the round-2 harness also missed this).
+    meaningless — the round-2 harness also missed this),
+  - the FUSED scatter-apply family (use_pallas="fused", SGD/Momentum —
+    ops/pallas_embedding.fused_*_scatter_apply): the on-chip numbers
+    the ROADMAP's pending dispatch-flip decision needs
+    (``use_pallas_apply`` stays False until this sweep shows a win on
+    real hardware). Same donated-and-threaded protocol.
 
 Writes EMBEDDING_SWEEP.json. Run on the TPU, nothing else on the host.
+``--lookup-only`` / ``--fused-only`` re-measure one section and merge
+over the previous file (single-section runs fit a session timeout).
 """
 
 import json
@@ -57,12 +64,30 @@ def device_ms(run, args, reps=10, donate_state=False):
     return float(np.median(times)) if times else float("nan")
 
 
-def sweep(lookup_only=False):
+def _merge_previous(results, keep_sections):
+    """Carry ``keep_sections`` over from the previous OUT_FILE so a
+    single-section re-measure doesn't clobber the rest."""
+    try:
+        with open(OUT_FILE) as f:
+            prev = json.load(f)
+        for section in keep_sections:
+            results[section] = prev.get(section, [])
+        return True
+    except (OSError, ValueError) as exc:
+        print(f"WARNING: previous {OUT_FILE} unreadable ({exc}); "
+              f"section(s) {keep_sections} will be EMPTY — re-run the "
+              "full sweep to restore them", file=sys.stderr)
+        return False
+
+
+def sweep(lookup_only=False, fused_only=False):
     import jax
     import jax.numpy as jnp
 
     from elasticdl_tpu.embedding.optimizer import (
         Adagrad,
+        Momentum,
+        SGD,
         init_slot_tables,
         sparse_apply,
     )
@@ -74,7 +99,62 @@ def sweep(lookup_only=False):
                "method": "per-program device time off the profiler "
                          "trace (benchlib.module_device_times); update "
                          "path donated+threaded",
-               "lookup": [], "sparse_update": []}
+               "lookup": [], "sparse_update": [],
+               "fused_sparse_update": []}
+
+    def fused_section():
+        """use_pallas='fused' (block-pipelined scatter-apply kernels)
+        vs the XLA path, SGD + Momentum, donated and threaded."""
+        dim = 256
+        for opt_name, opt in (("sgd", SGD(lr=0.05)),
+                              ("momentum", Momentum(lr=0.05))):
+            for n in [256, 4096, 16384]:
+                ids = np.unique(
+                    rng.randint(0, VOCAB, n)
+                ).astype(np.int32)
+                padded = jnp.asarray(
+                    np.concatenate([ids, [VOCAB]], 0), jnp.int32
+                )
+                grads = jnp.asarray(
+                    rng.randn(len(ids) + 1, dim).astype(np.float32)
+                )
+
+                def mk(mode):
+                    def f(t, s, i, g):
+                        t2, s2 = sparse_apply(
+                            opt, t, s, i, g, step=1, use_pallas=mode,
+                        )
+                        return t2, s2
+                    return jax.jit(f, donate_argnums=(0, 1))
+
+                def fresh():
+                    return (
+                        jnp.asarray(
+                            rng.randn(VOCAB, dim).astype(np.float32)
+                        ),
+                        init_slot_tables(opt, VOCAB, dim),
+                    )
+
+                table, slots = fresh()
+                k = device_ms(mk("fused"), (table, slots, padded, grads),
+                              donate_state=True)
+                table, slots = fresh()
+                x = device_ms(mk("never"), (table, slots, padded, grads),
+                              donate_state=True)
+                row = {"opt": opt_name, "dim": dim,
+                       "rows": int(len(ids)), "vocab": VOCAB,
+                       "fused_ms": round(k, 4), "xla_ms": round(x, 4),
+                       "fused_speedup": round(x / k, 4) if k else None}
+                results["fused_sparse_update"].append(row)
+                print(json.dumps(row), flush=True)
+                del table
+
+    if fused_only:
+        _merge_previous(results, ("lookup", "sparse_update"))
+        fused_section()
+        with open(OUT_FILE, "w") as f:
+            json.dump(results, f, indent=1)
+        return 0
 
     for dim, L, B in [(256, 32, 64), (256, 32, 512), (256, 64, 1024),
                       (512, 64, 1024)]:
@@ -105,19 +185,12 @@ def sweep(lookup_only=False):
         del table
 
     if lookup_only:
-        # Merge over the previous full run so sparse_update rows
+        # Merge over the previous full run so the update sections
         # survive a lookup-only re-measure (single-section runs fit the
         # session command timeout).
-        try:
-            with open(OUT_FILE) as f:
-                prev = json.load(f)
-            results["sparse_update"] = prev.get("sparse_update", [])
-        except (OSError, ValueError) as exc:
-            # Refuse to clobber the only copy of the expensive sparse
-            # measurements without saying so.
-            print(f"WARNING: previous {OUT_FILE} unreadable ({exc}); "
-                  "sparse_update section will be EMPTY — re-run the "
-                  "full sweep to restore it", file=sys.stderr)
+        _merge_previous(
+            results, ("sparse_update", "fused_sparse_update")
+        )
         with open(OUT_FILE, "w") as f:
             json.dump(results, f, indent=1)
         return 0
@@ -155,6 +228,8 @@ def sweep(lookup_only=False):
         print(json.dumps(row), flush=True)
         del table
 
+    fused_section()
+
     with open(OUT_FILE, "w") as f:
         json.dump(results, f, indent=1)
     return 0
@@ -162,4 +237,5 @@ def sweep(lookup_only=False):
 
 if __name__ == "__main__":
     enable_bench_compile_cache()
-    sys.exit(sweep(lookup_only="--lookup-only" in sys.argv))
+    sys.exit(sweep(lookup_only="--lookup-only" in sys.argv,
+                   fused_only="--fused-only" in sys.argv))
