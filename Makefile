@@ -7,7 +7,7 @@ PY ?= python
 .PHONY: test test-all test-tpu test-k8s native bench serve-bench dryrun \
 	clean lint metrics chaos-smoke chaos-soak chaos-master-smoke \
 	trace-smoke serve-fleet-smoke sparse-smoke sparse-bench \
-	autoscale-smoke autoscale-bench
+	autoscale-smoke autoscale-bench slo-smoke
 
 # Scrape-and-pretty-print a master's /metrics (docs/observability.md).
 METRICS_ADDR ?= localhost:8080
@@ -103,6 +103,19 @@ autoscale-smoke:
 # direction on the in-process virtual CPU mesh).
 autoscale-bench:
 	JAX_PLATFORMS=cpu $(PY) bench_elasticity.py --scenario autoscale
+
+# SLO-engine drill (docs/observability.md "SLOs & alerting"): a
+# MiniCluster job with every row pull stalled 120ms must trip the
+# latency burn-rate rule and leave an incident bundle that
+# check_incident.py accepts (Perfetto-loadable trace, non-empty series
+# window, journal tail); the fault-free twin run must fire NOTHING.
+# Fast-lane equivalent: tests/test_slo.py::test_slo_drill_passes.
+slo-smoke:
+	workdir=$$(mktemp -d /tmp/edl_slo.XXXXXX); \
+	JAX_PLATFORMS=cpu $(PY) -m elasticdl_tpu.chaos.slo_drill \
+		--workdir $$workdir --report SLO_DRILL.json \
+	&& $(PY) tools/check_incident.py $$workdir/incidents; \
+	rc=$$?; rm -rf $$workdir; exit $$rc
 
 # Deterministic chaos plan (kill + stall-row-shard + corrupt-checkpoint)
 # against the in-process cluster; exits nonzero if any recovery

@@ -269,6 +269,27 @@ def add_train_params(parser):
                         default=15.0,
                         help="How often each worker piggybacks a metrics "
                              "registry snapshot on master RPCs")
+    # SLO engine (observability/timeseries.py + slo.py;
+    # docs/observability.md): the master samples its telemetry into a
+    # bounded time-series store each run tick, evaluates declarative
+    # SLO rules (burn rate / threshold / absence) on it, and serves
+    # /timeseries + /alerts next to /metrics.
+    parser.add_argument("--timeseries_secs", type=float, default=5.0,
+                        help="Master time-series sampling cadence "
+                             "(seconds); 0 disables the store, the SLO "
+                             "engine, and the /timeseries + /alerts "
+                             "endpoints")
+    parser.add_argument("--slo_rules", default="",
+                        help="JSON SLO rule file (docs/observability.md "
+                             "'SLOs & alerting' for the format); empty "
+                             "= the built-in default rules")
+    parser.add_argument("--incident_dir", default="",
+                        help="Write a black-box incident bundle here "
+                             "(flight-recorder trace, time-series "
+                             "window, critical-path attribution, "
+                             "journal tail) whenever an SLO rule "
+                             "starts firing; empty (default) disables "
+                             "capture")
     parser.add_argument("--metrics_ttl_secs", type=pos_float, default=None,
                         help="Master drops a worker's metrics after this "
                              "long without a report (elastic resize "
@@ -312,6 +333,15 @@ def add_train_params(parser):
                         default=0.3,
                         help="Scale down when the queue is empty and "
                              "mean utilization sits below this")
+    add_bool_param(parser, "--autoscale_from_timeseries", False,
+                   "Feed the autoscaler the mean worker utilization "
+                   "over --autoscale_trend_window_secs from the "
+                   "time-series store instead of the instantaneous "
+                   "snapshot (requires --timeseries_secs > 0)")
+    parser.add_argument("--autoscale_trend_window_secs", type=pos_float,
+                        default=120.0,
+                        help="Trailing window for the time-series-"
+                             "backed utilization signal")
 
 
 def add_evaluate_params(parser):

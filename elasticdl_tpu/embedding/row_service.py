@@ -129,6 +129,13 @@ class HostRowService:
         self._table_versions: Dict[str, int] = {
             name: 0 for name in tables
         }
+        # Wall-clock stamp of the last APPLIED push per table — the
+        # ROADMAP's push-to-servable freshness signal: pulls return it,
+        # and serving-side readers observe ``now - applied_at`` as
+        # ``edl_tpu_row_freshness_seconds`` (how stale the rows a
+        # prediction just used could be). Wall clock on purpose: the
+        # reader is another process; monotonic clocks don't compare.
+        self._applied_at: Dict[str, float] = {}
         self._checkpoint_steps = 0
         self._saver = None
         self._ckpt_writer_free = threading.Semaphore(1)
@@ -166,7 +173,8 @@ class HostRowService:
         table size, so a cache can poll it far cheaper than re-pulling
         rows."""
         with self._lock:
-            return {"versions": dict(self._table_versions)}
+            return {"versions": dict(self._table_versions),
+                    "applied_at": dict(self._applied_at)}
 
     def table_version(self, table: str) -> int:
         """In-process accessor (tests / local tables)."""
@@ -184,9 +192,13 @@ class HostRowService:
                           rows=int(ids.size)):
             with self._lock:
                 rows = table.get(ids)
+                applied_at = self._applied_at.get(request["table"], 0.0)
         self._m_pulled.inc(ids.size)
         self._m_pull.observe(time.monotonic() - t0)
-        return {"rows": np.asarray(rows, np.float32)}
+        # applied_at rides every pull so readers can observe row
+        # freshness without an extra RPC (0.0 = never pushed).
+        return {"rows": np.asarray(rows, np.float32),
+                "applied_at": applied_at}
 
     def _export_rows(self, request: dict) -> dict:
         """Dense rows ``lo+offset, lo+offset+stride, ... < hi`` for
@@ -234,6 +246,7 @@ class HostRowService:
                     np.asarray(request["grads"], np.float32),
                 )
                 self._table_versions[request["table"]] += 1
+                self._applied_at[request["table"]] = time.time()
                 if client and seq >= 0:
                     # Record only AFTER apply succeeds: a failed apply
                     # must leave the seq unburned so the client's retry
@@ -420,12 +433,18 @@ class _RemoteTable:
         self.dim = dim
         self._retries = retries
         self._backoff = backoff_secs
+        # Wall-clock stamp of the service's last applied push as of
+        # our newest pull (0.0 = never pushed / never pulled): what
+        # serving's HostRowResolver turns into the
+        # edl_tpu_row_freshness_seconds observation.
+        self.last_applied_at = 0.0
 
     def get(self, ids) -> np.ndarray:
         resp = _call_with_retry(
             self._stub, "pull_rows", self._retries, self._backoff,
             table=self.name, ids=np.asarray(ids, np.int64),
         )
+        self.last_applied_at = float(resp.get("applied_at", 0.0) or 0.0)
         return np.asarray(resp["rows"], np.float32)
 
     def pull_version(self) -> int:
@@ -564,6 +583,20 @@ class _ShardedTable:
         other shard's growth exactly cancels it, which the cache's
         != comparison treats identically to growth anyway.)"""
         return sum(s.pull_version() for s in self._shards)
+
+    @property
+    def last_applied_at(self) -> float:
+        """OLDEST applied-push stamp across shards that have reported
+        one — the conservative freshness bound. max() would let three
+        healthy shards mask one whose push pipeline stalled, which is
+        exactly the regime the freshness SLO exists to catch; shards
+        that never saw a push (stamp 0) are excluded rather than
+        pinning the metric to 'never'."""
+        stamps = [
+            s.last_applied_at for s in self._shards
+            if s.last_applied_at > 0
+        ]
+        return min(stamps) if stamps else 0.0
 
     def export_dense(self, vocab: int, chunk: int = 65536) -> np.ndarray:
         """Each shard exports ONLY its owned rows (strided

@@ -227,17 +227,49 @@ def p99_attribution(spans: List[dict]) -> tuple:
     return float(tasks.get("p99_secs", 0.0)), p99.get("dominant_phase")
 
 
+def utilization_from_timeseries(store, window_secs: float,
+                                ) -> Optional[float]:
+    """Mean ``worker_step_utilization`` over the trailing time-series
+    window — the trend-backed alternative to the instantaneous
+    snapshot mean. One worker flapping between 0.9 and 0.1 across two
+    report intervals reads as ~0.5 here instead of whichever extreme
+    the tick happened to land on; None when the window holds no points
+    (same don't-guess contract as the snapshot path)."""
+    values = store.gauge_values(
+        "edl_tpu_worker_step_utilization", window_secs
+    )
+    if not values:
+        return None
+    return sum(values) / len(values)
+
+
 def master_signals(dispatcher, servicer, metrics_plane,
                    live_workers_fn: Callable[[], int],
                    with_traces: bool = True,
+                   timeseries=None,
+                   trend_window_secs: float = 120.0,
                    ) -> Callable[[], AutoscaleSignals]:
-    """Bind the master's live objects into a ``signals_fn``."""
+    """Bind the master's live objects into a ``signals_fn``.
+
+    ``timeseries`` (a ``TimeSeriesStore``, opted in via
+    ``--autoscale_from_timeseries``) replaces the instantaneous
+    utilization snapshot with the mean over ``trend_window_secs`` —
+    decisions then damp over the window like the SRE-style alerts do,
+    instead of reacting to whichever report the tick caught. The
+    snapshot path stays the default (and the fallback while the window
+    is still empty)."""
 
     def signals() -> AutoscaleSignals:
         queue_depth, doing = dispatcher.queue_depths()
-        util = utilization_from_snapshots(
-            metrics_plane.cluster.snapshots()
-        )
+        util = None
+        if timeseries is not None:
+            util = utilization_from_timeseries(
+                timeseries, trend_window_secs
+            )
+        if util is None:
+            util = utilization_from_snapshots(
+                metrics_plane.cluster.snapshots()
+            )
         p99_secs, p99_phase = (0.0, None)
         if with_traces and queue_depth > 0:
             # The p99 attribution only gates the scale-UP veto, and

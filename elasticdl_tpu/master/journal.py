@@ -353,6 +353,23 @@ class MasterJournal:
             out.append(record)
         return out
 
+    def tail(self, n: int = 50) -> List[dict]:
+        """Last ``n`` intact records — the incident bundle's
+        ``journal_tail.json`` (observability/slo.IncidentRecorder):
+        what the control plane was doing right before a breach.
+        Read-only and crash-tolerant (torn tails drop, bad records
+        return what precedes them rather than raising — an incident
+        capture must never fail on a journal quirk)."""
+        if not os.path.exists(self.path):
+            return []
+        out: List[dict] = []
+        try:
+            for _offset, _end, record in read_records(self.path):
+                out.append(record)
+        except Exception:
+            logger.exception("journal tail read stopped early")
+        return out[-int(n):]
+
     def recover_into(self, dispatcher) -> dict:
         """Replay snapshot + tail into ``dispatcher`` (freshly
         constructed with the same shard/epoch/seed config). Returns

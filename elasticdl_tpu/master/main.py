@@ -162,6 +162,41 @@ class Master:
             ttl_secs=metrics_ttl,
             summary_writer=tb_service,
         )
+        # SLO engine (observability/timeseries.py + slo.py): the run
+        # loop samples the cluster view into a bounded time-series
+        # store and evaluates burn-rate / threshold / absence rules on
+        # it; /timeseries and /alerts serve next to /metrics, and with
+        # --incident_dir a firing rule captures a black-box bundle.
+        ts_secs = float(getattr(args, "timeseries_secs", 5.0) or 0.0)
+        if ts_secs > 0:
+            from elasticdl_tpu.observability import slo as slo_mod
+
+            self.metrics_plane.enable_timeseries(cadence_secs=ts_secs)
+            rules_path = getattr(args, "slo_rules", "")
+            rules = (
+                slo_mod.load_rules(rules_path) if rules_path else None
+            )
+            recorder = None
+            incident_dir = getattr(args, "incident_dir", "")
+            if incident_dir:
+                if not int(getattr(args, "flight_recorder", 0) or 0):
+                    logger.warning(
+                        "--incident_dir without --flight_recorder: "
+                        "incident bundles will carry an empty trace "
+                        "timeline (series window, attribution, and "
+                        "journal tail are still captured)"
+                    )
+                recorder = slo_mod.IncidentRecorder(
+                    incident_dir,
+                    metrics_plane=self.metrics_plane,
+                    store=self.metrics_plane.timeseries,
+                    journal_tail_fn=(
+                        self._journal.tail if self._journal else None
+                    ),
+                )
+            self.metrics_plane.enable_slo(
+                rules=rules, incident_recorder=recorder
+            )
         # Distributed tracing (observability/tracing.py): with a
         # recorder installed, dispatch spans + collected worker spans
         # serve on /traces next to /metrics.
@@ -492,11 +527,27 @@ class Master:
                 manager.drain_worker(victim)
                 self.servicer.remove_worker_metrics(victim)
 
+        # Opt-in trend signal: utilization as the mean over the
+        # time-series window instead of the instantaneous snapshot
+        # (the old path stays the default; see master_signals).
+        timeseries = None
+        if getattr(args, "autoscale_from_timeseries", False):
+            timeseries = self.metrics_plane.timeseries
+            if timeseries is None:
+                logger.warning(
+                    "--autoscale_from_timeseries needs "
+                    "--timeseries_secs > 0; falling back to the "
+                    "snapshot utilization signal"
+                )
         self.autoscaler = Autoscaler(
             policy,
             master_signals(
                 self.task_dispatcher, self.servicer,
                 self.metrics_plane, live_count,
+                timeseries=timeseries,
+                trend_window_secs=float(getattr(
+                    args, "autoscale_trend_window_secs", 120.0
+                )),
             ),
             scale_up, scale_down,
         )
@@ -543,6 +594,9 @@ class Master:
                     self.servicer.maybe_complete_resize(live)
                 if self.autoscaler is not None:
                     self.autoscaler.tick()
+                # SLO plane: sample the time-series store (if due) and
+                # evaluate the rules on the fresh window.
+                self.metrics_plane.slo_tick()
                 self.metrics_plane.publish_tensorboard(
                     self.servicer.model_version
                 )

@@ -108,6 +108,7 @@ class MasterServicer:
             "report_evaluation_metrics": self.report_evaluation_metrics,
             "report_version": self.report_version,
             "report_resize": self.report_resize,
+            "report_metrics": self.report_metrics,
             "ping": lambda req: {"ok": True},
         }
 
@@ -226,6 +227,51 @@ class MasterServicer:
                 task_id=int(request.get("task_id", -1)),
             )
         return {"accepted": ok, "generation": self.generation}
+
+    def report_metrics(self, request: dict) -> dict:
+        """Standalone-component telemetry fold-in: processes that are
+        not workers (the serving router today) push their registry
+        snapshots here so ``ClusterMetrics`` — and the time-series
+        store sampling it — see the whole fleet, not just the training
+        tier. Keyed ``<component>-<id>`` (e.g. ``router-0``) in the
+        cluster view; the same TTL aging applies, so a router that
+        stops reporting leaves ``/metrics`` and its series go stale."""
+        component = str(request.get("component", "") or "")
+        if not component or any(
+            c in component for c in ("/", "\\", "\n", '"')
+        ):
+            return {"accepted": False,
+                    "generation": self.generation}
+        component_id = int(request.get("component_id", 0))
+        snapshot = request.get("metrics")
+        if snapshot:
+            # Shape gate: a version-skewed reporter's malformed
+            # snapshot must be rejected here, not stored to crash the
+            # sampler on the next master tick.
+            if not self._valid_snapshot(snapshot):
+                return {"accepted": False,
+                        "generation": self.generation}
+            self.metrics_plane.ingest(
+                f"{component}-{component_id}", snapshot
+            )
+        return {"accepted": True, "generation": self.generation}
+
+    @staticmethod
+    def _valid_snapshot(snapshot) -> bool:
+        if not isinstance(snapshot, dict):
+            return False
+        families = snapshot.get("families", [])
+        if not isinstance(families, list):
+            return False
+        for family in families:
+            if not isinstance(family, dict):
+                return False
+            if not isinstance(family.get("series", []), list):
+                return False
+            if not all(isinstance(s, dict)
+                       for s in family.get("series", [])):
+                return False
+        return True
 
     def report_version(self, request: dict) -> dict:
         version = int(request["model_version"])
@@ -461,7 +507,8 @@ class MasterServicer:
     def remove_worker_metrics(self, worker_id: int):
         """Drop a departed worker from the cluster view immediately
         (recovery / elastic scale-down path) instead of waiting for the
-        report TTL."""
-        self.metrics_plane.cluster.remove_worker(worker_id)
+        report TTL — and from the time-series store, so a deliberate
+        removal never reads as an absence-rule breach."""
+        self.metrics_plane.remove_worker(worker_id)
         with self._lock:
             self._worker_liveness.pop(worker_id, None)

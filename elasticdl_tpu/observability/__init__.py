@@ -25,7 +25,16 @@ layer a shared measurement substrate instead:
 - ``trace_export``: Chrome/Perfetto ``trace_event`` JSON export + the
                    ``elasticdl_tpu trace`` CLI;
 - ``critical_path``: per-step critical-path and straggler-attribution
-                   reports over collected span trees.
+                   reports over collected span trees;
+- ``timeseries``:  the master-side ring time-series store sampling the
+                   registries above (counters as rates, gauges as-is,
+                   histograms as rolling quantiles; hot + downsampled
+                   cold retention tiers; ``/timeseries`` endpoint);
+- ``slo``:         declarative SLO rules (multi-window burn rate,
+                   threshold, absence/staleness) evaluated on the
+                   master tick, ``/alerts`` + ``edl_tpu_alert_active``
+                   gauges, and black-box incident bundles captured on
+                   firing (``IncidentRecorder``).
 
 Metric names follow ``edl_tpu_<layer>_<name>`` (docs/observability.md).
 """
@@ -41,6 +50,16 @@ from elasticdl_tpu.observability.exposition import (  # noqa: F401
 from elasticdl_tpu.observability.registry import (  # noqa: F401
     MetricsRegistry,
     default_registry,
+)
+from elasticdl_tpu.observability.slo import (  # noqa: F401
+    IncidentRecorder,
+    SLOEngine,
+    SLORule,
+    default_rules,
+    load_rules,
+)
+from elasticdl_tpu.observability.timeseries import (  # noqa: F401
+    TimeSeriesStore,
 )
 from elasticdl_tpu.observability.tracing import (  # noqa: F401
     FlightRecorder,

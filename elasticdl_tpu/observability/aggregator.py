@@ -124,12 +124,24 @@ class ClusterMetrics:
                     next(iter(self._compacted_tokens))
                 )
 
-    def ingest(self, worker_id: int, snapshot: dict,
+    @staticmethod
+    def _key(worker_id):
+        """Reporter key: workers stay ints; named components (the
+        serving router's snapshot piggyback reports as ``router-N``)
+        key by string. Sorting mixed keys always goes through
+        ``key=str``."""
+        if isinstance(worker_id, str):
+            return worker_id
+        return int(worker_id)
+
+    def ingest(self, worker_id, snapshot: dict,
                now: Optional[float] = None):
-        if worker_id < 0 or not snapshot:
+        if not snapshot:
+            return
+        if not isinstance(worker_id, str) and worker_id < 0:
             return
         now = time.monotonic() if now is None else now
-        wid = int(worker_id)
+        wid = self._key(worker_id)
         token = snapshot.get("instance")
         with self._lock:
             retired = self._retired.pop(wid, None)
@@ -182,11 +194,11 @@ class ClusterMetrics:
                         acc[1] -= h_count
             self._snapshots[wid] = (snapshot, now)
 
-    def remove_worker(self, worker_id: int):
+    def remove_worker(self, worker_id):
         """Immediate removal (master recovered the worker's tasks /
         elastic resize scaled it away) — don't wait for the TTL."""
         with self._lock:
-            self._retire_locked(int(worker_id))
+            self._retire_locked(self._key(worker_id))
 
     def _retire_locked(self, worker_id: int):
         entry = self._snapshots.pop(worker_id, None)
@@ -194,10 +206,22 @@ class ClusterMetrics:
             self._retired[worker_id] = entry[0]
 
     def worker_ids(self):
-        return sorted(self.snapshots())
+        return sorted(self.snapshots(), key=str)
 
     def snapshots(self, now: Optional[float] = None) -> Dict[int, dict]:
         """Live snapshots; expired workers are retired as a side effect."""
+        return {
+            wid: snap
+            for wid, (snap, _ts) in self.snapshot_entries(now).items()
+        }
+
+    def snapshot_entries(self, now: Optional[float] = None) -> Dict:
+        """Live ``{reporter: (snapshot, arrival time)}`` — the arrival
+        time is the time-series sampler's *fingerprint*: a reporter
+        whose snapshot hasn't re-arrived since the last sample is
+        skipped there, so its series go stale instead of flat-lining
+        at the last piggybacked value (the TTL then removes it from
+        /metrics entirely)."""
         now = time.monotonic() if now is None else now
         with self._lock:
             expired = [
@@ -206,9 +230,7 @@ class ClusterMetrics:
             ]
             for wid in expired:
                 self._retire_locked(wid)
-            return {
-                wid: snap for wid, (snap, _ts) in self._snapshots.items()
-            }
+            return dict(self._snapshots)
 
     # ---- cross-worker scalar aggregates --------------------------------
 
@@ -257,6 +279,11 @@ class MetricsPlane:
         # same worker snapshots the cluster view merges (a "spans" key
         # next to "families"); the collector dedups by span id.
         self.traces = TraceCollector()
+        # The SLO plane (optional, see enable_timeseries/enable_slo):
+        # a time-series store periodically sampling this plane, and a
+        # rule engine evaluated right after each sample.
+        self.timeseries = None
+        self.slo = None
         # TensorboardService (write_dict_to_summary) or SummaryWriter
         # (add_scalars) — both are duck-typed below; None = no bridge.
         self._summary_writer = summary_writer
@@ -270,6 +297,15 @@ class MetricsPlane:
         if spans:
             self.traces.ingest(spans)
         self.cluster.ingest(worker_id, snapshot)
+
+    def remove_worker(self, worker_id):
+        """Deliberate departure (scale-down drain, recovery dropping a
+        dead id): retire from the cluster view AND forget the
+        time-series — an intentional removal must not trip the absence
+        rules meant for reporters that died unexpectedly."""
+        self.cluster.remove_worker(worker_id)
+        if self.timeseries is not None:
+            self.timeseries.drop_source(str(worker_id))
 
     def render(self) -> str:
         return render_prometheus(
@@ -291,12 +327,93 @@ class MetricsPlane:
         """JSON body for the ``/traces`` endpoint."""
         return {"spans": self.trace_spans()}
 
+    # ---- SLO plane (observability/timeseries.py + slo.py) --------------
+
+    def enable_timeseries(self, cadence_secs: float = 5.0, **kwargs):
+        """Attach the master-side time-series store; sampled from the
+        run-loop tick via ``slo_tick`` and served on ``/timeseries``."""
+        from elasticdl_tpu.observability.timeseries import TimeSeriesStore
+
+        self.timeseries = TimeSeriesStore(
+            cadence_secs=cadence_secs, **kwargs
+        )
+        return self.timeseries
+
+    def enable_slo(self, rules=None, incident_recorder=None, clock=None):
+        """Attach the SLO engine over the (required) time-series store;
+        evaluated after every sample, served on ``/alerts``."""
+        from elasticdl_tpu.observability.slo import SLOEngine
+
+        if self.timeseries is None:
+            raise RuntimeError(
+                "enable_timeseries() before enable_slo()"
+            )
+        kwargs = {"clock": clock} if clock is not None else {}
+        self.slo = SLOEngine(
+            self.timeseries, rules=rules,
+            metrics_registry=self.registry,
+            incident_recorder=incident_recorder, **kwargs,
+        )
+        return self.slo
+
+    def sample_timeseries(self, now: Optional[float] = None) -> bool:
+        """Feed one sample (if due) from the local registry + every
+        live cluster reporter into the store. Reporter snapshots carry
+        their arrival time as the staleness fingerprint."""
+        if self.timeseries is None or not self.timeseries.due(now):
+            return False
+        sources = {"": (self.registry.snapshot(), None)}
+        for wid, (snap, arrived) in \
+                self.cluster.snapshot_entries().items():
+            sources[str(wid)] = (snap, arrived)
+        self.timeseries.sample(sources, now=now)
+        return True
+
+    def slo_tick(self, now: Optional[float] = None):
+        """The master run-loop hook: sample if due, then evaluate the
+        rules on fresh data. Cheap when not due (one clock read).
+        Exception-contained: a malformed piggybacked snapshot (or any
+        store/engine bug) must degrade telemetry, never crash the run
+        loop that dispatches the job."""
+        try:
+            if self.sample_timeseries(now) and self.slo is not None:
+                return self.slo.evaluate(now)
+        except Exception:
+            from elasticdl_tpu.common.log_utils import get_logger
+
+            get_logger("metrics_plane").exception("slo tick failed")
+        return None
+
     # ---- HTTP ----------------------------------------------------------
+
+    def _json_routes(self):
+        # Both routes resolve self.timeseries/self.slo at request time:
+        # a plane enabled after serve() (tests, the drill harness)
+        # still gets its endpoints.
+        def timeseries_route(params: dict):
+            if self.timeseries is None:
+                return {"error": "time-series store disabled "
+                                 "(--timeseries_secs 0)"}
+            window = params.get("window")
+            return self.timeseries.render(
+                name=params.get("name"),
+                window_secs=float(window) if window else None,
+                tier=params.get("tier", "hot"),
+            )
+
+        def alerts_route(params: dict):
+            if self.slo is None:
+                return {"error": "SLO engine disabled", "rules": [],
+                        "firing": []}
+            return self.slo.render()
+
+        return {"/timeseries": timeseries_route, "/alerts": alerts_route}
 
     def serve(self, port: int = 0, host: str = "") -> MetricsHTTPServer:
         self._http = MetricsHTTPServer(
             self.render, port=port, host=host,
             traces=self.render_traces,
+            json_routes=self._json_routes(),
         ).start()
         return self._http
 
@@ -308,6 +425,11 @@ class MetricsPlane:
         if self._http is not None:
             self._http.stop()
             self._http = None
+        # In-flight incident bundle writes must land before the
+        # process that triggered them exits.
+        if self.slo is not None and self.slo.incident_recorder \
+                is not None:
+            self.slo.incident_recorder.flush()
 
     # ---- TensorBoard bridge -------------------------------------------
 

@@ -18,6 +18,7 @@ collection as JSON, for ``tools/dump_metrics.py --traces``.
 
 import json
 import threading
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Optional
 
@@ -115,7 +116,9 @@ def render_prometheus(
 
     if local_snapshot:
         _ingest(local_snapshot, {})
-    for worker_id in sorted(worker_snapshots or {}):
+    # key=str: reporter keys mix worker ints with named components
+    # ("router-0") since the snapshot piggyback grew beyond workers.
+    for worker_id in sorted(worker_snapshots or {}, key=str):
         _ingest(worker_snapshots[worker_id], {"worker": str(worker_id)})
 
     lines = []
@@ -133,6 +136,10 @@ class _Handler(BaseHTTPRequestHandler):
     # MetricsHTTPServer.start().
     render: Callable[[], str] = staticmethod(lambda: "")
     traces: Optional[Callable[[], dict]] = None
+    # path -> callable(query_params_dict) -> JSON-able object; how the
+    # SLO plane mounts /timeseries and /alerts without this module
+    # knowing either (docs/observability.md).
+    json_routes: Dict[str, Callable[[dict], object]] = {}
 
     def _reply(self, body: bytes, content_type: str):
         self.send_response(200)
@@ -142,7 +149,8 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.write(body)
 
     def do_GET(self):  # noqa: N802 (BaseHTTPRequestHandler API)
-        path = self.path.split("?", 1)[0]
+        path, _, query = self.path.partition("?")
+        routes = type(self).json_routes
         if path == "/metrics":
             try:
                 body = type(self).render().encode("utf-8")
@@ -157,10 +165,24 @@ class _Handler(BaseHTTPRequestHandler):
                 self.send_error(500, f"{type(exc).__name__}: {exc}")
                 return
             self._reply(body, "application/json")
+        elif path in routes:
+            params = {
+                k: v[-1]
+                for k, v in urllib.parse.parse_qs(query).items()
+            }
+            try:
+                body = json.dumps(routes[path](params)).encode("utf-8")
+            except Exception as exc:
+                self.send_error(500, f"{type(exc).__name__}: {exc}")
+                return
+            self._reply(body, "application/json")
         elif path == "/healthz":
             self._reply(b"ok\n", "text/plain; charset=utf-8")
         else:
-            self.send_error(404, "try /metrics, /traces, or /healthz")
+            known = ", ".join(
+                ["/metrics", "/traces", "/healthz"] + sorted(routes)
+            )
+            self.send_error(404, f"try {known}")
 
     def log_message(self, fmt, *args):
         logger.debug("metrics http: " + fmt, *args)
@@ -176,9 +198,12 @@ class MetricsHTTPServer:
 
     def __init__(self, render: Callable[[], str], port: int = 0,
                  host: str = "",
-                 traces: Optional[Callable[[], dict]] = None):
+                 traces: Optional[Callable[[], dict]] = None,
+                 json_routes: Optional[
+                     Dict[str, Callable[[dict], object]]] = None):
         self._render = render
         self._traces = traces
+        self._json_routes = dict(json_routes or {})
         self._host = host
         self._requested_port = int(port)
         self._httpd: Optional[ThreadingHTTPServer] = None
@@ -191,6 +216,7 @@ class MetricsHTTPServer:
                 staticmethod(self._traces)
                 if self._traces is not None else None
             ),
+            "json_routes": self._json_routes,
         })
         self._httpd = ThreadingHTTPServer(
             (self._host, self._requested_port), handler

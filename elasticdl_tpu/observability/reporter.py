@@ -1,0 +1,76 @@
+"""Fold a standalone component's telemetry into the master's cluster
+view.
+
+Workers piggyback registry snapshots on RPCs they already make; a
+process with no task loop (the serving router, a predict replica) has
+nothing to piggyback on, so this thread periodically pushes the
+snapshot over the master's ``report_metrics`` RPC instead. The master
+keys it ``<component>-<id>`` — same TTL aging, same exposition
+(``worker="router-0"`` / ``worker="serving-1"`` labels), same
+time-series sampling as any worker, which is what lets master-side SLO
+rules (e.g. the default ``row-freshness`` rule over the replicas'
+``edl_tpu_row_freshness_seconds``) watch the whole fleet
+(docs/observability.md "Time series").
+"""
+
+import threading
+
+from elasticdl_tpu.common.log_utils import get_logger
+
+logger = get_logger("metrics_reporter")
+
+
+class ComponentMetricsReporter(threading.Thread):
+    """Daemon thread pushing this process's registry snapshot to the
+    master every ``interval_secs``. Master unavailability degrades to
+    a warning + channel rebuild (a refused gRPC channel can wedge
+    permanently in-container — the PR 5/6 lesson), never an error in
+    the component itself."""
+
+    def __init__(self, master_addr: str, component: str,
+                 component_id: int = 0, interval_secs: float = 15.0,
+                 registry=None):
+        super().__init__(
+            daemon=True, name=f"{component}-metrics-report"
+        )
+        from elasticdl_tpu.observability import default_registry
+
+        self._master_addr = master_addr
+        self._component = str(component)
+        self._component_id = int(component_id)
+        self._interval = max(0.5, float(interval_secs))
+        self._registry = registry or default_registry()
+        self._stop = threading.Event()
+        self._stub = None
+        self.reports_sent = 0
+
+    def send_once(self):
+        from elasticdl_tpu.comm.rpc import RpcStub
+
+        if self._stub is None:
+            self._stub = RpcStub(
+                self._master_addr, "elasticdl_tpu.Master"
+            )
+        try:
+            self._stub.call(
+                "report_metrics", component=self._component,
+                component_id=self._component_id,
+                metrics=self._registry.snapshot(),
+            )
+            self.reports_sent += 1
+        except Exception as exc:
+            logger.warning(
+                "%s-%d master metrics report failed: %s",
+                self._component, self._component_id, exc,
+            )
+            try:
+                self._stub.reconnect()
+            except Exception:
+                self._stub = None
+
+    def run(self):
+        while not self._stop.wait(self._interval):
+            self.send_once()
+
+    def stop(self):
+        self._stop.set()

@@ -90,6 +90,11 @@ class HotRowCache:
         # after the probe already invalidated, or they would outlive
         # the bounded-staleness contract (until the NEXT push).
         self._epochs: Dict[str, int] = {}
+        # Per-table applied-push stamp (row-service wall clock) carried
+        # by the pull that filled the cache: a cache-hit read still
+        # knows how fresh its rows are — see HostRowResolver's
+        # edl_tpu_row_freshness_seconds observation.
+        self._applied_at: Dict[str, float] = {}
         self._probe_tables: Dict = {}
         self._probe_stop = threading.Event()
         self._probe_thread: Optional[threading.Thread] = None
@@ -228,12 +233,23 @@ class HotRowCache:
         with self._lock:
             return self._epochs.get(table, 0)
 
+    def applied_at(self, table: str) -> float:
+        """Row-service applied-push stamp as of the pull that last
+        filled this table's cache entries (0.0 = unknown)."""
+        with self._lock:
+            return self._applied_at.get(table, 0.0)
+
     def put_many(self, table: str, ids: np.ndarray, rows: np.ndarray,
-                 epoch: Optional[int] = None):
+                 epoch: Optional[int] = None,
+                 applied_at: Optional[float] = None):
         if self.capacity <= 0:
             return
         evicted = 0
         with self._lock:
+            if applied_at:
+                self._applied_at[table] = max(
+                    self._applied_at.get(table, 0.0), float(applied_at)
+                )
             if epoch is not None \
                     and self._epochs.get(table, 0) != epoch:
                 # The rows were pulled before an invalidation landed:
@@ -320,6 +336,17 @@ class HostRowResolver:
             "Unique rows resolved, by source",
             labelnames=("source",),
         )
+        # The ROADMAP's push-to-servable freshness signal: how long
+        # after a gradient push was applied could a serving read still
+        # be using it un-refreshed. Observed per resolved table read —
+        # pulls use the applied-push stamp riding the pull response,
+        # cache hits the stamp recorded when the cache was filled. The
+        # default SLO ruleset alerts on its p99 (docs/observability.md).
+        self._m_freshness = registry.histogram(
+            "row_freshness_seconds",
+            "Push-to-servable latency: age of the row service's last "
+            "applied push at serving-read time",
+        )
 
     def resolve(self, features: dict) -> dict:
         from elasticdl_tpu.embedding.host_engine import bucket_size
@@ -343,27 +370,42 @@ class HostRowResolver:
                 bucket = bucket_size(len(uniq))
                 dim = self._dims[table_name]
                 rows = np.zeros((bucket, dim), np.float32)
+                table = self._tables[table_name]
+                applied_at = 0.0
                 if self._cache is not None:
                     block = rows[: len(uniq)]
                     miss = self._cache.get_many(table_name, uniq, block)
                     if miss.any():
                         epoch = self._cache.table_epoch(table_name)
                         fetched = np.asarray(
-                            self._tables[table_name].get(uniq[miss]),
-                            np.float32,
+                            table.get(uniq[miss]), np.float32,
                         )
                         block[miss] = fetched
+                        applied_at = float(getattr(
+                            table, "last_applied_at", 0.0
+                        ) or 0.0)
                         self._cache.put_many(
                             table_name, uniq[miss], fetched,
-                            epoch=epoch,
+                            epoch=epoch, applied_at=applied_at,
                         )
+                    else:
+                        # Pure cache hit: freshness bound comes from
+                        # the pull that filled the cache.
+                        applied_at = self._cache.applied_at(table_name)
                     cache_hits += int(len(uniq) - miss.sum())
                     pulled += int(miss.sum())
                 else:
                     rows[: len(uniq)] = np.asarray(
-                        self._tables[table_name].get(uniq), np.float32
+                        table.get(uniq), np.float32
                     )
+                    applied_at = float(getattr(
+                        table, "last_applied_at", 0.0
+                    ) or 0.0)
                     pulled += len(uniq)
+                if applied_at > 0:
+                    self._m_freshness.observe(
+                        max(0.0, time.time() - applied_at)
+                    )
                 out[key] = inverse.reshape(raw.shape).astype(
                     self._id_dtypes[table_name]
                 )

@@ -413,12 +413,29 @@ class RouterCore:
                  unhealthy_after: int = 1,
                  probe_secs: float = 1.0,
                  replica_timeout: float = 30.0,
+                 slo_window_secs: float = 60.0,
+                 slo_p95_ms: float = 500.0,
+                 slo_error_ratio: float = 0.05,
                  metrics_registry=None):
         if not replica_addrs:
             raise ValueError("router needs at least one replica")
         self.replicas = [
             Replica(addr, i, timeout=replica_timeout)
             for i, addr in enumerate(replica_addrs)
+        ]
+        # Per-replica SLO status (the /v1/replicas "slo" field): a
+        # rolling window of attempt outcomes per replica, judged
+        # against a p95-latency + error-ratio objective — the
+        # router-local sibling of the master's SLO engine
+        # (observability/slo.py; full rules run master-side on the
+        # piggybacked router_* families).
+        from elasticdl_tpu.observability.slo import RollingWindow
+
+        self.slo_p95_ms = float(slo_p95_ms)
+        self.slo_error_ratio = float(slo_error_ratio)
+        self._slo_windows = [
+            RollingWindow(window_secs=slo_window_secs)
+            for _ in self.replicas
         ]
         if policy == "hash":
             self.policy = ConsistentHashPolicy(self.replicas)
@@ -631,7 +648,22 @@ class RouterCore:
             self.hedge.observe(attempt.elapsed)
         if not attempt._cancelled:
             # A cancelled loser says nothing about replica health.
-            self._note_result(attempt.replica, attempt.error is None)
+            ok = attempt.error is None
+            self._note_result(attempt.replica, ok)
+            # SLO sample: transport failures and 5xx count against the
+            # replica. Sheds (429) are EXCLUDED entirely — same
+            # discipline as the hedge window above: an overloaded
+            # replica answering fast 429s would otherwise report a
+            # collapsed p95 and a clean error ratio (ok=true) exactly
+            # during the overload /v1/replicas exists to surface.
+            if attempt.outcome is not None \
+                    and attempt.outcome[0] == 429:
+                return
+            served_ok = ok and attempt.outcome is not None \
+                and attempt.outcome[0] < 500
+            self._slo_windows[attempt.replica.index].record(
+                served_ok, attempt.elapsed
+            )
 
     def _make_attempt(self, replica: Replica, body, content_type,
                       priority, hedge: bool) -> _Attempt:
@@ -836,9 +868,28 @@ class RouterCore:
                 self._idle.wait(timeout=min(remaining, 0.05))
         return True
 
+    def replica_slo(self, index: int) -> dict:
+        """Windowed per-replica SLO status: request count, error
+        ratio, p95, and the ok verdict against the configured
+        objective. ``ok`` is None (unknown) on an empty window — a
+        just-started or idle replica has no evidence either way."""
+        status = self._slo_windows[index].status()
+        if status["requests"] == 0:
+            status["ok"] = None
+            return status
+        status["ok"] = bool(
+            status["error_ratio"] <= self.slo_error_ratio
+            and (self.slo_p95_ms <= 0
+                 or status["p95_ms"] <= self.slo_p95_ms)
+        )
+        return status
+
     def states(self) -> List[dict]:
         with self._lock:
-            return [r.state() for r in self.replicas]
+            states = [r.state() for r in self.replicas]
+        for state in states:
+            state["slo"] = self.replica_slo(state["index"])
+        return states
 
 
 class _RouterHandler(BaseHTTPRequestHandler):
@@ -997,6 +1048,8 @@ class RouterServer:
     def __init__(self, replica_addrs: List[str], port: int = 8600,
                  host: str = "", request_timeout: float = 30.0,
                  routing_key_header: str = "X-User-Id",
+                 master_addr: str = "", router_id: int = 0,
+                 metrics_report_secs: float = 15.0,
                  **core_kwargs):
         self.core = RouterCore(replica_addrs, **core_kwargs)
         self.request_timeout = float(request_timeout)
@@ -1006,6 +1059,20 @@ class RouterServer:
         self._requested_port = int(port)
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+        # Fold this router's telemetry into the training master's
+        # cluster view (keyed router-<id>; same TTL aging and
+        # time-series sampling as a worker's piggybacked snapshots).
+        self._reporter = None
+        if master_addr:
+            from elasticdl_tpu.observability.reporter import (
+                ComponentMetricsReporter,
+            )
+
+            self._reporter = ComponentMetricsReporter(
+                master_addr, "router", router_id,
+                interval_secs=metrics_report_secs,
+                registry=self.core.registry,
+            )
 
     @property
     def port(self) -> int:
@@ -1031,6 +1098,8 @@ class RouterServer:
             name="router-http",
         )
         self._thread.start()
+        if self._reporter is not None:
+            self._reporter.start()
         logger.info(
             "Router on port %d over %d replica(s), policy=%s",
             self.port, len(self.core.replicas), self.core.policy.name,
@@ -1041,6 +1110,8 @@ class RouterServer:
         self._thread.join()
 
     def stop(self):
+        if self._reporter is not None:
+            self._reporter.stop()
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()
@@ -1054,6 +1125,8 @@ class RouterServer:
         new hard-kill point."""
         logger.info("draining router (grace %.1fs)", grace)
         self.draining = True
+        if self._reporter is not None:
+            self._reporter.stop()
         if self._httpd is not None:
             # Stop the accept loop; handler threads for accepted
             # requests keep running and block in core.handle().
@@ -1135,6 +1208,37 @@ def main(argv=None) -> int:
              "(route/attempt spans on the router track, served on "
              "/traces). 0 (default) = off",
     )
+    parser.add_argument(
+        "--master_addr", default="",
+        help="Training master host:port — fold this router's "
+             "router_* telemetry into the master's cluster view "
+             "(/metrics and the time-series store) via the same "
+             "snapshot piggyback workers use; empty (default) = "
+             "standalone",
+    )
+    parser.add_argument(
+        "--router_id", type=int, default=0,
+        help="This router's id in the master's cluster view "
+             "(series label worker=\"router-<id>\")",
+    )
+    parser.add_argument(
+        "--metrics_report_secs", type=float, default=15.0,
+        help="Master telemetry report interval (with --master_addr)",
+    )
+    parser.add_argument(
+        "--replica_slo_window_secs", type=float, default=60.0,
+        help="Rolling window for the per-replica SLO status on "
+             "/v1/replicas",
+    )
+    parser.add_argument(
+        "--replica_slo_p95_ms", type=float, default=500.0,
+        help="Per-replica p95 latency objective (ms); <=0 disables "
+             "the latency clause",
+    )
+    parser.add_argument(
+        "--replica_slo_error_ratio", type=float, default=0.05,
+        help="Per-replica windowed error-ratio objective",
+    )
     args = parser.parse_args(argv)
 
     if args.flight_recorder > 0:
@@ -1156,6 +1260,12 @@ def main(argv=None) -> int:
         hedge_shed_frac=args.hedge_shed_frac,
         low_shed_frac=args.low_shed_frac,
         probe_secs=args.probe_secs,
+        master_addr=args.master_addr,
+        router_id=args.router_id,
+        metrics_report_secs=args.metrics_report_secs,
+        slo_window_secs=args.replica_slo_window_secs,
+        slo_p95_ms=args.replica_slo_p95_ms,
+        slo_error_ratio=args.replica_slo_error_ratio,
     ).start()
     logger.info(
         "Routing :%d -> %s (policy=%s, hedge=%s)",

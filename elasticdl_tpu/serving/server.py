@@ -893,6 +893,23 @@ def main(argv=None) -> int:
              "served on /traces next to /metrics; "
              "tools/dump_metrics.py --traces). 0 (default) = off",
     )
+    parser.add_argument(
+        "--master_addr", default="",
+        help="Training master host:port — fold this replica's "
+             "serving_* / row_freshness telemetry into the master's "
+             "cluster view and time-series store (how the master-side "
+             "row-freshness SLO rule sees serving reads; "
+             "docs/observability.md). Empty (default) = standalone",
+    )
+    parser.add_argument(
+        "--replica_id", type=int, default=0,
+        help="This replica's id in the master's cluster view "
+             "(series label worker=\"serving-<id>\")",
+    )
+    parser.add_argument(
+        "--metrics_report_secs", type=float, default=15.0,
+        help="Master telemetry report interval (with --master_addr)",
+    )
     args = parser.parse_args(argv)
 
     if args.flight_recorder > 0:
@@ -950,6 +967,17 @@ def main(argv=None) -> int:
         args.model_dir, server.port, args.max_batch_size,
         args.batch_deadline_ms,
     )
+    reporter = None
+    if args.master_addr:
+        from elasticdl_tpu.observability.reporter import (
+            ComponentMetricsReporter,
+        )
+
+        reporter = ComponentMetricsReporter(
+            args.master_addr, "serving", args.replica_id,
+            interval_secs=args.metrics_report_secs,
+        )
+        reporter.start()
     # Graceful pod eviction: SIGTERM stops the accept loop, flushes
     # in-flight micro-batches, then exits well inside the k8s
     # termination grace — without this, eviction drops every queued
@@ -966,6 +994,8 @@ def main(argv=None) -> int:
         server.wait()
         return 0
     stop_evt.wait()
+    if reporter is not None:
+        reporter.stop()
     server.drain(grace=args.drain_grace)
     return 0
 

@@ -1,0 +1,1120 @@
+"""SLO engine: time-series store, burn-rate/threshold/absence rules,
+incident bundles, and the consumers wired onto them
+(docs/observability.md "Time series" / "SLOs & alerting").
+"""
+
+import json
+import os
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from elasticdl_tpu.observability.registry import MetricsRegistry
+from elasticdl_tpu.observability.slo import (
+    IncidentRecorder,
+    RollingWindow,
+    SLOEngine,
+    SLORule,
+    default_rules,
+    load_rules,
+)
+from elasticdl_tpu.observability.timeseries import (
+    TimeSeriesStore,
+    quantile_from_buckets,
+)
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, secs):
+        self.t += secs
+        return self.t
+
+
+def make_store(clock, cadence=5.0, **kw):
+    return TimeSeriesStore(cadence_secs=cadence, clock=clock, **kw)
+
+
+def sample_registry(store, registry, clock, source=""):
+    store.sample({source: (registry.snapshot(), None)},
+                 now=clock())
+
+
+# ---- store semantics -----------------------------------------------------
+
+
+def test_counter_sampled_as_rate_and_window_delta():
+    clock = FakeClock()
+    store = make_store(clock)
+    reg = MetricsRegistry()
+    c = reg.counter("pushes_total", "h")
+    c.inc(10)
+    sample_registry(store, reg, clock)  # primes prev, no point yet
+    clock.advance(5)
+    c.inc(20)
+    sample_registry(store, reg, clock)
+    clock.advance(5)
+    c.inc(5)
+    sample_registry(store, reg, clock)
+    delta, n = store.window_counter_delta("edl_tpu_pushes_total", 60)
+    assert delta == pytest.approx(25.0)
+    assert n == 2
+    body = store.render(name="edl_tpu_pushes_total")
+    points = body["series"]["edl_tpu_pushes_total"]["points"]
+    # Rendered as rates: 20/5s then 5/5s.
+    assert [p[1] for p in points] == pytest.approx([4.0, 1.0])
+
+
+def test_counter_reset_reads_as_fresh_delta_not_negative():
+    clock = FakeClock()
+    store = make_store(clock)
+    reg = MetricsRegistry()
+    c = reg.counter("x_total", "h")
+    c.inc(100)
+    sample_registry(store, reg, clock)
+    clock.advance(5)
+    # Process restart: counter restarts from 0 and grows to 7.
+    reg.reset()
+    reg.counter("x_total", "h").inc(7)
+    sample_registry(store, reg, clock)
+    delta, _ = store.window_counter_delta("edl_tpu_x_total", 60)
+    assert delta == pytest.approx(7.0)
+
+
+def test_histogram_window_quantile_and_mean():
+    clock = FakeClock()
+    store = make_store(clock)
+    reg = MetricsRegistry()
+    h = reg.histogram("lat_seconds", "h")
+    h.observe(0.001)
+    sample_registry(store, reg, clock)
+    clock.advance(5)
+    for _ in range(9):
+        h.observe(0.002)
+    h.observe(2.0)
+    sample_registry(store, reg, clock)
+    p50, n = store.window_quantile("edl_tpu_lat_seconds", 60, 0.5)
+    p99, _ = store.window_quantile("edl_tpu_lat_seconds", 60, 0.99)
+    assert n == 10
+    assert p50 == pytest.approx(0.005)  # bucket upper bound estimate
+    assert p99 == pytest.approx(5.0)
+    count, total, deltas, ubs = store.window_hist(
+        "edl_tpu_lat_seconds", 60
+    )
+    assert count == 10
+    assert total == pytest.approx(9 * 0.002 + 2.0)
+    assert len(deltas) == len(ubs)
+
+
+def test_quantile_overflow_saturates_at_last_bucket():
+    assert quantile_from_buckets((0.1, 1.0), [0, 0], 0.5) == 0.0
+    # All observations above every bucket: count grew, buckets didn't.
+    assert quantile_from_buckets((0.1,), [0.0], 0.99) == 0.0
+    assert quantile_from_buckets((0.1, 1.0), [1, 0], 0.999) == \
+        pytest.approx(0.1)
+    # Rank past the last bucket saturates (JSON-safe), never +inf.
+    assert quantile_from_buckets((0.1, 1.0), [1, 9], 0.999) == \
+        pytest.approx(1.0)
+
+
+def test_quantile_sees_overflow_observations():
+    """Observations above the top histogram bucket land in `count`
+    but no bucket; the quantile must rank against the TRUE count and
+    saturate — not report 0 exactly when everything is catastrophically
+    slow (the regime the freshness SLO exists to page on)."""
+    clock = FakeClock()
+    store = make_store(clock)
+    reg = MetricsRegistry()
+    h = reg.histogram("row_freshness_seconds", "h")
+    sample_registry(store, reg, clock)
+    clock.advance(5)
+    for _ in range(50):
+        h.observe(300.0)  # above the 120s top bucket
+    sample_registry(store, reg, clock)
+    p99, n = store.window_quantile(
+        "edl_tpu_row_freshness_seconds", 60, 0.99
+    )
+    assert n == 50
+    assert p99 == pytest.approx(120.0)  # saturated top bound, not 0
+    # And the default freshness rule fires on it.
+    rule = [r for r in default_rules() if r.name == "row-freshness"][0]
+    engine = SLOEngine(store, rules=[rule],
+                       metrics_registry=MetricsRegistry(), clock=clock)
+    assert engine.evaluate()[0]["firing"] is True
+    # Mixed regime: half in-bucket fast, half overflow → p99 still
+    # reflects the slow tail.
+    clock.advance(5)
+    for _ in range(25):
+        h.observe(0.001)
+        h.observe(300.0)
+    sample_registry(store, reg, clock)
+    p99, _ = store.window_quantile(
+        "edl_tpu_row_freshness_seconds", 4, 0.99
+    )
+    assert p99 == pytest.approx(120.0)
+
+
+def test_absence_rule_rejects_inverted_forget_window():
+    with pytest.raises(ValueError, match="forget_secs"):
+        SLORule(name="x", kind="absence", series="s",
+                staleness_secs=600.0, forget_secs=300.0)
+
+
+def test_cold_tier_downsamples_gauges_to_mean_min_max():
+    clock = FakeClock(t=1200.0)  # aligned on a 60s bucket boundary
+    store = make_store(clock, cadence=5.0, cold_resolution_secs=60.0)
+    reg = MetricsRegistry()
+    g = reg.gauge("util", "h")
+    for value in (0.2, 0.4, 0.6):
+        g.set(value)
+        sample_registry(store, reg, clock)
+        clock.advance(15)
+    # Crossing into the next 60s bucket flushes the first cold point
+    # covering all three samples.
+    clock.advance(60)
+    g.set(1.0)
+    sample_registry(store, reg, clock)
+    body = store.render(name="edl_tpu_util", tier="cold")
+    points = body["series"]["edl_tpu_util"]["points"]
+    assert len(points) == 1
+    _t, mean, mn, mx = points[0]
+    assert mn == pytest.approx(0.2)
+    assert mx == pytest.approx(0.6)
+    assert mean == pytest.approx(0.4)
+
+
+def test_stale_fingerprint_skips_source_so_series_freeze():
+    clock = FakeClock()
+    store = make_store(clock)
+    reg = MetricsRegistry()
+    reg.gauge("util", "h").set(0.9)
+    snap = reg.snapshot()
+    store.sample({"3": (snap, 111)}, now=clock())
+    frozen_at = clock()
+    clock.advance(5)
+    # Same fingerprint (the worker never re-reported): skipped.
+    store.sample({"3": (snap, 111)}, now=clock())
+    seen = store.last_seen("edl_tpu_util", source="3")
+    assert list(seen.values()) == [frozen_at]
+    clock.advance(5)
+    # New arrival: series resumes.
+    store.sample({"3": (snap, 222)}, now=clock())
+    seen = store.last_seen("edl_tpu_util", source="3")
+    assert list(seen.values()) == [clock()]
+
+
+def test_max_series_cap_drops_not_grows():
+    clock = FakeClock()
+    store = make_store(clock, max_series=2)
+    reg = MetricsRegistry()
+    fam = reg.gauge("g", "h", labelnames=("k",))
+    for i in range(5):
+        fam.labels(str(i)).set(float(i))
+    sample_registry(store, reg, clock)
+    assert len(store.series_names()) == 2
+    assert store.dropped_series == 3
+
+
+def test_sampler_overhead_under_1ms_per_tick():
+    """Acceptance pin: one sample over a realistic population — 240
+    series across a master-local registry plus two reporters, half of
+    them actively moving each tick — costs <1ms, so the default master
+    tick (5s poll, 5s sampling cadence) pays sub-permille overhead.
+    Median over repeats to damp CI noise."""
+    clock = FakeClock()
+    store = make_store(clock, cadence=0.0)
+    reg = MetricsRegistry()
+    counters, hists = [], []
+    for i in range(20):
+        c = reg.counter(f"c{i}_total", "h")
+        c.inc(i)
+        counters.append(c)
+        reg.gauge(f"g{i}", "h").set(i)
+        h = reg.histogram(f"h{i}_seconds", "h", labelnames=("m",))
+        h.labels("a").observe(0.01 * i)
+        h.labels("b").observe(0.1 * i)
+        hists.append(h)
+    costs = []
+    for k in range(40):
+        clock.advance(5)
+        for c in counters[:10]:
+            c.inc()
+        for h in hists[:10]:
+            h.labels("a").observe(0.01)
+        snap = reg.snapshot()
+        store.sample(
+            {"": (snap, None), "1": (snap, k), "2": (snap, k)},
+            now=clock(),
+        )
+        costs.append(store.last_sample_cost_secs)
+    assert len(store.series_names()) == 240
+    costs.sort()
+    median = costs[len(costs) // 2]
+    assert median < 0.001, f"sampler median {median * 1e3:.3f}ms >= 1ms"
+
+
+def test_gauge_values_time_ordered_across_series():
+    """`last` must mean the chronologically newest observation, not
+    the final point of whichever series the store created last."""
+    clock = FakeClock()
+    store = make_store(clock)
+    reg_a, reg_b = MetricsRegistry(), MetricsRegistry()
+    reg_a.gauge("util", "h").set(0.9)
+    reg_b.gauge("util", "h").set(0.1)
+    # Series "b" is created in the store AFTER "a" but its points are
+    # OLDER: a stale reporter must not win the `last` aggregation.
+    store.sample({"a": (reg_a.snapshot(), 1)}, now=clock())
+    clock.advance(5)
+    store.sample({"a": (reg_a.snapshot(), 2),
+                  "b": (reg_b.snapshot(), 1)}, now=clock())
+    clock.advance(5)
+    reg_a.gauge("util", "h").set(0.7)
+    store.sample({"a": (reg_a.snapshot(), 3)}, now=clock())
+    values = store.gauge_values("edl_tpu_util", 120)
+    assert values[-1] == pytest.approx(0.7)
+    engine = SLOEngine(store, rules=[SLORule(
+        name="u", kind="threshold", series="edl_tpu_util",
+        aggregation="last", op=">", value=0.5, window_secs=120.0,
+    )], metrics_registry=MetricsRegistry(), clock=clock)
+    state = engine.evaluate()[0]
+    assert state["firing"] is True and state["value"] == \
+        pytest.approx(0.7)
+
+
+def test_render_concurrent_with_sampling_no_deque_race():
+    """/timeseries (and the incident writer) render while the master
+    tick samples; iterating a live deque would raise 'deque mutated
+    during iteration'."""
+    import threading as th
+
+    clock = FakeClock()
+    store = make_store(clock, cadence=0.0, hot_capacity=32)
+    reg = MetricsRegistry()
+    g = reg.gauge("g", "h")
+    h = reg.histogram("h_seconds", "h")
+    stop = th.Event()
+    errors = []
+
+    def renderer():
+        while not stop.is_set():
+            try:
+                store.render(window_secs=1e9)
+            except RuntimeError as exc:
+                errors.append(exc)
+                return
+
+    thread = th.Thread(target=renderer)
+    thread.start()
+    try:
+        for i in range(400):
+            g.set(float(i))
+            h.observe(0.01)
+            clock.advance(1)
+            store.sample({"": (reg.snapshot(), None)}, now=clock())
+    finally:
+        stop.set()
+        thread.join(timeout=10)
+    assert not errors, errors
+
+
+def test_rate_uses_per_series_dt_across_skipped_samples():
+    """A reporter piggybacking every 15s against a 5s sampler is
+    skipped on two of three samples (unchanged fingerprint); its
+    counter delta spans 15s and must be rated over 15s, not the
+    sampler's 5s interval (which would inflate the rate 3x)."""
+    clock = FakeClock()
+    store = make_store(clock, cadence=5.0)
+    reg = MetricsRegistry()
+    c = reg.counter("x_total", "h")
+    c.inc(30)
+    store.sample({"3": (reg.snapshot(), 1)}, now=clock())
+    for fp in (1, 1):  # two stale samples: source skipped
+        clock.advance(5)
+        store.sample({"3": (reg.snapshot(), fp)}, now=clock())
+    clock.advance(5)
+    c.inc(30)  # 30 more over the full 15s
+    store.sample({"3": (reg.snapshot(), 2)}, now=clock())
+    points = store.render(name="edl_tpu_x_total")["series"][
+        "edl_tpu_x_total@3"]["points"]
+    assert [p[1] for p in points] == pytest.approx([2.0])  # 30/15s
+
+
+def test_remove_worker_drops_series_no_false_absence():
+    """Deliberate scale-down (servicer.remove_worker_metrics) must
+    forget the worker's series — otherwise every autoscaler drain
+    would trip the absence rule 600s later."""
+    from elasticdl_tpu.master.servicer import MasterServicer
+    from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+    from elasticdl_tpu.observability import MetricsPlane
+
+    clock = FakeClock()
+    plane = MetricsPlane(registry=MetricsRegistry(), ttl_secs=600.0)
+    store = plane.enable_timeseries(cadence_secs=5.0)
+    store._clock = clock
+    engine = plane.enable_slo(rules=[SLORule(
+        name="gone", kind="absence",
+        series="edl_tpu_worker_step_seconds", staleness_secs=20.0,
+        forget_secs=10000.0,
+    )], clock=clock)
+    worker_reg = MetricsRegistry()
+    worker_reg.histogram("worker_step_seconds", "h").observe(0.1)
+    plane.ingest(5, worker_reg.snapshot())
+    plane.slo_tick(clock())
+    assert store.last_seen("edl_tpu_worker_step_seconds", source="5")
+    # The autoscaler drains worker 5 on purpose.
+    servicer = MasterServicer(
+        TaskDispatcher({}, {}, {}, 4, 1), metrics_plane=plane
+    )
+    servicer.remove_worker_metrics(5)
+    assert not store.last_seen(
+        "edl_tpu_worker_step_seconds", source="5"
+    )
+    clock.advance(600)
+    assert engine.evaluate(clock())[0]["firing"] is False
+
+
+def test_sharded_freshness_reports_stalest_shard():
+    from elasticdl_tpu.embedding.row_service import _ShardedTable
+
+    class FakeShard:
+        name, dim = "t", 4
+
+        def __init__(self, stamp):
+            self.last_applied_at = stamp
+
+    # One shard's push pipeline stalled 600s ago: the table-level
+    # stamp must be the stale one (max would mask the stall).
+    table = _ShardedTable(
+        [FakeShard(1000.0), FakeShard(1600.0), FakeShard(0.0)],
+        pool=None,
+    )
+    assert table.last_applied_at == pytest.approx(1000.0)
+    # No shard ever pushed: unknown, not "freshest possible".
+    assert _ShardedTable(
+        [FakeShard(0.0), FakeShard(0.0)], pool=None
+    ).last_applied_at == 0.0
+
+
+# ---- rule evaluation -----------------------------------------------------
+
+
+def burn_rule(**overrides):
+    kw = dict(
+        name="latency-burn", kind="burn_rate",
+        series="edl_tpu_lat_seconds", latency_threshold=0.05,
+        objective=0.95, long_window_secs=60.0, short_window_secs=15.0,
+        burn_rate_threshold=3.0, min_count=5,
+    )
+    kw.update(overrides)
+    return SLORule(**kw)
+
+
+def test_burn_rate_fires_on_slow_tail_and_clears():
+    clock = FakeClock()
+    store = make_store(clock)
+    reg = MetricsRegistry()
+    h = reg.histogram("lat_seconds", "h")
+    engine = SLOEngine(store, rules=[burn_rule()],
+                       metrics_registry=reg, clock=clock)
+    # Healthy: all fast.
+    for _ in range(3):
+        for _ in range(5):
+            h.observe(0.001)
+        sample_registry(store, reg, clock)
+        clock.advance(5)
+    states = engine.evaluate()
+    assert states[0]["firing"] is False
+    # Stall: every observation slow → error ratio 1.0 = 20x budget.
+    for _ in range(3):
+        for _ in range(5):
+            h.observe(0.5)
+        sample_registry(store, reg, clock)
+        clock.advance(5)
+    states = engine.evaluate()
+    assert states[0]["firing"] is True
+    assert states[0]["value"] >= 3.0
+    assert engine.firing() == ["latency-burn"]
+    # Gauge surfaced for scrapers.
+    snap = reg.snapshot()
+    active = [
+        s for f in snap["families"]
+        if f["name"] == "edl_tpu_alert_active"
+        for s in f["series"]
+    ]
+    assert active and active[0]["value"] == 1.0
+    # Recovery: the short window goes clean first; once the long
+    # window's tail ages out the alert clears.
+    for _ in range(14):
+        for _ in range(5):
+            h.observe(0.001)
+        sample_registry(store, reg, clock)
+        clock.advance(5)
+    states = engine.evaluate()
+    assert states[0]["firing"] is False
+    assert engine.firing() == []
+
+
+def test_burn_rate_insufficient_data_never_fires():
+    clock = FakeClock()
+    store = make_store(clock)
+    reg = MetricsRegistry()
+    h = reg.histogram("lat_seconds", "h")
+    engine = SLOEngine(store, rules=[burn_rule(min_count=50)],
+                       metrics_registry=reg, clock=clock)
+    h.observe(0.5)
+    sample_registry(store, reg, clock)
+    clock.advance(5)
+    h.observe(0.5)
+    sample_registry(store, reg, clock)
+    assert engine.evaluate()[0]["firing"] is False
+
+
+def test_counter_pair_burn_rate():
+    clock = FakeClock()
+    store = make_store(clock)
+    reg = MetricsRegistry()
+    total = reg.counter("requests_total", "h")
+    bad = reg.counter("errors_total", "h")
+    rule = SLORule(
+        name="error-burn", kind="burn_rate",
+        series="edl_tpu_requests_total",
+        bad_series="edl_tpu_errors_total",
+        objective=0.99, long_window_secs=60.0, short_window_secs=15.0,
+        burn_rate_threshold=4.0, min_count=10,
+    )
+    engine = SLOEngine(store, rules=[rule], metrics_registry=reg,
+                       clock=clock)
+    total.inc(100)
+    sample_registry(store, reg, clock)
+    clock.advance(5)
+    total.inc(100)
+    bad.inc(10)  # 10% errors = 10x the 1% budget
+    sample_registry(store, reg, clock)
+    state = engine.evaluate()[0]
+    assert state["firing"] is True
+    assert state["value"] == pytest.approx(10.0)
+
+
+def test_threshold_rule_on_gauge_and_histogram():
+    clock = FakeClock()
+    store = make_store(clock)
+    reg = MetricsRegistry()
+    reg.gauge("queue", "h").set(50)
+    h = reg.histogram("step_seconds", "h")
+    rules = [
+        SLORule(name="deep-queue", kind="threshold",
+                series="edl_tpu_queue", aggregation="last", op=">",
+                value=10.0, window_secs=60.0),
+        SLORule(name="slow-steps", kind="threshold",
+                series="edl_tpu_step_seconds", aggregation="p99",
+                op=">", value=5.0, window_secs=60.0),
+    ]
+    engine = SLOEngine(store, rules=rules, metrics_registry=reg,
+                       clock=clock)
+    sample_registry(store, reg, clock)  # primes histogram prev
+    clock.advance(5)
+    h.observe(10.0)
+    sample_registry(store, reg, clock)
+    states = {s["rule"]: s for s in engine.evaluate()}
+    assert states["deep-queue"]["firing"] is True
+    assert states["slow-steps"]["firing"] is True
+    assert states["slow-steps"]["value"] >= 5.0
+
+
+def test_absence_rule_fires_on_stale_then_forgets():
+    clock = FakeClock()
+    store = make_store(clock)
+    reg = MetricsRegistry()
+    reg.gauge("worker_step_utilization", "h").set(0.8)
+    rule = SLORule(
+        name="gone", kind="absence",
+        series="edl_tpu_worker_step_utilization",
+        staleness_secs=30.0, forget_secs=120.0,
+    )
+    engine = SLOEngine(store, rules=[rule], metrics_registry=reg,
+                       clock=clock)
+    snap = reg.snapshot()
+    store.sample({"7": (snap, 1)}, now=clock())
+    assert engine.evaluate()[0]["firing"] is False
+    # Reporter stops: fingerprint never advances.
+    clock.advance(60)
+    store.sample({"7": (snap, 1)}, now=clock())
+    state = engine.evaluate()[0]
+    assert state["firing"] is True
+    assert "7" in state["detail"]
+    # Long-gone (scaled away): drops off the alert after forget_secs.
+    clock.advance(120)
+    assert engine.evaluate()[0]["firing"] is False
+
+
+def test_rule_file_roundtrip_and_unknown_field_rejected(tmp_path):
+    path = tmp_path / "rules.json"
+    path.write_text(json.dumps({
+        "rules": [r.to_dict() for r in default_rules()]
+    }))
+    rules = load_rules(str(path))
+    assert [r.name for r in rules] == [r.name for r in default_rules()]
+    path.write_text(json.dumps([{
+        "name": "x", "kind": "threshold", "series": "s",
+        "thresold_value": 3,
+    }]))
+    with pytest.raises(ValueError, match="thresold_value"):
+        load_rules(str(path))
+
+
+def test_duplicate_rule_names_rejected():
+    clock = FakeClock()
+    store = make_store(clock)
+    rule = burn_rule()
+    with pytest.raises(ValueError, match="duplicate"):
+        SLOEngine(store, rules=[rule, burn_rule()],
+                  metrics_registry=MetricsRegistry(), clock=clock)
+
+
+# ---- cluster-view interplay (satellite: TTL vs sampler) ------------------
+
+
+def test_worker_that_stops_reporting_goes_stale_not_flat():
+    """A worker that stops piggybacking must NOT flat-line at its last
+    gauge value: the sampler skips un-re-arrived snapshots (fingerprint)
+    so its series freeze, the absence rule fires, and once the
+    ClusterMetrics TTL retires the worker it leaves the sample set
+    entirely."""
+    from elasticdl_tpu.observability import MetricsPlane
+
+    clock = FakeClock()
+    plane = MetricsPlane(registry=MetricsRegistry(), ttl_secs=60.0)
+    store = plane.enable_timeseries(cadence_secs=5.0)
+    store._clock = clock
+    engine = plane.enable_slo(rules=[SLORule(
+        name="worker-gone", kind="absence",
+        series="edl_tpu_worker_step_utilization",
+        staleness_secs=20.0, forget_secs=1000.0,
+    )], clock=clock)
+
+    worker_reg = MetricsRegistry()
+    worker_reg.gauge("worker_step_utilization", "h").set(0.9)
+    plane.ingest(3, worker_reg.snapshot())
+    assert plane.slo_tick(clock()) is not None
+    last = store.last_seen("edl_tpu_worker_step_utilization",
+                           source="3")
+    assert list(last.values()) == [clock()]
+    frozen_at = clock()
+
+    # The worker goes silent. Its snapshot stays in the cluster view
+    # (TTL not hit) but the sampler must not re-append it.
+    for _ in range(5):
+        clock.advance(5)
+        plane.slo_tick(clock())
+    last = store.last_seen("edl_tpu_worker_step_utilization",
+                           source="3")
+    assert list(last.values()) == [frozen_at], \
+        "silent worker's series flat-lined instead of going stale"
+    states = engine.evaluate(clock())
+    assert states[0]["firing"] is True
+
+    # Reporting resumes → fresh arrival fingerprint → alert clears.
+    plane.ingest(3, worker_reg.snapshot())
+    clock.advance(5)
+    plane.slo_tick(clock())
+    assert engine.evaluate(clock())[0]["firing"] is False
+
+
+def test_router_report_metrics_folds_into_cluster_view():
+    """Satellite: non-worker components report through the same
+    snapshot piggyback; the cluster view, exposition, and time-series
+    store all see them."""
+    from elasticdl_tpu.master.servicer import MasterServicer
+    from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+    from elasticdl_tpu.observability import (
+        MetricsPlane,
+        render_prometheus,
+    )
+
+    plane = MetricsPlane(registry=MetricsRegistry(), ttl_secs=600.0)
+    plane.enable_timeseries(cadence_secs=0.0)
+    servicer = MasterServicer(
+        TaskDispatcher({}, {}, {}, 4, 1), metrics_plane=plane
+    )
+    router_reg = MetricsRegistry()
+    router_reg.counter("router_requests_total", "h",
+                       labelnames=("code",)).labels("200").inc(5)
+    resp = servicer.report_metrics({
+        "component": "router", "component_id": 0,
+        "metrics": router_reg.snapshot(),
+    })
+    assert resp["accepted"] is True
+    assert "router-0" in plane.cluster.snapshots()
+    text = render_prometheus(
+        plane.registry.snapshot(), plane.cluster.snapshots()
+    )
+    assert 'worker="router-0"' in text
+    assert "edl_tpu_router_requests_total" in text
+    # Mixed int + str reporter keys must not break sorting anywhere.
+    plane.ingest(1, router_reg.snapshot())
+    assert plane.cluster.worker_ids() == [1, "router-0"]
+    render_prometheus(None, plane.cluster.snapshots())
+    # And the sampler sees the router as a source.
+    plane.sample_timeseries()
+    assert any(
+        key.endswith("@router-0")
+        for key in plane.timeseries.series_names()
+    )
+    # Garbage component names are rejected, not labeled.
+    assert servicer.report_metrics({
+        "component": 'bad"name', "metrics": router_reg.snapshot(),
+    })["accepted"] is False
+    # Malformed snapshot shapes are rejected at the RPC, not stored to
+    # crash the sampler on the next master tick.
+    for bad in (
+        "not-a-dict",
+        {"families": "nope"},
+        {"families": [{"name": "x", "kind": "counter",
+                       "series": "y"}]},
+        {"families": [{"name": "x", "kind": "counter",
+                       "series": ["z"]}]},
+    ):
+        assert servicer.report_metrics({
+            "component": "router", "metrics": bad,
+        })["accepted"] is False
+    # And even if one slipped past, the tick degrades instead of
+    # killing the run loop.
+    plane.cluster.ingest("router-9", {
+        "instance": "i", "families": [
+            {"name": "edl_tpu_x", "kind": "counter", "series": "boom"}
+        ],
+    })
+    assert plane.slo_tick() is None or True  # must not raise
+
+
+def test_serving_replica_reporter_feeds_freshness_rule():
+    """The serving replica's ComponentMetricsReporter closes the loop
+    the default row-freshness rule depends on: its registry (with
+    edl_tpu_row_freshness_seconds) reaches the master's store over the
+    real report_metrics RPC."""
+    from elasticdl_tpu.comm.rpc import RpcServer
+    from elasticdl_tpu.master.servicer import (
+        SERVICE_NAME,
+        MasterServicer,
+    )
+    from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+    from elasticdl_tpu.observability import MetricsPlane
+    from elasticdl_tpu.observability.reporter import (
+        ComponentMetricsReporter,
+    )
+
+    plane = MetricsPlane(registry=MetricsRegistry(), ttl_secs=600.0)
+    store = plane.enable_timeseries(cadence_secs=0.0)
+    servicer = MasterServicer(
+        TaskDispatcher({}, {}, {}, 4, 1), metrics_plane=plane
+    )
+    server = RpcServer(
+        "localhost:0", {SERVICE_NAME: servicer.handlers()}
+    ).start()
+    try:
+        replica_reg = MetricsRegistry()
+        replica_reg.histogram(
+            "row_freshness_seconds", "h"
+        ).observe(3.0)
+        reporter = ComponentMetricsReporter(
+            f"localhost:{server.port}", "serving", 1,
+            registry=replica_reg,
+        )
+        reporter.send_once()
+        reporter.send_once()
+        assert reporter.reports_sent == 2
+        assert "serving-1" in plane.cluster.snapshots()
+        plane.sample_timeseries()
+        replica_reg.histogram("row_freshness_seconds", "h").observe(4.0)
+        reporter.send_once()
+        store._last_sample_at = None
+        plane.sample_timeseries()
+        _p99, n = store.window_quantile(
+            "edl_tpu_row_freshness_seconds", 1e9, 0.99,
+            source="serving-1",
+        )
+        assert n >= 1
+    finally:
+        server.stop(0)
+
+
+def test_window_hist_survives_bucket_length_change():
+    """A process restarted with a different bucket config appends
+    new-length points into the same ring; the window reduction must
+    degrade gracefully, not IndexError the rule blind."""
+    clock = FakeClock()
+    store = make_store(clock)
+    reg = MetricsRegistry()
+    h = reg.histogram("lat_seconds", "h", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    sample_registry(store, reg, clock)
+    clock.advance(5)
+    h.observe(0.05)
+    sample_registry(store, reg, clock)
+    clock.advance(5)
+    # Restart with MORE buckets under the same family name.
+    reg.reset()
+    h2 = reg.histogram("lat_seconds", "h", buckets=(0.1, 0.5, 1.0, 5.0))
+    h2.observe(2.0)
+    sample_registry(store, reg, clock)
+    count, total, deltas, ubs = store.window_hist(
+        "edl_tpu_lat_seconds", 60
+    )
+    assert count == 2  # one pre-restart point + the reset point
+    assert len(deltas) == 4
+
+
+# ---- endpoints -----------------------------------------------------------
+
+
+def test_timeseries_and_alerts_endpoints_over_http():
+    from elasticdl_tpu.observability import MetricsPlane
+
+    reg = MetricsRegistry()
+    plane = MetricsPlane(registry=reg)
+    plane.enable_timeseries(cadence_secs=0.0)
+    plane.enable_slo(rules=[SLORule(
+        name="q", kind="threshold", series="edl_tpu_queue",
+        aggregation="last", op=">", value=1.0, window_secs=600.0,
+    )])
+    reg.gauge("queue", "h").set(5)
+    server = plane.serve(port=0)
+    try:
+        plane.slo_tick()
+        time.sleep(0.01)
+        plane.timeseries._last_sample_at = None  # force a second due
+        plane.slo_tick()
+        base = f"http://localhost:{server.port}"
+        with urllib.request.urlopen(
+            base + "/timeseries?name=edl_tpu_queue&window=600"
+        ) as resp:
+            body = json.loads(resp.read())
+        assert body["series"]["edl_tpu_queue"]["points"]
+        with urllib.request.urlopen(base + "/alerts") as resp:
+            alerts = json.loads(resp.read())
+        assert alerts["firing"] == ["q"]
+        assert alerts["rules"][0]["rule"] == "q"
+        # Unknown route still 404s with the route list.
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(base + "/nope")
+    finally:
+        plane.stop()
+
+
+def test_dump_metrics_alerts_rendering(capsys):
+    from tools.dump_metrics import print_alerts
+
+    print_alerts({
+        "now": 100.0,
+        "firing": ["a"],
+        "rules": [
+            {"rule": "a", "kind": "burn_rate", "series": "s",
+             "firing": True, "since": 40.0, "detail": "burning"},
+            {"rule": "b", "kind": "absence", "series": "t",
+             "firing": False, "detail": "all fresh"},
+        ],
+    })
+    out = capsys.readouterr().out
+    assert "1/2 rule(s) firing: a" in out
+    assert "FIRING" in out and "for 60s" in out
+    assert "all fresh" in out
+    print_alerts({"error": "disabled"})
+    assert "no SLO rules" in capsys.readouterr().out
+
+
+# ---- incident bundles ----------------------------------------------------
+
+
+def test_incident_recorder_bundle_passes_schema_check(tmp_path):
+    from tools.check_incident import check_incident, newest_bundle
+
+    from elasticdl_tpu.observability import MetricsPlane, tracing
+
+    clock = FakeClock()
+    reg = MetricsRegistry()
+    plane = MetricsPlane(registry=reg)
+    store = plane.enable_timeseries(cadence_secs=0.0)
+    store._clock = clock
+    h = reg.histogram("lat_seconds", "h")
+    h.observe(0.5)
+    store.sample({"": (reg.snapshot(), None)}, now=clock())
+    clock.advance(5)
+    h.observe(0.7)
+    store.sample({"": (reg.snapshot(), None)}, now=clock())
+
+    recorder_ring = tracing.FlightRecorder(64)
+    tracing.install_recorder(recorder_ring)
+    try:
+        with tracing.Tracer("worker", "0").span("task"):
+            with tracing.span("device_step"):
+                pass
+    finally:
+        tracing.uninstall_recorder()
+    plane.traces.ingest(recorder_ring.snapshot())
+
+    recorder = IncidentRecorder(
+        str(tmp_path), metrics_plane=plane, store=store,
+        journal_tail_fn=lambda: [{"t": "dispatch", "seq": 1}],
+        cooldown_secs=300.0, background=False, clock=clock,
+    )
+    engine = SLOEngine(
+        store, rules=[burn_rule(min_count=1)], metrics_registry=reg,
+        incident_recorder=recorder, clock=clock,
+    )
+    states = engine.evaluate()
+    assert states[0]["firing"] is True
+    assert len(recorder.bundles) == 1
+    bundle = recorder.bundles[0]
+    assert newest_bundle(str(tmp_path)) == bundle
+    assert check_incident(bundle) == []
+    with open(os.path.join(bundle, "journal_tail.json")) as fh:
+        assert json.load(fh)["records"][0]["t"] == "dispatch"
+
+    # Cooldown: a re-fire inside the window writes nothing new.
+    assert recorder.capture(engine.alert_state("latency-burn")) is None
+    clock.advance(301)
+    assert recorder.capture(
+        engine.alert_state("latency-burn")
+    ) is not None
+
+
+def test_check_incident_rejects_empty_series(tmp_path):
+    from tools.check_incident import check_incident
+
+    bundle = tmp_path / "incident_x"
+    bundle.mkdir()
+    (bundle / "alert.json").write_text(json.dumps({
+        "captured_at": 1.0,
+        "alert": {"rule": "r", "kind": "burn_rate", "firing": True,
+                  "series": "edl_tpu_lat_seconds"},
+    }))
+    (bundle / "trace.json").write_text(json.dumps({"traceEvents": [
+        {"ph": "M", "name": "process_name", "pid": 1, "tid": 0,
+         "args": {"name": "master"}},
+        {"ph": "X", "name": "task", "ts": 0, "dur": 1, "pid": 1,
+         "tid": 1, "args": {"span_id": "a"}},
+    ]}))
+    (bundle / "critical_path.json").write_text(
+        json.dumps({"span_count": 1, "trace_count": 1})
+    )
+    (bundle / "series.json").write_text(json.dumps({"series": {}}))
+    (bundle / "journal_tail.json").write_text(
+        json.dumps({"records": []})
+    )
+    errors = check_incident(str(bundle))
+    assert any("empty series window" in e for e in errors)
+
+
+def test_check_incident_tolerates_empty_trace(tmp_path):
+    """A master with --incident_dir but no --flight_recorder bundles
+    an empty trace; the checker must accept it (the series window and
+    attribution are still the artifact)."""
+    from tools.check_incident import check_incident
+
+    bundle = tmp_path / "incident_y"
+    bundle.mkdir()
+    (bundle / "alert.json").write_text(json.dumps({
+        "captured_at": 1.0,
+        "alert": {"rule": "r", "kind": "threshold", "firing": True,
+                  "series": "edl_tpu_g"},
+    }))
+    (bundle / "trace.json").write_text(
+        json.dumps({"traceEvents": [], "displayTimeUnit": "ms"})
+    )
+    (bundle / "critical_path.json").write_text(
+        json.dumps({"span_count": 0, "trace_count": 0})
+    )
+    (bundle / "series.json").write_text(json.dumps({"series": {
+        "edl_tpu_g": {"kind": "gauge", "family": "edl_tpu_g",
+                      "source": "", "points": [[1.0, 2.0]]},
+    }}))
+    (bundle / "journal_tail.json").write_text(
+        json.dumps({"records": []})
+    )
+    assert check_incident(str(bundle)) == []
+
+
+# ---- consumers -----------------------------------------------------------
+
+
+def test_autoscaler_timeseries_utilization_trend():
+    from elasticdl_tpu.master.autoscaler import (
+        utilization_from_timeseries,
+    )
+
+    clock = FakeClock()
+    store = make_store(clock)
+    reg = MetricsRegistry()
+    util = reg.gauge("worker_step_utilization", "h")
+    assert utilization_from_timeseries(store, 120.0) is None
+    for value in (0.9, 0.1, 0.5):
+        util.set(value)
+        sample_registry(store, reg, clock, source="0")
+        clock.advance(5)
+    trend = utilization_from_timeseries(store, 120.0)
+    assert trend == pytest.approx(0.5)
+
+
+def test_master_signals_prefers_timeseries_when_given():
+    from elasticdl_tpu.master.autoscaler import master_signals
+    from elasticdl_tpu.master.servicer import MasterServicer
+    from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+    from elasticdl_tpu.observability import MetricsPlane
+
+    clock = FakeClock()
+    plane = MetricsPlane(registry=MetricsRegistry(), ttl_secs=600.0)
+    store = plane.enable_timeseries(cadence_secs=0.0)
+    store._clock = clock
+    dispatcher = TaskDispatcher({}, {}, {}, 4, 1)
+    servicer = MasterServicer(dispatcher, metrics_plane=plane)
+    # Instantaneous snapshot says 0.9; the trend window says 0.3.
+    worker_reg = MetricsRegistry()
+    gauge = worker_reg.gauge("worker_step_utilization", "h")
+    gauge.set(0.1)
+    store.sample({"0": (worker_reg.snapshot(), 1)}, now=clock())
+    clock.advance(5)
+    gauge.set(0.5)
+    store.sample({"0": (worker_reg.snapshot(), 2)}, now=clock())
+    gauge.set(0.9)
+    plane.ingest(0, worker_reg.snapshot())
+    signals_snapshot = master_signals(
+        dispatcher, servicer, plane, lambda: 1, with_traces=False,
+    )
+    signals_trend = master_signals(
+        dispatcher, servicer, plane, lambda: 1, with_traces=False,
+        timeseries=store, trend_window_secs=120.0,
+    )
+    assert signals_snapshot().step_utilization == pytest.approx(0.9)
+    assert signals_trend().step_utilization == pytest.approx(0.3)
+
+
+def test_rolling_window_status_and_router_replica_slo():
+    window = RollingWindow(window_secs=60.0)
+    assert window.status()["requests"] == 0
+    now = time.monotonic()
+    for i in range(20):
+        window.record(ok=(i != 0), latency_secs=0.01, now=now)
+    status = window.status(now=now)
+    assert status["requests"] == 20
+    assert status["error_ratio"] == pytest.approx(0.05)
+    assert status["p95_ms"] == pytest.approx(10.0)
+
+    from elasticdl_tpu.serving.router import RouterCore
+
+    core = RouterCore(
+        ["localhost:1", "localhost:2"], hedge=False,
+        slo_p95_ms=100.0, slo_error_ratio=0.1,
+        metrics_registry=MetricsRegistry(),
+    )
+    try:
+        states = core.states()
+        assert [s["slo"]["ok"] for s in states] == [None, None]
+        for _ in range(10):
+            core._slo_windows[0].record(True, 0.01)
+            core._slo_windows[1].record(False, 0.5)
+        states = core.states()
+        assert states[0]["slo"]["ok"] is True
+        assert states[1]["slo"]["ok"] is False
+        assert states[1]["slo"]["error_ratio"] == 1.0
+    finally:
+        core.stop()
+
+
+def test_row_service_freshness_stamp_and_resolver_metric():
+    """Satellite: push stamps applied-at; a pull carries it; the
+    serving resolver (and its cache) observe push-to-servable
+    latency."""
+    from model_zoo.deepfm import deepfm_host
+
+    from elasticdl_tpu.embedding.row_service import make_remote_engine
+    from elasticdl_tpu.serving.model_store import (
+        HostRowResolver,
+        HotRowCache,
+    )
+
+    svc = deepfm_host.make_row_service()
+    svc.start("localhost:0", tag="rowservice/0")
+    try:
+        engine = make_remote_engine(
+            f"localhost:{svc.port}",
+            id_keys={deepfm_host.TABLE_NAME: deepfm_host.FEATURE_KEY},
+        )
+        table = engine.tables[deepfm_host.TABLE_NAME]
+        table.get(np.array([1, 2, 3]))
+        assert table.last_applied_at == 0.0  # nothing pushed yet
+        engine.optimizer.apply_gradients(
+            table, np.array([1, 2]),
+            np.zeros((2, table.dim), np.float32),
+        )
+        t_push = time.time()
+        table.get(np.array([1, 2]))
+        assert 0 < table.last_applied_at <= t_push + 1.0
+        versions = svc._table_versions_handler({})
+        assert versions["applied_at"][deepfm_host.TABLE_NAME] > 0
+
+        reg = MetricsRegistry()
+        cache = HotRowCache(capacity=100, version_check_secs=-1,
+                            metrics_registry=reg)
+        resolver = HostRowResolver(
+            {"id_keys": {deepfm_host.TABLE_NAME:
+                         deepfm_host.FEATURE_KEY},
+             "tables": {deepfm_host.TABLE_NAME: table.dim}},
+            {deepfm_host.TABLE_NAME: table},
+            row_cache=cache,
+            metrics_registry=reg,
+        )
+        features = {deepfm_host.FEATURE_KEY: np.array([[1, 2]])}
+        resolver.resolve(dict(features))   # miss path: pull observes
+        resolver.resolve(dict(features))   # hit path: cache stamp
+
+        def freshness_count():
+            snap = reg.snapshot()
+            fam = [f for f in snap["families"]
+                   if f["name"] == "edl_tpu_row_freshness_seconds"]
+            return fam[0]["series"][0]["count"] if fam else 0
+
+        assert freshness_count() == 2
+        assert cache.applied_at(deepfm_host.TABLE_NAME) > 0
+    finally:
+        svc.stop(0)
+
+
+def test_default_rules_include_freshness_slo():
+    rules = {r.name: r for r in default_rules()}
+    fresh = rules["row-freshness"]
+    assert fresh.series == "edl_tpu_row_freshness_seconds"
+    assert fresh.kind == "threshold"
+    # Idle by default: a deployment without the serving tier must not
+    # page on the missing family.
+    clock = FakeClock()
+    store = make_store(clock)
+    engine = SLOEngine(store, rules=default_rules(),
+                       metrics_registry=MetricsRegistry(), clock=clock)
+    assert all(not s["firing"] for s in engine.evaluate())
+
+
+# ---- the drill (fast-lane equivalent of make slo-smoke) ------------------
+
+
+def test_slo_drill_passes(tmp_path):
+    from elasticdl_tpu.chaos import slo_drill
+
+    report = tmp_path / "SLO_DRILL.json"
+    rc = slo_drill.main([
+        "--workdir", str(tmp_path / "work"),
+        "--report", str(report),
+        "--records", "64",
+    ])
+    assert rc == 0
+    body = json.loads(report.read_text())
+    assert body["ok"] is True
+    assert body["faulted"]["fired_count"] >= 1
+    assert body["faulted"]["bundles"]
+    assert body["healthy"]["fired_count"] == 0

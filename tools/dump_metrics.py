@@ -6,6 +6,8 @@ Usage::
     python tools/dump_metrics.py localhost:8080          # pretty table
     python tools/dump_metrics.py http://host:port --raw  # exposition text
     python tools/dump_metrics.py localhost:8080 --traces # + span trees
+    python tools/dump_metrics.py localhost:8080 --alerts # + /alerts
+    python tools/dump_metrics.py localhost:8080 --watch 5  # live redraw
     make metrics METRICS_ADDR=localhost:8080
 
 Works against any Prometheus text endpoint — the in-process test
@@ -14,14 +16,19 @@ cluster (``MiniCluster(metrics_port=0)``), a real master started with
 registry. ``--traces`` additionally fetches the sibling ``/traces``
 endpoint (the flight recorder / master trace collection, served when
 the process runs with ``--flight_recorder N``) and pretty-prints each
-trace as an indented span tree with durations. Stdlib only (urllib),
-like the endpoint itself.
+trace as an indented span tree with durations. ``--alerts`` fetches
+``/alerts`` (the SLO engine's rule states, served when the master runs
+with ``--timeseries_secs > 0``) and renders a firing/ok table.
+``--watch N`` redraws everything every N seconds until interrupted —
+the terminal equivalent of a dashboard, no curl+jq loop required.
+Stdlib only (urllib), like the endpoints themselves.
 """
 
 import argparse
 import json
 import re
 import sys
+import time
 import urllib.request
 
 _SAMPLE_RE = re.compile(
@@ -103,7 +110,7 @@ def pretty_print(text: str, out=None):
 
 
 def traces_url(addr: str) -> str:
-    return normalize_url(addr).rsplit("/metrics", 1)[0] + "/traces"
+    return sibling_url(addr, "/traces")
 
 
 def fetch_traces(addr: str, timeout: float = 10.0) -> list:
@@ -160,17 +167,48 @@ def print_spans(spans: list, out=None):
     out.write(f"({len(spans)} spans, {len(roots)} roots)\n")
 
 
-def main(argv=None) -> int:
-    parser = argparse.ArgumentParser("dump_metrics")
-    parser.add_argument("addr", help="host:port or URL of the master "
-                                     "metrics endpoint")
-    parser.add_argument("--raw", action="store_true",
-                        help="Print the exposition text verbatim")
-    parser.add_argument("--traces", action="store_true",
-                        help="Also fetch /traces and print the flight "
-                             "recorder as indented span trees")
-    parser.add_argument("--timeout", type=float, default=10.0)
-    args = parser.parse_args(argv)
+def sibling_url(addr: str, path: str) -> str:
+    return normalize_url(addr).rsplit("/metrics", 1)[0] + path
+
+
+def fetch_alerts(addr: str, timeout: float = 10.0) -> dict:
+    """The SLO engine's /alerts body (docs/observability.md)."""
+    with urllib.request.urlopen(
+        sibling_url(addr, "/alerts"), timeout=timeout
+    ) as resp:
+        return json.loads(resp.read().decode("utf-8"))
+
+
+def print_alerts(alerts: dict, out=None):
+    """One line per rule: state, value, human detail."""
+    out = out if out is not None else sys.stdout
+    rules = alerts.get("rules") or []
+    if alerts.get("error") or not rules:
+        out.write(
+            f"no SLO rules ({alerts.get('error', 'none configured')};"
+            " master needs --timeseries_secs > 0)\n"
+        )
+        return
+    firing = alerts.get("firing") or []
+    out.write(
+        f"{len(firing)}/{len(rules)} rule(s) firing"
+        f"{': ' + ', '.join(firing) if firing else ''}\n"
+    )
+    for rule in rules:
+        state = "FIRING" if rule.get("firing") else "ok"
+        since = rule.get("since")
+        since_text = ""
+        if rule.get("firing") and since and alerts.get("now"):
+            since_text = f" for {alerts['now'] - since:.0f}s"
+        out.write(
+            f"  [{state:>6}]{since_text} {rule.get('rule')} "
+            f"({rule.get('kind')} on {rule.get('series')})\n"
+            f"           {rule.get('detail') or rule.get('description')}"
+            "\n"
+        )
+
+
+def dump_once(args) -> int:
     try:
         text = fetch_metrics(args.addr, timeout=args.timeout)
     except OSError as exc:
@@ -190,7 +228,52 @@ def main(argv=None) -> int:
             return 1
         sys.stdout.write("\n---- traces ----\n")
         print_spans(spans)
+    if args.alerts:
+        try:
+            alerts = fetch_alerts(args.addr, timeout=args.timeout)
+        except OSError as exc:
+            print(f"alerts fetch failed: {exc} (endpoint serves "
+                  "/alerts only with --timeseries_secs > 0)",
+                  file=sys.stderr)
+            return 1
+        sys.stdout.write("\n---- alerts ----\n")
+        print_alerts(alerts)
     return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser("dump_metrics")
+    parser.add_argument("addr", help="host:port or URL of the master "
+                                     "metrics endpoint")
+    parser.add_argument("--raw", action="store_true",
+                        help="Print the exposition text verbatim")
+    parser.add_argument("--traces", action="store_true",
+                        help="Also fetch /traces and print the flight "
+                             "recorder as indented span trees")
+    parser.add_argument("--alerts", action="store_true",
+                        help="Also fetch /alerts and print the SLO "
+                             "rule states")
+    parser.add_argument("--watch", type=float, default=0.0,
+                        metavar="SECS",
+                        help="Redraw every SECS seconds until "
+                             "interrupted (ctrl-C exits cleanly)")
+    parser.add_argument("--timeout", type=float, default=10.0)
+    args = parser.parse_args(argv)
+    if args.watch <= 0:
+        return dump_once(args)
+    try:
+        while True:
+            # ANSI clear + home: redraw in place like `watch(1)`.
+            sys.stdout.write("\x1b[2J\x1b[H")
+            sys.stdout.write(
+                f"{args.addr}  every {args.watch:g}s  "
+                f"{time.strftime('%H:%M:%S')}\n\n"
+            )
+            dump_once(args)
+            sys.stdout.flush()
+            time.sleep(args.watch)
+    except KeyboardInterrupt:
+        return 0
 
 
 if __name__ == "__main__":
