@@ -7,7 +7,7 @@ PY ?= python
 .PHONY: test test-all test-tpu test-k8s native bench serve-bench dryrun \
 	clean lint metrics chaos-smoke chaos-soak chaos-master-smoke \
 	trace-smoke serve-fleet-smoke sparse-smoke sparse-bench \
-	autoscale-smoke autoscale-bench slo-smoke
+	autoscale-smoke autoscale-bench slo-smoke ckpt-bench ckpt-smoke
 
 # Scrape-and-pretty-print a master's /metrics (docs/observability.md).
 METRICS_ADDR ?= localhost:8080
@@ -117,13 +117,43 @@ slo-smoke:
 	&& $(PY) tools/check_incident.py $$workdir/incidents; \
 	rc=$$?; rm -rf $$workdir; exit $$rc
 
+# Checkpoint-plane bench (docs/fault_tolerance.md "Checkpoint
+# format"): async capture/write + dirty-row deltas vs the inline
+# full-snapshot path over identical push schedules; writes
+# BENCH_CHECKPOINT.json. Gates: p99 push stall >=5x lower async,
+# delta bytes <=0.2x a full base on the hot-working-set workload.
+ckpt-bench:
+	JAX_PLATFORMS=cpu $(PY) tools/bench_checkpoint.py
+
+# Fast checkpoint smoke: tiny bench config (report to the scratch dir,
+# the committed BENCH_CHECKPOINT.json stays put), then fsck both
+# checkpoint dirs it produced — framing, chain linkage,
+# slowest-shard-wins validity, reclaimable garbage. Fast-lane
+# equivalent: tests/test_checkpoint.py::TestDeltaChain +
+# ::TestCheckpointFsck.
+ckpt-smoke:
+	workdir=$$(mktemp -d /tmp/edl_ckpt.XXXXXX); \
+	JAX_PLATFORMS=cpu $(PY) tools/bench_checkpoint.py --smoke \
+		--workdir $$workdir --out $$workdir/BENCH_CHECKPOINT.json \
+	&& $(PY) tools/check_checkpoint.py $$workdir/inline/ckpt \
+	&& $(PY) tools/check_checkpoint.py $$workdir/async_delta/ckpt; \
+	rc=$$?; rm -rf $$workdir; exit $$rc
+
 # Deterministic chaos plan (kill + stall-row-shard + corrupt-checkpoint)
 # against the in-process cluster; exits nonzero if any recovery
-# invariant fails. Tier-1 safe (~15s on CPU). docs/chaos.md.
+# invariant fails — the schedule includes a worker kill landing
+# between a row-service delta save and its base compaction, and the
+# end-of-run shard relaunch restores across the base+delta chain.
+# The row checkpoint dir the drill leaves behind is then fsck'd.
+# Tier-1 safe (~15s on CPU). docs/chaos.md.
 CHAOS_SEED ?= 7
 chaos-smoke:
+	workdir=$$(mktemp -d /tmp/edl_chaos.XXXXXX); \
 	JAX_PLATFORMS=cpu $(PY) -m elasticdl_tpu chaos run \
-		--seed $(CHAOS_SEED) --report CHAOS_r01.json
+		--seed $(CHAOS_SEED) --workdir $$workdir \
+		--report CHAOS_r01.json \
+	&& $(PY) tools/check_checkpoint.py $$workdir/r0/faulted/rows/s0; \
+	rc=$$?; rm -rf $$workdir; exit $$rc
 
 # Master-crash drill (docs/fault_tolerance.md): two master kills
 # recovered by write-ahead journal replay, workers riding the outage

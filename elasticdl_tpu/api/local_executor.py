@@ -95,6 +95,7 @@ class LocalExecutor:
             # (CheckpointSaver.gc); only an absent flag falls back to 3.
             keep_max=getattr(args, "keep_checkpoint_max", 3),
             host_tables=getattr(self._step_runner, "host_tables", None),
+            delta_chain_max=getattr(args, "checkpoint_delta_chain", 0),
         )
         self._init_checkpoint_dir = getattr(
             args, "checkpoint_dir_for_init", ""

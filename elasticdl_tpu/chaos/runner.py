@@ -94,6 +94,8 @@ class ChaosRunner:
         include_timings: bool = False,
         debug_disable_recovery: bool = False,
         flight_recorder_spans: int = 512,
+        row_delta_chain: int = 2,
+        row_checkpoint_steps: int = 1,
     ):
         if model not in ("sparse", "dense"):
             raise ValueError(f"unknown chaos model flavor {model!r}")
@@ -108,6 +110,15 @@ class ChaosRunner:
         # checkpoint always covers exactly the completed tasks — the
         # alignment loss-trajectory equivalence needs.
         self.checkpoint_steps = self.num_minibatches_per_task
+        # Row services checkpoint every push with a SHORT delta chain
+        # (full, delta, delta, compaction, ...): the plan's worker
+        # kills land between a delta save and the next base compaction
+        # — the kill-mid-chain case — and the end-of-run shard
+        # relaunch restores across a base+delta chain. Writes are
+        # synchronous (async_write=False below) so the save schedule
+        # replays byte-identically per seed.
+        self.row_delta_chain = max(0, int(row_delta_chain))
+        self.row_checkpoint_steps = max(1, int(row_checkpoint_steps))
         self.num_row_service_shards = max(1, int(num_row_service_shards))
         self.use_rpc = bool(use_rpc)
         self.twin = bool(twin)
@@ -157,7 +168,9 @@ class ChaosRunner:
                 svc.configure_checkpoint(
                     os.path.join(self.workdir, subdir, "rows",
                                  f"s{shard}"),
-                    checkpoint_steps=self.num_minibatches_per_task,
+                    checkpoint_steps=self.row_checkpoint_steps,
+                    delta_chain_max=self.row_delta_chain,
+                    async_write=False,
                 )
             svc.start(tag=f"rowservice/{shard}")
             services.append(svc)
@@ -378,9 +391,13 @@ class ChaosRunner:
             svc.checkpoint_now()
             svc.stop(0)
             fresh = deepfm_host.make_row_service()
+            # Restore path: configure_checkpoint replays the newest
+            # base + delta chain the dead service left behind.
             fresh.configure_checkpoint(
                 os.path.join(self.workdir, subdir, "rows", f"s{shard}"),
-                checkpoint_steps=self.num_minibatches_per_task,
+                checkpoint_steps=self.row_checkpoint_steps,
+                delta_chain_max=self.row_delta_chain,
+                async_write=False,
             )
             relaunched.append(fresh)
         return relaunched
@@ -525,6 +542,8 @@ class ChaosRunner:
                 "minibatch_size": self.minibatch_size,
                 "num_minibatches_per_task": self.num_minibatches_per_task,
                 "checkpoint_steps": self.checkpoint_steps,
+                "row_checkpoint_steps": self.row_checkpoint_steps,
+                "row_delta_chain": self.row_delta_chain,
                 "num_row_service_shards": self.num_row_service_shards,
                 "use_rpc": self.use_rpc,
                 "twin": self.twin,

@@ -11,10 +11,12 @@ from elasticdl_tpu.checkpoint.state_io import (
     named_leaves_from_state,
     restore_state_from_named_leaves,
 )
+from elasticdl_tpu.checkpoint.writer import CheckpointWriter
 
 __all__ = [
     "CheckpointHook",
     "CheckpointSaver",
+    "CheckpointWriter",
     "CorruptCheckpointError",
     "named_leaves_from_state",
     "restore_from_dir",

@@ -7,8 +7,6 @@ this across ps/parameter_server.py:49-66 and ps/servicer.py:242-257).
 
 from typing import Optional
 
-import numpy as np
-
 from elasticdl_tpu.checkpoint.saver import CheckpointSaver
 from elasticdl_tpu.checkpoint.state_io import (
     named_leaves_from_state,
@@ -120,6 +118,11 @@ def restore_from_dir(state, checkpoint_dir: str, required: bool = True,
         ids, rows = embeddings[name].to_arrays()
         if ids.size:
             table.set(ids, rows)
+        if getattr(table, "supports_dirty_rows", False):
+            # The refill marked every restored row dirty; the on-disk
+            # state it came from already holds them, so the next delta
+            # must not re-ship the whole table.
+            table.clear_dirty()
     logger.info(
         "Restored state at version %d from %s",
         int(state.step), checkpoint_dir,
@@ -143,6 +146,7 @@ class CheckpointHook:
         async_save: bool = True,
         backend: str = "native",
         host_tables=None,
+        delta_chain_max: int = 0,
     ):
         # host_tables ({name: EmbeddingTable-like}): host-tier rows are
         # saved alongside the state (native backend; the saver shards
@@ -152,6 +156,13 @@ class CheckpointHook:
                 "host_tables checkpointing requires the native backend"
             )
         self._host_tables = host_tables or {}
+        for view in self._host_tables.values():
+            # Turn dirty tracking on now that a consumer drains it
+            # (tables default OFF so jobs without checkpointing never
+            # pay for the marked-ids set).
+            enable = getattr(view, "enable_dirty_tracking", None)
+            if enable is not None:
+                enable()
         # "orbax": required for multi-host jobs (one process cannot
         # device_get a global array); writes coordinately and restores
         # onto any target sharding. Orbax manages its own async IO, so
@@ -164,48 +175,42 @@ class CheckpointHook:
             saver = saver or self._orbax  # enables the save paths below
         if saver is None and checkpoint_dir:
             saver = CheckpointSaver(
-                checkpoint_dir, num_shards=num_shards, keep_max=keep_max
+                checkpoint_dir, num_shards=num_shards, keep_max=keep_max,
+                delta_chain_max=delta_chain_max,
             )
         self.saver = saver
         self.checkpoint_steps = int(checkpoint_steps)
         self._last_saved = None
-        # Async: the device->host copy stays on the caller's thread (it
-        # must observe a consistent state), but serialization + disk IO
-        # move to a single background writer — the training step doesn't
-        # wait on storage. At most ONE write is in flight: a new save
-        # joins the previous one first, so slow storage backpressures
-        # instead of piling up full host model copies. A crash mid-write
-        # leaves a torn version dir the saver's validity check skips.
-        self._async = async_save
-        self._writer = None
-        self._inflight = None
-        self._pending_error = None
+        # Async capture/write split: the device->host copy + host-table
+        # capture stay on the caller's thread (they must observe a
+        # consistent state), but serialization, checksumming, and disk
+        # IO move to the bounded background CheckpointWriter — the
+        # training step doesn't wait on storage, and a slow disk
+        # backpressures (bounded queue) instead of piling up full host
+        # model copies. A crash mid-write leaves a torn ``.tmp`` dir
+        # the saver's validity scan never sees.
+        from elasticdl_tpu.checkpoint.saver import ChainPlanner
+        from elasticdl_tpu.checkpoint.writer import CheckpointWriter
 
-    def _writer_submit(self, fn):
-        from concurrent.futures import ThreadPoolExecutor
+        self._writer = CheckpointWriter(max_pending=1,
+                                        sync=not async_save)
+        # In-memory chain planning: disk lags the write queue, so
+        # planning from it could fork the chain (see ChainPlanner).
+        self._planner = ChainPlanner(delta_chain_max)
+        from elasticdl_tpu.observability import default_registry
 
-        if self._writer is None:
-            self._writer = ThreadPoolExecutor(
-                max_workers=1, thread_name_prefix="ckpt-writer"
-            )
-        if self._inflight is not None:
-            # Backpressure + strict ordering: one write in flight.
-            self._inflight.exception()
-        self._inflight = self._writer.submit(fn)
-        return self._inflight
+        self._m_stall = default_registry().histogram(
+            "checkpoint_stall_seconds",
+            "Step/push-path time spent capturing + enqueuing a "
+            "checkpoint (the part the hot path actually waits on)",
+        )
 
     def flush(self):
         """Wait for in-flight async writes; raise a deferred failure
         (unless a newer write has since succeeded and superseded it)."""
         if self._orbax is not None:
             self._orbax.wait()
-        if self._writer is not None:
-            self._writer.shutdown(wait=True)
-            self._writer = None
-            self._inflight = None
-        if self._pending_error is not None:
-            exc, self._pending_error = self._pending_error, None
-            raise exc
+        self._writer.flush()
 
     @property
     def enabled(self) -> bool:
@@ -254,57 +259,77 @@ class CheckpointHook:
         return True
 
     def _save(self, version: int, state):
-        # Device->host copy here (consistent snapshot before the step
-        # mutates/donates buffers); serialization+IO async when enabled.
-        # _last_saved advances only on a SUCCESSFUL write, so a failed
-        # one is retried by the next maybe_save/save_final.
+        # CAPTURE on the caller's thread (consistent snapshot before
+        # the step mutates/donates buffers and before further row
+        # applies): start the device->host transfers async, capture
+        # host tables (dirty rows only when a delta is planned), then
+        # hand serialization + IO to the background writer. The time
+        # spent HERE is the whole step-path checkpoint cost —
+        # checkpoint_stall_seconds measures it.
         import jax
+        import time as _time
 
+        t0 = _time.monotonic()
         if self._orbax is not None:
             from elasticdl_tpu.checkpoint.orbax_backend import save_state
 
             save_state(self._orbax, state)
             self._last_saved = version
+            self._m_stall.observe(_time.monotonic() - t0)
             return
 
-        leaves = jax.device_get(named_leaves_from_state(state))
-        # Host-table snapshot on the caller's thread: the async writer
-        # must not race ongoing apply_row_grads over live tables.
-        embeddings = None
-        if self._host_tables:
-            from elasticdl_tpu.embedding.table import EmbeddingTable
+        from elasticdl_tpu.checkpoint.state_io import start_host_transfer
 
-            embeddings = {}
-            for name, table in self._host_tables.items():
-                ids, rows = table.to_arrays()
-                # Preserve the source dtype: step counters serialize as
-                # float64 rows (exact ints past 2^24), and a float32
-                # default here would silently round them.
-                embeddings[name] = EmbeddingTable.from_arrays(
-                    name, ids, rows,
-                    dtype=rows.dtype if rows.size else np.float32,
-                )
+        start_host_transfer(state)
+        # Incremental plan: only when the saver supports chains AND
+        # host tables exist (a dense-only delta saves nothing — the
+        # dense leaves ARE the payload and ride in full either way).
+        plan, base, prev = ("full", None, None)
+        if self._host_tables and hasattr(self.saver, "save_delta"):
+            plan, base, prev = self._planner.plan(version)
+        from elasticdl_tpu.checkpoint.saver import (
+            capture_tables,
+            remark_dirty,
+        )
+
+        embeddings, dirty_ids = capture_tables(
+            self._host_tables, delta=plan == "delta"
+        )
+        leaves = jax.device_get(named_leaves_from_state(state))
         # Only pass the kwarg when host tables exist — custom savers
         # (tests, adapters) need not grow the parameter otherwise.
         kwargs = {"embeddings": embeddings} if embeddings else {}
-        if not self._async:
-            self.saver.save(version, leaves, **kwargs)
-            self._last_saved = version
-            return
 
         def write():
             try:
-                self.saver.save(version, leaves, **kwargs)
-            except BaseException as exc:
-                self._pending_error = exc
-                logger.error(
-                    "async checkpoint write (version %d) failed: %s",
-                    version, exc,
-                )
+                if plan == "delta":
+                    if not self.saver.element_exists(prev):
+                        from elasticdl_tpu.checkpoint.state_io import (
+                            CorruptCheckpointError,
+                        )
+
+                        # The predecessor this delta was planned
+                        # against failed ahead of us in the FIFO
+                        # queue: writing would produce an
+                        # unrestorable element whose success would
+                        # also mask the predecessor's deferred error.
+                        raise CorruptCheckpointError(
+                            f"delta {version}: predecessor {prev} "
+                            "never became durable; restarting chain"
+                        )
+                    self.saver.save_delta(
+                        version, leaves, embeddings, base, prev
+                    )
+                else:
+                    self.saver.save(version, leaves, **kwargs)
+            except BaseException:
+                # Drained dirty rows must re-enter the NEXT delta, and
+                # the chain restarts from a fresh base (queued deltas
+                # linking through the failure are unrestorable).
+                remark_dirty(self._host_tables, dirty_ids)
+                self._planner.reset()
                 raise
             self._last_saved = version
-            # A newer successful write supersedes an older failure —
-            # the freshest checkpoint is what restores.
-            self._pending_error = None
 
-        self._writer_submit(write)
+        self._writer.submit(write, label=f"v{version}-{plan}")
+        self._m_stall.observe(_time.monotonic() - t0)

@@ -10,6 +10,7 @@ structure compatibility.
 """
 
 import dataclasses
+import zlib
 from typing import Dict
 
 import jax
@@ -21,6 +22,46 @@ class CorruptCheckpointError(RuntimeError):
     shard payload (truncated write, bit rot, chaos injection). Restore
     treats the whole version as unusable and falls back to the
     previous retained version (saver.CheckpointSaver.restore)."""
+
+
+# ---- shard-file framing --------------------------------------------------
+#
+# New shard files carry a magic + CRC32 header so torn writes and bit
+# rot are caught by checksum before msgpack ever sees the bytes (the
+# same discipline as the master journal's frames). Legacy files (raw
+# msgpack, no magic) still load: msgpack map headers can never start
+# with this magic, so sniffing is unambiguous.
+
+SHARD_MAGIC = b"EDLC1"
+
+
+def frame_shard_blob(blob: bytes) -> bytes:
+    """``magic + u32le crc32(blob) + blob`` — the on-disk shard frame."""
+    crc = zlib.crc32(blob) & 0xFFFFFFFF
+    return SHARD_MAGIC + crc.to_bytes(4, "little") + blob
+
+
+def unframe_shard_blob(data: bytes, path: str = "") -> bytes:
+    """Strip and verify the frame; raw legacy blobs pass through.
+    Raises CorruptCheckpointError on checksum mismatch or a frame too
+    short to carry its header."""
+    if not data.startswith(SHARD_MAGIC):
+        return data  # legacy (pre-framing) shard file
+    where = f" ({path})" if path else ""
+    header = len(SHARD_MAGIC) + 4
+    if len(data) < header:
+        raise CorruptCheckpointError(
+            f"framed shard shorter than its header{where}"
+        )
+    want = int.from_bytes(data[len(SHARD_MAGIC):header], "little")
+    blob = data[header:]
+    got = zlib.crc32(blob) & 0xFFFFFFFF
+    if got != want:
+        raise CorruptCheckpointError(
+            f"shard crc32 mismatch (want {want:#010x}, got "
+            f"{got:#010x}){where}"
+        )
+    return blob
 
 
 def validate_shard_payload(payload, path: str = ""):
@@ -78,6 +119,22 @@ def _state_trees(state):
 
 def _leaf_name(prefix: str, path) -> str:
     return prefix + jax.tree_util.keystr(path)
+
+
+def start_host_transfer(state):
+    """Kick off the device→host copies for every checkpointable leaf
+    WITHOUT blocking (jax arrays expose ``copy_to_host_async``). The
+    subsequent ``named_leaves_from_state`` then mostly waits on
+    transfers that already ran while the caller did other capture work
+    — the async-checkpoint path's cheap first half."""
+    for _prefix, tree in _state_trees(state):
+        for leaf in jax.tree_util.tree_leaves(tree):
+            start = getattr(leaf, "copy_to_host_async", None)
+            if start is not None:
+                try:
+                    start()
+                except Exception:
+                    pass  # committed-elsewhere arrays still device_get
 
 
 def named_leaves_from_state(state) -> Dict[str, np.ndarray]:

@@ -214,7 +214,10 @@ class RpcServer:
         return self
 
     def stop(self, grace: Optional[float] = None):
-        self._server.stop(grace)
+        """Returns grpc's termination event — set once in-flight
+        handlers have fully drained/cancelled, so callers can fence
+        teardown of resources those handlers still use."""
+        return self._server.stop(grace)
 
     def wait(self):
         self._server.wait_for_termination()

@@ -159,6 +159,14 @@ def add_train_params(parser):
     parser.add_argument("--checkpoint_steps", type=non_neg_int, default=0)
     parser.add_argument("--checkpoint_dir", default="")
     parser.add_argument("--keep_checkpoint_max", type=non_neg_int, default=3)
+    parser.add_argument("--checkpoint_delta_chain", type=non_neg_int,
+                        default=0,
+                        help="Max incremental delta checkpoints riding "
+                             "one full base before a save compacts into "
+                             "a fresh base (host-tier embedding rows "
+                             "only; dense state always rides in full). "
+                             "0 (default) = full snapshots only. "
+                             "docs/fault_tolerance.md")
     parser.add_argument("--checkpoint_dir_for_init", default="")
     parser.add_argument("--output", default="",
                         help="Export directory for the trained model")

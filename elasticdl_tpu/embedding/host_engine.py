@@ -788,7 +788,9 @@ class _LockedTable:
     """Lock-guarded view over a host table (or checkpoint adapter): the
     checkpoint hook snapshots and restore refills under the engine's
     lock, never racing training threads; reads drain the async applier
-    first (``flush``)."""
+    first (``flush``). Dirty-row tracking (incremental checkpoints)
+    passes through under the same lock when the wrapped table supports
+    it."""
 
     def __init__(self, table, lock, flush=None):
         self._table = table
@@ -803,6 +805,40 @@ class _LockedTable:
         self._drain()
         with self._lock:
             return self._table.to_arrays()
+
+    @property
+    def supports_dirty_rows(self) -> bool:
+        return bool(getattr(self._table, "supports_dirty_rows", False))
+
+    def dirty_arrays(self):
+        self._drain()
+        with self._lock:
+            return self._table.dirty_arrays()
+
+    def capture_arrays(self):
+        """Full snapshot + dirty-drain under ONE lock acquisition
+        (full-base capture): splitting them lets a write land between
+        the two, excluded from the snapshot with its dirty mark
+        wiped — the row would never ride any subsequent delta."""
+        self._drain()
+        with self._lock:
+            ids, rows = self._table.to_arrays()
+            if getattr(self._table, "supports_dirty_rows", False):
+                self._table.clear_dirty()
+            return ids, rows
+
+    def mark_dirty(self, ids):
+        with self._lock:
+            self._table.mark_dirty(ids)
+
+    def clear_dirty(self):
+        with self._lock:
+            self._table.clear_dirty()
+
+    @property
+    def dirty_count(self) -> int:
+        with self._lock:
+            return self._table.dirty_count
 
     def set(self, ids, values):
         self._drain()

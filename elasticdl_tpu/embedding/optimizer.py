@@ -418,13 +418,19 @@ class HostOptimizerWrapper:
     def _slot_table(self, table: EmbeddingTable, slot_name: str):
         key = get_slot_table_name(table.name, slot_name)
         if key not in self._slot_tables:
-            self._slot_tables[key] = EmbeddingTable(
+            st = EmbeddingTable(
                 key,
                 table.dim,
                 is_slot=True,
                 slot_init_value=slot_init_value(self.opt, slot_name),
                 dtype=table.dtype,
             )
+            if getattr(table, "supports_dirty_rows", False):
+                # A slot created after checkpointing was configured
+                # inherits tracking from its main table, or its rows
+                # would never ride a delta.
+                st.enable_dirty_tracking()
+            self._slot_tables[key] = st
         return self._slot_tables[key]
 
     def apply_gradients(self, table: EmbeddingTable, ids, grads):

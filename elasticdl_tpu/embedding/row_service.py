@@ -115,6 +115,11 @@ class HostRowService:
             "row_service_duplicate_pushes_total",
             "Retried pushes dropped by (client, seq) dedup",
         )
+        self._m_stall = registry.histogram(
+            "checkpoint_stall_seconds",
+            "Step/push-path time spent capturing + enqueuing a "
+            "checkpoint (the part the hot path actually waits on)",
+        )
         self._lock = threading.RLock()
         self._server: Optional[RpcServer] = None
         self._push_count = 0
@@ -138,7 +143,17 @@ class HostRowService:
         self._applied_at: Dict[str, float] = {}
         self._checkpoint_steps = 0
         self._saver = None
-        self._ckpt_writer_free = threading.Semaphore(1)
+        self._ckpt_writer = None
+        self._ckpt_planner = None
+        # Serializes the busy-check/plan/capture/submit sequence:
+        # concurrent push handlers at consecutive checkpoint versions
+        # must not interleave inside the planner, or two deltas name
+        # the same prev and the chain walk drops the second (its
+        # drained rows would be silently unrestorable). An overlapping
+        # interval trigger skips (non-blocking acquire), the drain
+        # path waits — the old single-writer semaphore's discipline,
+        # now at the trigger instead of the write.
+        self._ckpt_trigger = threading.Lock()
         # Push dedup: {client key: last applied seq} — retried pushes
         # after an ambiguous failure must not double-apply. Persisted
         # with the checkpoint (see _SeqTable).
@@ -267,56 +282,163 @@ class HostRowService:
     # ---- checkpoint ----------------------------------------------------
 
     def configure_checkpoint(self, checkpoint_dir: str,
-                             checkpoint_steps: int = 0, keep_max: int = 3):
+                             checkpoint_steps: int = 0, keep_max: int = 3,
+                             delta_chain_max: int = 8,
+                             async_write: bool = True):
         """Attach (or re-point) the checkpoint saver and restore the
-        newest valid version."""
-        from elasticdl_tpu.checkpoint.saver import CheckpointSaver
+        newest valid version (chain-aware).
 
-        self._saver = CheckpointSaver(checkpoint_dir, keep_max=keep_max)
+        ``delta_chain_max`` > 0 (default): interval saves write
+        incremental deltas — dirty rows + their optimizer slots since
+        the previous save — with a periodic full base compaction.
+        ``async_write`` (default): the push handler pays only capture
+        + enqueue; serialization and file IO run on the bounded
+        background writer (``CheckpointWriter``). The chaos harness
+        passes False for deterministic schedules."""
+        from elasticdl_tpu.checkpoint.saver import (
+            ChainPlanner,
+            CheckpointSaver,
+        )
+        from elasticdl_tpu.checkpoint.writer import CheckpointWriter
+
+        if self._ckpt_writer is not None:
+            # Re-point: land (and surface) anything queued on the old
+            # writer before abandoning it — an orphaned writer's
+            # deferred failure would never raise, and its parked
+            # thread never retire.
+            self._ckpt_writer.close()
+        self._saver = CheckpointSaver(
+            checkpoint_dir, keep_max=keep_max,
+            delta_chain_max=delta_chain_max,
+        )
+        self._ckpt_writer = CheckpointWriter(
+            max_pending=1, sync=not async_write
+        )
+        self._ckpt_planner = ChainPlanner(delta_chain_max)
         self._checkpoint_steps = int(checkpoint_steps)
+        for view in self.host_tables.values():
+            # Turn dirty tracking on now that a consumer drains it
+            # (host_tables pre-creates the optimizer slot tables, so
+            # this covers them too; tables are OFF by default — the
+            # marked-ids set would otherwise grow unbounded on
+            # services that never checkpoint).
+            enable = getattr(view, "enable_dirty_tracking", None)
+            if enable is not None:
+                enable()
         self._restore_latest()
         return self
 
     def _checkpoint(self, version: int, blocking: bool = False) -> bool:
-        """ONE lock acquisition across the whole snapshot so rows,
-        optimizer slots, and step counters are captured at the same
-        version; the file write happens outside (pushes keep flowing
-        during IO). A single writer at a time: overlapping interval
-        triggers skip (their version is covered by the next interval)
-        while the drain path (checkpoint_now) blocks for its turn.
-        Returns whether a write happened."""
-        from elasticdl_tpu.embedding.table import EmbeddingTable
-
-        if not self._ckpt_writer_free.acquire(blocking=blocking):
+        """Capture/write split: ONE lock acquisition across the whole
+        capture so rows, optimizer slots, step counters, and the seq
+        map are snapshotted at the same version — but the handler pays
+        only that capture (dirty rows when a delta is planned) plus an
+        enqueue; serialization + IO run on the background writer.
+        Backpressure is the writer's bounded queue: an interval
+        trigger that finds it full skips (its rows stay dirty and ride
+        the next interval) while the drain path (checkpoint_now)
+        blocks for its turn. Returns whether a write was enqueued."""
+        if not self._ckpt_trigger.acquire(blocking=blocking):
+            # Another trigger is mid-plan/capture: this interval's
+            # state is covered by the next one.
             return False
         try:
-            snapshot = {}
-            with self._lock:
-                for name, view in self.host_tables.items():
-                    ids, rows = view.to_arrays()
-                    snapshot[name] = EmbeddingTable.from_arrays(
-                        name, ids, rows,
-                        dtype=rows.dtype if rows.size else np.float32,
-                    )
-            self._saver.save(version, {}, embeddings=snapshot)
-            return True
+            return self._checkpoint_locked(version, blocking)
         finally:
-            self._ckpt_writer_free.release()
+            self._ckpt_trigger.release()
+
+    def _checkpoint_locked(self, version: int, blocking: bool) -> bool:
+        if not blocking and self._ckpt_writer.busy:
+            # Skip BEFORE planning or draining anything: the rows stay
+            # dirty, the chain stays unbroken, and this interval's
+            # state is covered by the next one.
+            return False
+        from elasticdl_tpu.checkpoint.saver import (
+            CorruptCheckpointError,
+            capture_tables,
+            remark_dirty,
+        )
+
+        t0 = time.monotonic()
+        plan, base, prev = self._ckpt_planner.plan(version)
+        with self._lock:
+            # ONE lock acquisition around the shared capture helper so
+            # rows, slots, seq map, and step counters snapshot at the
+            # same version.
+            captured, dirty_ids = capture_tables(
+                self.host_tables, delta=plan == "delta"
+            )
+
+        def remark():
+            remark_dirty(self.host_tables, dirty_ids)
+
+        def write():
+            try:
+                if plan == "delta":
+                    if not self._saver.element_exists(prev):
+                        # The predecessor this delta was planned
+                        # against never became durable (its write
+                        # failed ahead of us in the queue): writing
+                        # would produce an unrestorable element while
+                        # reporting success.
+                        raise CorruptCheckpointError(
+                            f"delta {version}: predecessor {prev} "
+                            "never became durable; restarting chain"
+                        )
+                    self._saver.save_delta(
+                        version, {}, captured, base, prev
+                    )
+                else:
+                    self._saver.save(version, {}, embeddings=captured)
+            except BaseException:
+                # A failed write must put the drained rows back into
+                # the dirty sets (or they vanish from every future
+                # delta), and the chain must restart from a fresh base
+                # (queued deltas linking through the failure are
+                # unrestorable).
+                remark()
+                self._ckpt_planner.reset()
+                raise
+
+        try:
+            ok = self._ckpt_writer.submit(
+                write, label=f"rows-v{version}-{plan}", block=blocking
+            )
+        except RuntimeError:
+            # Writer closed under us (stop()/re-point racing a push
+            # across a checkpoint interval): the push itself was
+            # applied — put the drained rows back and skip the save
+            # instead of failing the RPC.
+            ok = False
+        if not ok:
+            remark()
+            self._ckpt_planner.reset()
+        self._m_stall.observe(time.monotonic() - t0)
+        return ok
 
     def checkpoint_now(self) -> bool:
-        """Synchronous checkpoint at the current push count — the
+        """DURABLE checkpoint at the current push count — the
         graceful-drain write (SIGTERM grace period / scripted shard
         relaunch): rows pushed since the last interval save must not
         be lost to a planned restart. Unlike the interval trigger this
-        WAITS for any in-flight interval write (skipping here would
-        silently drop the freshest pushes — the exact loss this method
-        exists to prevent). Returns False when no saver is
-        configured."""
+        blocks for its writer-queue turn AND flushes the writer before
+        returning, so the caller observes a fully durable version —
+        not a queued one. Returns False when no saver is configured."""
         if self._saver is None:
             return False
+        # Land any queued write FIRST: the on-disk tip lags the async
+        # writer queue, and comparing against the lagging tip would
+        # re-capture and re-write state already on its way to disk —
+        # a full-table blocking save exactly when the SIGTERM grace
+        # budget is tightest.
+        self._ckpt_writer.flush()
         with self._lock:
             version = self._push_count
-        return self._checkpoint(version, blocking=True)
+        if self._saver.get_valid_latest_version() == version:
+            return True
+        ok = self._checkpoint(version, blocking=True)
+        self._ckpt_writer.flush()
+        return ok
 
     def _restore_latest(self):
         try:
@@ -334,6 +456,11 @@ class HostRowService:
             ids, rows = embeddings[name].to_arrays()
             if ids.size:
                 view.set(ids, rows)
+            if getattr(view, "supports_dirty_rows", False):
+                # The refill marked every restored row dirty; disk
+                # already holds them — the first post-restore delta
+                # must not re-ship the whole table.
+                view.clear_dirty()
         self._push_count = int(version)
         logger.info(
             "Row service restored version %d (%d tables)",
@@ -359,7 +486,23 @@ class HostRowService:
 
     def stop(self, grace: Optional[float] = None):
         if self._server is not None:
-            self._server.stop(grace)
+            # Drain in-flight handlers BEFORE closing the writer: a
+            # push crossing a checkpoint interval during shutdown
+            # must not hit a closed writer — its RPC would fail after
+            # the grads were already applied.
+            ev = self._server.stop(grace)
+            if ev is not None:
+                ev.wait((grace or 0) + 30.0)
+        if self._ckpt_writer is not None:
+            try:
+                # Land any queued checkpoint write and retire the
+                # writer thread before the process goes away; failures
+                # are logged, not raised — stop() is a teardown path.
+                self._ckpt_writer.close()
+            except BaseException as exc:
+                logger.error(
+                    "checkpoint flush on stop failed: %s", exc
+                )
 
     def wait(self):
         """Block until the server stops (process-main lifetime)."""
@@ -794,6 +937,16 @@ def main(argv=None):
     parser.add_argument("--checkpoint_dir", default="")
     parser.add_argument("--checkpoint_steps", type=int, default=0)
     parser.add_argument("--keep_checkpoint_max", type=int, default=3)
+    parser.add_argument("--checkpoint_delta_chain", type=int, default=8,
+                        help="Max incremental delta checkpoints riding "
+                             "one full base before a save compacts "
+                             "into a fresh base; 0 = full snapshots "
+                             "only (docs/fault_tolerance.md)")
+    parser.add_argument("--checkpoint_sync", action="store_true",
+                        help="Write checkpoints inline on the push "
+                             "handler instead of the background "
+                             "writer (debugging / deterministic "
+                             "schedules)")
     parser.add_argument("--shard_id", type=int, default=0)
     parser.add_argument("--num_shards", type=int, default=1)
     parser.add_argument("--metrics_port", type=int, default=-1,
@@ -822,6 +975,8 @@ def main(argv=None):
         service.configure_checkpoint(
             args.checkpoint_dir, args.checkpoint_steps,
             args.keep_checkpoint_max,
+            delta_chain_max=args.checkpoint_delta_chain,
+            async_write=not args.checkpoint_sync,
         )
     service.start(args.addr, tag=f"rowservice/{args.shard_id}")
     logger.info("Row service serving on %s", args.addr)

@@ -34,8 +34,26 @@ def _row_seed(name: str, row_id: int) -> int:
     )
 
 
+def supports_dirty_rows(table) -> bool:
+    """Whether a table-like view can serve incremental (delta)
+    checkpoints: it tracks the rows touched since the last drain.
+    Checkpoint adapters (seq maps, step counters) report False and are
+    captured in full inside every delta — they are tiny by
+    construction."""
+    return bool(getattr(table, "supports_dirty_rows", False))
+
+
 class EmbeddingTable:
-    """Lazy id->row store with deterministic per-row init."""
+    """Lazy id->row store with deterministic per-row init.
+
+    Tracks **dirty rows** — ids materialized or written since the last
+    ``dirty_arrays`` drain — so incremental (delta) checkpoints move
+    only the working set instead of the whole table. Reads of existing
+    rows stay free: only a first materialization or a ``set`` marks.
+    Tracking is OFF until a checkpoint consumer enables it
+    (``configure_checkpoint``/``CheckpointHook``): without a drain,
+    the marked-ids set would grow to every touched row for nothing.
+    """
 
     def __init__(
         self,
@@ -53,6 +71,8 @@ class EmbeddingTable:
         self.slot_init_value = float(slot_init_value)
         self.dtype = np.dtype(dtype)
         self.vectors: Dict[int, np.ndarray] = {}
+        self._dirty: set = set()
+        self._track_dirty = False
 
     def _init_row(self, row_id: int) -> np.ndarray:
         if self.is_slot or self.initializer == "zeros":
@@ -74,6 +94,11 @@ class EmbeddingTable:
             if row is None:
                 row = self._init_row(int(row_id))
                 self.vectors[int(row_id)] = row
+                # Materialization dirties: a lazily created row must
+                # ride the next delta so restore-from-chain conserves
+                # it (row-conservation invariant) without re-reading.
+                if self._track_dirty:
+                    self._dirty.add(int(row_id))
             out[i] = row
         return out
 
@@ -81,10 +106,50 @@ class EmbeddingTable:
         values = np.asarray(values, self.dtype)
         for i, row_id in enumerate(ids):
             self.vectors[int(row_id)] = values[i].copy()
+            if self._track_dirty:
+                self._dirty.add(int(row_id))
 
     @property
     def num_rows(self) -> int:
         return len(self.vectors)
+
+    # ---- dirty-row tracking (incremental checkpoints) -----------------
+
+    @property
+    def supports_dirty_rows(self) -> bool:
+        """True once a checkpoint consumer enabled tracking — the
+        delta-capture predicate. Reporting capability instead of
+        enablement would make delta captures silently empty."""
+        return self._track_dirty
+
+    def enable_dirty_tracking(self) -> None:
+        self._track_dirty = True
+
+    @property
+    def dirty_count(self) -> int:
+        return len(self._dirty)
+
+    def dirty_arrays(self):
+        """(ids, rows) of rows touched since the last drain, sorted by
+        id, and CLEAR the dirty set — the delta-checkpoint capture
+        unit. On a later write failure the caller re-marks via
+        ``mark_dirty`` so the rows re-enter the next delta."""
+        if not self._dirty:
+            return (np.zeros((0,), np.int64),
+                    np.zeros((0, self.dim), self.dtype))
+        ids = np.array(sorted(self._dirty), np.int64)
+        self._dirty.clear()
+        rows = np.stack([self.vectors[int(i)] for i in ids])
+        return ids, rows
+
+    def mark_dirty(self, ids) -> None:
+        if self._track_dirty:
+            self._dirty.update(int(i) for i in np.asarray(ids).ravel())
+
+    def clear_dirty(self) -> None:
+        """Forget tracked dirt — called after a restore refill, whose
+        rows already match the on-disk state they came from."""
+        self._dirty.clear()
 
     def to_arrays(self):
         """(ids, rows) sorted by id — checkpoint serialization unit."""

@@ -58,6 +58,15 @@ class NativeEmbeddingTable:
 
     float32 only — the arena is a float store; other dtypes fall back to
     the Python table via ``make_host_table``.
+
+    Dirty-row tracking lives on the Python side (a set of ids): the C++
+    arena doesn't report which gets materialized, so a ``get`` that
+    grew the store conservatively marks every requested id — bounded by
+    the batch working set, and in training every pulled row receives a
+    push anyway. The fused native optimizer kernels bypass ``set``, so
+    ``NativeOptimizerWrapper`` marks the applied ids explicitly.
+    Tracking is OFF until a checkpoint consumer enables it — see
+    EmbeddingTable.
     """
 
     def __init__(
@@ -93,6 +102,8 @@ class NativeEmbeddingTable:
             EMBEDDING_INIT_SCALE,
             self.slot_init_value if const_init else 0.0,
         )
+        self._dirty: set = set()
+        self._track_dirty = False
 
     def __del__(self):
         lib, h = getattr(self, "_lib", None), getattr(self, "_h", None)
@@ -103,13 +114,20 @@ class NativeEmbeddingTable:
     def get(self, ids: Iterable[int]) -> np.ndarray:
         ids = _ids_arr(ids)
         out = np.empty((ids.size, self.dim), np.float32)
+        before = self.num_rows
         self._lib.rs_get(self._h, _i64p(ids), ids.size, _f32p(out))
+        if self._track_dirty and self.num_rows != before:
+            # The arena grew: at least one requested row materialized.
+            # Which ones is invisible from here, so mark them all.
+            self._dirty.update(ids.tolist())
         return out
 
     def set(self, ids: Iterable[int], values: np.ndarray) -> None:
         ids = _ids_arr(ids)
         values = np.ascontiguousarray(values, np.float32)
         self._lib.rs_set(self._h, _i64p(ids), ids.size, _f32p(values))
+        if self._track_dirty:
+            self._dirty.update(ids.tolist())
 
     @property
     def num_rows(self) -> int:
@@ -131,6 +149,38 @@ class NativeEmbeddingTable:
         if len(ids):
             table.set(ids, rows)
         return table
+
+    # ---- dirty-row tracking (incremental checkpoints) -----------------
+
+    @property
+    def supports_dirty_rows(self) -> bool:
+        return self._track_dirty
+
+    def enable_dirty_tracking(self) -> None:
+        self._track_dirty = True
+
+    @property
+    def dirty_count(self) -> int:
+        return len(self._dirty)
+
+    def dirty_arrays(self):
+        """(ids, rows) touched since the last drain, sorted; clears the
+        set (see EmbeddingTable.dirty_arrays)."""
+        if not self._dirty:
+            return (np.zeros((0,), np.int64),
+                    np.zeros((0, self.dim), np.float32))
+        ids = np.array(sorted(self._dirty), np.int64)
+        self._dirty.clear()
+        out = np.empty((ids.size, self.dim), np.float32)
+        self._lib.rs_get(self._h, _i64p(ids), ids.size, _f32p(out))
+        return ids, out
+
+    def mark_dirty(self, ids) -> None:
+        if self._track_dirty:
+            self._dirty.update(int(i) for i in np.asarray(ids).ravel())
+
+    def clear_dirty(self) -> None:
+        self._dirty.clear()
 
     def debug_info(self) -> str:
         size = self.num_rows * self.dim * 4
@@ -154,12 +204,18 @@ class NativeOptimizerWrapper:
     def _slot_table(self, table, slot_name: str):
         key = get_slot_table_name(table.name, slot_name)
         if key not in self._slot_tables:
-            self._slot_tables[key] = NativeEmbeddingTable(
+            st = NativeEmbeddingTable(
                 key,
                 table.dim,
                 is_slot=True,
                 slot_init_value=slot_init_value(self.opt, slot_name),
             )
+            if getattr(table, "supports_dirty_rows", False):
+                # A slot created after checkpointing was configured
+                # inherits tracking from its main table, or its rows
+                # would never ride a delta.
+                st.enable_dirty_tracking()
+            self._slot_tables[key] = st
         return self._slot_tables[key]
 
     def apply_gradients(self, table, ids, grads):
@@ -201,6 +257,14 @@ class NativeOptimizerWrapper:
             lib.rs_sgd(table._h, ip, n, gp, opt.lr)
         else:
             raise ValueError(f"No native kernel for {opt.name}")
+        # The fused kernels write rows + slots inside C++, bypassing the
+        # tables' set(): mark the applied ids dirty here so incremental
+        # checkpoints see native-path updates too. Gated so the hot
+        # apply path pays nothing when checkpointing is off.
+        if table.supports_dirty_rows:
+            table.mark_dirty(ids)
+            for slot in opt.slot_names:
+                self._slot_table(table, slot).mark_dirty(ids)
         return table
 
     def state_tables(self, main_tables: Dict) -> Dict:

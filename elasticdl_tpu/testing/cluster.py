@@ -51,6 +51,7 @@ class MiniCluster:
         metrics_ttl_secs: float = 600.0,
         fault_injector=None,
         checkpoint_async: bool = True,
+        checkpoint_delta_chain: int = 0,
         journal_dir: str = "",
         host_prefetch_depth: int = 2,
         version_report_steps: int = 1,
@@ -219,6 +220,7 @@ class MiniCluster:
                     checkpoint_steps=checkpoint_steps,
                     host_tables=getattr(runner, "host_tables", None),
                     async_save=checkpoint_async,
+                    delta_chain_max=checkpoint_delta_chain,
                 )
             self.workers.append(
                 Worker(

@@ -240,6 +240,10 @@ def build_worker(args, master_client=None) -> Worker:
             # the native per-process saver.
             backend="orbax" if mesh_multihost else "native",
             host_tables=getattr(step_runner, "host_tables", None),
+            delta_chain_max=(
+                0 if mesh_multihost
+                else getattr(args, "checkpoint_delta_chain", 0)
+            ),
         )
     from elasticdl_tpu.callbacks import (
         ensure_saved_model_exporter,

@@ -2,7 +2,12 @@
 
 Mirrors the reference's save_utils_test.py and Go checkpoint_test.go:
 shard layout, validity checks, keep-max GC, cross-N repartition restore,
-and end-to-end resume through the LocalExecutor.
+and end-to-end resume through the LocalExecutor — plus the ISSUE 10
+checkpoint plane: dirty-row tracking, incremental delta chains
+(save/restore/torn-prefix/compaction), chain-aware GC, the async
+capture/write split (CheckpointWriter), checkpoint_now durability, and
+the check_checkpoint fsck. ``make ckpt-smoke`` / ``make ckpt-bench``
+are the out-of-lane equivalents.
 """
 
 import os
@@ -334,3 +339,783 @@ class TestCorruptionFallback:
             np.asarray(restored.params["w"]),
             np.ones((4, 3), np.float32),
         )
+
+
+def _chain_save(saver, version, dense, tables):
+    """Drive one save through the saver's own on-disk plan (tests run
+    single-threaded, so plan_next is race-free here)."""
+    kind, base, prev = saver.plan_next()
+    captured = {}
+    for name, table in tables.items():
+        if kind == "delta" and getattr(table, "supports_dirty_rows",
+                                       False):
+            captured[name] = table.dirty_arrays()
+        else:
+            ids, rows = table.to_arrays()
+            if getattr(table, "supports_dirty_rows", False):
+                table.clear_dirty()
+            captured[name] = (ids, rows)
+    if kind == "delta":
+        saver.save_delta(version, dense, captured, base, prev)
+    else:
+        saver.save(version, dense, embeddings=captured)
+    return kind
+
+
+class TestDirtyTracking:
+    def test_set_and_materialize_mark_dirty(self):
+        table = EmbeddingTable("t", 4)
+        table.enable_dirty_tracking()
+        table.get([1, 2])           # materialization dirties
+        table.set([2, 5], np.ones((2, 4), np.float32))
+        assert table.dirty_count == 3
+        ids, rows = table.dirty_arrays()
+        assert ids.tolist() == [1, 2, 5]
+        assert rows.shape == (3, 4)
+        assert table.dirty_count == 0  # drained
+        table.get([1])              # re-read of existing row: clean
+        assert table.dirty_count == 0
+        table.mark_dirty([5])       # writer-failure re-mark path
+        assert table.dirty_count == 1
+        table.clear_dirty()
+        assert table.dirty_count == 0
+
+    def test_dirty_tracking_off_without_checkpoint_consumer(self):
+        """Review fix: without configure_checkpoint/CheckpointHook
+        nothing ever drains the dirty sets — tables must not pay the
+        per-touch marking or grow a set of every id ever touched."""
+        table = EmbeddingTable("emb", 4)
+        table.get([1, 2])
+        table.set([3], np.ones((1, 4), np.float32))
+        table.mark_dirty([4])
+        assert table.dirty_count == 0
+        assert not table.supports_dirty_rows
+        table.enable_dirty_tracking()
+        table.set([5], np.ones((1, 4), np.float32))
+        assert table.supports_dirty_rows
+        assert table.dirty_count == 1
+
+    def test_full_capture_atomic_on_self_locking_views(self):
+        """Review fix: a self-locking view's full capture must be ONE
+        lock acquisition (capture_arrays) — split to_arrays() +
+        clear_dirty() lets a write land in between, excluded from the
+        snapshot with its dirty mark wiped."""
+        import threading
+
+        from elasticdl_tpu.checkpoint.saver import capture_tables
+        from elasticdl_tpu.embedding.host_engine import _LockedTable
+
+        table = EmbeddingTable("emb", 4)
+        table.enable_dirty_tracking()
+        table.get([0, 1])
+        view = _LockedTable(table, threading.Lock())
+
+        def split_capture():
+            raise AssertionError("split to_arrays/clear_dirty capture")
+
+        view.clear_dirty = split_capture
+        captured, dirty_ids = capture_tables({"emb": view}, delta=False)
+        assert captured["emb"][0].size == 2
+        assert dirty_ids == {}
+        assert table.dirty_count == 0  # drained inside the one lock
+
+
+class TestDeltaChain:
+    def _tables(self):
+        table = EmbeddingTable("emb", 4)
+        table.enable_dirty_tracking()
+        table.get(range(12))
+        return {"emb": table}
+
+    def test_chain_layout_roundtrip_and_compaction(self, tmp_path):
+        tables = self._tables()
+        table = tables["emb"]
+        saver = CheckpointSaver(str(tmp_path / "c"), num_shards=2,
+                                delta_chain_max=2)
+        kinds = []
+        kinds.append(_chain_save(saver, 1, {}, tables))
+        for v in (2, 3, 4, 5):
+            table.set([v], np.full((1, 4), float(v)))
+            kinds.append(_chain_save(saver, v, {}, tables))
+        # base, delta, delta, compaction, delta
+        assert kinds == ["full", "delta", "delta", "full", "delta"]
+        assert saver.get_valid_latest_version() == 5
+        version, _, restored = CheckpointSaver(str(tmp_path / "c")).restore()
+        assert version == 5
+        live_ids, live_rows = table.to_arrays()
+        got_ids, got_rows = restored["emb"].to_arrays()
+        np.testing.assert_array_equal(got_ids, live_ids)
+        np.testing.assert_allclose(got_rows, live_rows)
+
+    def test_repartition_restore_across_chain(self, tmp_path):
+        """Base written with N=3, deltas with N=2, restored by an N=5
+        saver: id%N placement repartitions per element, so a whole
+        chain restores onto any shard count."""
+        tables = self._tables()
+        table = tables["emb"]
+        base_saver = CheckpointSaver(str(tmp_path / "c"), num_shards=3,
+                                     delta_chain_max=4)
+        _chain_save(base_saver, 1, {}, tables)
+        delta_saver = CheckpointSaver(str(tmp_path / "c"), num_shards=2,
+                                      delta_chain_max=4)
+        table.set([3, 13], np.full((2, 4), 9.0))
+        kind = _chain_save(delta_saver, 2, {}, tables)
+        assert kind == "delta"
+        version, _, restored = CheckpointSaver(
+            str(tmp_path / "c"), num_shards=5
+        ).restore()
+        assert version == 2
+        assert restored["emb"].num_rows == 13
+        np.testing.assert_allclose(
+            restored["emb"].get([3, 13]), np.full((2, 4), 9.0)
+        )
+
+    def test_torn_delta_restores_longest_intact_prefix(self, tmp_path):
+        tables = self._tables()
+        table = tables["emb"]
+        saver = CheckpointSaver(str(tmp_path / "c"), delta_chain_max=4)
+        _chain_save(saver, 1, {}, tables)
+        for v in (2, 3):
+            table.set([v], np.full((1, 4), float(v)))
+            _chain_save(saver, v, {}, tables)
+        ddir = str(tmp_path / "c" / "delta-3")
+        fname = sorted(
+            f for f in os.listdir(ddir) if f.endswith(".ckpt")
+        )[0]
+        blob = open(os.path.join(ddir, fname), "rb").read()
+        with open(os.path.join(ddir, fname), "wb") as fh:
+            fh.write(blob[: len(blob) // 2])  # torn: crc32 mismatch
+        version, _, restored = CheckpointSaver(str(tmp_path / "c")).restore()
+        assert version == 2
+        np.testing.assert_allclose(
+            restored["emb"].get([2]), np.full((1, 4), 2.0)
+        )
+        # Row 3's delta was torn: the prefix state (pre-set value) wins.
+        ref = EmbeddingTable("emb", 4)
+        np.testing.assert_allclose(restored["emb"].get([3]), ref.get([3]))
+
+    def test_explicit_delta_version_restores_its_prefix(self, tmp_path):
+        tables = self._tables()
+        table = tables["emb"]
+        saver = CheckpointSaver(str(tmp_path / "c"), delta_chain_max=4)
+        _chain_save(saver, 1, {}, tables)
+        for v in (2, 3):
+            table.set([v], np.full((1, 4), float(v)))
+            _chain_save(saver, v, {}, tables)
+        version, _, restored = saver.restore(version=2)
+        assert version == 2
+        ref = EmbeddingTable("emb", 4)
+        np.testing.assert_allclose(restored["emb"].get([3]), ref.get([3]))
+
+
+class TestChainGC:
+    def test_keep_max_never_deletes_base_under_live_deltas(
+        self, tmp_path
+    ):
+        """Regression (ISSUE 10 satellite): keep_max=1 with a
+        base+2-delta chain must keep all three dirs — the deltas are
+        the newest restorable state and need their base."""
+        table = EmbeddingTable("emb", 4)
+        table.enable_dirty_tracking()
+        table.get(range(6))
+        tables = {"emb": table}
+        saver = CheckpointSaver(str(tmp_path / "c"), keep_max=1,
+                                delta_chain_max=4)
+        _chain_save(saver, 1, {}, tables)
+        for v in (2, 3):
+            table.set([v], np.ones((1, 4)))
+            _chain_save(saver, v, {}, tables)
+        assert sorted(os.listdir(tmp_path / "c")) == [
+            "delta-2", "delta-3", "version-1",
+        ]
+        version, _, _ = saver.restore()
+        assert version == 3
+
+    def test_compaction_retires_old_chain_and_orphans(self, tmp_path):
+        table = EmbeddingTable("emb", 4)
+        table.enable_dirty_tracking()
+        table.get(range(6))
+        tables = {"emb": table}
+        saver = CheckpointSaver(str(tmp_path / "c"), keep_max=1,
+                                delta_chain_max=2)
+        _chain_save(saver, 1, {}, tables)
+        for v in (2, 3):
+            table.set([v], np.ones((1, 4)))
+            _chain_save(saver, v, {}, tables)
+        # Chain full -> version 4 compacts; keep_max=1 retires the old
+        # chain (base 1 + deltas 2,3) wholesale.
+        table.set([4], np.ones((1, 4)))
+        kind = _chain_save(saver, 4, {}, tables)
+        assert kind == "full"
+        assert sorted(os.listdir(tmp_path / "c")) == ["version-4"]
+
+    def test_gc_reclaims_stale_tmp_publish(self, tmp_path):
+        """Review fix: a crashed/failed publish leaves version-N.tmp
+        behind, and no later save ever renames it (versions are
+        monotonic) — gc must reclaim it or full-table-sized partials
+        accumulate forever."""
+        table = EmbeddingTable("emb", 4)
+        table.enable_dirty_tracking()
+        table.get(range(4))
+        tables = {"emb": table}
+        saver = CheckpointSaver(str(tmp_path / "c"), keep_max=2,
+                                delta_chain_max=4)
+        _chain_save(saver, 1, {}, tables)
+        stale = tmp_path / "c" / "version-5.tmp"
+        stale.mkdir()
+        (stale / "variables-0-of-1.ckpt").write_bytes(b"partial")
+        table.set([1], np.ones((1, 4)))
+        _chain_save(saver, 2, {}, tables)
+        assert not stale.exists()
+        # Live chain untouched.
+        assert (tmp_path / "c" / "version-1").is_dir()
+        assert (tmp_path / "c" / "delta-2").is_dir()
+
+
+class TestRowServiceAsyncCheckpoint:
+    def _service(self, ckpt, **kwargs):
+        from elasticdl_tpu.embedding.optimizer import (
+            SGD,
+            HostOptimizerWrapper,
+        )
+        from elasticdl_tpu.embedding.row_service import HostRowService
+
+        svc = HostRowService(
+            {"emb": EmbeddingTable("emb", 4)},
+            HostOptimizerWrapper(SGD(lr=1.0)),
+        )
+        svc.configure_checkpoint(ckpt, **kwargs)
+        return svc
+
+    def _push(self, svc, seq, ids):
+        svc._push_row_grads({
+            "table": "emb",
+            "ids": np.asarray(ids, np.int64),
+            "grads": np.ones((len(ids), 4), np.float32),
+            "client": "t", "seq": seq,
+        })
+
+    def test_checkpoint_now_flushes_to_durable(self, tmp_path):
+        """ISSUE 10 satellite: the drain path must observe a fully
+        DURABLE version, not a queued one — a SIGTERM drain or chaos
+        relaunch reads the directory immediately after."""
+        ckpt = str(tmp_path / "c")
+        svc = self._service(ckpt, checkpoint_steps=0, async_write=True)
+        self._push(svc, 1, [0, 1])
+        assert svc.checkpoint_now()
+        # No flush needed by the caller: the version is already valid.
+        assert CheckpointSaver(ckpt).get_valid_latest_version() == 1
+
+    def test_checkpoint_now_flushes_queued_save_without_recapture(
+        self, tmp_path
+    ):
+        """Review fix: the drain path compares against the ON-DISK
+        tip, which lags the writer queue — it must flush first, or a
+        save already on its way to disk triggers a second full
+        capture + blocking write exactly inside the SIGTERM grace."""
+        import time as _time
+
+        ckpt = str(tmp_path / "c")
+        svc = self._service(ckpt, checkpoint_steps=1, async_write=True)
+        orig_save = svc._saver.save
+
+        def slow_save(*a, **k):
+            _time.sleep(0.3)  # the queued write is provably in flight
+            return orig_save(*a, **k)
+
+        svc._saver.save = slow_save
+        captures = []
+        orig_ckpt = svc._checkpoint
+
+        def spying_checkpoint(*a, **k):
+            captures.append(a)
+            return orig_ckpt(*a, **k)
+
+        svc._checkpoint = spying_checkpoint
+        self._push(svc, 1, [0, 1])  # interval trigger enqueues v1
+        assert svc.checkpoint_now()
+        assert len(captures) == 1  # no redundant re-capture
+        assert CheckpointSaver(ckpt).get_valid_latest_version() == 1
+
+    def test_push_crossing_closed_writer_skips_and_remarks(
+        self, tmp_path
+    ):
+        """Review fix: a push crossing a checkpoint interval while
+        stop()/a re-point closes the writer must not fail the RPC —
+        the grads were already applied; the save is skipped and the
+        drained dirty rows re-marked for the next consumer."""
+        ckpt = str(tmp_path / "c")
+        svc = self._service(ckpt, checkpoint_steps=1, async_write=True)
+        self._push(svc, 1, [0, 1])
+        svc._ckpt_writer.close()
+        self._push(svc, 2, [2, 3])  # must not raise
+        assert svc._tables["emb"].dirty_count >= 2  # re-marked
+
+    def test_configure_checkpoint_repoint_closes_old_writer(
+        self, tmp_path
+    ):
+        """Review fix: re-pointing must close the old writer — an
+        orphaned writer's deferred failure would never raise and its
+        parked thread never retire."""
+        svc = self._service(str(tmp_path / "a"), checkpoint_steps=0,
+                            async_write=True)
+        old = svc._ckpt_writer
+
+        def boom():
+            raise RuntimeError("disk gone")
+
+        old.submit(boom)
+        with pytest.raises(RuntimeError, match="disk gone"):
+            svc.configure_checkpoint(str(tmp_path / "b"))
+        # The failed writer was still closed; a retry lands on a
+        # fresh one and the old writer refuses further submits.
+        svc.configure_checkpoint(str(tmp_path / "b"))
+        assert svc._ckpt_writer is not old
+        with pytest.raises(RuntimeError):
+            old.submit(lambda: None)
+        # The fresh writer is live end to end.
+        self._push(svc, 1, [0, 1])
+        assert svc.checkpoint_now()
+        assert CheckpointSaver(
+            str(tmp_path / "b")
+        ).get_valid_latest_version() == 1
+        svc.stop(0)
+
+    def test_kill_between_delta_and_base_compaction(self, tmp_path):
+        """Chain max 2, checkpoint every push: full@1, delta@2,
+        delta@3 — then the process 'dies' before the @4 compaction. A
+        fresh service must restore the full chain, keep training, and
+        compact cleanly."""
+        ckpt = str(tmp_path / "c")
+        svc = self._service(ckpt, checkpoint_steps=1, delta_chain_max=2,
+                            async_write=False)
+        for seq, ids in ((1, [0, 1]), (2, [1, 2]), (3, [2, 3])):
+            self._push(svc, seq, ids)
+        assert sorted(os.listdir(ckpt)) == [
+            "delta-2", "delta-3", "version-1",
+        ]
+        live = svc.host_tables["emb"].to_arrays()
+        # SIGKILL: no checkpoint_now, no flush — the dirs are all a
+        # replacement gets.
+        svc2 = self._service(ckpt, checkpoint_steps=1,
+                             delta_chain_max=2, async_write=False)
+        assert svc2._push_count == 3
+        got = svc2.host_tables["emb"].to_arrays()
+        np.testing.assert_array_equal(got[0], live[0])
+        np.testing.assert_allclose(got[1], live[1])
+        # Replacement keeps pushing; the next save compacts (chain was
+        # full at restore) and GC keeps the old chain until then.
+        self._push(svc2, 4, [3, 4])
+        assert os.path.isdir(os.path.join(ckpt, "version-4"))
+        version, _, restored = CheckpointSaver(ckpt).restore()
+        assert version == 4
+        np.testing.assert_allclose(
+            restored["emb"].to_arrays()[1],
+            svc2.host_tables["emb"].to_arrays()[1],
+        )
+
+    def test_interval_skip_under_writer_pressure_keeps_rows(
+        self, tmp_path
+    ):
+        """A full writer queue skips the interval WITHOUT draining
+        dirt: the skipped rows ride the next successful save."""
+        import threading
+
+        from elasticdl_tpu.checkpoint.writer import CheckpointWriter
+
+        ckpt = str(tmp_path / "c")
+        svc = self._service(ckpt, checkpoint_steps=1, delta_chain_max=8,
+                            async_write=True)
+        gate = threading.Event()
+        svc._ckpt_writer.submit(lambda: gate.wait(30), label="block")
+        assert svc._ckpt_writer.busy  # one write in flight = capacity
+        self._push(svc, 1, [0, 1])  # interval save skipped
+        table = svc._tables["emb"]
+        assert table.dirty_count >= 2  # rows still tracked
+        gate.set()
+        assert svc.checkpoint_now()
+        version, _, restored = CheckpointSaver(ckpt).restore()
+        assert version == 1
+        ids, _rows = restored["emb"].to_arrays()
+        assert 0 in ids and 1 in ids
+        assert isinstance(svc._ckpt_writer, CheckpointWriter)
+
+
+class TestCheckpointWriter:
+    def test_bounded_nonblocking_refusal_and_flush_barrier(self):
+        import threading
+
+        from elasticdl_tpu.checkpoint.writer import CheckpointWriter
+
+        writer = CheckpointWriter(max_pending=1)
+        gate = threading.Event()
+        done = []
+        writer.submit(lambda: (gate.wait(30), done.append(1)),
+                      label="a")
+        assert not writer.submit(lambda: done.append(2), label="b",
+                                 block=False)
+        gate.set()
+        writer.flush()
+        assert done == [1]
+        writer.close()
+
+    def test_deferred_error_raises_on_flush_and_is_superseded(self):
+        from elasticdl_tpu.checkpoint.writer import CheckpointWriter
+
+        writer = CheckpointWriter(max_pending=2)
+
+        def boom():
+            raise IOError("disk full")
+
+        writer.submit(boom, label="bad")
+        with pytest.raises(IOError, match="disk full"):
+            writer.flush()
+        # A newer success supersedes an older failure.
+        writer.submit(boom, label="bad2")
+        writer.submit(lambda: None, label="good")
+        writer.flush()
+        writer.close()
+
+    def test_stall_metric_observed_on_hook_save(self, tmp_path):
+        from elasticdl_tpu.checkpoint import CheckpointHook
+        from elasticdl_tpu.observability import default_registry
+
+        hist = default_registry().histogram(
+            "checkpoint_stall_seconds",
+            "Step/push-path time spent capturing + enqueuing a "
+            "checkpoint (the part the hot path actually waits on)",
+        )
+        before = hist.labels().count
+
+        class State:
+            step = np.asarray(1)
+            params = {"w": np.zeros((2,), np.float32)}
+            batch_stats = {}
+            opt_state = ()
+            rng = np.zeros((2,), np.uint32)
+
+        hook = CheckpointHook(str(tmp_path / "c"), checkpoint_steps=1,
+                              async_save=True)
+        assert hook.maybe_save(State())
+        hook.flush()
+        assert hist.labels().count == before + 1
+
+
+class TestHookDeltaChain:
+    def test_hook_writes_deltas_for_host_tables(self, tmp_path):
+        """Worker-side incremental checkpoints: host tables ride
+        deltas, dense leaves ride in full, restore_from_dir replays
+        the chain."""
+        from elasticdl_tpu.checkpoint import (
+            CheckpointHook,
+            restore_from_dir,
+        )
+
+        table = EmbeddingTable("emb", 4)
+        table.get(range(8))
+
+        class State:
+            def __init__(self, step):
+                self.step = np.asarray(step)
+                self.params = {"w": np.full((2,), float(step),
+                                            np.float32)}
+                self.batch_stats = {}
+                self.opt_state = ()
+                self.rng = np.zeros((2,), np.uint32)
+
+            def replace(self, **kw):
+                for k, v in kw.items():
+                    setattr(self, k, v)
+                return self
+
+        ckpt = str(tmp_path / "c")
+        hook = CheckpointHook(
+            ckpt, checkpoint_steps=1, async_save=False,
+            host_tables={"emb": table}, delta_chain_max=4,
+        )
+        assert hook.maybe_save(State(1))
+        table.set([2], np.full((1, 4), 2.0))
+        assert hook.maybe_save(State(2))
+        assert os.path.isdir(os.path.join(ckpt, "version-1"))
+        assert os.path.isdir(os.path.join(ckpt, "delta-2"))
+        fresh = EmbeddingTable("emb", 4)
+        restored = restore_from_dir(
+            State(0), ckpt, host_tables={"emb": fresh}
+        )
+        assert int(np.asarray(restored.step)) == 2
+        np.testing.assert_array_equal(
+            restored.params["w"], np.full((2,), 2.0, np.float32)
+        )
+        np.testing.assert_allclose(
+            fresh.get([2]), np.full((1, 4), 2.0)
+        )
+        assert fresh.dirty_count == 0  # restore refill leaves no dirt
+
+
+class TestCheckpointFsck:
+    def _chain_dir(self, tmp_path):
+        table = EmbeddingTable("emb", 4)
+        table.get(range(8))
+        tables = {"emb": table}
+        saver = CheckpointSaver(str(tmp_path / "c"), num_shards=2,
+                                delta_chain_max=4)
+        _chain_save(saver, 1, {}, tables)
+        for v in (2, 3):
+            table.set([v], np.ones((1, 4)))
+            _chain_save(saver, v, {}, tables)
+        return str(tmp_path / "c")
+
+    def test_fsck_green_on_healthy_chain(self, tmp_path):
+        from tools.check_checkpoint import check_checkpoint
+
+        path = self._chain_dir(tmp_path)
+        errors, report = check_checkpoint(path)
+        assert errors == []
+        assert report["chains"] == [{"base": 1, "deltas": [2, 3]}]
+        assert report["garbage"] == []
+
+    def test_fsck_flags_torn_shard_and_orphan_delta(self, tmp_path):
+        from tools.check_checkpoint import check_checkpoint
+
+        path = self._chain_dir(tmp_path)
+        ddir = os.path.join(path, "delta-3")
+        fname = sorted(
+            f for f in os.listdir(ddir) if f.endswith(".ckpt")
+        )[0]
+        blob = open(os.path.join(ddir, fname), "rb").read()
+        with open(os.path.join(ddir, fname), "wb") as fh:
+            fh.write(blob[: len(blob) // 2])
+        errors, report = check_checkpoint(path)
+        assert any("crc32" in e for e in errors)
+        assert any(g["dir"] == "delta-3" for g in report["garbage"])
+        # Orphan: base deleted out from under delta-2.
+        import shutil
+
+        shutil.rmtree(os.path.join(path, "version-1"))
+        errors, report = check_checkpoint(path)
+        assert any("orphaned delta" in g["why"]
+                   for g in report["garbage"])
+        assert report["reclaimable_bytes"] > 0
+
+    def test_fsck_reports_tmp_garbage(self, tmp_path):
+        from tools.check_checkpoint import check_checkpoint
+
+        path = self._chain_dir(tmp_path)
+        os.makedirs(os.path.join(path, "version-9.tmp"))
+        with open(os.path.join(path, "version-9.tmp", "x"), "wb") as f:
+            f.write(b"junk")
+        errors, report = check_checkpoint(path)
+        assert errors == []
+        assert any("tmp" in g["why"] for g in report["garbage"])
+
+
+@pytest.mark.slow
+class TestCheckpointBenchSmoke:
+    def test_bench_smoke_gates_shape(self, tmp_path):
+        """Fast-lane twin of make ckpt-smoke/ckpt-bench: the bench
+        runs on a tiny config, restores both modes losslessly (it
+        asserts that internally), and reports the two gate ratios.
+        The committed BENCH_CHECKPOINT.json enforces the real gates;
+        here we only pin that async beats inline at all on a config
+        this small."""
+        import json
+
+        from tools.bench_checkpoint import main as bench_main
+
+        out = str(tmp_path / "b.json")
+        rc = bench_main([
+            "--smoke", "--out", out,
+            "--workdir", str(tmp_path / "w"),
+            "--cold_rows", "2000", "--pushes", "40",
+            "--checkpoint_steps", "8",
+        ])
+        assert rc == 0
+        report = json.load(open(out))
+        assert report["stall_p99_ratio"] > 1.0
+        assert report["delta_bytes_ratio"] < 1.0
+        from tools.check_checkpoint import check_checkpoint
+
+        for mode in ("inline", "async_delta"):
+            errors, _ = check_checkpoint(
+                str(tmp_path / "w" / mode / "ckpt")
+            )
+            assert errors == []
+
+
+class TestChainForkRegressions:
+    """Review regressions: a delta chain must never fork — not under
+    concurrent checkpoint triggers, and not across a failed
+    predecessor in the writer queue."""
+
+    def test_fresh_base_outranks_stale_fork_chain(self, tmp_path):
+        """Review fix (confirmed repro): after a torn delta truncates
+        a restore, the restarted writer opens a fresh base and the
+        service RE-RUNS those versions with new data — the dead
+        timeline's numerically-newer tip must not outrank the fresh
+        base, or restore() returns pre-crash rows and keep_max gc
+        deletes the good base."""
+        table = EmbeddingTable("emb", 4)
+        table.enable_dirty_tracking()
+        table.get(range(4))
+        tables = {"emb": table}
+        ckpt = str(tmp_path / "c")
+        saver = CheckpointSaver(ckpt, keep_max=3, delta_chain_max=8)
+        _chain_save(saver, 4, {}, tables)
+        for v in (5, 6, 7):
+            table.set([0], np.full((1, 4), float(v)))
+            _chain_save(saver, v, {}, tables)
+        # delta-6's shard: file-count-valid, CRC-torn.
+        shard = next((tmp_path / "c" / "delta-6").glob("rows-*.ckpt"))
+        shard.write_bytes(b"EDLC1 garbage")
+        # Crash + relaunch: restore truncates to the intact prefix...
+        saver2 = CheckpointSaver(ckpt, keep_max=3, delta_chain_max=8)
+        version, _, emb = saver2.restore()
+        assert version == 5
+        # ...and version 6 is re-run with NEW data on a fresh base.
+        table2 = EmbeddingTable("emb", 4)
+        ids, rows = emb["emb"].to_arrays()
+        table2.set(ids, rows)
+        table2.set([0], np.full((1, 4), 66.0))
+        saver2.save(6, {}, embeddings={"emb": table2})
+        # The fresh base is the authoritative lineage, despite the
+        # stale chain's tip 7.
+        assert saver2.get_valid_latest_version() == 6
+        version, _, emb = saver2.restore()
+        assert version == 6
+        np.testing.assert_array_equal(
+            emb["emb"].get([0]), np.full((1, 4), 66.0)
+        )
+        # keep_max gc keeps the fresh lineage, not the dead one.
+        gc_saver = CheckpointSaver(ckpt, keep_max=1, delta_chain_max=8)
+        gc_saver.gc()
+        assert (tmp_path / "c" / "version-6").is_dir()
+        assert not (tmp_path / "c" / "version-4").exists()
+        assert gc_saver.restore()[0] == 6
+
+    def test_delta_over_failed_predecessor_refuses_and_heals(
+        self, tmp_path
+    ):
+        """A delta planned against a base that FAILS ahead of it in
+        the FIFO queue must refuse to write (an element linking
+        through a missing predecessor is unrestorable, and its
+        success would mask the deferred error), re-mark its drained
+        rows, and let the next save open a fresh base."""
+        import threading
+
+        from elasticdl_tpu.checkpoint import (
+            CheckpointHook,
+            CheckpointSaver,
+            CorruptCheckpointError,
+        )
+
+        table = EmbeddingTable("emb", 4)
+        table.get(range(4))
+        ckpt = str(tmp_path / "c")
+        hook = CheckpointHook(
+            ckpt, checkpoint_steps=1, async_save=True,
+            host_tables={"emb": table}, delta_chain_max=4,
+        )
+        gate = threading.Event()
+        real_save = hook.saver.save
+
+        def failing_save(version, dense, **kw):
+            gate.wait(30)
+            raise IOError("disk full")
+
+        hook.saver.save = failing_save
+
+        class State:
+            def __init__(self, step):
+                self.step = np.asarray(step)
+                self.params = {"w": np.zeros((2,), np.float32)}
+                self.batch_stats = {}
+                self.opt_state = ()
+                self.rng = np.zeros((2,), np.uint32)
+
+        # v1 full base: blocks in the writer, then fails. While it is
+        # in flight, v2 is planned as a delta against it and drains
+        # the dirty rows.
+        assert hook.maybe_save(State(1))
+        table.set([2], np.full((1, 4), 2.0))
+        planner_thread = threading.Thread(
+            target=lambda: hook.maybe_save(State(2))
+        )
+        planner_thread.start()
+        import time
+
+        time.sleep(0.2)  # let v2 reach the (blocked) submit
+        gate.set()
+        planner_thread.join(30)
+        with pytest.raises(
+            (IOError, CorruptCheckpointError)
+        ):
+            hook.flush()
+        # The delta refused: no unrestorable element on disk, and the
+        # drained rows are dirty again for the next (healing) save.
+        assert not os.path.isdir(os.path.join(ckpt, "delta-2"))
+        assert table.dirty_count >= 1
+        hook.saver.save = real_save
+        assert hook.maybe_save(State(3))  # heals with a fresh base
+        hook.flush()
+        assert CheckpointSaver(ckpt).get_valid_latest_version() == 3
+
+    def test_concurrent_triggers_never_fork_the_chain(self, tmp_path):
+        """Two checkpoint triggers racing at consecutive versions must
+        serialize through the trigger lock: every element that lands
+        links into ONE chain (a fork would strand the second delta's
+        rows outside every restore)."""
+        import threading
+        import time
+
+        from elasticdl_tpu.embedding.optimizer import (
+            SGD,
+            HostOptimizerWrapper,
+        )
+        from elasticdl_tpu.embedding.row_service import HostRowService
+
+        svc = HostRowService(
+            {"emb": EmbeddingTable("emb", 4)},
+            HostOptimizerWrapper(SGD(lr=1.0)),
+        )
+        ckpt = str(tmp_path / "c")
+        svc.configure_checkpoint(ckpt, checkpoint_steps=0,
+                                 delta_chain_max=8, async_write=False)
+        # Seed a base so racing triggers plan deltas.
+        svc._tables["emb"].set([0], np.ones((1, 4)))
+        assert svc._checkpoint(1, blocking=True)
+        real_plan = svc._ckpt_planner.plan
+
+        def slow_plan(version):
+            out = real_plan(version)
+            time.sleep(0.05)  # widen the plan->capture window
+            return out
+
+        svc._ckpt_planner.plan = slow_plan
+        results = {}
+
+        def trigger(v):
+            svc._tables["emb"].set([v], np.ones((1, 4)))
+            results[v] = svc._checkpoint(v, blocking=True)
+
+        threads = [threading.Thread(target=trigger, args=(v,))
+                   for v in (2, 3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30)
+        svc._ckpt_writer.flush()
+        saver = svc._saver
+        chains = saver.chains()
+        landed = [v for v in (2, 3) if results.get(v)]
+        in_chains = set()
+        for chain in chains:
+            in_chains.add(chain["base"])
+            in_chains.update(chain["deltas"])
+        for v in landed:
+            assert v in in_chains, (
+                f"element {v} landed but is unreachable "
+                f"(forked chain): {chains}"
+            )
+        # And the whole thing restores to the live rows.
+        version, _, restored = saver.restore()
+        assert version == max(landed + [1])

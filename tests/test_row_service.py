@@ -396,9 +396,11 @@ def test_concurrent_pushes_and_checkpoints_stay_consistent(tmp_path):
                 np.testing.assert_allclose(k, k[0], atol=1e-5)  # uniform
                 assert -1e-5 <= k[0] <= PUSHES + 1e-5
 
-        # One quiescent push (no concurrent writers left, so its save
-        # cannot be overlap-skipped) seals a final checkpoint; restoring
-        # it reproduces the live rows exactly.
+        # One quiescent push, then the DRAIN path: pushes no longer
+        # wait for durability (async capture/write split), so the
+        # durable seal is checkpoint_now's flush — exactly what the
+        # SIGTERM drain and relaunch drills call. Restoring it
+        # reproduces the live rows exactly.
         engine = make_remote_engine(
             addr, id_keys={"items": "ids"}, retries=2, backoff_secs=0.1,
         )
@@ -406,6 +408,7 @@ def test_concurrent_pushes_and_checkpoints_stay_consistent(tmp_path):
             engine.tables["items"], np.array([THREADS]),
             np.zeros((1, DIM), np.float32),
         )
+        assert svc.checkpoint_now()
         svc2 = HostRowService(
             {"items": EmbeddingTable("items", DIM)},
             HostOptimizerWrapper(SGD(lr=1.0)),

@@ -1,0 +1,264 @@
+#!/usr/bin/env python
+"""Checkpoint-plane bench: async capture/write + incremental deltas vs
+the inline full-snapshot path (ISSUE 10) → BENCH_CHECKPOINT.json.
+
+The workload is the row service's own regime: a mostly-cold table
+(``--cold_rows`` materialized once) with a hot working set
+(``--hot_rows``) hammered by gradient pushes, checkpointing every
+``--checkpoint_steps`` pushes. Two runs over identical push schedules:
+
+- **inline** — the pre-PR shape: every save is a FULL snapshot,
+  serialized + written on the push-handler thread
+  (``delta_chain_max=0, async_write=False``);
+- **async_delta** — the PR shape: the handler pays capture + enqueue
+  only, writes land on the background ``CheckpointWriter``, and saves
+  are dirty-row DELTAS against a periodic full base
+  (``delta_chain_max``, ``async_write=True``).
+
+Reported gates (acceptance criteria):
+
+- ``stall_p99_ratio`` = inline p99 push latency / async p99 push
+  latency ≥ 5 — checkpointing leaves the push path;
+- ``delta_bytes_ratio`` = mean delta element bytes / full base bytes
+  ≤ 0.2 — a hot-working-set checkpoint moves the working set, not the
+  table.
+
+Both runs end with ``checkpoint_now()`` (durable) and must restore to
+the same row values — the bench refuses to report a win that lost
+data. ``--smoke`` shrinks the config for the fast lane and skips gate
+enforcement (tiny configs are noisy); ``make ckpt-bench`` runs the
+committed config and exits nonzero if a gate fails.
+"""
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+import numpy as np  # noqa: E402
+
+DEFAULT_OUT = "BENCH_CHECKPOINT.json"
+TABLE = "bench_rows"
+
+
+def _percentile(values, q):
+    values = sorted(values)
+    if not values:
+        return 0.0
+    idx = min(len(values) - 1, int(round(q * (len(values) - 1))))
+    return float(values[idx])
+
+
+def _dir_bytes(path):
+    total = 0
+    for root, _dirs, files in os.walk(path):
+        for fname in files:
+            total += os.path.getsize(os.path.join(root, fname))
+    return total
+
+
+def _build_service(ckpt_dir, cfg, delta_chain, async_write):
+    """A HostRowService over the production table/optimizer impls
+    (native row store when the library is available), pre-populated
+    with the cold row set, checkpoint-configured."""
+    from elasticdl_tpu.embedding.row_service import HostRowService
+    from elasticdl_tpu.native.row_store import (
+        make_host_optimizer,
+        make_host_table,
+    )
+    from elasticdl_tpu.embedding.optimizer import SGD
+
+    table = make_host_table(TABLE, cfg["dim"])
+    svc = HostRowService(
+        {TABLE: table}, make_host_optimizer(SGD(lr=0.1))
+    )
+    # Cold bulk: materialized once, then never touched again — the
+    # part a full snapshot re-ships every save and a delta never does.
+    rng = np.random.RandomState(7)
+    chunk = 8192
+    for lo in range(0, cfg["cold_rows"], chunk):
+        ids = np.arange(lo, min(lo + chunk, cfg["cold_rows"]))
+        table.set(ids, rng.rand(ids.size, cfg["dim"]).astype(np.float32))
+    svc.configure_checkpoint(
+        ckpt_dir, checkpoint_steps=cfg["checkpoint_steps"],
+        keep_max=cfg["keep_max"], delta_chain_max=delta_chain,
+        async_write=async_write,
+    )
+    return svc, table
+
+
+def _drive(svc, cfg, label):
+    """Push the hot working set through the real handler and time each
+    handler call — the step-path latency a training worker's applier
+    would observe."""
+    rng = np.random.RandomState(13)
+    hot = np.arange(cfg["hot_rows"], dtype=np.int64)
+    latencies = []
+    for seq in range(1, cfg["pushes"] + 1):
+        ids = hot  # every push touches the whole hot set (dedup'd)
+        grads = rng.rand(ids.size, cfg["dim"]).astype(np.float32)
+        t0 = time.monotonic()
+        svc._push_row_grads({
+            "table": TABLE, "ids": ids, "grads": grads,
+            "client": f"bench-{label}", "seq": seq,
+        })
+        latencies.append(time.monotonic() - t0)
+    assert svc.checkpoint_now(), "drain checkpoint failed"
+    return latencies
+
+
+def _element_bytes(ckpt_dir):
+    """(full_base_bytes, mean_delta_bytes) over surviving elements."""
+    fulls, deltas = [], []
+    for entry in os.listdir(ckpt_dir):
+        path = os.path.join(ckpt_dir, entry)
+        if not os.path.isdir(path):
+            continue
+        if entry.startswith("version-"):
+            fulls.append(_dir_bytes(path))
+        elif entry.startswith("delta-"):
+            deltas.append(_dir_bytes(path))
+    full = max(fulls) if fulls else 0
+    mean_delta = sum(deltas) / len(deltas) if deltas else 0
+    return full, mean_delta, len(fulls), len(deltas)
+
+
+def run_bench(cfg, workdir):
+    results = {}
+    rows = {}
+    for label, delta_chain, async_write in (
+        ("inline", 0, False),
+        ("async_delta", cfg["delta_chain"], True),
+    ):
+        ckpt_dir = os.path.join(workdir, label, "ckpt")
+        t0 = time.monotonic()
+        svc, table = _build_service(
+            ckpt_dir, cfg, delta_chain, async_write
+        )
+        lat = _drive(svc, cfg, label)
+        wall = time.monotonic() - t0
+        full_b, delta_b, n_full, n_delta = _element_bytes(ckpt_dir)
+        # Post-run durability audit: restore must reproduce the live
+        # hot rows exactly (a stall win that lost data is no win).
+        from elasticdl_tpu.checkpoint.saver import CheckpointSaver
+
+        version, _, restored = CheckpointSaver(ckpt_dir).restore()
+        hot = np.arange(cfg["hot_rows"], dtype=np.int64)
+        np.testing.assert_allclose(
+            restored[TABLE].get(hot), table.get(hot), rtol=1e-6,
+            err_msg=f"{label}: restored rows diverge from live rows",
+        )
+        rows[label] = table.get(hot)
+        results[label] = {
+            "push_p50_ms": round(_percentile(lat, 0.50) * 1e3, 4),
+            "push_p99_ms": round(_percentile(lat, 0.99) * 1e3, 4),
+            "push_max_ms": round(max(lat) * 1e3, 4),
+            "wall_secs": round(wall, 3),
+            "restored_version": int(version),
+            "full_base_bytes": int(full_b),
+            "mean_delta_bytes": int(delta_b),
+            "full_elements": n_full,
+            "delta_elements": n_delta,
+        }
+    # Identical schedules → identical final rows across modes.
+    np.testing.assert_allclose(
+        rows["inline"], rows["async_delta"], rtol=1e-6,
+        err_msg="inline and async_delta trajectories diverged",
+    )
+    inline, asynch = results["inline"], results["async_delta"]
+    stall_ratio = (
+        inline["push_p99_ms"] / asynch["push_p99_ms"]
+        if asynch["push_p99_ms"] else float("inf")
+    )
+    bytes_ratio = (
+        asynch["mean_delta_bytes"] / asynch["full_base_bytes"]
+        if asynch["full_base_bytes"] else 1.0
+    )
+    return {
+        "bench": "checkpoint_plane",
+        "config": cfg,
+        "results": results,
+        "stall_p99_ratio": round(stall_ratio, 2),
+        "delta_bytes_ratio": round(bytes_ratio, 4),
+        "gates": {
+            "stall_p99_ratio_min": 5.0,
+            "delta_bytes_ratio_max": 0.2,
+        },
+        "passed": {
+            "stall": stall_ratio >= 5.0,
+            "bytes": bytes_ratio <= 0.2,
+        },
+    }
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser("bench_checkpoint")
+    parser.add_argument("--out", default=DEFAULT_OUT)
+    parser.add_argument("--workdir", default="",
+                        help="Scratch dir; kept when given (so make "
+                             "ckpt-smoke can fsck it), else a removed "
+                             "tempdir")
+    parser.add_argument("--smoke", action="store_true",
+                        help="Tiny config for the fast lane; gates "
+                             "reported but not enforced")
+    parser.add_argument("--dim", type=int, default=32)
+    parser.add_argument("--cold_rows", type=int, default=60000)
+    parser.add_argument("--hot_rows", type=int, default=512)
+    parser.add_argument("--pushes", type=int, default=300)
+    parser.add_argument("--checkpoint_steps", type=int, default=20)
+    parser.add_argument("--delta_chain", type=int, default=8)
+    parser.add_argument("--keep_max", type=int, default=3)
+    args = parser.parse_args(argv)
+
+    cfg = {
+        "dim": args.dim,
+        "cold_rows": args.cold_rows,
+        "hot_rows": args.hot_rows,
+        "pushes": args.pushes,
+        "checkpoint_steps": args.checkpoint_steps,
+        "delta_chain": args.delta_chain,
+        "keep_max": args.keep_max,
+        "smoke": bool(args.smoke),
+    }
+    if args.smoke:
+        cfg.update(cold_rows=min(cfg["cold_rows"], 4000),
+                   pushes=min(cfg["pushes"], 80),
+                   checkpoint_steps=min(cfg["checkpoint_steps"], 10))
+    from elasticdl_tpu.native import native_available
+
+    cfg["native_row_store"] = bool(native_available())
+
+    workdir = args.workdir
+    cleanup = False
+    if not workdir:
+        workdir = tempfile.mkdtemp(prefix="edl_ckpt_bench_")
+        cleanup = True
+    try:
+        report = run_bench(cfg, workdir)
+    finally:
+        if cleanup:
+            shutil.rmtree(workdir, ignore_errors=True)
+    with open(args.out, "w") as f:
+        json.dump(report, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(f"bench_checkpoint: p99 push {report['results']['inline']['push_p99_ms']}ms inline "
+          f"vs {report['results']['async_delta']['push_p99_ms']}ms async "
+          f"(ratio {report['stall_p99_ratio']}x, gate >=5x); "
+          f"delta/full bytes {report['delta_bytes_ratio']} "
+          f"(gate <=0.2); report -> {args.out}")
+    if not args.smoke and not all(report["passed"].values()):
+        print(f"bench_checkpoint: GATE FAILED {report['passed']}",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
