@@ -7,7 +7,8 @@ PY ?= python
 .PHONY: test test-all test-tpu test-k8s native bench serve-bench dryrun \
 	clean lint metrics chaos-smoke chaos-soak chaos-master-smoke \
 	trace-smoke serve-fleet-smoke sparse-smoke sparse-bench \
-	autoscale-smoke autoscale-bench slo-smoke ckpt-bench ckpt-smoke
+	autoscale-smoke autoscale-bench slo-smoke ckpt-bench ckpt-smoke \
+	tiered-smoke tiered-bench
 
 # Scrape-and-pretty-print a master's /metrics (docs/observability.md).
 METRICS_ADDR ?= localhost:8080
@@ -139,15 +140,41 @@ ckpt-smoke:
 	&& $(PY) tools/check_checkpoint.py $$workdir/async_delta/ckpt; \
 	rc=$$?; rm -rf $$workdir; exit $$rc
 
+# Tiered-storage chaos drill (docs/sparse_path.md "Tiered storage"):
+# kills mid-eviction and mid-compaction against a tiered row service,
+# relaunch + replay must land byte-equal to a fault-free twin (rows,
+# slots, step counters — across both tiers), and a cold store crashed
+# mid-compaction must reopen to pre-crash bytes. Every cold dir the
+# drill leaves (dead incarnations included) is then fsck'd by
+# check_store.py. Fast-lane equivalent:
+# tests/test_tiered_store.py::test_tiered_drill_passes.
+tiered-smoke:
+	workdir=$$(mktemp -d /tmp/edl_tiered.XXXXXX); \
+	JAX_PLATFORMS=cpu $(PY) -m elasticdl_tpu.chaos.tiered_drill \
+		--seed $(CHAOS_SEED) --workdir $$workdir \
+		--report TIERED_DRILL.json \
+	&& $(PY) tools/check_store.py $$workdir/cold; \
+	rc=$$?; rm -rf $$workdir; exit $$rc
+
+# Tiered-storage bench (docs/sparse_path.md): train + serve a table
+# ~10x the hot-tier row budget on a hot-working-set workload, tiered
+# vs all-in-memory; writes BENCH_TIERED.json. Gates: tiered p99 step
+# <=1.5x the in-memory baseline, and a mid-run checkpoint restores
+# byte-equal rows across both tiers.
+tiered-bench:
+	JAX_PLATFORMS=cpu $(PY) tools/bench_tiered_store.py
+
 # Deterministic chaos plan (kill + stall-row-shard + corrupt-checkpoint)
 # against the in-process cluster; exits nonzero if any recovery
 # invariant fails — the schedule includes a worker kill landing
 # between a row-service delta save and its base compaction, and the
 # end-of-run shard relaunch restores across the base+delta chain.
 # The row checkpoint dir the drill leaves behind is then fsck'd.
+# Runs the tiered-storage drill first (tiered-smoke), so the chaos
+# lane also fsck's cold-tier segment stores via check_store.py.
 # Tier-1 safe (~15s on CPU). docs/chaos.md.
 CHAOS_SEED ?= 7
-chaos-smoke:
+chaos-smoke: tiered-smoke
 	workdir=$$(mktemp -d /tmp/edl_chaos.XXXXXX); \
 	JAX_PLATFORMS=cpu $(PY) -m elasticdl_tpu chaos run \
 		--seed $(CHAOS_SEED) --workdir $$workdir \

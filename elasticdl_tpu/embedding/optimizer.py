@@ -418,6 +418,17 @@ class HostOptimizerWrapper:
     def _slot_table(self, table: EmbeddingTable, slot_name: str):
         key = get_slot_table_name(table.name, slot_name)
         if key not in self._slot_tables:
+            make = getattr(table, "make_slot_table", None)
+            if make is not None:
+                # Tiered primaries (storage/tiered.py) create their
+                # slots inside their own TierGroup: a demoted row must
+                # take its optimizer state with it, and a fault must
+                # bring it back — lockstep only holds when the slot
+                # shares the primary's recency map and budget.
+                self._slot_tables[key] = make(
+                    key, slot_init_value(self.opt, slot_name)
+                )
+                return self._slot_tables[key]
             st = EmbeddingTable(
                 key,
                 table.dim,
@@ -440,19 +451,28 @@ class HostOptimizerWrapper:
             raise ValueError("ids must be deduplicated before apply")
         step = self._steps.get(table.name, 0) + 1
         self._steps[table.name] = step
-        rows = table.get(ids)
+        # Tiered tables: defer every per-get/set budget sweep to ONE
+        # sweep after the whole apply (or to the row-service handler's
+        # post-lock maybe_sweep when defer_apply_sweep is set) —
+        # otherwise each of these 2+2*slots calls runs eviction's
+        # cold-tier writes inside whatever lock the caller holds.
+        tiered = hasattr(table, "maybe_sweep")
+        kw = {"_defer_sweep": True} if tiered else {}
+        rows = table.get(ids, **kw)
         slots = {
-            name: self._slot_table(table, name).get(ids)
+            name: self._slot_table(table, name).get(ids, **kw)
             for name in self.opt.slot_names
         }
         new_rows, new_slots = self.opt.apply_rows(
             rows, np.asarray(grads, table.dtype), slots, step
         )
-        table.set(ids, np.asarray(new_rows))
+        table.set(ids, np.asarray(new_rows), **kw)
         for name in self.opt.slot_names:
             self._slot_table(table, name).set(
-                ids, np.asarray(new_slots[name])
+                ids, np.asarray(new_slots[name]), **kw
             )
+        if tiered and not table.defer_apply_sweep:
+            table.maybe_sweep()
         return table
 
     def state_tables(self, main_tables: Dict) -> Dict:

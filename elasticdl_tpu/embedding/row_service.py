@@ -203,11 +203,24 @@ class HostRowService:
         # Ambient span: nests under the RPC server span (role
         # rowservice) so lock-wait + store time is attributable
         # separately from wire/serde time; free with no recorder.
+        tiered = hasattr(table, "prefault")
         with tracing.span("row_pull", table=request["table"],
                           rows=int(ids.size)):
+            if tiered:
+                # Fault this pull's cold rows with the DISK READ
+                # outside the service lock: concurrent pushes wait on
+                # in-memory bookkeeping only, and the host engine's
+                # pull-ahead turns the fault into prefetch
+                # (storage/tiered.py "Tiered storage").
+                table.prefault(ids)
             with self._lock:
-                rows = table.get(ids)
+                rows = (table.get(ids, _defer_sweep=True) if tiered
+                        else table.get(ids))
                 applied_at = self._applied_at.get(request["table"], 0.0)
+            if tiered:
+                # Budget sweep AFTER releasing the service lock: the
+                # eviction's cold write stalls no handler but this one.
+                table.maybe_sweep()
         self._m_pulled.inc(ids.size)
         self._m_pull.observe(time.monotonic() - t0)
         # applied_at rides every pull so readers can observe row
@@ -244,8 +257,14 @@ class HostRowService:
         client = request.get("client", "")
         seq = int(request.get("seq", -1))
         ids = np.asarray(request["ids"], np.int64)
+        prefault = getattr(table, "prefault_group", None)
         with tracing.span("row_push", table=request["table"],
                           rows=int(ids.size)):
+            if prefault is not None:
+                # Cold reads for evicted rows (and their optimizer
+                # slots) OUTSIDE the service lock; a duplicate push
+                # merely promotes rows it would have touched anyway.
+                prefault(ids)
             with self._lock:
                 if client and seq >= 0:
                     key = _client_key(client)
@@ -270,6 +289,10 @@ class HostRowService:
                     self._applied_seq[_client_key(client)] = seq
                 self._push_count += 1
                 version = self._push_count
+            if prefault is not None:
+                # Deferred half of the fused apply's budget sweep —
+                # eviction's cold writes run with the lock released.
+                table.maybe_sweep()
         self._m_pushed.inc(ids.size)
         self._m_push.observe(time.monotonic() - t0)
         if (
@@ -278,6 +301,60 @@ class HostRowService:
         ):
             self._checkpoint(version)
         return {}
+
+    # ---- tiered storage ------------------------------------------------
+
+    def configure_tiering(self, cold_dir: str, hot_budget_rows: int,
+                          segment_max_bytes: int = 8 << 20,
+                          compact_live_fraction: float = 0.5,
+                          background_compact: bool = True):
+        """Re-house every table behind a two-tier store (hot arena
+        bounded by ``hot_budget_rows`` per table, cold rows spilled to
+        CRC-framed segments under ``cold_dir`` — storage/tiered.py):
+        the beyond-RAM path, letting this shard serve tables far larger
+        than host memory as long as the working set fits the budget.
+
+        Must run BEFORE ``configure_checkpoint``: checkpoint config
+        enables dirty tracking on the table views it sees, and the
+        tier wrapper owns that tracking once tiering is on (a row
+        demoted while dirty must still ride the next delta)."""
+        from elasticdl_tpu.storage import TierPolicy, tier_host_tables
+
+        with self._lock:
+            if self._saver is not None:
+                raise RuntimeError(
+                    "configure_tiering must run before "
+                    "configure_checkpoint (dirty tracking moves to the "
+                    "tier wrapper)"
+                )
+            self._tables = tier_host_tables(
+                self._tables, cold_dir,
+                TierPolicy(
+                    hot_budget_rows,
+                    segment_max_bytes=segment_max_bytes,
+                    compact_live_fraction=compact_live_fraction,
+                    background_compact=background_compact,
+                ),
+            )
+            for table in self._tables.values():
+                # The push handler sweeps AFTER releasing the service
+                # lock (maybe_sweep below); a fused apply must not
+                # also sweep inside it.
+                table.defer_apply_sweep = True
+        logger.info(
+            "Row service tiering on: hot budget %d rows/table, cold "
+            "tier at %s", hot_budget_rows, cold_dir,
+        )
+        return self
+
+    def tier_stats(self) -> Dict[str, dict]:
+        """Per-table tier occupancy/garbage (tests, debug endpoints)."""
+        with self._lock:
+            return {
+                name: table.tier_stats()
+                for name, table in self._tables.items()
+                if hasattr(table, "tier_stats")
+            }
 
     # ---- checkpoint ----------------------------------------------------
 
@@ -503,6 +580,16 @@ class HostRowService:
                 logger.error(
                     "checkpoint flush on stop failed: %s", exc
                 )
+        for table in self._tables.values():
+            # Tiered tables: flush cold segments, stop the compactor,
+            # and snapshot the index (the clean-close marker
+            # tools/check_store.py audits against).
+            group = getattr(table, "tier_group", None)
+            if group is not None:
+                try:
+                    group.close()
+                except BaseException as exc:
+                    logger.error("cold-tier close failed: %s", exc)
 
     def wait(self):
         """Block until the server stops (process-main lifetime)."""
@@ -947,6 +1034,24 @@ def main(argv=None):
                              "handler instead of the background "
                              "writer (debugging / deterministic "
                              "schedules)")
+    parser.add_argument("--hot_budget_rows", type=int, default=0,
+                        help="Tiered storage: max rows/table resident "
+                             "in the hot in-memory arena; colder rows "
+                             "spill to CRC-framed disk segments "
+                             "(docs/sparse_path.md 'Tiered storage'). "
+                             "0 (default) = everything in memory")
+    parser.add_argument("--cold_dir", default="",
+                        help="Cold-tier segment directory (spill "
+                             "cache, wiped on start — checkpoints own "
+                             "durability). Default: "
+                             "<checkpoint_dir>_cold, or a tempdir "
+                             "when no checkpoint dir is set")
+    parser.add_argument("--cold_segment_mb", type=int, default=8,
+                        help="Cold-tier segment file size bound (MB)")
+    parser.add_argument("--cold_compact_live_fraction", type=float,
+                        default=0.5,
+                        help="Compact a cold segment when its live "
+                             "record fraction drops below this")
     parser.add_argument("--shard_id", type=int, default=0)
     parser.add_argument("--num_shards", type=int, default=1)
     parser.add_argument("--metrics_port", type=int, default=-1,
@@ -968,6 +1073,23 @@ def main(argv=None):
             f"{args.model_def}: module defines no make_row_service()"
         )
     service = factory()
+    if args.hot_budget_rows > 0:
+        # BEFORE checkpoint config: restore refills stream through the
+        # tier (the budget holds from the first row), and dirty
+        # tracking lands on the tier wrapper.
+        cold_dir = args.cold_dir
+        if not cold_dir:
+            if args.checkpoint_dir:
+                cold_dir = args.checkpoint_dir.rstrip("/") + "_cold"
+            else:
+                import tempfile
+
+                cold_dir = tempfile.mkdtemp(prefix="edl_cold_")
+        service.configure_tiering(
+            cold_dir, args.hot_budget_rows,
+            segment_max_bytes=args.cold_segment_mb << 20,
+            compact_live_fraction=args.cold_compact_live_fraction,
+        )
     if args.checkpoint_dir:
         validate_shard_layout(
             args.checkpoint_dir, args.shard_id, args.num_shards

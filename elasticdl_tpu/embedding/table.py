@@ -109,6 +109,25 @@ class EmbeddingTable:
             if self._track_dirty:
                 self._dirty.add(int(row_id))
 
+    def erase(self, ids) -> int:
+        """Drop rows (tiered-store demotion, storage/tiered.py);
+        absent ids are ignored. Returns the number actually erased.
+        Erased ids leave the dirty set — their bytes are gone, and a
+        later dirty drain re-reading them through get() would
+        resurrect them as fresh lazy inits."""
+        erased = 0
+        for row_id in ids:
+            if self.vectors.pop(int(row_id), None) is not None:
+                erased += 1
+            self._dirty.discard(int(row_id))
+        return erased
+
+    def contains(self, ids) -> np.ndarray:
+        """Bool membership mask, without materializing anything."""
+        return np.array(
+            [int(i) in self.vectors for i in ids], bool
+        )
+
     @property
     def num_rows(self) -> int:
         return len(self.vectors)

@@ -74,6 +74,12 @@ def _bind(lib):
     lib.rs_num_rows.argtypes = [p]
     lib.rs_dim.restype = i64
     lib.rs_dim.argtypes = [p]
+    lib.rs_created_count.restype = i64
+    lib.rs_created_count.argtypes = [p]
+    lib.rs_erase.restype = i64
+    lib.rs_erase.argtypes = [p, i64p, i64]
+    lib.rs_contains.argtypes = [p, i64p, i64,
+                                c.POINTER(c.c_uint8)]
     lib.rs_get.argtypes = [p, i64p, i64, f32p]
     lib.rs_set.argtypes = [p, i64p, i64, f32p]
     lib.rs_export.argtypes = [p, i64p, f32p]

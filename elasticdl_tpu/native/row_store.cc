@@ -9,8 +9,11 @@
 // a ctypes binding (no pybind11 in the image).
 //
 // Layout: open-addressed id->index map + one contiguous float arena
-// (dim-strided rows, never freed) — pointer-stable, cache-friendly
-// sequential updates, O(1) amortized insert.
+// (dim-strided rows) — pointer-stable, cache-friendly sequential
+// updates, O(1) amortized insert. Rows can be erased (tiered-store
+// eviction, storage/tiered.py): the slot goes on a free list and is
+// reused by the next materialization, so the arena's high-water mark
+// is bounded by the hot-tier budget, not by every id ever touched.
 //
 // Build: g++ -O3 -shared -fPIC (see native/__init__.py; no external deps).
 
@@ -82,6 +85,32 @@ struct IdMap {
     insert_nogrow(key, val);
     ++count;
   }
+
+  // Backward-shift deletion (linear probing, no tombstones): walk the
+  // probe chain past the hole and pull back any entry whose probe
+  // distance spans the hole, so find() never meets a false empty slot.
+  bool erase(int64_t key) {
+    size_t mask = keys.size() - 1;
+    size_t slot = splitmix64(static_cast<uint64_t>(key)) & mask;
+    while (keys[slot] != key) {
+      if (keys[slot] == kEmptyKey) return false;
+      slot = (slot + 1) & mask;
+    }
+    size_t hole = slot;
+    size_t next = (hole + 1) & mask;
+    while (keys[next] != kEmptyKey) {
+      size_t ideal = splitmix64(static_cast<uint64_t>(keys[next])) & mask;
+      if (((next - ideal) & mask) >= ((next - hole) & mask)) {
+        keys[hole] = keys[next];
+        vals[hole] = vals[next];
+        hole = next;
+      }
+      next = (next + 1) & mask;
+    }
+    keys[hole] = kEmptyKey;
+    --count;
+    return true;
+  }
 };
 
 struct RowStore {
@@ -92,7 +121,15 @@ struct RowStore {
   float const_value;  // constant init value (slot tables)
   IdMap map;
   std::vector<float> arena;
-  std::vector<int64_t> ids_in_order;  // insertion order, for export
+  // slot -> owning id (kEmptyKey when the slot is on the free list);
+  // doubles as export order for live slots.
+  std::vector<int64_t> slot_ids;
+  std::vector<int64_t> free_slots;  // erased arena slots, reused LIFO
+  // Monotonic count of row materializations. The Python dirty-tracking
+  // heuristic compares this across a get(): num_rows (live count) is
+  // NOT a safe proxy once erase exists — a get that re-materializes an
+  // evicted row into a reused slot leaves the arena size unchanged.
+  int64_t created = 0;
 
   float* row_ptr(int64_t idx) { return arena.data() + idx * dim; }
 
@@ -101,8 +138,15 @@ struct RowStore {
   int64_t get_or_create(int64_t id) {
     int64_t idx = map.find(id);
     if (idx >= 0) return idx;
-    idx = static_cast<int64_t>(ids_in_order.size());
-    arena.resize(arena.size() + dim);
+    if (!free_slots.empty()) {
+      idx = free_slots.back();
+      free_slots.pop_back();
+      slot_ids[idx] = id;
+    } else {
+      idx = static_cast<int64_t>(slot_ids.size());
+      arena.resize(arena.size() + dim);
+      slot_ids.push_back(id);
+    }
     float* r = row_ptr(idx);
     if (init_mode == 1) {
       for (int64_t c = 0; c < dim; ++c) r[c] = const_value;
@@ -116,7 +160,7 @@ struct RowStore {
       }
     }
     map.insert(id, idx);
-    ids_in_order.push_back(id);
+    ++created;
     return idx;
   }
 };
@@ -139,7 +183,34 @@ void* rs_create(int64_t dim, uint32_t seed, int init_mode, float init_scale,
 void rs_destroy(void* p) { delete static_cast<RowStore*>(p); }
 
 int64_t rs_num_rows(void* p) {
-  return static_cast<int64_t>(static_cast<RowStore*>(p)->ids_in_order.size());
+  // LIVE rows (erased slots excluded), not arena high-water.
+  return static_cast<int64_t>(static_cast<RowStore*>(p)->map.count);
+}
+
+int64_t rs_created_count(void* p) {
+  return static_cast<RowStore*>(p)->created;
+}
+
+// Erase rows (tier demotion). Absent ids are ignored; returns how many
+// were actually erased. Slots go on the free list for reuse.
+int64_t rs_erase(void* p, const int64_t* ids, int64_t n) {
+  RowStore* s = static_cast<RowStore*>(p);
+  int64_t erased = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t idx = s->map.find(ids[i]);
+    if (idx < 0) continue;
+    s->map.erase(ids[i]);
+    s->slot_ids[idx] = kEmptyKey;
+    s->free_slots.push_back(idx);
+    ++erased;
+  }
+  return erased;
+}
+
+// Membership without materialization: out[i] = 1 iff ids[i] is live.
+void rs_contains(void* p, const int64_t* ids, int64_t n, uint8_t* out) {
+  RowStore* s = static_cast<RowStore*>(p);
+  for (int64_t i = 0; i < n; ++i) out[i] = s->map.find(ids[i]) >= 0;
 }
 
 int64_t rs_dim(void* p) { return static_cast<RowStore*>(p)->dim; }
@@ -160,12 +231,19 @@ void rs_set(void* p, const int64_t* ids, int64_t n, const float* values) {
   }
 }
 
-// Export in insertion order: ids_out[num_rows], rows_out[num_rows*dim].
+// Export live rows in slot order (erased slots skipped):
+// ids_out[num_rows], rows_out[num_rows*dim].
 void rs_export(void* p, int64_t* ids_out, float* rows_out) {
   RowStore* s = static_cast<RowStore*>(p);
-  int64_t n = static_cast<int64_t>(s->ids_in_order.size());
-  std::memcpy(ids_out, s->ids_in_order.data(), sizeof(int64_t) * n);
-  std::memcpy(rows_out, s->arena.data(), sizeof(float) * n * s->dim);
+  int64_t out = 0;
+  for (size_t slot = 0; slot < s->slot_ids.size(); ++slot) {
+    if (s->slot_ids[slot] == kEmptyKey) continue;
+    ids_out[out] = s->slot_ids[slot];
+    std::memcpy(rows_out + out * s->dim,
+                s->row_ptr(static_cast<int64_t>(slot)),
+                sizeof(float) * s->dim);
+    ++out;
+  }
 }
 
 // ---- fused row optimizers (reference kernel_api.cc, vectorized by the

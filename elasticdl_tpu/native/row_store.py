@@ -13,6 +13,7 @@ implementations mid-job without going through a checkpoint (set() rows
 round-trip exactly either way).
 """
 
+import contextlib
 import ctypes
 from typing import Dict, Iterable
 
@@ -114,11 +115,16 @@ class NativeEmbeddingTable:
     def get(self, ids: Iterable[int]) -> np.ndarray:
         ids = _ids_arr(ids)
         out = np.empty((ids.size, self.dim), np.float32)
-        before = self.num_rows
+        before = self.created_count
         self._lib.rs_get(self._h, _i64p(ids), ids.size, _f32p(out))
-        if self._track_dirty and self.num_rows != before:
-            # The arena grew: at least one requested row materialized.
-            # Which ones is invisible from here, so mark them all.
+        if self._track_dirty and self.created_count != before:
+            # At least one requested row materialized. Which ones is
+            # invisible from here, so mark them all. Compared on the
+            # MONOTONIC materialization counter, not num_rows: with
+            # erase() in play (tier eviction) a re-materialized row can
+            # land in a reused free slot, leaving arena/live sizes
+            # unchanged — a size heuristic would silently skip the
+            # dirty mark and the row would miss every delta checkpoint.
             self._dirty.update(ids.tolist())
         return out
 
@@ -131,7 +137,36 @@ class NativeEmbeddingTable:
 
     @property
     def num_rows(self) -> int:
+        """LIVE rows (erased rows excluded)."""
         return int(self._lib.rs_num_rows(self._h))
+
+    @property
+    def created_count(self) -> int:
+        """Monotonic count of row materializations — unlike num_rows
+        it never decreases, so deltas across an operation are exact
+        even when erase() recycles arena slots."""
+        return int(self._lib.rs_created_count(self._h))
+
+    def erase(self, ids) -> int:
+        """Drop rows (tier demotion); absent ids are ignored. Returns
+        the number actually erased. Erased ids leave the dirty set —
+        their bytes are gone, and a later dirty drain re-reading them
+        through get() would resurrect them as fresh lazy inits."""
+        ids = _ids_arr(ids)
+        erased = int(self._lib.rs_erase(self._h, _i64p(ids), ids.size))
+        if self._dirty:
+            self._dirty.difference_update(ids.tolist())
+        return erased
+
+    def contains(self, ids) -> np.ndarray:
+        """Bool membership mask, without materializing anything."""
+        ids = _ids_arr(ids)
+        out = np.zeros((ids.size,), np.uint8)
+        self._lib.rs_contains(
+            self._h, _i64p(ids), ids.size,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        )
+        return out.astype(bool)
 
     def to_arrays(self):
         n = self.num_rows
@@ -204,6 +239,17 @@ class NativeOptimizerWrapper:
     def _slot_table(self, table, slot_name: str):
         key = get_slot_table_name(table.name, slot_name)
         if key not in self._slot_tables:
+            make = getattr(table, "make_slot_table", None)
+            if make is not None:
+                # Tiered primaries (storage/tiered.py) create their
+                # slots inside their own TierGroup: a demoted row must
+                # take its optimizer state with it, and a fault must
+                # bring it back — lockstep only holds when the slot
+                # shares the primary's recency map and budget.
+                self._slot_tables[key] = make(
+                    key, slot_init_value(self.opt, slot_name)
+                )
+                return self._slot_tables[key]
             st = NativeEmbeddingTable(
                 key,
                 table.dim,
@@ -222,49 +268,82 @@ class NativeOptimizerWrapper:
         ids = _ids_arr(ids)
         if np.unique(ids).size != ids.size:
             raise ValueError("ids must be deduplicated before apply")
-        if not isinstance(table, NativeEmbeddingTable):
+        # A tiered table (storage/tiered.py) wraps the native arena as
+        # its hot tier: the fused kernels run against ``hot_inner``
+        # after a pre-kernel fault promotes every applied row (and its
+        # slot rows) hot — a kernel's lazy get_or_create on an evicted
+        # slot row would silently reset optimizer state to its init.
+        tiered = hasattr(table, "fault_for_apply")
+        hot = table.hot_inner if tiered else table
+        if not isinstance(hot, NativeEmbeddingTable):
             raise TypeError(
-                "NativeOptimizerWrapper needs a NativeEmbeddingTable"
+                "NativeOptimizerWrapper needs a NativeEmbeddingTable "
+                "(or a TieredTable whose hot tier is one)"
             )
         grads = np.ascontiguousarray(grads, np.float32)
         step = self._steps.get(table.name, 0) + 1
         self._steps[table.name] = step
         opt, lib, n = self.opt, self._lib, ids.size
-        ip, gp = _i64p(ids), _f32p(grads)
-        if isinstance(opt, Momentum):
-            lib.rs_momentum(
-                table._h, self._slot_table(table, "momentum")._h,
-                ip, n, gp, opt.lr, opt.momentum, int(opt.nesterov),
-            )
-        elif isinstance(opt, (Adam, AdamAmsgrad)):
-            max_h = (
-                self._slot_table(table, "max_v")._h
-                if opt.amsgrad else None
-            )
-            lib.rs_adam(
-                table._h,
-                self._slot_table(table, "m")._h,
-                self._slot_table(table, "v")._h,
-                max_h, ip, n, gp,
-                opt.lr, opt.beta1, opt.beta2, opt.epsilon, step,
-            )
-        elif isinstance(opt, Adagrad):
-            lib.rs_adagrad(
-                table._h, self._slot_table(table, "accumulator")._h,
-                ip, n, gp, opt.lr, opt.epsilon,
-            )
-        elif isinstance(opt, SGD):
-            lib.rs_sgd(table._h, ip, n, gp, opt.lr)
-        else:
-            raise ValueError(f"No native kernel for {opt.name}")
-        # The fused kernels write rows + slots inside C++, bypassing the
-        # tables' set(): mark the applied ids dirty here so incremental
-        # checkpoints see native-path updates too. Gated so the hot
-        # apply path pays nothing when checkpointing is off.
-        if table.supports_dirty_rows:
-            table.mark_dirty(ids)
-            for slot in opt.slot_names:
-                self._slot_table(table, slot).mark_dirty(ids)
+        slots = {
+            name: self._slot_table(table, name)
+            for name in opt.slot_names
+        }
+        # The kernels mutate the hot arena with the GIL released
+        # (ctypes CDLL): hold the GROUP lock across fault → kernel →
+        # bookkeeping, or a concurrent handler's prefault/sweep could
+        # grow or erase the same open-addressed arena mid-kernel. The
+        # budget sweep runs after release — eviction's cold writes
+        # never happen under this lock.
+        guard = (table.tier_group.lock if tiered
+                 else contextlib.nullcontext())
+        with guard:
+            if tiered:
+                table.fault_for_apply(
+                    ids, slot_tables=list(slots.values())
+                )
+
+            def _h(t):
+                return (t.hot_inner if tiered else t)._h
+
+            ip, gp = _i64p(ids), _f32p(grads)
+            if isinstance(opt, Momentum):
+                lib.rs_momentum(
+                    _h(table), _h(slots["momentum"]),
+                    ip, n, gp, opt.lr, opt.momentum, int(opt.nesterov),
+                )
+            elif isinstance(opt, (Adam, AdamAmsgrad)):
+                max_h = _h(slots["max_v"]) if opt.amsgrad else None
+                lib.rs_adam(
+                    _h(table), _h(slots["m"]), _h(slots["v"]),
+                    max_h, ip, n, gp,
+                    opt.lr, opt.beta1, opt.beta2, opt.epsilon, step,
+                )
+            elif isinstance(opt, Adagrad):
+                lib.rs_adagrad(
+                    _h(table), _h(slots["accumulator"]),
+                    ip, n, gp, opt.lr, opt.epsilon,
+                )
+            elif isinstance(opt, SGD):
+                lib.rs_sgd(_h(table), ip, n, gp, opt.lr)
+            else:
+                raise ValueError(f"No native kernel for {opt.name}")
+            if tiered:
+                # Post-kernel bookkeeping: applied ids are hot, their
+                # cold records stale. Sweep deferred past the lock.
+                table.finish_apply(
+                    ids, slot_tables=list(slots.values()), _sweep=False
+                )
+            # The fused kernels write rows + slots inside C++,
+            # bypassing the tables' set(): mark the applied ids dirty
+            # here so incremental checkpoints see native-path updates
+            # too. Gated so the hot apply path pays nothing when
+            # checkpointing is off.
+            if table.supports_dirty_rows:
+                table.mark_dirty(ids)
+                for slot in slots.values():
+                    slot.mark_dirty(ids)
+        if tiered and not table.defer_apply_sweep:
+            table.maybe_sweep()
         return table
 
     def state_tables(self, main_tables: Dict) -> Dict:

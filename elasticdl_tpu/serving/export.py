@@ -59,7 +59,10 @@ def _variables(state):
 def _clone_empty(table):
     """Fresh table of the same type AND configuration (initializer,
     slot settings, dtype) — the lazy init for untouched ids must match
-    the live table exactly."""
+    the live table exactly. A tiered table clones from its HOT tier's
+    type (storage/tiered.py): the inner table owns lazy init, and a
+    throwaway must not drag a cold store along."""
+    table = getattr(table, "hot_inner", table)
     return type(table)(
         table.name,
         table.dim,

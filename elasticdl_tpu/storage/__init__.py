@@ -1,0 +1,25 @@
+"""Tiered row storage: hot rows in the native/Python arena, cold rows
+spilled to CRC-framed disk segments (docs/sparse_path.md "Tiered
+storage").
+
+- ``cold_store.ColdRowStore`` — the disk tier: append-only segment
+  files of length-prefixed CRC32-framed row records, an in-memory
+  id→(segment, offset) index, and compaction of low-live segments.
+- ``tiered.TieredTable`` / ``tiered.TierGroup`` — the two-tier table
+  behind ``EmbeddingTable``/``NativeEmbeddingTable``: a configurable
+  hot-row budget with recency-driven admission/eviction, optimizer
+  slot tables demoting/promoting in lockstep with their primary, and
+  dirty tracking that spans both tiers so delta checkpoints stay
+  correct.
+"""
+
+from elasticdl_tpu.storage.cold_store import (  # noqa: F401
+    ColdRowStore,
+    ColdStoreError,
+)
+from elasticdl_tpu.storage.tiered import (  # noqa: F401
+    TierGroup,
+    TierPolicy,
+    TieredTable,
+    tier_host_tables,
+)

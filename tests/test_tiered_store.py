@@ -1,0 +1,724 @@
+"""Tiered hot/cold row storage (elasticdl_tpu/storage/): cold-store
+segment mechanics, the two-tier table's admission/eviction and dirty
+tracking, optimizer-slot lockstep, checkpoint byte-equality across
+tiers, N→M repartition, the cold-tier fsck, and the fast-lane twin of
+``make tiered-smoke``. docs/sparse_path.md "Tiered storage"."""
+
+import os
+
+import numpy as np
+import pytest
+
+from elasticdl_tpu.embedding.optimizer import Adam, SGD
+from elasticdl_tpu.embedding.table import EmbeddingTable
+from elasticdl_tpu.native import native_available
+from elasticdl_tpu.observability.registry import MetricsRegistry
+from elasticdl_tpu.storage import (
+    ColdRowStore,
+    TierGroup,
+    TierPolicy,
+    tier_host_tables,
+)
+from elasticdl_tpu.storage.cold_store import (
+    INDEX_SNAPSHOT_FILE,
+    record_bytes,
+)
+
+DIM = 8
+
+
+def _rows(rng, n):
+    return rng.rand(n, DIM).astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# ColdRowStore: segment files, index, recovery, compaction
+# ---------------------------------------------------------------------------
+
+
+class TestColdRowStore:
+    def test_roundtrip_overwrite_and_membership(self, tmp_path):
+        store = ColdRowStore(str(tmp_path / "c"), dim=DIM,
+                             background_compact=False)
+        rng = np.random.RandomState(0)
+        ids = np.arange(10, dtype=np.int64)
+        rows = _rows(rng, 10)
+        store.put_rows(ids, rows)
+        np.testing.assert_array_equal(store.get_rows(ids), rows)
+        assert store.num_rows == 10
+        # Overwrite: later record wins; old one becomes garbage.
+        newer = _rows(rng, 3)
+        store.put_rows(ids[:3], newer)
+        np.testing.assert_array_equal(store.get_rows(ids[:3]), newer)
+        assert store.num_rows == 10
+        assert store.stats()["garbage_records"] == 3
+        mask = store.contains(np.array([0, 99], np.int64))
+        np.testing.assert_array_equal(mask, [True, False])
+        with pytest.raises(KeyError):
+            store.get_rows([99])
+        store.close()
+
+    def test_segment_rotation_bounded_files(self, tmp_path):
+        # Segment bound fits 4 records -> 32 rows roll across >=8 files.
+        store = ColdRowStore(
+            str(tmp_path / "c"), dim=DIM,
+            segment_max_bytes=4 * record_bytes(DIM),
+            background_compact=False,
+        )
+        rng = np.random.RandomState(1)
+        ids = np.arange(32, dtype=np.int64)
+        rows = _rows(rng, 32)
+        store.put_rows(ids, rows)
+        segs = ColdRowStore.list_segments(str(tmp_path / "c"))
+        assert len(segs) >= 8
+        # Batched read spans all of them.
+        np.testing.assert_array_equal(store.get_rows(ids), rows)
+        store.close()
+
+    def test_compaction_reclaims_low_live_segments(self, tmp_path):
+        store = ColdRowStore(
+            str(tmp_path / "c"), dim=DIM,
+            segment_max_bytes=4 * record_bytes(DIM),
+            compact_live_fraction=0.6, background_compact=False,
+        )
+        rng = np.random.RandomState(2)
+        ids = np.arange(16, dtype=np.int64)
+        rows = _rows(rng, 16)
+        store.put_rows(ids, rows)
+        # Overwriting every row turns the first segments into garbage;
+        # the inline compactor runs from put_rows itself.
+        rows2 = _rows(rng, 16)
+        store.put_rows(ids, rows2)
+        stats = store.stats()
+        # Fully-dead segments are gone; live bytes stay correct.
+        assert all(s["live"] > 0 for s in stats["segments"].values())
+        np.testing.assert_array_equal(store.get_rows(ids), rows2)
+        # Files on disk match the surviving segment set.
+        on_disk = ColdRowStore.list_segments(str(tmp_path / "c"))
+        assert set(on_disk) == set(stats["segments"])
+        store.close()
+
+    def test_reopen_rebuilds_index_later_record_wins(self, tmp_path):
+        path = str(tmp_path / "c")
+        store = ColdRowStore(path, dim=DIM,
+                             segment_max_bytes=4 * record_bytes(DIM),
+                             background_compact=False,
+                             compact_live_fraction=0.0)
+        rng = np.random.RandomState(3)
+        ids = np.arange(12, dtype=np.int64)
+        store.put_rows(ids, _rows(rng, 12))
+        newest = _rows(rng, 12)
+        store.put_rows(ids, newest)
+        # No clean close: simulate a crash by abandoning the handle
+        # (write_index=False keeps the dir as a crash would leave it).
+        store.close(write_index=False)
+        reopened = ColdRowStore(path, fresh=False,
+                                background_compact=False)
+        np.testing.assert_array_equal(reopened.get_rows(ids), newest)
+        assert reopened.num_rows == 12
+        reopened.close()
+
+    def test_torn_tail_truncates_on_reopen(self, tmp_path):
+        path = str(tmp_path / "c")
+        store = ColdRowStore(path, dim=DIM, background_compact=False)
+        rng = np.random.RandomState(4)
+        ids = np.arange(6, dtype=np.int64)
+        rows = _rows(rng, 6)
+        store.put_rows(ids, rows)
+        store.close(write_index=False)
+        # Tear the newest segment mid-record (a crashed append).
+        seg = os.path.join(path, "segment-000000.seg")
+        size = os.path.getsize(seg)
+        with open(seg, "rb+") as f:
+            f.truncate(size - record_bytes(DIM) // 2)
+        reopened = ColdRowStore(path, fresh=False,
+                                background_compact=False)
+        # The torn record (id 5) is gone; everything before is intact.
+        assert reopened.num_rows == 5
+        np.testing.assert_array_equal(
+            reopened.get_rows(ids[:5]), rows[:5]
+        )
+        reopened.close()
+
+    def test_drop_survives_clean_close(self, tmp_path):
+        """drop_rows writes no tombstone, so the clean-close index
+        snapshot is what keeps a dropped row dead: reopen must not
+        resurrect it, and fsck must count its record as garbage."""
+        path = str(tmp_path / "c")
+        store = ColdRowStore(path, dim=DIM, background_compact=False,
+                             compact_live_fraction=0.0)
+        rng = np.random.RandomState(21)
+        ids = np.arange(6, dtype=np.int64)
+        store.put_rows(ids, _rows(rng, 6))
+        assert store.drop_rows(np.array([2, 3], np.int64)) == 2
+        store.close()
+        errors, report = _check_store()(str(tmp_path))
+        assert errors == []
+        assert report["live_rows"] == 4
+        assert report["stores"][0]["garbage_records"] == 2
+        reopened = ColdRowStore(path, fresh=False,
+                                background_compact=False)
+        assert reopened.num_rows == 4
+        present = reopened.contains(ids)
+        assert not present[2] and not present[3]
+        assert present[[0, 1, 4, 5]].all()
+        reopened.close()
+
+    def test_fresh_wipes_previous_contents(self, tmp_path):
+        path = str(tmp_path / "c")
+        store = ColdRowStore(path, dim=DIM, background_compact=False)
+        store.put_rows(np.array([1], np.int64), np.ones((1, DIM),
+                                                        np.float32))
+        store.close()
+        wiped = ColdRowStore(path, dim=DIM, background_compact=False)
+        assert wiped.num_rows == 0
+        wiped.close()
+
+
+# ---------------------------------------------------------------------------
+# TieredTable / TierGroup: admission, eviction, dirty tracking
+# ---------------------------------------------------------------------------
+
+
+def _tiered(tmp_path, budget, *, registry=None, table=None,
+            **policy_kw):
+    registry = registry or MetricsRegistry()
+    policy_kw.setdefault("background_compact", False)
+    tables = {"t": table if table is not None
+              else EmbeddingTable("t", DIM)}
+    tiered = tier_host_tables(
+        tables, str(tmp_path / "cold"), TierPolicy(budget, **policy_kw),
+        metrics_registry=registry,
+    )
+    return tiered["t"], registry
+
+
+class TestTieredTable:
+    def test_budget_enforced_and_faults_byte_equal(self, tmp_path):
+        table, registry = _tiered(tmp_path, budget=8)
+        rng = np.random.RandomState(0)
+        ids = np.arange(32, dtype=np.int64)
+        rows = _rows(rng, 32)
+        table.set(ids, rows)
+        group = table.tier_group
+        assert group.hot_rows() <= 8
+        assert table.num_rows == 32
+        # Cold rows fault back byte-equal, and the budget still holds.
+        np.testing.assert_array_equal(table.get(ids[:6]), rows[:6])
+        assert group.hot_rows() <= 8
+        assert registry.counter(
+            "row_tier_evictions_total"
+        ).labels().value > 0
+
+    def test_lru_keeps_the_working_set_hot(self, tmp_path):
+        table, registry = _tiered(tmp_path, budget=8)
+        rng = np.random.RandomState(1)
+        all_ids = np.arange(64, dtype=np.int64)
+        table.set(all_ids, _rows(rng, 64))
+        hot_set = np.arange(6, dtype=np.int64)
+        for _ in range(4):
+            table.get(hot_set)
+        faults_before = registry.counter(
+            "row_tier_faults_total"
+        ).labels().value
+        # Touch cold strangers one at a time: the hot working set must
+        # never be chosen as victim, so re-reading it stays fault-free.
+        for cold_id in range(40, 48):
+            table.get(np.array([cold_id], np.int64))
+            table.get(hot_set)
+        faults = registry.counter(
+            "row_tier_faults_total"
+        ).labels().value
+        # One fault per stranger pull, none for the LRU-protected set.
+        assert faults - faults_before == 8
+
+    def test_one_fault_event_per_batched_pull(self, tmp_path):
+        # Misses are counted per pull, not per row — the batched miss
+        # path the tentpole requires of pull_rows.
+        table, registry = _tiered(tmp_path, budget=4)
+        rng = np.random.RandomState(2)
+        ids = np.arange(32, dtype=np.int64)
+        table.set(ids, _rows(rng, 32))
+        faults0 = registry.counter(
+            "row_tier_faults_total"
+        ).labels().value
+        rows0 = registry.counter(
+            "row_tier_fault_rows_total"
+        ).labels().value
+        table.get(ids[:20])  # >=16 of these are cold
+        assert registry.counter(
+            "row_tier_faults_total"
+        ).labels().value - faults0 == 1
+        assert registry.counter(
+            "row_tier_fault_rows_total"
+        ).labels().value - rows0 >= 16
+
+    def test_bulk_set_streams_through_budget(self, tmp_path):
+        table, _ = _tiered(tmp_path, budget=8)
+        rng = np.random.RandomState(3)
+        ids = np.arange(100, dtype=np.int64)
+        table.set(ids, _rows(rng, 100))
+        # A 12x-budget refill (checkpoint restore) must not inflate
+        # the arena past budget at any point; spot-check the end state.
+        assert table.tier_group.hot_rows() <= 8
+        assert table.num_rows == 100
+
+    def test_erase_and_contains_span_tiers(self, tmp_path):
+        table, _ = _tiered(tmp_path, budget=4)
+        rng = np.random.RandomState(4)
+        ids = np.arange(16, dtype=np.int64)
+        table.set(ids, _rows(rng, 16))
+        # id 15 is hot (just written), id 0 is cold by now.
+        mask = table.contains(np.array([0, 15, 99], np.int64))
+        np.testing.assert_array_equal(mask, [True, True, False])
+        assert table.erase(np.array([0, 15, 99], np.int64)) == 2
+        assert table.num_rows == 14
+        mask = table.contains(np.array([0, 15], np.int64))
+        np.testing.assert_array_equal(mask, [False, False])
+
+    def test_to_arrays_spans_tiers_sorted(self, tmp_path):
+        table, _ = _tiered(tmp_path, budget=4)
+        rng = np.random.RandomState(5)
+        ids = np.arange(20, dtype=np.int64)
+        rows = _rows(rng, 20)
+        table.set(ids, rows)
+        out_ids, out_rows = table.to_arrays()
+        np.testing.assert_array_equal(out_ids, ids)
+        np.testing.assert_array_equal(out_rows, rows)
+
+    def test_demoted_dirty_row_drains_from_cold(self, tmp_path):
+        table, _ = _tiered(tmp_path, budget=4)
+        table.enable_dirty_tracking()
+        rng = np.random.RandomState(6)
+        marked = _rows(rng, 1)
+        table.set(np.array([7], np.int64), marked)
+        # Demote id 7 by touching a budget's worth of strangers.
+        table.set(np.arange(100, 108, dtype=np.int64), _rows(rng, 8))
+        assert 7 not in table._hot
+        ids, rows = table.dirty_arrays()
+        assert 7 in ids.tolist()
+        np.testing.assert_array_equal(
+            rows[ids.tolist().index(7)], marked[0]
+        )
+
+    def test_demote_repromote_redirty_exactly_once(self, tmp_path):
+        # The ISSUE's dirty-across-tiers case: a row demoted while
+        # dirty, then re-promoted and re-dirtied, appears exactly once
+        # in the next dirty drain — with its NEWEST bytes.
+        table, _ = _tiered(tmp_path, budget=4)
+        table.enable_dirty_tracking()
+        rng = np.random.RandomState(7)
+        table.set(np.array([7], np.int64), _rows(rng, 1))   # dirty
+        table.set(np.arange(100, 108, dtype=np.int64),
+                  _rows(rng, 8))                            # demotes 7
+        assert 7 not in table._hot
+        table.get(np.array([7], np.int64))                  # re-promote
+        final = _rows(rng, 1)
+        table.set(np.array([7], np.int64), final)           # re-dirty
+        ids, rows = table.dirty_arrays()
+        assert ids.tolist().count(7) == 1
+        np.testing.assert_array_equal(
+            rows[ids.tolist().index(7)], final[0]
+        )
+        # Drained means drained: the next delta is empty.
+        ids2, _ = table.dirty_arrays()
+        assert 7 not in ids2.tolist()
+
+    def test_faulted_clean_row_demotes_without_rewrite(self, tmp_path):
+        table, _ = _tiered(tmp_path, budget=4,
+                           compact_live_fraction=0.0)
+        rng = np.random.RandomState(8)
+        ids = np.arange(12, dtype=np.int64)
+        table.set(ids, _rows(rng, 12))
+        records = lambda: sum(  # noqa: E731
+            s["records"]
+            for s in table._cold.stats()["segments"].values()
+        )
+        # Cycle the whole hot set to spill-backed rows: ids 0-3 fault
+        # in clean, the never-spilled tail (8-11) flushes out.
+        table.get(ids[:4])
+        before = records()
+        # Fault 4-7 (clean, from cold); the victims 0-3 are ALSO clean
+        # faulted rows whose cold records are still current — their
+        # re-demotion must not append a single new record.
+        table.get(ids[4:8])
+        assert records() == before
+
+    def test_float64_table_rejected(self, tmp_path):
+        with pytest.raises(TypeError):
+            _tiered(tmp_path, budget=4,
+                    table=EmbeddingTable("t", DIM, dtype=np.float64))
+
+
+# ---------------------------------------------------------------------------
+# Optimizer-slot lockstep
+# ---------------------------------------------------------------------------
+
+
+class TestSlotLockstep:
+    def _apply_schedule(self, wrapper, table, rng, n_pushes=6):
+        for _ in range(n_pushes):
+            ids = np.unique(rng.randint(0, 64, 24)).astype(np.int64)
+            wrapper.apply_gradients(table, ids,
+                                    _rows(rng, ids.size))
+
+    @pytest.mark.parametrize("native", [False, True])
+    def test_slots_demote_and_fault_with_primary(self, tmp_path,
+                                                 native):
+        if native and not native_available():
+            pytest.skip("native library unavailable")
+        from elasticdl_tpu.native.row_store import (
+            NativeOptimizerWrapper,
+            make_host_table,
+        )
+
+        if native:
+            table_in = make_host_table("t", DIM)
+            wrapper = NativeOptimizerWrapper(Adam(lr=0.01))
+        else:
+            from elasticdl_tpu.embedding.optimizer import (
+                HostOptimizerWrapper,
+            )
+
+            table_in = EmbeddingTable("t", DIM)
+            wrapper = HostOptimizerWrapper(Adam(lr=0.01))
+        table, _ = _tiered(tmp_path, budget=8, table=table_in)
+        rng = np.random.RandomState(9)
+        self._apply_schedule(wrapper, table, rng)
+        group = table.tier_group
+        # Slots landed in the primary's group and follow its budget.
+        assert set(group.slots) == {"t-m", "t-v"}
+        for slot in group.slots.values():
+            assert len(slot._hot) <= 8
+            # Lockstep: a slot's hot set tracks the primary's.
+            assert slot._hot == table._hot
+            # A demoted row took real optimizer state with it — the
+            # cold record is not the 0.0 init.
+            cold_only = sorted(
+                set(slot._cold.live_ids().tolist()) - slot._hot
+            )
+            assert cold_only
+            assert np.abs(
+                slot._cold.get_rows(np.array(cold_only, np.int64))
+            ).max() > 0
+
+    def test_tiered_matches_untiered_trajectory(self, tmp_path):
+        # Tiering must be invisible to training semantics: the same
+        # push schedule lands byte-equal rows with and without tiers.
+        from elasticdl_tpu.embedding.optimizer import (
+            HostOptimizerWrapper,
+        )
+
+        plain = EmbeddingTable("t", DIM)
+        w1 = HostOptimizerWrapper(SGD(lr=0.1))
+        tiered, _ = _tiered(tmp_path, budget=6)
+        w2 = HostOptimizerWrapper(SGD(lr=0.1))
+        rng1 = np.random.RandomState(10)
+        rng2 = np.random.RandomState(10)
+        self._apply_schedule(w1, plain, rng1, n_pushes=8)
+        self._apply_schedule(w2, tiered, rng2, n_pushes=8)
+        ids_a, rows_a = plain.to_arrays()
+        ids_b, rows_b = tiered.to_arrays()
+        order = np.argsort(ids_a)
+        np.testing.assert_array_equal(ids_a[order], ids_b)
+        np.testing.assert_array_equal(
+            np.asarray(rows_a)[order], rows_b
+        )
+
+
+# ---------------------------------------------------------------------------
+# Native arena: erase/contains + the get-touch regression
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.skipif(not native_available(),
+                    reason="native library unavailable")
+class TestNativeErase:
+    def _table(self, **kw):
+        from elasticdl_tpu.native.row_store import NativeEmbeddingTable
+
+        return NativeEmbeddingTable("t", DIM, **kw)
+
+    def test_erase_contains_and_slot_reuse(self):
+        t = self._table()
+        rows = t.get([1, 2, 3])
+        assert t.num_rows == 3
+        assert t.erase([2, 99]) == 1
+        assert t.num_rows == 2
+        np.testing.assert_array_equal(
+            t.contains([1, 2, 3]), [True, False, True]
+        )
+        # Export skips the erased slot.
+        ids, out = t.to_arrays()
+        assert sorted(ids.tolist()) == [1, 3]
+        # Re-materializing reuses the freed slot: live count grows,
+        # and the new row matches the deterministic lazy init.
+        created = t.created_count
+        np.testing.assert_array_equal(t.get([2]), rows[1:2])
+        assert t.num_rows == 3
+        assert t.created_count == created + 1
+        # Erased-id bytes didn't clobber the survivors.
+        np.testing.assert_array_equal(t.get([1]), rows[0:1])
+        np.testing.assert_array_equal(t.get([3]), rows[2:3])
+
+    def test_get_after_erase_marks_dirty_in_reused_slot(self):
+        # Regression (native/row_store.py get): dirty marking used to
+        # compare arena SIZE around a get — a cold-tier fault that
+        # re-materializes a row into a freed slot leaves the live size
+        # on the same trajectory an untouched get would, so the mark
+        # must key on the monotonic created_count instead.
+        t = self._table()
+        t.get([1, 2])
+        t.enable_dirty_tracking()
+        t.clear_dirty()
+        t.erase([1])
+        # One erase + one re-materialization: num_rows ends where it
+        # started, but the get DID materialize a row — it must be
+        # marked dirty or it misses every delta checkpoint.
+        before = t.num_rows
+        t.get([1])
+        assert t.num_rows == before + 1  # 1 was erased above
+        ids, _rows_ = t.dirty_arrays()
+        assert 1 in ids.tolist()
+
+    def test_erase_drops_dirty_mark(self):
+        t = self._table()
+        t.enable_dirty_tracking()
+        t.set(np.array([5], np.int64), np.ones((1, DIM), np.float32))
+        assert t.dirty_count == 1
+        t.erase([5])
+        ids, _rows_ = t.dirty_arrays()
+        assert ids.size == 0
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint across tiers: byte-equality, deltas, N→M repartition
+# ---------------------------------------------------------------------------
+
+
+def _service(ckpt_dir, cold_dir=None, budget=16, **ckpt_kw):
+    from elasticdl_tpu.embedding.row_service import HostRowService
+    from elasticdl_tpu.native.row_store import (
+        make_host_optimizer,
+        make_host_table,
+    )
+
+    svc = HostRowService(
+        {"t": make_host_table("t", DIM)},
+        make_host_optimizer(Adam(lr=0.01)),
+    )
+    if cold_dir is not None:
+        svc.configure_tiering(str(cold_dir), budget,
+                              segment_max_bytes=4096,
+                              background_compact=False)
+    ckpt_kw.setdefault("checkpoint_steps", 5)
+    ckpt_kw.setdefault("delta_chain_max", 3)
+    svc.configure_checkpoint(str(ckpt_dir), async_write=False,
+                             **ckpt_kw)
+    return svc
+
+
+def _drive(svc, seed, pushes, client):
+    rng = np.random.RandomState(seed)
+    for seq in range(1, pushes + 1):
+        ids = np.unique(rng.randint(0, 200, 48)).astype(np.int64)
+        svc._push_row_grads({
+            "table": "t", "ids": ids,
+            "grads": _rows(rng, ids.size), "client": client,
+            "seq": seq,
+        })
+
+
+def _row_state(svc):
+    return {
+        name: view.to_arrays()
+        for name, view in svc.host_tables.items()
+        if name != "__row_service_seqs__"
+    }
+
+
+def _assert_state_equal(a, b):
+    assert sorted(a) == sorted(b)
+    for name in a:
+        ids_a, rows_a = a[name]
+        ids_b, rows_b = b[name]
+        np.testing.assert_array_equal(np.asarray(ids_a),
+                                      np.asarray(ids_b), err_msg=name)
+        np.testing.assert_array_equal(
+            np.asarray(rows_a, np.float32),
+            np.asarray(rows_b, np.float32), err_msg=name,
+        )
+
+
+class TestTieredCheckpoint:
+    def test_mid_run_checkpoint_restores_byte_equal(self, tmp_path):
+        # The acceptance bar: a checkpoint taken mid-run (base + delta
+        # chain, dirty rows spanning both tiers) restores byte-equal
+        # rows across both tiers — into a tiered twin AND an untiered
+        # one.
+        svc = _service(tmp_path / "ckpt", tmp_path / "cold", budget=16)
+        _drive(svc, seed=11, pushes=12, client="a")
+        assert svc.checkpoint_now()
+        want = _row_state(svc)
+        stats = svc.tier_stats()["t"]
+        assert stats["hot_rows"] <= 16 and stats["cold_rows"] > 0
+        svc.stop()
+
+        tiered_twin = _service(tmp_path / "ckpt", tmp_path / "cold2",
+                               budget=16)
+        _assert_state_equal(want, _row_state(tiered_twin))
+        assert tiered_twin.tier_stats()["t"]["hot_rows"] <= 16
+        tiered_twin.stop()
+
+        untiered_twin = _service(tmp_path / "ckpt")
+        _assert_state_equal(want, _row_state(untiered_twin))
+        untiered_twin.stop()
+
+    def test_delta_carries_cold_dirty_rows(self, tmp_path):
+        # checkpoint_steps=5 over 12 pushes: version 5 is a full base,
+        # 10 a delta; rows the sweep demoted between saves must still
+        # ride the delta (the dirty set spans tiers).
+        svc = _service(tmp_path / "ckpt", tmp_path / "cold", budget=8)
+        _drive(svc, seed=12, pushes=12, client="a")
+        entries = os.listdir(tmp_path / "ckpt")
+        assert "version-5" in entries and "delta-10" in entries
+        svc.stop()
+
+    def test_repartition_across_tiers(self, tmp_path):
+        # N→M shard repartition with the source capture spanning both
+        # tiers and the destination refill streaming back through a
+        # (smaller-budget) tier.
+        from elasticdl_tpu.checkpoint.saver import CheckpointSaver
+
+        src, _ = _tiered(tmp_path, budget=8)
+        rng = np.random.RandomState(13)
+        ids = np.arange(0, 120, dtype=np.int64)
+        rows = _rows(rng, 120)
+        src.set(ids, rows)
+        saver3 = CheckpointSaver(str(tmp_path / "ck"), num_shards=3)
+        saver3.save(1, {}, embeddings={"t": src})
+
+        saver2 = CheckpointSaver(str(tmp_path / "ck"), num_shards=2)
+        _version, _dense, tables = saver2.restore()
+        got_ids, got_rows = tables["t"].to_arrays()
+        order = np.argsort(np.asarray(got_ids))
+        np.testing.assert_array_equal(np.asarray(got_ids)[order], ids)
+        np.testing.assert_array_equal(
+            np.asarray(got_rows)[order], rows
+        )
+        # Refill a fresh, smaller tier from the restored arrays.
+        dst, _ = _tiered(tmp_path / "dst", budget=4)
+        dst.set(np.asarray(got_ids), np.asarray(got_rows))
+        out_ids, out_rows = dst.to_arrays()
+        np.testing.assert_array_equal(out_ids, ids)
+        np.testing.assert_array_equal(out_rows, rows)
+        assert dst.tier_group.hot_rows() <= 4
+
+
+# ---------------------------------------------------------------------------
+# fsck (tools/check_store.py)
+# ---------------------------------------------------------------------------
+
+
+def _check_store():
+    import sys
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tools",
+    ))
+    from check_store import check_store
+
+    return check_store
+
+
+class TestCheckStore:
+    def _store_with_rows(self, path, n=12):
+        store = ColdRowStore(
+            str(path), dim=DIM,
+            segment_max_bytes=4 * record_bytes(DIM),
+            background_compact=False, compact_live_fraction=0.0,
+        )
+        rng = np.random.RandomState(14)
+        store.put_rows(np.arange(n, dtype=np.int64), _rows(rng, n))
+        return store
+
+    def test_clean_store_passes(self, tmp_path):
+        store = self._store_with_rows(tmp_path / "c")
+        store.close()
+        errors, report = _check_store()(str(tmp_path))
+        assert errors == []
+        assert report["live_rows"] == 12
+        assert report["stores"][0]["index_snapshot"]
+
+    def test_torn_tail_reported_not_fatal(self, tmp_path):
+        store = self._store_with_rows(tmp_path / "c")
+        store.close(write_index=False)
+        segs = ColdRowStore.list_segments(str(tmp_path / "c"))
+        seg = os.path.join(tmp_path / "c",
+                           f"segment-{segs[-1]:06d}.seg")
+        with open(seg, "rb+") as f:
+            f.truncate(os.path.getsize(seg) - 7)
+        errors, report = _check_store()(str(tmp_path))
+        assert errors == []
+        assert report["stores"][0]["torn_tail"] is not None
+
+    def test_mid_store_corruption_fails(self, tmp_path):
+        store = self._store_with_rows(tmp_path / "c")
+        store.close(write_index=False)
+        segs = ColdRowStore.list_segments(str(tmp_path / "c"))
+        # Flip bytes inside a NON-newest segment: not a torn tail —
+        # this is bit rot and must fail the audit.
+        seg = os.path.join(tmp_path / "c",
+                           f"segment-{segs[0]:06d}.seg")
+        with open(seg, "rb+") as f:
+            f.seek(record_bytes(DIM) // 2)
+            f.write(b"\xde\xad\xbe\xef")
+        errors, _report = _check_store()(str(tmp_path))
+        assert errors
+
+    def test_stale_index_snapshot_fails(self, tmp_path):
+        import json
+
+        store = self._store_with_rows(tmp_path / "c")
+        store.close()
+        snap = os.path.join(tmp_path / "c", INDEX_SNAPSHOT_FILE)
+        with open(snap) as f:
+            data = json.load(f)
+        # Claim a row the segments don't hold.
+        data["index"]["999"] = [0, 0]
+        with open(snap, "w") as f:
+            json.dump(data, f)
+        errors, _report = _check_store()(str(tmp_path))
+        assert any("999" in e for e in errors)
+
+    def test_garbage_accounting(self, tmp_path):
+        store = self._store_with_rows(tmp_path / "c")
+        rng = np.random.RandomState(15)
+        # Overwrite 2 of 4 records in each of the first two segments:
+        # live fraction stays at 0.5, so nothing compacts (threshold
+        # 0.0) and the superseded records stay visible as garbage.
+        store.put_rows(np.array([0, 1, 4, 5], np.int64), _rows(rng, 4))
+        store.close()
+        errors, report = _check_store()(str(tmp_path))
+        assert errors == []
+        rep = report["stores"][0]
+        assert rep["garbage_records"] == 4
+        assert rep["garbage_bytes"] == 4 * record_bytes(DIM)
+
+
+# ---------------------------------------------------------------------------
+# Fast-lane chaos drill (make tiered-smoke's twin)
+# ---------------------------------------------------------------------------
+
+
+def test_tiered_drill_passes(tmp_path):
+    from elasticdl_tpu.chaos.tiered_drill import run_drill
+
+    report = run_drill(str(tmp_path), seed=7)
+    problems = [
+        (s["scenario"], s["problems"]) for s in report["scenarios"]
+        if not s["passed"]
+    ]
+    assert report["passed"], (problems, report["fsck"]["errors"])
+    assert report["fsck"]["stores"] >= 9

@@ -1,0 +1,483 @@
+#!/usr/bin/env python
+"""Tiered-storage bench: a beyond-budget table behind the two-tier row
+store vs the same table all in memory (ISSUE 11) → BENCH_TIERED.json.
+
+The workload is the regime tiering exists for: a table ``vocab_factor``
+times (≥10x) the hot-tier row budget, driven by a **hot-working-set**
+schedule — every step pulls and pushes a working set that fits the
+budget, plus ``strangers_per_step`` cold ids so the fault path stays
+exercised (a recommendation batch is mostly head items plus a tail).
+Every ``drift_every`` steps ``drift_rows`` of the working set are
+replaced with fresh ids — the gradual popularity shift admission/
+eviction has to absorb. Both modes run the IDENTICAL pipelined
+harness over identical schedules through the REAL ``HostRowService``
+handlers: a producer thread pulls ``prefetch_depth`` steps ahead
+(mirroring the host engine's ``--host_prefetch_depth`` pull-ahead,
+which doubles as cold-row prefetch), and the timed consumer step is
+wait-for-pulled-rows + push — the round a pipelined training worker
+actually pays per step (docs/sparse_path.md):
+
+- **in_memory** — the baseline: every row resident in the arena;
+- **tiered** — hot budget ``hot_budget_rows``, cold rows spilled to
+  CRC-framed segments (``storage/cold_store.py``).
+
+Reported gates (acceptance criteria):
+
+- ``step_p99_ratio`` = tiered p99 step / in-memory p99 step ≤ 1.5 —
+  a warm working set never blocks on disk;
+- ``restore_byte_equal`` — the checkpoint taken MID-RUN restores
+  byte-equal rows across both tiers (into a fresh tiered service) and
+  the two modes' final tables are byte-identical (tiering is invisible
+  to training semantics).
+
+Fault/eviction/occupancy counts come from the ``row_tier_*`` metric
+families. ``--smoke`` shrinks the config for the fast lane and skips
+gate enforcement; ``make tiered-bench`` runs the committed config and
+exits nonzero if a gate fails.
+"""
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+import numpy as np  # noqa: E402
+
+DEFAULT_OUT = "BENCH_TIERED.json"
+TABLE = "bench_rows"
+
+
+def _percentile(values, q):
+    values = sorted(values)
+    if not values:
+        return 0.0
+    idx = min(len(values) - 1, int(round(q * (len(values) - 1))))
+    return float(values[idx])
+
+
+def _tier_counters():
+    from elasticdl_tpu.observability import default_registry
+
+    reg = default_registry()
+    return {
+        name: reg.counter(f"row_tier_{name}").labels().value
+        for name in ("faults_total", "fault_rows_total",
+                     "evictions_total", "compactions_total")
+    }
+
+
+def _build_service(ckpt_dir, cfg, cold_dir):
+    """A HostRowService over the production table/optimizer impls,
+    pre-populated with the full vocabulary (streamed through the tier
+    when one is configured), checkpoint-configured."""
+    from elasticdl_tpu.embedding.optimizer import SGD
+    from elasticdl_tpu.embedding.row_service import HostRowService
+    from elasticdl_tpu.native.row_store import (
+        make_host_optimizer,
+        make_host_table,
+    )
+
+    svc = HostRowService(
+        {TABLE: make_host_table(TABLE, cfg["dim"])},
+        make_host_optimizer(SGD(lr=0.1)),
+    )
+    if cold_dir is not None:
+        svc.configure_tiering(
+            cold_dir, cfg["hot_budget_rows"],
+            segment_max_bytes=cfg["segment_max_bytes"],
+            compact_live_fraction=cfg["compact_live_fraction"],
+        )
+    table = svc._tables[TABLE]
+    rng = np.random.RandomState(7)
+    chunk = 4096
+    for lo in range(0, cfg["vocab"], chunk):
+        ids = np.arange(lo, min(lo + chunk, cfg["vocab"]),
+                        dtype=np.int64)
+        table.set(ids, rng.rand(ids.size, cfg["dim"])
+                  .astype(np.float32))
+    svc.configure_checkpoint(
+        ckpt_dir, checkpoint_steps=0, delta_chain_max=4,
+        async_write=False,
+    )
+    return svc
+
+
+def _schedule(cfg):
+    """The seeded per-step id sets: a working set (fits the budget)
+    whose ``drift_rows`` members are replaced with fresh vocabulary
+    ids every ``drift_every`` steps (gradual drift, not wholesale
+    redraw — a recsys head shifts, it doesn't teleport), plus a few
+    cold strangers per step."""
+    rng = np.random.RandomState(13)
+    steps = []
+    working = rng.choice(
+        cfg["vocab"], size=cfg["working_set"], replace=False
+    ).astype(np.int64)
+    for step in range(cfg["steps"]):
+        if step and step % cfg["drift_every"] == 0:
+            out = rng.choice(
+                cfg["working_set"], size=cfg["drift_rows"],
+                replace=False,
+            )
+            working[out] = rng.randint(
+                0, cfg["vocab"], cfg["drift_rows"]
+            )
+        take = rng.choice(
+            working, size=cfg["ids_per_step"], replace=False
+        )
+        strangers = rng.randint(
+            0, cfg["vocab"], cfg["strangers_per_step"]
+        ).astype(np.int64)
+        ids = np.unique(np.concatenate([take, strangers]))
+        steps.append((ids, rng.rand(ids.size, cfg["dim"])
+                      .astype(np.float32)))
+    return steps
+
+
+def _drive(svc, schedule, label, checkpoint_at, depth):
+    """Drive the schedule through the real handlers with the host
+    engine's pipeline shape (docs/sparse_path.md): a producer thread
+    pulls up to ``depth`` steps ahead (``--host_prefetch_depth`` —
+    the pull-ahead that doubles as cold-row prefetch), and pushes go
+    through a single-thread applier exactly like the host engine's
+    async apply fan-out (per-table FIFO, the step joins the PREVIOUS
+    step's push, not its own). The timed consumer step is therefore
+    wait-for-pulled-rows + submit + join-previous-push — the round a
+    pipelined training worker actually pays per step.
+    ``checkpoint_at`` triggers the MID-RUN durable checkpoint
+    (untimed, fully joined — both modes pay it between the same
+    steps). Returns ``(latencies, mid_state)`` where ``mid_state`` is
+    the full row state AT the checkpoint — what a restore of that
+    version must reproduce byte-for-byte."""
+    import queue as queue_mod
+    import threading
+    from concurrent.futures import ThreadPoolExecutor
+
+    fifo = queue_mod.Queue(maxsize=max(1, depth))
+    fail = []
+
+    def _producer():
+        try:
+            for ids, grads in schedule:
+                out = svc._pull_rows({"table": TABLE, "ids": ids})
+                fifo.put((ids, out["rows"], grads))
+        except BaseException as exc:  # surface in the consumer
+            fail.append(exc)
+            fifo.put(None)
+
+    def _push(seq, ids, grads):
+        svc._push_row_grads({
+            "table": TABLE, "ids": ids, "grads": grads,
+            "client": f"bench-{label}", "seq": seq,
+        })
+
+    producer = threading.Thread(target=_producer, daemon=True,
+                                name=f"bench-pull-{label}")
+    applier = ThreadPoolExecutor(
+        max_workers=1, thread_name_prefix=f"bench-apply-{label}"
+    )
+    # Device-step stand-in: a fixed MLP forward over the pulled rows
+    # (real FLOPs, GIL-released BLAS — what the pull-ahead actually
+    # overlaps in a training worker). Its loss is reported for sanity
+    # only: pipeline staleness makes it approximate, so the pushed
+    # grads stay schedule-fixed and the byte-equality gates stay
+    # deterministic.
+    wrng = np.random.RandomState(5)
+    dim, hidden = schedule[0][1].shape[1], 128
+    w1 = (wrng.randn(dim, hidden) / np.sqrt(dim)).astype(np.float32)
+    w2 = (wrng.randn(hidden, hidden) / np.sqrt(hidden)
+          ).astype(np.float32)
+    loss_sum = 0.0
+    latencies = []
+    mid_state = None
+    prev = None
+    producer.start()
+    try:
+        for seq in range(1, len(schedule) + 1):
+            t0 = time.monotonic()
+            item = fifo.get()
+            if item is None:
+                raise fail[0]
+            ids, rows, grads = item
+            h = np.tanh(rows @ w1)
+            y = np.tanh(h @ w2)
+            loss_sum += float((y * y).mean())
+            fut = applier.submit(_push, seq, ids, grads)
+            if prev is not None:
+                prev.result()
+            latencies.append(time.monotonic() - t0)
+            prev = fut
+            if seq == checkpoint_at:
+                # Join the in-flight push so the checkpoint observes
+                # it (the worker's checkpoint hook does the same).
+                fut.result()
+                prev = None
+                assert svc.checkpoint_now(), "mid-run checkpoint failed"
+                mid_state = _row_state(svc)
+        if prev is not None:
+            prev.result()
+    finally:
+        applier.shutdown(wait=True)
+    producer.join()
+    if fail:
+        raise fail[0]
+    return latencies, mid_state, loss_sum / len(schedule)
+
+
+def _row_state(svc):
+    return {
+        name: view.to_arrays()
+        for name, view in svc.host_tables.items()
+        if name != "__row_service_seqs__"
+    }
+
+
+def _states_equal(a, b):
+    if sorted(a) != sorted(b):
+        return False
+    for name in a:
+        ids_a, rows_a = a[name]
+        ids_b, rows_b = b[name]
+        if not np.array_equal(np.asarray(ids_a), np.asarray(ids_b)):
+            return False
+        if not np.array_equal(np.asarray(rows_a, np.float32),
+                              np.asarray(rows_b, np.float32)):
+            return False
+    return True
+
+
+def run_bench(cfg, workdir):
+    schedule = _schedule(cfg)
+    checkpoint_at = cfg["steps"] // 2
+    results = {}
+    finals = {}
+    mids = {}
+    repeats = max(1, cfg["repeats"])
+    raw = {"in_memory": [], "tiered": []}
+    trajectory_equal = True
+    # Modes run INTERLEAVED ``repeats`` times; the reported repeat per
+    # mode is the one with the lowest p99 (shared-box noise is
+    # one-sided — a noisy neighbor only ever adds time). The
+    # byte-equality gates are checked on EVERY repeat.
+    for rep in range(repeats):
+        for label in ("in_memory", "tiered"):
+            ckpt_dir = os.path.join(workdir, f"{label}_r{rep}", "ckpt")
+            cold_dir = (
+                os.path.join(workdir, f"{label}_r{rep}", "cold")
+                if label == "tiered" else None
+            )
+            t0 = time.monotonic()
+            svc = _build_service(ckpt_dir, cfg, cold_dir)
+            fill_secs = time.monotonic() - t0
+            # Counter baseline AFTER the fill: streaming a 10x-budget
+            # vocabulary through the tier evicts ~vocab rows by design
+            # — the drive-phase numbers are what the workload
+            # produces.
+            counters0 = _tier_counters()
+            lat, mids[label], loss = _drive(
+                svc, schedule, f"{label}-r{rep}", checkpoint_at,
+                cfg["prefetch_depth"],
+            )
+            wall = time.monotonic() - t0
+            counters = {
+                k: v - counters0[k]
+                for k, v in _tier_counters().items()
+            }
+            finals[label] = _row_state(svc)
+            entry = {
+                "step_p50_ms": round(_percentile(lat, 0.50) * 1e3, 4),
+                "step_p99_ms": round(_percentile(lat, 0.99) * 1e3, 4),
+                "step_max_ms": round(max(lat) * 1e3, 4),
+                "fill_secs": round(fill_secs, 3),
+                "wall_secs": round(wall, 3),
+                "mean_proxy_loss": round(loss, 6),
+            }
+            if label == "tiered":
+                stats = svc.tier_stats()[TABLE]
+                entry.update({
+                    "faults": int(counters["faults_total"]),
+                    "fault_rows": int(counters["fault_rows_total"]),
+                    "evictions": int(counters["evictions_total"]),
+                    "compactions": int(counters["compactions_total"]),
+                    "hot_rows": stats["hot_rows"],
+                    "cold_rows": stats["cold_rows"],
+                })
+                assert stats["hot_rows"] <= cfg["hot_budget_rows"], (
+                    "hot tier over budget"
+                )
+            svc.stop()
+            raw[label].append(entry)
+        trajectory_equal = trajectory_equal and _states_equal(
+            finals["in_memory"], finals["tiered"]
+        )
+    for label, entries in raw.items():
+        best = min(entries, key=lambda e: e["step_p99_ms"])
+        best = dict(best)
+        best["repeats_p99_ms"] = [e["step_p99_ms"] for e in entries]
+        results[label] = best
+
+    # The mid-run checkpoint must restore byte-equal rows across both
+    # tiers: a fresh tiered service restoring the tiered run's chain
+    # tip (the mid-run version) must reproduce the row state captured
+    # AT that checkpoint.
+    restored = _build_restore_twin(
+        os.path.join(workdir, f"tiered_r{repeats - 1}", "ckpt"),
+        os.path.join(workdir, "restore", "cold"), cfg,
+    )
+    restore_equal = _states_equal(mids["tiered"], _row_state(restored))
+    restored.stop()
+
+    p99_ratio = (
+        results["tiered"]["step_p99_ms"]
+        / results["in_memory"]["step_p99_ms"]
+        if results["in_memory"]["step_p99_ms"] else float("inf")
+    )
+    return {
+        "bench": "tiered_store",
+        "config": cfg,
+        "results": results,
+        "step_p99_ratio": round(p99_ratio, 3),
+        "restore_byte_equal": bool(restore_equal),
+        "trajectory_byte_equal": bool(trajectory_equal),
+        "gates": {
+            "step_p99_ratio_max": 1.5,
+            "restore_byte_equal": True,
+        },
+        "passed": {
+            "p99": p99_ratio <= 1.5,
+            "restore": bool(restore_equal and trajectory_equal),
+        },
+    }
+
+
+def _build_restore_twin(ckpt_dir, cold_dir, cfg):
+    """Fresh tiered service restoring the mid-run chain's tip — the
+    restore-across-tiers half of the acceptance gate. The restore
+    refill streams through ``set`` on the tiered tables, so rows past
+    the hot budget land in the cold tier and the comparison genuinely
+    spans both."""
+    from elasticdl_tpu.embedding.optimizer import SGD
+    from elasticdl_tpu.embedding.row_service import HostRowService
+    from elasticdl_tpu.native.row_store import (
+        make_host_optimizer,
+        make_host_table,
+    )
+
+    svc = HostRowService(
+        {TABLE: make_host_table(TABLE, cfg["dim"])},
+        make_host_optimizer(SGD(lr=0.1)),
+    )
+    svc.configure_tiering(
+        cold_dir, cfg["hot_budget_rows"],
+        segment_max_bytes=cfg["segment_max_bytes"],
+        compact_live_fraction=cfg["compact_live_fraction"],
+    )
+    svc.configure_checkpoint(ckpt_dir, checkpoint_steps=0,
+                             async_write=False)
+    return svc
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser("bench_tiered_store")
+    parser.add_argument("--out", default=DEFAULT_OUT)
+    parser.add_argument("--workdir", default="",
+                        help="Scratch dir; kept when given, else a "
+                             "removed tempdir")
+    parser.add_argument("--smoke", action="store_true",
+                        help="Tiny config for the fast lane; gates "
+                             "reported but not enforced")
+    parser.add_argument("--dim", type=int, default=32)
+    parser.add_argument("--hot_budget_rows", type=int, default=2048)
+    parser.add_argument("--vocab_factor", type=int, default=12,
+                        help="Table size as a multiple of the hot "
+                             "budget (acceptance: >=10)")
+    parser.add_argument("--steps", type=int, default=400)
+    parser.add_argument("--working_set", type=int, default=1536)
+    parser.add_argument("--ids_per_step", type=int, default=768)
+    parser.add_argument("--strangers_per_step", type=int, default=4)
+    parser.add_argument("--drift_every", type=int, default=5)
+    parser.add_argument("--drift_rows", type=int, default=64,
+                        help="Working-set rows replaced with fresh "
+                             "ids every drift_every steps")
+    parser.add_argument("--prefetch_depth", type=int, default=2,
+                        help="Producer pull-ahead depth (mirrors "
+                             "--host_prefetch_depth)")
+    parser.add_argument("--repeats", type=int, default=3,
+                        help="Interleaved repeats per mode; the "
+                             "reported repeat is the min-p99 one "
+                             "(shared-box noise is one-sided)")
+    parser.add_argument("--segment_kb", type=int, default=2048)
+    parser.add_argument("--compact_live_fraction", type=float,
+                        default=0.5)
+    args = parser.parse_args(argv)
+
+    cfg = {
+        "dim": args.dim,
+        "hot_budget_rows": args.hot_budget_rows,
+        "vocab": args.hot_budget_rows * args.vocab_factor,
+        "vocab_factor": args.vocab_factor,
+        "steps": args.steps,
+        "working_set": args.working_set,
+        "ids_per_step": args.ids_per_step,
+        "strangers_per_step": args.strangers_per_step,
+        "drift_every": args.drift_every,
+        "drift_rows": args.drift_rows,
+        "prefetch_depth": args.prefetch_depth,
+        "repeats": args.repeats,
+        "segment_max_bytes": args.segment_kb << 10,
+        "compact_live_fraction": args.compact_live_fraction,
+        "smoke": bool(args.smoke),
+    }
+    if args.smoke:
+        cfg.update(
+            hot_budget_rows=min(cfg["hot_budget_rows"], 256),
+            steps=min(cfg["steps"], 60),
+            working_set=min(cfg["working_set"], 192),
+            ids_per_step=min(cfg["ids_per_step"], 96),
+            drift_rows=min(cfg["drift_rows"], 24),
+            repeats=1,
+        )
+        cfg["vocab"] = cfg["hot_budget_rows"] * cfg["vocab_factor"]
+    if cfg["working_set"] >= cfg["hot_budget_rows"]:
+        parser.error("working_set must fit the hot budget")
+    from elasticdl_tpu.native import native_available
+
+    cfg["native_row_store"] = bool(native_available())
+
+    workdir = args.workdir
+    cleanup = False
+    if not workdir:
+        workdir = tempfile.mkdtemp(prefix="edl_tiered_bench_")
+        cleanup = True
+    try:
+        report = run_bench(cfg, workdir)
+    finally:
+        if cleanup:
+            shutil.rmtree(workdir, ignore_errors=True)
+    with open(args.out, "w") as f:
+        json.dump(report, f, indent=2, sort_keys=True)
+        f.write("\n")
+    tiered, base = report["results"]["tiered"], report["results"]["in_memory"]
+    print(f"bench_tiered_store: p99 step {tiered['step_p99_ms']}ms tiered "
+          f"({cfg['vocab']} rows, budget {cfg['hot_budget_rows']}) vs "
+          f"{base['step_p99_ms']}ms in-memory "
+          f"(ratio {report['step_p99_ratio']}x, gate <=1.5x); "
+          f"{tiered['faults']} faults / {tiered['evictions']} evictions; "
+          f"restore byte-equal: {report['restore_byte_equal']}; "
+          f"report -> {args.out}")
+    if not args.smoke and not all(report["passed"].values()):
+        print(f"bench_tiered_store: GATE FAILED {report['passed']}",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
