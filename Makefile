@@ -8,7 +8,7 @@ PY ?= python
 	clean lint metrics chaos-smoke chaos-soak chaos-master-smoke \
 	trace-smoke serve-fleet-smoke sparse-smoke sparse-bench \
 	autoscale-smoke autoscale-bench slo-smoke ckpt-bench ckpt-smoke \
-	tiered-smoke tiered-bench
+	tiered-smoke tiered-bench reshard-smoke reshard-bench
 
 # Scrape-and-pretty-print a master's /metrics (docs/observability.md).
 METRICS_ADDR ?= localhost:8080
@@ -163,6 +163,29 @@ tiered-smoke:
 # byte-equal rows across both tiers.
 tiered-bench:
 	JAX_PLATFORMS=cpu $(PY) tools/bench_tiered_store.py
+
+# Live-reshard chaos drill (docs/sparse_path.md "Live resharding &
+# hot-row replication"): a 2-shard fleet under a seeded push schedule
+# splits live twice; the source shard is killed mid-migration and the
+# authority mid-cutover. Relaunch + resume must converge to ONE
+# consistent shard map, byte-equal rows+slots vs a fault-free twin,
+# no row lost or double-homed (replica copies included), and the
+# authority state file passes check_reshard.py at every kill point.
+# Fast-lane equivalent: tests/test_reshard.py::test_reshard_drill_passes.
+reshard-smoke:
+	workdir=$$(mktemp -d /tmp/edl_reshard.XXXXXX); \
+	JAX_PLATFORMS=cpu $(PY) -m elasticdl_tpu.chaos.reshard_drill \
+		--seed $(CHAOS_SEED) --workdir $$workdir \
+		--report RESHARD_DRILL.json; \
+	rc=$$?; rm -rf $$workdir; exit $$rc
+
+# Live-reshard + hot-row-replica bench (writes BENCH_ROW_RESHARD.json).
+# Gates: live 2->3 split downtime >=5x lower than checkpoint-restart
+# repartition under continuous pull/push load, zipf(1.1) replicated
+# read throughput >=1.5x single-home, p99 replica staleness under the
+# default freshness SLO.
+reshard-bench:
+	JAX_PLATFORMS=cpu $(PY) tools/bench_row_reshard.py
 
 # Deterministic chaos plan (kill + stall-row-shard + corrupt-checkpoint)
 # against the in-process cluster; exits nonzero if any recovery

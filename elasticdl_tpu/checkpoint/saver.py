@@ -135,6 +135,10 @@ class CheckpointSaver:
         # them. 0 = auto.
         self._io_workers = int(io_workers) or min(4, self.num_shards)
         self._io_pool = None
+        # Caller-supplied meta of the newest element the last restore
+        # applied (tip wins along a chain): how the row service's
+        # shard map rides the checkpoint (row_service._restore_latest).
+        self.last_restored_meta: dict = {}
         os.makedirs(checkpoint_dir, exist_ok=True)
 
     # ---- write pipeline ------------------------------------------------
@@ -160,12 +164,14 @@ class CheckpointSaver:
         }
         payloads = {}
         for shard in range(n):
-            meta = {
+            # Caller meta first: the structural keys (version/shard/
+            # num_shards) are load-bearing for restore and must win.
+            meta = dict(extra_meta or {})
+            meta.update({
                 "version": int(version),
                 "shard": shard,
                 "num_shards": n,
-            }
-            meta.update(extra_meta or {})
+            })
             payload = {
                 "meta": meta,
                 "dense": {
@@ -263,14 +269,19 @@ class CheckpointSaver:
         version: int,
         dense: Dict[str, np.ndarray],
         embeddings=None,
+        meta: Optional[dict] = None,
     ) -> str:
         """Write all shards of one FULL version, then GC old chains.
         ``embeddings`` maps table name to a table-like (``to_arrays``)
-        or a pre-captured ``(ids, rows)`` tuple."""
+        or a pre-captured ``(ids, rows)`` tuple. ``meta`` rides every
+        shard file's meta dict and surfaces on restore via
+        ``last_restored_meta`` (reserved keys version/shard/num_shards
+        win)."""
         t0 = time.monotonic()
         vdir = _version_dir(self.checkpoint_dir, version)
         payloads = self._build_payloads(
             version, dense, _table_arrays(embeddings), "variables",
+            extra_meta=meta,
         )
         bytes_written = self._publish_dir(vdir, payloads)
         logger.info(
@@ -287,12 +298,14 @@ class CheckpointSaver:
         embeddings,
         base_version: int,
         prev_version: int,
+        meta: Optional[dict] = None,
     ) -> str:
         """Write one DELTA element against ``base_version`` whose
         predecessor in the chain is ``prev_version`` (the base itself
         for the first delta). ``embeddings`` carries only the dirty
         rows; dense leaves ride in full (dense state has no sparsity
-        to exploit — every leaf changes every step)."""
+        to exploit — every leaf changes every step). ``meta`` as in
+        ``save`` (chain keys win)."""
         t0 = time.monotonic()
         chain_info = {
             "version": int(version),
@@ -301,10 +314,12 @@ class CheckpointSaver:
             "num_shards": self.num_shards,
         }
         vdir = _delta_dir(self.checkpoint_dir, version)
+        extra = dict(meta or {})
+        extra.update({"base": int(base_version),
+                      "prev": int(prev_version)})
         payloads = self._build_payloads(
             version, dense, _table_arrays(embeddings), "rows",
-            extra_meta={"base": int(base_version),
-                        "prev": int(prev_version)},
+            extra_meta=extra,
         )
         bytes_written = self._publish_dir(vdir, payloads, chain_info)
         logger.info(
@@ -527,6 +542,7 @@ class CheckpointSaver:
 
         dense: Dict[str, np.ndarray] = {}
         embeddings: Dict[str, EmbeddingTable] = {}
+        self.last_restored_meta = {}
         # The base raises on corruption (nothing to fall back on within
         # this chain); the caller skips to an older chain.
         self._load_dir(
@@ -585,6 +601,10 @@ class CheckpointSaver:
                     f"{type(exc).__name__}: {exc}"
                 ) from exc
             validate_shard_payload(payload, path)
+            # Tip-wins along a chain: each loaded element overwrites,
+            # so after a chain restore this holds the newest element's
+            # caller meta (e.g. the row service's shard map).
+            self.last_restored_meta = dict(payload.get("meta") or {})
             dense.update(payload.get("dense", {}))
             for tname, slices in payload.get("embeddings", {}).items():
                 # An empty (0, D) slice still carries the row dim; a shard

@@ -350,6 +350,29 @@ def add_train_params(parser):
                         default=120.0,
                         help="Trailing window for the time-series-"
                              "backed utilization signal")
+    # Row-plane elasticity (master/row_reshard.py; docs/sparse_path.md
+    # "Live resharding & hot-row replication"): the master runs the
+    # shard-map authority over the --row_service_addr fleet — load-
+    # imbalance range moves plus hot-row replica designation.
+    add_bool_param(parser, "--row_reshard", False,
+                   "Run the row-service shard-map controller in the "
+                   "master tick (needs --row_service_addr; live range "
+                   "rebalancing + hot-row read replicas)")
+    parser.add_argument("--row_reshard_state", default="",
+                        help="Shard-map authority state file (default: "
+                             "<journal_dir>/shard_map.json; required "
+                             "when no --journal_dir is set)")
+    parser.add_argument("--row_reshard_cooldown_secs", type=pos_float,
+                        default=30.0,
+                        help="Quiet period between reshard actions "
+                             "(range moves / replica updates)")
+    parser.add_argument("--row_replica_top_k", type=pos_int, default=64,
+                        help="Hottest ids per table eligible for read "
+                             "replication")
+    parser.add_argument("--row_replica_count", type=non_neg_int,
+                        default=2,
+                        help="Read replicas per hot id (capped at "
+                             "fleet size - 1; 0 disables replication)")
 
 
 def add_evaluate_params(parser):

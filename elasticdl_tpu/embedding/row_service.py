@@ -21,24 +21,113 @@ framework's msgpack RPC (comm/rpc.py):
 Worker-side dedup/bucketing still applies: each pull moves only the
 batch's unique rows, mirroring the reference worker's dedup before
 push (worker.py:487-599).
+
+**Live resharding + hot-row replicas (PR 12, docs/sparse_path.md
+"Live resharding & hot-row replication"):** placement is no longer a
+frozen ``id % N`` — it is a versioned ``ShardMap``
+(embedding/shard_map.py) the client routes through and the server
+*enforces*: a pull/push for buckets a shard does not own returns a
+retryable REDIRECT carrying the newer map. Row ranges move between
+live shards through a generation-fenced migration (``migrate_out`` /
+``begin_ingest``/``ingest_rows``: bulk copy in chunks — hot rows from
+the arena, cold rows via the tiered store's segment reads, never
+promoted through the hot budget — then touched-set catch-up deltas,
+then a brief write fence until the authority flips the map version).
+Power-law read skew is attacked with **hot-row read replicas**: shards
+track per-id pull frequency, the authority designates replica shards
+for the hot set, the home pushes async refreshes after applied pushes,
+and ``_ShardedTable.get`` fans hot-id reads across home + replicas
+while writes stay single-home. The authority (shard-map controller +
+split/merge/replication policy) lives in ``master/row_reshard.py``.
 """
 
 import itertools
 import threading
 import time
-from typing import Dict, Optional
+from collections import Counter
+from typing import Callable, Dict, Optional
 
 import numpy as np
 
 from elasticdl_tpu.common.log_utils import get_logger
 from elasticdl_tpu.comm.rpc import RpcError, RpcServer, RpcStub
 from elasticdl_tpu.embedding.host_engine import HostEmbeddingEngine
+from elasticdl_tpu.embedding.shard_map import (
+    ClientShardMap,
+    ShardMap,
+    bucket_of,
+)
+from elasticdl_tpu.embedding.table import get_slot_table_name
 from elasticdl_tpu.observability import tracing
 
 logger = get_logger("row_service")
 
 SERVICE_NAME = "RowService"
 SEQS_TABLE_NAME = "__row_service_seqs__"
+
+# Rows per migration chunk: bounds how long the service lock is held
+# per read and how large each ingest RPC is.
+MIGRATE_CHUNK_ROWS = 2048
+# Catch-up rounds before the source fences writes to the moving range
+# and ships the final delta.
+MIGRATE_CATCHUP_ROUNDS = 4
+# A write fence expires on its own if the cutover never arrives (the
+# authority died mid-protocol and will re-run the whole migration):
+# better to re-accept writes — the re-run re-copies them — than to
+# reject the range forever. The TTL must comfortably exceed the
+# WORST-CASE final-delta + cutover-distribution time (the authority's
+# RideOutTransport retries span ~64s against a flaky shard): a fence
+# lapsing mid-protocol would let a push apply on the source after the
+# final delta shipped — silently lost at the cutover erase.
+FENCE_TTL_SECS = 300.0
+# Hot-id pull tracking: bounded per-table counter (lossy: on overflow
+# the tail halves away), only maintained once a shard map is installed.
+HOT_TRACK_MAX_IDS = 4096
+
+
+# ---- chaos seam (chaos/reshard_drill.py installs) ----------------------
+#
+# mid_migrate(service, migration_id, view_name, chunk_ids) runs after
+# each migrated chunk lands on the target; raising simulates the
+# source dying mid-copy.
+
+_mid_migrate_hook: Optional[Callable] = None
+
+
+def set_reshard_chaos_hooks(mid_migrate: Optional[Callable] = None):
+    global _mid_migrate_hook
+    _mid_migrate_hook = mid_migrate
+
+
+class DirectTransport:
+    """In-process transport to a ``HostRowService`` (tests/drills):
+    the same ``.call`` surface as ``RpcStub`` without a socket."""
+
+    def __init__(self, service: "HostRowService"):
+        self._handlers = service.handlers()
+
+    def call(self, method: str, timeout=None, **fields):
+        return self._handlers[method](fields) or {}
+
+
+def _all_ids(table) -> np.ndarray:
+    """Every materialized row id of a table-like, WITHOUT reading row
+    bytes where the store can avoid it (tiered tables enumerate from
+    membership sets; the fallback pays a full to_arrays)."""
+    fn = getattr(table, "all_ids", None)
+    if fn is not None:
+        return np.asarray(fn(), np.int64)
+    return np.asarray(table.to_arrays()[0], np.int64)
+
+
+def _peek_rows(table, ids: np.ndarray) -> np.ndarray:
+    """Read rows for EXISTING ids without promotion/recency side
+    effects where the store supports it (tiered tables serve cold ids
+    straight from segment reads — a migrated cold range must not churn
+    through the hot budget)."""
+    fn = getattr(table, "peek", None)
+    rows = fn(ids) if fn is not None else table.get(ids)
+    return np.asarray(rows, np.float32)
 
 
 def _client_key(client: str) -> int:
@@ -120,7 +209,74 @@ class HostRowService:
             "Step/push-path time spent capturing + enqueuing a "
             "checkpoint (the part the hot path actually waits on)",
         )
+        # Reshard plane (docs/sparse_path.md "Live resharding"):
+        self._m_map_version = registry.gauge(
+            "row_shard_map_version",
+            "Installed shard-map epoch (0 = static legacy topology)",
+        )
+        self._m_mig_rows = registry.counter(
+            "row_migration_rows_total",
+            "Rows streamed out by live range migrations",
+        )
+        self._m_mig_bytes = registry.counter(
+            "row_migration_bytes_total",
+            "Row bytes streamed out by live range migrations",
+        )
+        self._m_mig_secs = registry.counter(
+            "row_migration_seconds_total",
+            "Wall seconds spent inside migrate_out (copy + catch-up "
+            "+ fence window)",
+        )
+        self._m_redirects = registry.counter(
+            "row_redirects_total",
+            "Pulls/pushes redirected because this shard does not own "
+            "their buckets under the installed map",
+        )
+        self._m_replica_reads = registry.counter(
+            "row_replica_reads_total",
+            "Rows served from this shard's hot-row replica store",
+        )
+        self._m_replica_stale = registry.histogram(
+            "row_replica_staleness_seconds",
+            "Replication lag observed at refresh receipt (home "
+            "read-time to replica apply-time, wall clock)",
+        )
         self._lock = threading.RLock()
+        # ---- reshard state (all mutated under self._lock) ----
+        self._shard_map: Optional[ShardMap] = None
+        self._shard_id = 0
+        # Outbound migration: {"id", "lo", "hi", "touched": {table:
+        # set(ids)}} — the push handler records applied ids landing in
+        # the moving range so catch-up ships exactly the delta (the
+        # PR 10 dirty-tracking idea, scoped to the migration so the
+        # checkpoint's own dirty sets are untouched).
+        self._out_migration: Optional[dict] = None
+        # Inbound migrations this shard agreed to ingest (generation
+        # fence: ingest_rows for an unregistered id is rejected).
+        self._ingests: Dict[str, dict] = {}
+        # Write fences: [(lo, hi, monotonic deadline)] — pushes to a
+        # fenced bucket get a retryable "fenced" verdict between the
+        # final migration delta and the cutover map install.
+        self._fences = []
+        # Hot-id pull tracking (only once a map is installed). Its own
+        # lock: the counting is advisory and must never serialize the
+        # pull/push handlers on the service lock.
+        self._hot_lock = threading.Lock()
+        self._hot_counts: Dict[str, Counter] = {}
+        self._hot_track_pulls = 0
+        # Plain load counters for shard_stats (registry counters are
+        # process-global; the authority needs THIS shard's numbers).
+        self._stat_pulled_rows = 0
+        self._stat_pushed_rows = 0
+        # Hot-row replica store: {table: {id: [row, applied_at,
+        # read_at]}} — rows this shard serves as a READ replica.
+        self._replica_store: Dict[str, dict] = {}
+        self._replica_queue = None
+        self._replica_thread = None
+        # Shard-to-shard transports (migration streaming, replica
+        # refresh). Tests/drills inject an in-process factory.
+        self.transport_factory: Optional[Callable] = None
+        self._transports: Dict[str, object] = {}
         self._server: Optional[RpcServer] = None
         self._push_count = 0
         # Per-table monotonic update counter: bumped under the lock on
@@ -172,6 +328,17 @@ class HostRowService:
             "pull_rows": self._pull_rows,
             "push_row_grads": self._push_row_grads,
             "export_rows": self._export_rows,
+            # Reshard plane:
+            "get_shard_map": self._get_shard_map,
+            "set_shard_map": self._set_shard_map,
+            "shard_stats": self._shard_stats,
+            "migrate_out": self._migrate_out,
+            "begin_ingest": self._begin_ingest,
+            "end_ingest": self._end_ingest,
+            "ingest_rows": self._ingest_rows,
+            "ingest_steps": self._ingest_steps,
+            "pull_replica_rows": self._pull_replica_rows,
+            "replica_refresh": self._replica_refresh,
         }
 
     def _table_info(self, request: dict) -> dict:
@@ -214,19 +381,38 @@ class HostRowService:
                 # (storage/tiered.py "Tiered storage").
                 table.prefault(ids)
             with self._lock:
+                reject = self._reshard_reject_locked(ids)
+                if reject is not None:
+                    return reject
                 rows = (table.get(ids, _defer_sweep=True) if tiered
                         else table.get(ids))
                 applied_at = self._applied_at.get(request["table"], 0.0)
+                self._stat_pulled_rows += int(ids.size)
+                map_version = 0
+                if self._shard_map is not None:
+                    map_version = self._shard_map.version
             if tiered:
                 # Budget sweep AFTER releasing the service lock: the
                 # eviction's cold write stalls no handler but this one.
                 table.maybe_sweep()
+            if map_version:
+                # Hot-id tracking feeds the authority's replica
+                # designation; only maintained once a map is installed
+                # (static topologies pay nothing) and OUTSIDE the
+                # service lock (advisory stats must not serialize
+                # handlers).
+                self._track_hot(request["table"], ids)
         self._m_pulled.inc(ids.size)
         self._m_pull.observe(time.monotonic() - t0)
         # applied_at rides every pull so readers can observe row
         # freshness without an extra RPC (0.0 = never pushed).
+        # map_version rides too: a replica-only epoch changes no
+        # ownership, so REDIRECTs alone would never teach clients
+        # about it — the piggybacked version lets them fetch the map
+        # when it moves (0 = no map installed).
         return {"rows": np.asarray(rows, np.float32),
-                "applied_at": applied_at}
+                "applied_at": applied_at,
+                "map_version": map_version}
 
     def _export_rows(self, request: dict) -> dict:
         """Dense rows ``lo+offset, lo+offset+stride, ... < hi`` for
@@ -236,6 +422,26 @@ class HostRowService:
         ``stride``/``offset`` let a sharded client pull only the rows
         this shard owns (id % N == shard) instead of the whole range."""
         table = self._tables[request["table"]]
+        if "ids" in request:
+            # Map-routed export (shard-map topologies): the client
+            # asks each shard for exactly the ids it owns. Ownership
+            # is enforced like pulls — a stale-epoch exporter gets a
+            # REDIRECT, not silently lazy-initialized rows.
+            want = np.asarray(request["ids"], np.int64)
+            with self._lock:
+                reject = self._reshard_reject_locked(want)
+                if reject is not None:
+                    return reject
+                ids, rows = table.to_arrays()
+            from elasticdl_tpu.serving.export import _clone_empty
+
+            dense = np.asarray(_clone_empty(table).get(want))
+            pos = {int(i): k for k, i in enumerate(want.tolist())}
+            for i, row in zip(ids.tolist(), rows):
+                at = pos.get(int(i))
+                if at is not None:
+                    dense[at] = row
+            return {"rows": dense.astype(np.float32)}
         lo, hi = int(request["lo"]), int(request["hi"])
         stride = int(request.get("stride", 1))
         offset = int(request.get("offset", 0))
@@ -266,6 +472,15 @@ class HostRowService:
                 # merely promotes rows it would have touched anyway.
                 prefault(ids)
             with self._lock:
+                # Ownership + fence checks BEFORE any mutation: a
+                # redirected/fenced push applies nothing, so the
+                # client's retry (against the new home, or after the
+                # cutover) is the first and only apply.
+                reject = self._reshard_reject_locked(ids)
+                if reject is not None:
+                    return reject
+                if self._fence_hit_locked(ids):
+                    return {"reshard": {"reason": "fenced"}}
                 if client and seq >= 0:
                     key = _client_key(client)
                     if seq <= self._applied_seq.get(key, -1):
@@ -289,6 +504,26 @@ class HostRowService:
                     self._applied_seq[_client_key(client)] = seq
                 self._push_count += 1
                 version = self._push_count
+                self._stat_pushed_rows += int(ids.size)
+                mig = self._out_migration
+                if mig is not None:
+                    # Applied writes landing in the moving range feed
+                    # the catch-up delta — the migration's own dirty
+                    # tracking (the checkpoint's sets stay untouched).
+                    b = bucket_of(ids)
+                    in_range = (b >= mig["lo"]) & (b < mig["hi"])
+                    if in_range.any():
+                        mig["touched"].setdefault(
+                            request["table"], set()
+                        ).update(ids[in_range].tolist())
+                refresh_ids = self._replicated_ids_locked(
+                    request["table"], ids
+                )
+            if refresh_ids is not None:
+                # Async push-driven replica refresh: enqueue OUTSIDE
+                # the lock; the refresher thread reads fresh rows and
+                # fans them to the replica shards.
+                self._queue_refresh(request["table"], refresh_ids)
             if prefault is not None:
                 # Deferred half of the fused apply's budget sweep —
                 # eviction's cold writes run with the lock released.
@@ -300,7 +535,563 @@ class HostRowService:
             and version % self._checkpoint_steps == 0
         ):
             self._checkpoint(version)
+        m = self._shard_map
+        return {"map_version": m.version if m is not None else 0}
+
+    # ---- live resharding: map enforcement ------------------------------
+
+    def _reshard_reject_locked(self, ids: np.ndarray) -> Optional[dict]:
+        """REDIRECT verdict for ids this shard does not own under the
+        installed map (None = all owned, or no map installed — the
+        static legacy topology never redirects). The carried map is
+        how stale clients converge after a cutover."""
+        m = self._shard_map
+        if m is None:
+            return None
+        if m.owns(self._shard_id, ids).all():
+            return None
+        self._m_redirects.inc()
+        return {"reshard": {"reason": "not_owner", "map": m.to_json()}}
+
+    def _fence_hit_locked(self, ids: np.ndarray) -> bool:
+        """Whether any id lands in a write-fenced bucket range (the
+        window between a migration's final delta and its cutover).
+        Expired fences lift themselves — an authority that died before
+        the cutover re-runs the migration from scratch."""
+        if not self._fences:
+            return False
+        now = time.monotonic()
+        expired = [f for f in self._fences if f[2] <= now]
+        if expired:
+            # Loud: an expiring fence means a migration was abandoned
+            # mid-protocol (or the cutover is pathologically slow) —
+            # writes re-accepted here diverge from the target's copy
+            # until the authority re-runs the move.
+            for lo, hi, _dl in expired:
+                logger.warning(
+                    "write fence on buckets [%d, %d) EXPIRED before "
+                    "cutover; accepting writes again (the abandoned "
+                    "migration must re-run)", lo, hi,
+                )
+            self._fences = [f for f in self._fences if f[2] > now]
+        if not self._fences:
+            return False
+        b = bucket_of(ids)
+        return any(
+            bool(((b >= lo) & (b < hi)).any())
+            for lo, hi, _deadline in self._fences
+        )
+
+    def _track_hot(self, table: str, ids: np.ndarray):
+        with self._hot_lock:
+            counts = self._hot_counts.setdefault(table, Counter())
+            counts.update(ids.tolist())
+            self._hot_track_pulls += 1
+            if (self._hot_track_pulls % 256 == 0
+                    and len(counts) > HOT_TRACK_MAX_IDS):
+                # Lossy decay: keep the head at half weight, drop the
+                # tail — one-touch stranger ids must not grow the
+                # counter without bound.
+                self._hot_counts[table] = Counter({
+                    i: n // 2
+                    for i, n in counts.most_common(
+                        HOT_TRACK_MAX_IDS // 2
+                    )
+                    if n > 1
+                })
+
+    def _replicated_ids_locked(self, table: str,
+                               ids: np.ndarray) -> Optional[np.ndarray]:
+        """The pushed ids whose replica sets need a refresh (None =
+        replication not in play for this table)."""
+        m = self._shard_map
+        if m is None:
+            return None
+        per = m.replicas.get(table)
+        if not per:
+            return None
+        hot = [i for i in ids.tolist() if i in per]
+        return np.asarray(hot, np.int64) if hot else None
+
+    # ---- live resharding: map install ----------------------------------
+
+    def install_shard_map(self, shard_map: ShardMap, shard_id: int):
+        """In-process map install (the RPC handler's body; drills and
+        the authority's direct transport call this)."""
+        return self._set_shard_map({
+            "map": shard_map.to_json(), "shard_id": int(shard_id),
+        })
+
+    def _get_shard_map(self, request: dict) -> dict:
+        with self._lock:
+            m = self._shard_map
+            return {
+                "map": m.to_json() if m is not None else None,
+                "shard_id": self._shard_id,
+            }
+
+    def _set_shard_map(self, request: dict) -> dict:
+        """Install a newer map epoch (idempotent at the same version,
+        stale versions rejected — the monotonic version IS the fence).
+        On install this shard erases rows it no longer owns (they were
+        migrated before the authority ever flipped the version) except
+        rows inside a registered inbound migration (those arrive ahead
+        of the ownership flip by design)."""
+        fresh = ShardMap.from_json(request["map"])
+        shard_id = int(request.get("shard_id", -1))
+        with self._lock:
+            cur = self._shard_map
+            if cur is not None and fresh.version < cur.version:
+                return {"accepted": False, "version": cur.version}
+            if shard_id >= 0:
+                self._shard_id = shard_id
+            already = cur is not None and fresh.version == cur.version
+            self._shard_map = fresh
+            self._m_map_version.set(float(fresh.version))
+            erased = 0
+            if not already:
+                # Fences on ranges we no longer own served their
+                # purpose (the cutover landed); writes there now
+                # redirect instead.
+                self._fences = [
+                    (lo, hi, dl) for lo, hi, dl in self._fences
+                    if bool((fresh.owner_table[lo:hi]
+                             == self._shard_id).any())
+                ]
+                erased = self._erase_unowned_locked()
+                # Replica store: drop copies this shard no longer
+                # replicates (topology moved on).
+                for table, store in self._replica_store.items():
+                    per = fresh.replicas.get(table, {})
+                    for i in list(store):
+                        if self._shard_id not in per.get(i, ()):
+                            del store[i]
+        if not already:
+            self._warm_replicas()
+        return {"accepted": True, "version": fresh.version,
+                "erased_rows": erased}
+
+    def _erase_unowned_locked(self) -> int:
+        """Drop rows (and their optimizer slots) whose bucket this
+        shard no longer owns — the cutover's single-homing guarantee.
+        Buckets inside a registered inbound migration are exempt: the
+        copy precedes the ownership flip."""
+        m = self._shard_map
+        if m is None:
+            return 0
+        exempt = [(g["lo"], g["hi"]) for g in self._ingests.values()]
+        erased = 0
+        for group in self._migration_views().values():
+            for table in group.values():
+                ids = _all_ids(table)
+                if not ids.size:
+                    continue
+                b = bucket_of(ids)
+                drop = m.home_of_ids(ids) != self._shard_id
+                for lo, hi in exempt:
+                    drop &= ~((b >= lo) & (b < hi))
+                if drop.any():
+                    erased += int(table.erase(ids[drop]))
+        return erased
+
+    # ---- live resharding: migration ------------------------------------
+
+    def _migration_views(self) -> Dict[str, Dict[str, object]]:
+        """{primary table: {view name: raw table}} — each primary with
+        its optimizer slot tables (lockstep movement). Step counters
+        and the push-dedup seq map stay per-shard: they are scalar
+        bookkeeping of THIS process, not row state."""
+        out = {}
+        for name, table in self._tables.items():
+            group = {name: table}
+            for slot in getattr(self._optimizer.opt, "slot_names", ()):
+                group[get_slot_table_name(name, slot)] = (
+                    self._optimizer._slot_table(table, slot)
+                )
+            out[name] = group
+        return out
+
+    def _transport(self, addr: str):
+        transport = self._transports.get(addr)
+        if transport is None:
+            if self.transport_factory is not None:
+                transport = self.transport_factory(addr)
+            else:
+                transport = RpcStub(addr, SERVICE_NAME, max_retries=2)
+            self._transports[addr] = transport
+        return transport
+
+    def _migrate_out(self, request: dict) -> dict:
+        """Source side of a live range move: stream every owned row in
+        buckets [lo, hi) — with its optimizer slots — to the target's
+        ``ingest_rows``, chunk-wise, WITHOUT stalling concurrent
+        pulls/pushes (the service lock is held only per chunk read;
+        tiered tables serve cold chunks from segment reads, never
+        promoting them through the hot budget). Writes landing in the
+        range during the copy are recorded and re-shipped in catch-up
+        rounds; the final round fences the range so the authority can
+        flip the map against frozen bytes."""
+        mig_id = str(request["migration_id"])
+        lo, hi = int(request["lo"]), int(request["hi"])
+        target_addr = str(request["target_addr"])
+        t0 = time.monotonic()
+        transport = self._transport(target_addr)
+        views = self._migration_views()
+        moved_rows = 0
+        moved_bytes = 0
+        rounds = 0
+        with self._lock:
+            if self._out_migration is not None:
+                raise RuntimeError(
+                    f"migration {self._out_migration['id']} already in "
+                    "flight; one outbound move at a time"
+                )
+            self._out_migration = {
+                "id": mig_id, "lo": lo, "hi": hi, "touched": {},
+            }
+        try:
+            with tracing.span("row_migrate_out", migration=mig_id,
+                              lo=lo, hi=hi):
+                # Bulk copy: enumerate once, then chunked reads.
+                for primary, group in views.items():
+                    for vname, table in group.items():
+                        with self._lock:
+                            ids = _all_ids(table)
+                        b = bucket_of(ids)
+                        sel = ids[(b >= lo) & (b < hi)]
+                        for at in range(0, sel.size, MIGRATE_CHUNK_ROWS):
+                            chunk = sel[at:at + MIGRATE_CHUNK_ROWS]
+                            with self._lock:
+                                rows = _peek_rows(table, chunk)
+                            transport.call(
+                                "ingest_rows", migration_id=mig_id,
+                                table=vname, ids=chunk, rows=rows,
+                            )
+                            moved_rows += int(chunk.size)
+                            moved_bytes += int(rows.nbytes)
+                            hook = _mid_migrate_hook
+                            if hook is not None:
+                                hook(self, mig_id, vname, chunk)
+                # Catch-up: re-ship rows written during the copy until
+                # the delta is drained or rounds run out; the last
+                # swap happens under a WRITE FENCE so no push can
+                # slip between the final delta and the cutover.
+                while True:
+                    with self._lock:
+                        touched = self._out_migration["touched"]
+                        drained = not any(touched.values())
+                        if drained or rounds >= MIGRATE_CATCHUP_ROUNDS:
+                            self._fences.append(
+                                (lo, hi,
+                                 time.monotonic() + FENCE_TTL_SECS)
+                            )
+                            final = touched
+                            self._out_migration["touched"] = {}
+                            break
+                        self._out_migration["touched"] = {}
+                    rounds += 1
+                    r, nbytes = self._ship_delta(
+                        views, touched, transport, mig_id
+                    )
+                    moved_rows += r
+                    moved_bytes += nbytes
+                r, nbytes = self._ship_delta(
+                    views, final, transport, mig_id
+                )
+                moved_rows += r
+                moved_bytes += nbytes
+                # Ship the per-table apply counts too (inside the
+                # fenced window, so they are final): Adam bias
+                # correction on a fresh target would otherwise apply
+                # migrated rows' first update with a near-step-1
+                # correction — a large unintended magnitude spike.
+                with self._lock:
+                    steps = {
+                        primary: int(
+                            self._optimizer._steps.get(primary, 0)
+                        )
+                        for primary in views
+                    }
+                if any(steps.values()):
+                    transport.call(
+                        "ingest_steps", migration_id=mig_id,
+                        steps=steps,
+                    )
+        finally:
+            with self._lock:
+                self._out_migration = None
+        secs = time.monotonic() - t0
+        self._m_mig_rows.inc(moved_rows)
+        self._m_mig_bytes.inc(moved_bytes)
+        self._m_mig_secs.inc(secs)
+        return {
+            "rows": moved_rows, "bytes": moved_bytes,
+            "seconds": secs, "catchup_rounds": rounds,
+        }
+
+    def _ship_delta(self, views, touched: Dict[str, set], transport,
+                    mig_id: str):
+        """Re-ship touched primaries + their slots (one catch-up or
+        final-fence round)."""
+        rows_out = 0
+        bytes_out = 0
+        for primary, id_set in touched.items():
+            if not id_set:
+                continue
+            ids = np.asarray(sorted(id_set), np.int64)
+            for vname, table in views.get(primary, {}).items():
+                with self._lock:
+                    rows = _peek_rows(table, ids)
+                transport.call(
+                    "ingest_rows", migration_id=mig_id,
+                    table=vname, ids=ids, rows=rows,
+                )
+                rows_out += int(ids.size)
+                bytes_out += int(rows.nbytes)
+        return rows_out, bytes_out
+
+    def _begin_ingest(self, request: dict) -> dict:
+        """Target side: register an inbound migration (generation
+        fence — chunks for an unregistered migration id are rejected,
+        so a zombie source from an abandoned attempt cannot corrupt a
+        later one)."""
+        mig_id = str(request["migration_id"])
+        with self._lock:
+            self._ingests[mig_id] = {
+                "lo": int(request["lo"]), "hi": int(request["hi"]),
+                "rows": 0,
+            }
         return {}
+
+    def _end_ingest(self, request: dict) -> dict:
+        with self._lock:
+            info = self._ingests.pop(str(request["migration_id"]), None)
+        return {"rows": int(info["rows"]) if info else 0}
+
+    def _ingest_rows(self, request: dict) -> dict:
+        """One migrated chunk: overwrite-set into the named view
+        (idempotent — a re-run migration re-ships the same bytes).
+        ``set`` marks the rows dirty when checkpointing is on, so
+        ingested rows ride the target's next delta checkpoint."""
+        mig_id = str(request["migration_id"])
+        vname = str(request["table"])
+        ids = np.asarray(request["ids"], np.int64)
+        rows = np.asarray(request["rows"], np.float32)
+        flat = {}
+        for group in self._migration_views().values():
+            flat.update(group)
+        table = flat.get(vname)
+        if table is None:
+            raise ValueError(f"ingest for unknown view {vname!r}")
+        with self._lock:
+            info = self._ingests.get(mig_id)
+            if info is None:
+                raise ValueError(
+                    f"ingest for unregistered migration {mig_id!r} "
+                    "(stale source? re-run the migration)"
+                )
+            table.set(ids, rows)
+            info["rows"] += int(ids.size)
+        return {}
+
+    def _ingest_steps(self, request: dict) -> dict:
+        """Adopt the source's per-table apply counts by MAX: a target
+        that already applied its own pushes keeps its larger count
+        (bias correction must only ever see a step as large as the
+        oldest state it covers), a fresh split target inherits the
+        source's so migrated rows' next Adam update is not corrected
+        as if it were step 1."""
+        mig_id = str(request["migration_id"])
+        steps = request.get("steps") or {}
+        with self._lock:
+            if mig_id not in self._ingests:
+                raise ValueError(
+                    f"steps for unregistered migration {mig_id!r}"
+                )
+            for table, count in steps.items():
+                if table in self._tables:
+                    self._optimizer._steps[table] = max(
+                        int(self._optimizer._steps.get(table, 0)),
+                        int(count),
+                    )
+        return {}
+
+    # ---- live resharding: hot-row read replicas ------------------------
+
+    def _shard_stats(self, request: dict) -> dict:
+        """Load + hot-set snapshot for the authority's policy tick."""
+        top_k = int(request.get("top_k", 64))
+        with self._hot_lock:
+            hot = {
+                table: [[int(i), int(n)]
+                        for i, n in counts.most_common(top_k)]
+                for table, counts in self._hot_counts.items()
+            }
+        with self._lock:
+            return {
+                "shard_id": self._shard_id,
+                "map_version": (
+                    self._shard_map.version
+                    if self._shard_map is not None else 0
+                ),
+                "pulled_rows": self._stat_pulled_rows,
+                "pushed_rows": self._stat_pushed_rows,
+                "num_rows": {
+                    name: int(t.num_rows)
+                    for name, t in self._tables.items()
+                    if hasattr(t, "num_rows")
+                },
+                "hot": hot,
+            }
+
+    def _pull_replica_rows(self, request: dict) -> dict:
+        """Serve hot-id reads from the replica store. Per-id found
+        mask: a miss (refresh not landed yet) falls back to the home
+        shard client-side — a replica is an accelerator, never an
+        availability dependency."""
+        table = str(request["table"])
+        ids = np.asarray(request["ids"], np.int64)
+        dim = int(self._tables[table].dim)
+        rows = np.zeros((ids.size, dim), np.float32)
+        found = np.zeros(ids.size, bool)
+        applied_at = 0.0
+        with self._lock:
+            store = self._replica_store.get(table, {})
+            stamps = []
+            for k, i in enumerate(ids.tolist()):
+                entry = store.get(i)
+                if entry is not None:
+                    rows[k] = entry[0]
+                    found[k] = True
+                    stamps.append(entry[1])
+            if stamps:
+                # MIN over served copies: the conservative freshness
+                # stamp (same discipline as _ShardedTable).
+                applied_at = min(stamps)
+        self._m_replica_reads.inc(int(found.sum()))
+        return {"rows": rows, "found": found, "applied_at": applied_at}
+
+    def _replica_refresh(self, request: dict) -> dict:
+        """Home-pushed copy of hot rows: store them and observe the
+        replication lag (home read-time → here, wall clock — same
+        cross-process clock caveat as row_freshness_seconds).
+
+        ``map_version`` is the epoch the HOME computed the fan-out
+        under. A newer-than-ours epoch is accepted wholesale: the
+        designation distribution races the home's warm-up refreshes
+        (the home gets the new map first and fans out immediately), and
+        dropping those copies would leave this replica cold until the
+        next organic push per id. Our own install prunes anything the
+        epoch turns out not to replicate here. Only a refresh from an
+        epoch at-or-below ours applies the per-id designation guard
+        (a zombie home's stale fan-out must not resurrect copies)."""
+        table = str(request["table"])
+        ids = np.asarray(request["ids"], np.int64)
+        rows = np.asarray(request["rows"], np.float32)
+        applied_at = float(request.get("applied_at", 0.0))
+        read_at = float(request.get("read_at", 0.0))
+        sender_version = int(request.get("map_version", 0))
+        now = time.time()
+        with self._lock:
+            m = self._shard_map
+            ahead = m is None or sender_version > m.version
+            store = self._replica_store.setdefault(table, {})
+            for k, i in enumerate(ids.tolist()):
+                if not ahead and self._shard_id not in (
+                    m.replica_targets(table, i)
+                ):
+                    continue  # stale designation; don't serve it
+                store[i] = (rows[k].copy(), applied_at, read_at)
+        if read_at:
+            self._m_replica_stale.observe(max(0.0, now - read_at))
+        return {}
+
+    def _queue_refresh(self, table: str, ids: np.ndarray):
+        if self._replica_thread is None:
+            import queue as _queue
+
+            with self._lock:
+                if self._replica_thread is None:
+                    self._replica_queue = _queue.Queue(maxsize=128)
+                    self._replica_thread = threading.Thread(
+                        target=self._replica_loop, daemon=True,
+                        name="row-replica-refresh",
+                    )
+                    self._replica_thread.start()
+        try:
+            self._replica_queue.put_nowait((table, ids))
+        except Exception:
+            # Full queue: drop this refresh — replicas are best-effort
+            # bounded-staleness copies; the next push re-enqueues.
+            pass
+
+    def _replica_loop(self):
+        while True:
+            item = self._replica_queue.get()
+            if item is None:
+                return
+            table, ids = item
+            try:
+                self._do_refresh(table, ids)
+            except Exception as exc:
+                logger.warning("replica refresh failed: %s", exc)
+
+    def _do_refresh(self, table_name: str, ids: np.ndarray):
+        with self._lock:
+            m = self._shard_map
+            if m is None:
+                return
+            per = m.replicas.get(table_name)
+            if not per:
+                return
+            table = self._tables[table_name]
+            if hasattr(table, "contains"):
+                ids = ids[table.contains(ids)]
+            if not ids.size:
+                return
+            rows = _peek_rows(table, ids)
+            applied_at = self._applied_at.get(table_name, 0.0)
+            shards = list(m.shards)
+            map_version = m.version
+        read_at = time.time()
+        targets: Dict[int, list] = {}
+        for k, i in enumerate(ids.tolist()):
+            for s in per.get(i, ()):
+                if s != self._shard_id:
+                    targets.setdefault(s, []).append(k)
+        for s, picks in targets.items():
+            sel = np.asarray(picks, np.intp)
+            try:
+                self._transport(shards[s]).call(
+                    "replica_refresh", table=table_name,
+                    ids=ids[sel], rows=rows[sel],
+                    applied_at=applied_at, read_at=read_at,
+                    map_version=map_version,
+                )
+            except Exception as exc:
+                logger.warning(
+                    "replica refresh to shard %d failed: %s", s, exc
+                )
+
+    def _warm_replicas(self):
+        """On a new map: push this shard's owned, already-materialized
+        replicated ids out once so replicas start warm (afterwards
+        refreshes are push-driven)."""
+        with self._lock:
+            m = self._shard_map
+            if m is None:
+                return
+            work = []
+            for table, per in m.replicas.items():
+                if table not in self._tables or not per:
+                    continue
+                ids = np.fromiter(per.keys(), np.int64,
+                                  count=len(per))
+                owned = ids[m.owns(self._shard_id, ids)]
+                if owned.size:
+                    work.append((table, owned))
+        for table, owned in work:
+            self._queue_refresh(table, owned)
 
     # ---- tiered storage ------------------------------------------------
 
@@ -441,10 +1232,19 @@ class HostRowService:
         with self._lock:
             # ONE lock acquisition around the shared capture helper so
             # rows, slots, seq map, and step counters snapshot at the
-            # same version.
+            # same version. The shard map snapshots with them: a
+            # restored shard must come back owning exactly the rows
+            # the checkpoint holds (checkpoint meta, not a sidecar —
+            # the pair is captured atomically).
             captured, dirty_ids = capture_tables(
                 self.host_tables, delta=plan == "delta"
             )
+            meta = {}
+            if self._shard_map is not None:
+                meta = {
+                    "shard_map": self._shard_map.to_json(),
+                    "shard_id": self._shard_id,
+                }
 
         def remark():
             remark_dirty(self.host_tables, dirty_ids)
@@ -463,10 +1263,12 @@ class HostRowService:
                             "never became durable; restarting chain"
                         )
                     self._saver.save_delta(
-                        version, {}, captured, base, prev
+                        version, {}, captured, base, prev, meta=meta
                     )
                 else:
-                    self._saver.save(version, {}, embeddings=captured)
+                    self._saver.save(
+                        version, {}, embeddings=captured, meta=meta
+                    )
             except BaseException:
                 # A failed write must put the drained rows back into
                 # the dirty sets (or they vanish from every future
@@ -539,6 +1341,15 @@ class HostRowService:
                 # must not re-ship the whole table.
                 view.clear_dirty()
         self._push_count = int(version)
+        # The map rides the checkpoint meta: a relaunched shard comes
+        # back routing/enforcing the epoch it was checkpointed under
+        # (the authority's sync bumps it forward if the world moved).
+        restored_meta = getattr(self._saver, "last_restored_meta", {})
+        map_json = restored_meta.get("shard_map")
+        if map_json and self._shard_map is None:
+            self._shard_map = ShardMap.from_json(map_json)
+            self._shard_id = int(restored_meta.get("shard_id", 0))
+            self._m_map_version.set(float(self._shard_map.version))
         logger.info(
             "Row service restored version %d (%d tables)",
             version, len(targets),
@@ -547,12 +1358,15 @@ class HostRowService:
     # ---- lifecycle / checkpoint ---------------------------------------
 
     def start(self, addr: str = "localhost:0",
-              tag: str = "") -> "HostRowService":
+              tag: str = "", max_workers: int = 64) -> "HostRowService":
         """``tag`` identifies this shard to chaos fault plans (e.g.
         ``rowservice/0``) — several shards of the same service can run
-        in one test process and a plan must be able to stall just one."""
+        in one test process and a plan must be able to stall just one.
+        ``max_workers`` bounds handler concurrency (the reshard bench
+        runs 1-worker shards to model per-shard capacity)."""
         self._server = RpcServer(
-            addr, {SERVICE_NAME: self.handlers()}, tag=tag
+            addr, {SERVICE_NAME: self.handlers()}, tag=tag,
+            max_workers=max_workers,
         ).start()
         logger.info("Row service on port %d", self._server.port)
         return self
@@ -580,6 +1394,20 @@ class HostRowService:
                 logger.error(
                     "checkpoint flush on stop failed: %s", exc
                 )
+        if self._replica_thread is not None:
+            # Retire the replica refresher (drains after in-flight
+            # handlers, so no push can re-arm it post-close).
+            self._replica_queue.put(None)
+            self._replica_thread.join(timeout=10.0)
+            self._replica_thread = None
+        for transport in self._transports.values():
+            close = getattr(transport, "close", None)
+            if close is not None:
+                try:
+                    close()
+                except Exception:
+                    pass
+        self._transports.clear()
         for table in self._tables.values():
             # Tiered tables: flush cold segments, stop the compactor,
             # and snapshot the index (the clean-close marker
@@ -617,6 +1445,29 @@ class HostRowService:
 # shutdown cancels in-flight calls, and every method here is safe to
 # retry (pulls are idempotent; pushes are deduped by (client, seq)).
 _TRANSIENT_CODES = ("UNAVAILABLE", "DEADLINE_EXCEEDED", "CANCELLED")
+
+
+class ReshardRedirect(Exception):
+    """The shard does not own the requested buckets under its map —
+    retry against the carried (newer) map. Nothing was applied."""
+
+    def __init__(self, map_json):
+        super().__init__("row home moved (stale shard-map epoch)")
+        self.map_json = map_json
+
+
+class ReshardFenced(Exception):
+    """Writes to the range are briefly fenced (a migration is between
+    its final delta and the cutover) — back off and retry."""
+
+
+def _check_reshard(resp: dict):
+    info = resp.get("reshard") if isinstance(resp, dict) else None
+    if not info:
+        return
+    if info.get("reason") == "fenced":
+        raise ReshardFenced()
+    raise ReshardRedirect(info.get("map"))
 
 
 def _call_with_retry(stub: RpcStub, method: str, retries: int,
@@ -668,13 +1519,48 @@ class _RemoteTable:
         # serving's HostRowResolver turns into the
         # edl_tpu_row_freshness_seconds observation.
         self.last_applied_at = 0.0
+        # Newest piggybacked shard-map epoch seen on this shard's
+        # responses (0 until one rides a pull).
+        self.last_map_version = 0
 
     def get(self, ids) -> np.ndarray:
         resp = _call_with_retry(
             self._stub, "pull_rows", self._retries, self._backoff,
             table=self.name, ids=np.asarray(ids, np.int64),
         )
+        _check_reshard(resp)
         self.last_applied_at = float(resp.get("applied_at", 0.0) or 0.0)
+        # Piggybacked epoch: lets the sharded wrapper notice replica-
+        # only epochs (no ownership change = no REDIRECT ever fires).
+        self.last_map_version = int(resp.get("map_version", 0) or 0)
+        return np.asarray(resp["rows"], np.float32)
+
+    def fetch_map(self) -> Optional[dict]:
+        return _call_with_retry(
+            self._stub, "get_shard_map", self._retries, self._backoff,
+        ).get("map")
+
+    def pull_replica(self, ids) -> dict:
+        """Hot-id read from this shard's REPLICA store: per-id found
+        mask (misses fall back to the home shard caller-side)."""
+        resp = _call_with_retry(
+            self._stub, "pull_replica_rows", self._retries,
+            self._backoff, table=self.name,
+            ids=np.asarray(ids, np.int64),
+        )
+        stamp = float(resp.get("applied_at", 0.0) or 0.0)
+        if stamp > 0:
+            self.last_applied_at = stamp
+        return resp
+
+    def export_ids(self, ids) -> np.ndarray:
+        """Dense rows for explicit ids (trained rows over lazy init) —
+        the map-routed export path; redirects like a pull."""
+        resp = _call_with_retry(
+            self._stub, "export_rows", self._retries, self._backoff,
+            table=self.name, ids=np.asarray(ids, np.int64),
+        )
+        _check_reshard(resp)
         return np.asarray(resp["rows"], np.float32)
 
     def pull_version(self) -> int:
@@ -744,75 +1630,264 @@ class _RemoteOptimizer:
             self._local.client = f"{self._client_base}-{n}"
             self._local.seq = 0
         self._local.seq += 1
-        _call_with_retry(
+        resp = _call_with_retry(
             self._stub, "push_row_grads", self._retries, self._backoff,
             table=table.name,
             ids=np.asarray(ids, np.int64),
             grads=np.asarray(grads, np.float32),
             client=self._local.client, seq=self._local.seq,
         )
+        # A redirected/fenced push applied NOTHING server-side; the
+        # burned seq is harmless (dedup only needs monotonicity) and
+        # the caller re-routes under the newer map.
+        _check_reshard(resp)
         return table
 
 
-def _scatter_by_home(pool, n: int, ids: np.ndarray, per_shard):
-    """Run ``per_shard(shard_idx, mask)`` concurrently for every shard
-    owning at least one of ``ids`` (home shard = id % n), and join.
-    The one fan-out loop both the pull and push scatters share."""
-    home = ids % n
-    futures = []
-    for s in range(n):
-        mask = home == s
-        if mask.any():
-            futures.append(pool.submit(per_shard, s, mask))
+_RESHARD_ATTEMPTS = 20
+_FENCE_BACKOFF_SECS = 0.02
+
+
+def _run_jobs(pool, jobs):
+    """Run job thunks, fanned on the pool only when there is real
+    fan-out (a single-target wave — the common case for small pulls
+    and for single-shard fleets — stays inline, no thread hop)."""
+    if pool is None or len(jobs) == 1:
+        for job in jobs:
+            job()
+        return
+    futures = [pool.submit(job) for job in jobs]
     for f in futures:
         f.result()
 
 
+class _ShardRegistry:
+    """Client-side view of the live shard FLEET: one stub / remote
+    table / remote optimizer per shard address, created lazily — maps
+    learned via REDIRECT can name addresses the engine was never
+    configured with (a split's fresh target), and the registry is
+    where they materialize. Shared by every table and the optimizer of
+    one engine, plus the fan-out pool."""
+
+    def __init__(self, retries: int, backoff_secs: float):
+        self._retries = retries
+        self._backoff = backoff_secs
+        self._lock = threading.Lock()
+        self._stubs: Dict[str, RpcStub] = {}
+        self._tables: Dict = {}
+        self._optimizers: Dict = {}
+        self._pool = None
+
+    def stub(self, addr: str) -> RpcStub:
+        with self._lock:
+            stub = self._stubs.get(addr)
+            if stub is None:
+                # max_retries=0: _call_with_retry owns the (much
+                # longer) retry budget; stacking the stub's own
+                # backoff under it would multiply attempts.
+                stub = RpcStub(addr, SERVICE_NAME, max_retries=0)
+                self._stubs[addr] = stub
+            return stub
+
+    def table(self, addr: str, name: str, dim: int) -> "_RemoteTable":
+        key = (addr, name)
+        with self._lock:
+            table = self._tables.get(key)
+        if table is None:
+            table = _RemoteTable(
+                self.stub(addr), name, dim, self._retries, self._backoff
+            )
+            with self._lock:
+                table = self._tables.setdefault(key, table)
+        return table
+
+    def tables_named(self, name: str):
+        with self._lock:
+            return [t for (_a, n), t in self._tables.items()
+                    if n == name]
+
+    def optimizer(self, addr: str) -> "_RemoteOptimizer":
+        with self._lock:
+            opt = self._optimizers.get(addr)
+        if opt is None:
+            # Build outside the lock (stub() takes it; non-reentrant).
+            opt = _RemoteOptimizer(
+                self.stub(addr), self._retries, self._backoff
+            )
+            with self._lock:
+                opt = self._optimizers.setdefault(addr, opt)
+        return opt
+
+    @property
+    def pool(self):
+        with self._lock:
+            if self._pool is None:
+                from concurrent.futures import ThreadPoolExecutor
+
+                self._pool = ThreadPoolExecutor(
+                    max_workers=16, thread_name_prefix="row-shard",
+                )
+            return self._pool
+
+
 class _ShardedTable:
-    """Client-side scatter/gather over N row-service shards: row id
-    lives on shard ``int_to_id(id, N)`` (= ``id % N`` — the same
-    placement ``checkpoint/saver.py`` uses for row file shards, so a
-    table checkpointed under either layout repartitions onto the
-    other). The TPU-native shape of the reference worker's pull scatter
-    over N PS pods (``worker/worker.py:362-391``,
-    ``common/hash_utils.py:4-49``); per-shard pulls fan out on the
-    engine's shard pool, so N servers' line rates aggregate WHEN the
-    servers are the binding constraint (each on its own cores/NIC —
-    the reference's N-pod regime). Measured on this repo's 1-core
-    bench host (ROW_SERVICE_SCALING.json, tools/bench_row_service.py):
-    one native-store shard serves ~2.2M pull / ~1.8M push rows/s
-    through the full msgpack-RPC path, and sharding there only splits
-    requests into smaller sub-RPCs — use shards for capacity
-    partitioning and for multi-host deployments, not single-host
-    throughput."""
+    """Client-side scatter/gather over the live row-service fleet,
+    routed through the shared ``ClientShardMap``: a row's home is
+    whatever shard owns its BUCKET under the newest map epoch this
+    client has seen — no shard-count arithmetic anywhere. A stale
+    epoch surfaces as a REDIRECT from the shard that stopped owning
+    the buckets; the redirect carries the newer map, the shared holder
+    adopts it (version-monotonic), and only the unresolved ids retry —
+    sub-pulls that already landed on their correct homes never
+    re-execute. Hot ids with replica sets fan reads across home +
+    replicas (round-robin); a replica miss (refresh not landed) falls
+    back to the home, and writes never touch replicas. Fan-out runs on
+    the registry's pool so N shards' line rates aggregate WHEN the
+    servers are the binding constraint (each on its own cores/NIC);
+    on a single host, sharding splits requests into smaller sub-RPCs —
+    use shards for capacity partitioning and skew isolation, not
+    single-host throughput (ROW_SERVICE_SCALING.json)."""
 
     concurrent_safe = True
 
-    def __init__(self, shards, pool):
-        self._shards = list(shards)
-        self._pool = pool
-        self.name = self._shards[0].name
-        self.dim = self._shards[0].dim
+    def __init__(self, name: str, dim: int, cmap: ClientShardMap,
+                 registry: _ShardRegistry):
+        self.name = name
+        self.dim = int(dim)
+        self._cmap = cmap
+        self._reg = registry
+        self._rr = itertools.count()
+
+    def _remote(self, m: ShardMap, shard: int) -> "_RemoteTable":
+        return self._reg.table(m.shards[shard], self.name, self.dim)
 
     def get(self, ids) -> np.ndarray:
-        ids = np.asarray(ids, np.int64)
+        ids = np.ascontiguousarray(np.asarray(ids, np.int64).ravel())
         out = np.empty((ids.size, self.dim), np.float32)
+        pending = np.arange(ids.size, dtype=np.intp)
+        force_home = np.zeros(ids.size, bool)
+        delay = _FENCE_BACKOFF_SECS
+        for _attempt in range(_RESHARD_ATTEMPTS):
+            m = self._cmap.get()
+            sub = ids[pending]
+            home = m.home_of_ids(sub)
+            target = home.copy()
+            via_replica = np.zeros(pending.size, bool)
+            per = m.replicas.get(self.name)
+            if per:
+                rr = next(self._rr)
+                for k in range(pending.size):
+                    if force_home[pending[k]]:
+                        continue
+                    reps = per.get(int(sub[k]))
+                    if reps:
+                        cands = (int(home[k]),) + tuple(
+                            s for s in reps if s != home[k]
+                        )
+                        pick = cands[rr % len(cands)]
+                        if pick != home[k]:
+                            target[k] = pick
+                            via_replica[k] = True
+            outcome = {"map": None, "unresolved": [], "refresh": None}
+            olock = threading.Lock()
+            jobs = []
+            for s in sorted(set(target.tolist())):
+                for is_rep in (False, True):
+                    mask = (target == s) & (via_replica == is_rep)
+                    if mask.any():
+                        jobs.append(self._pull_job(
+                            m, int(s), is_rep, pending[mask], ids,
+                            out, outcome, olock, force_home,
+                        ))
+            _run_jobs(
+                self._reg.pool if len(jobs) > 1 else None, jobs
+            )
+            if outcome["refresh"] is not None:
+                # A shard piggybacked a NEWER epoch than ours without
+                # redirecting (replica-only change): fetch it so the
+                # next pulls route through the new replica sets.
+                try:
+                    fresh = outcome["refresh"].fetch_map()
+                    if fresh:
+                        self._cmap.update(fresh)
+                except RpcError:
+                    pass  # opportunistic; next pull retries
+            if outcome["map"] is not None:
+                progressed = self._cmap.update(outcome["map"])
+            else:
+                progressed = bool(
+                    force_home[np.asarray(outcome["unresolved"],
+                                          np.intp)].any()
+                ) if outcome["unresolved"] else False
+            if not outcome["unresolved"]:
+                return out
+            pending = np.asarray(sorted(outcome["unresolved"]),
+                                 np.intp)
+            if not progressed:
+                # No newer map and no replica fallback to try: wait
+                # out whatever transition the server is mid-way
+                # through before re-asking.
+                time.sleep(delay)
+                delay = min(delay * 2, 4.0)
+        raise RuntimeError(
+            f"row pulls for table {self.name!r} kept redirecting "
+            f"after {_RESHARD_ATTEMPTS} attempts (shard-map churn?)"
+        )
 
-        def pull(s, mask):
-            # Disjoint row slices: concurrent writes never overlap.
-            out[mask] = self._shards[s].get(ids[mask])
-
-        _scatter_by_home(self._pool, len(self._shards), ids, pull)
-        return out
+    def _pull_job(self, m, shard, is_replica, positions, ids, out,
+                  outcome, olock, force_home):
+        def job():
+            remote = self._remote(m, shard)
+            try:
+                if is_replica:
+                    resp = remote.pull_replica(ids[positions])
+                    found = np.asarray(resp["found"], bool)
+                    rows = np.asarray(resp["rows"], np.float32)
+                    out[positions[found]] = rows[found]
+                    miss = positions[~found]
+                    if miss.size:
+                        with olock:
+                            outcome["unresolved"].extend(
+                                miss.tolist()
+                            )
+                            force_home[miss] = True
+                else:
+                    out[positions] = remote.get(ids[positions])
+                    if remote.last_map_version > m.version:
+                        with olock:
+                            outcome["refresh"] = remote
+            except ReshardRedirect as exc:
+                with olock:
+                    cur = outcome["map"]
+                    if cur is None or (
+                        exc.map_json
+                        and exc.map_json["version"] > cur["version"]
+                    ):
+                        outcome["map"] = exc.map_json
+                    outcome["unresolved"].extend(positions.tolist())
+            except RpcError:
+                if not is_replica:
+                    raise
+                # A dead replica must not fail the read — fall back
+                # to the authoritative home.
+                with olock:
+                    outcome["unresolved"].extend(positions.tolist())
+                    force_home[positions] = True
+        return job
 
     def pull_version(self) -> int:
-        """Sum of the shards' counters: any shard applying a push
-        changes the sum, and counters only grow per-process, so an
-        unchanged sum means no shard changed. (A shard RESTART resets
-        its counter and can lower the sum — still a change unless every
-        other shard's growth exactly cancels it, which the cache's
-        != comparison treats identically to growth anyway.)"""
-        return sum(s.pull_version() for s in self._shards)
+        """Sum of the fleet's counters under the current map: any
+        shard applying a push changes the sum, and counters only grow
+        per-process, so an unchanged sum means no shard changed. (A
+        shard RESTART resets its counter and can lower the sum —
+        still a change unless every other shard's growth exactly
+        cancels it, which the cache's != comparison treats identically
+        to growth anyway.)"""
+        m = self._cmap.get()
+        return sum(
+            self._remote(m, s).pull_version()
+            for s in range(len(m.shards))
+        )
 
     @property
     def last_applied_at(self) -> float:
@@ -821,66 +1896,145 @@ class _ShardedTable:
         healthy shards mask one whose push pipeline stalled, which is
         exactly the regime the freshness SLO exists to catch; shards
         that never saw a push (stamp 0) are excluded rather than
-        pinning the metric to 'never'."""
+        pinning the metric to 'never'. Replica reads feed the same
+        stamps (their copies carry the home's applied-at)."""
         stamps = [
-            s.last_applied_at for s in self._shards
-            if s.last_applied_at > 0
+            t.last_applied_at
+            for t in self._reg.tables_named(self.name)
+            if t.last_applied_at > 0
         ]
         return min(stamps) if stamps else 0.0
 
     def export_dense(self, vocab: int, chunk: int = 65536) -> np.ndarray:
-        """Each shard exports ONLY its owned rows (strided
-        ``export_range``: ids ≡ s mod N), interleaved client-side — the
-        total transfer is one table, not N (untrained rows fall back to
-        the home shard's deterministic lazy init)."""
-        n = len(self._shards)
+        """Each shard exports ONLY the ids it owns under the current
+        map (explicit-id ``export_rows``), merged client-side — the
+        total transfer is one table, not N (untrained ids fall back to
+        the home shard's deterministic lazy init). Redirects retry
+        like pulls, so an export racing a cutover stays correct."""
         parts = []
         for lo in range(0, int(vocab), chunk):
-            hi = min(lo + chunk, vocab)
-            out = np.empty((hi - lo, self.dim), np.float32)
-
-            def fill(s, lo=lo, hi=hi, out=out):
-                offset = (s - lo) % n
-                rows = self._shards[s].export_range(
-                    lo, hi, stride=n, offset=offset
+            want = np.arange(lo, min(lo + chunk, int(vocab)),
+                             dtype=np.int64)
+            out = np.empty((want.size, self.dim), np.float32)
+            pending = np.arange(want.size, dtype=np.intp)
+            for _attempt in range(_RESHARD_ATTEMPTS):
+                m = self._cmap.get()
+                home = m.home_of_ids(want[pending])
+                outcome = {"map": None, "unresolved": []}
+                olock = threading.Lock()
+                jobs = [
+                    self._export_job(m, int(s), pending[home == s],
+                                     want, out, outcome, olock)
+                    for s in sorted(set(home.tolist()))
+                ]
+                _run_jobs(
+                    self._reg.pool if len(jobs) > 1 else None, jobs
                 )
-                out[np.arange(lo + offset, hi, n) - lo] = rows
-
-            futures = [
-                self._pool.submit(fill, s)
-                for s in range(n) if lo + (s - lo) % n < hi
-            ]
-            for f in futures:
-                f.result()
+                if outcome["map"] is not None:
+                    self._cmap.update(outcome["map"])
+                if not outcome["unresolved"]:
+                    break
+                pending = np.asarray(sorted(outcome["unresolved"]),
+                                     np.intp)
+            else:
+                raise RuntimeError(
+                    f"export for table {self.name!r} kept redirecting"
+                )
             parts.append(out)
         return np.concatenate(parts, axis=0)
 
+    def _export_job(self, m, shard, positions, want, out, outcome,
+                    olock):
+        def job():
+            try:
+                out[positions] = self._remote(m, shard).export_ids(
+                    want[positions]
+                )
+            except ReshardRedirect as exc:
+                with olock:
+                    cur = outcome["map"]
+                    if cur is None or (
+                        exc.map_json
+                        and exc.map_json["version"] > cur["version"]
+                    ):
+                        outcome["map"] = exc.map_json
+                    outcome["unresolved"].extend(positions.tolist())
+        return job
+
 
 class _ShardedOptimizer:
-    """Push scatter over N shards (reference ``worker.py:570-580``):
-    each shard receives only the row grads it owns, applied by its own
-    ``_RemoteOptimizer`` (whose per-thread (client, seq) streams keep
-    the exactly-once dedup intact per shard)."""
+    """Push scatter over the fleet, routed through the same shared
+    map: each shard receives only the row grads it HOMES (writes are
+    never fanned to replicas — single-home writes keep the exactly-
+    once dedup and the replica-refresh fan-out trivially correct).
+    Each sub-push either fully applies or fully rejects (the server
+    checks ownership/fences before touching anything), so a redirect
+    retries only its own ids under the newer map — no double-apply."""
 
     concurrent_safe = True
 
-    def __init__(self, optimizers, pool):
-        self._optimizers = list(optimizers)
-        self._pool = pool
+    def __init__(self, cmap: ClientShardMap, registry: _ShardRegistry):
+        self._cmap = cmap
+        self._reg = registry
 
     def apply_gradients(self, table, ids, grads):
-        ids = np.asarray(ids, np.int64)
+        ids = np.ascontiguousarray(np.asarray(ids, np.int64).ravel())
         grads = np.asarray(grads, np.float32)
-
-        def push(s, mask):
-            self._optimizers[s].apply_gradients(
-                table, ids[mask], grads[mask]
+        pending = np.arange(ids.size, dtype=np.intp)
+        delay = _FENCE_BACKOFF_SECS
+        for _attempt in range(_RESHARD_ATTEMPTS):
+            m = self._cmap.get()
+            home = m.home_of_ids(ids[pending])
+            outcome = {"map": None, "fenced": False, "unresolved": []}
+            olock = threading.Lock()
+            jobs = [
+                self._push_job(m, int(s), table, ids, grads,
+                               pending[home == s], outcome, olock)
+                for s in sorted(set(home.tolist()))
+            ]
+            _run_jobs(
+                self._reg.pool if len(jobs) > 1 else None, jobs
             )
-
-        _scatter_by_home(
-            self._pool, len(self._optimizers), ids, push
+            if outcome["map"] is not None:
+                progressed = self._cmap.update(outcome["map"])
+            else:
+                progressed = False
+            if not outcome["unresolved"]:
+                return table
+            pending = np.asarray(sorted(outcome["unresolved"]),
+                                 np.intp)
+            if outcome["fenced"] or not progressed:
+                # Fence windows are short (final migration delta →
+                # cutover); ride them out with bounded backoff.
+                time.sleep(delay)
+                delay = min(delay * 2, 4.0)
+        raise RuntimeError(
+            "row pushes kept redirecting/fenced after "
+            f"{_RESHARD_ATTEMPTS} attempts (shard-map churn?)"
         )
-        return table
+
+    def _push_job(self, m, shard, table, ids, grads, positions,
+                  outcome, olock):
+        def job():
+            opt = self._reg.optimizer(m.shards[shard])
+            try:
+                opt.apply_gradients(
+                    table, ids[positions], grads[positions]
+                )
+            except ReshardRedirect as exc:
+                with olock:
+                    cur = outcome["map"]
+                    if cur is None or (
+                        exc.map_json
+                        and exc.map_json["version"] > cur["version"]
+                    ):
+                        outcome["map"] = exc.map_json
+                    outcome["unresolved"].extend(positions.tolist())
+            except ReshardFenced:
+                with olock:
+                    outcome["fenced"] = True
+                    outcome["unresolved"].extend(positions.tolist())
+        return job
 
 
 def make_remote_engine(
@@ -890,24 +2044,24 @@ def make_remote_engine(
 ) -> HostEmbeddingEngine:
     """Client-side engine over running `HostRowService` shard(s).
 
-    ``addr`` is one address or a comma list of N shard addresses —
-    the reference's N parameter servers (``--ps_pods``); rows scatter
-    by ``id % N`` client-side (``_ShardedTable``/``_ShardedOptimizer``)
-    and each shard process runs the UNCHANGED single-server
-    ``HostRowService`` (its lazy tables only ever materialize the rows
-    hashed to it). Table names and dims come from the services
-    themselves (verified consistent across shards); pulls/pushes retry
-    with bounded backoff across a shard relaunch. The default budget
-    (0.5s doubling, capped 30s, 12 retries ≈ 4 minutes) spans a real
-    pod relaunch — scheduling + image pull + checkpoint restore — like
-    the reference workers' 3x300s channel waits."""
+    ``addr`` is one address or a comma list — the BOOTSTRAP fleet.
+    Routing goes through a versioned ``ShardMap``
+    (embedding/shard_map.py): the engine adopts the newest map any
+    listed shard has installed (a resharded fleet), or builds the
+    bootstrap map over the listed addresses (static topology — the
+    servers then never redirect and behavior matches the classic
+    N-shard deployment). The topology can change UNDER a live engine:
+    a split/merge cutover surfaces as a retryable REDIRECT carrying
+    the newer map, and shard addresses the engine was never configured
+    with materialize lazily in its registry. Pulls/pushes retry with
+    bounded backoff across a shard relaunch; the default budget (0.5s
+    doubling, capped 30s, 12 retries ≈ 4 minutes) spans a real pod
+    relaunch like the reference workers' 3x300s channel waits."""
     addrs = [a.strip() for a in addr.split(",") if a.strip()]
     if not addrs:
         raise ValueError("empty row-service address")
-    # max_retries=0: _call_with_retry owns the (much longer) retry
-    # budget here — stacking the stub's own backoff under it would
-    # multiply attempts.
-    stubs = [RpcStub(a, SERVICE_NAME, max_retries=0) for a in addrs]
+    registry = _ShardRegistry(retries, backoff_secs)
+    stubs = [registry.stub(a) for a in addrs]
     infos = [
         _call_with_retry(stub, "table_info", retries, backoff_secs)[
             "tables"
@@ -922,54 +2076,55 @@ def make_remote_engine(
                 f"({sorted(infos[0])}); all shards must run the same "
                 "model module"
             )
-    if len(addrs) == 1:
-        stub = stubs[0]
-        tables = {
-            name: _RemoteTable(
-                stub, name, meta["dim"], retries, backoff_secs
+    best = None
+    for stub in stubs:
+        try:
+            resp = _call_with_retry(
+                stub, "get_shard_map", retries, backoff_secs
             )
-            for name, meta in infos[0].items()
-        }
-        optimizer = _RemoteOptimizer(stub, retries, backoff_secs)
-    else:
-        from concurrent.futures import ThreadPoolExecutor
-
-        pool = ThreadPoolExecutor(
-            max_workers=2 * len(addrs),
-            thread_name_prefix="row-shard",
-        )
-        tables = {
-            name: _ShardedTable(
-                [
-                    _RemoteTable(
-                        stub, name, meta["dim"], retries, backoff_secs
-                    )
-                    for stub in stubs
-                ],
-                pool,
-            )
-            for name, meta in infos[0].items()
-        }
-        optimizer = _ShardedOptimizer(
-            [_RemoteOptimizer(s, retries, backoff_secs) for s in stubs],
-            pool,
-        )
+        except RpcError:
+            continue
+        map_json = resp.get("map")
+        if map_json and (
+            best is None or map_json["version"] > best["version"]
+        ):
+            best = map_json
+    cmap = ClientShardMap(
+        ShardMap.from_json(best) if best is not None
+        else ShardMap.bootstrap(addrs)
+    )
+    tables = {
+        name: _ShardedTable(name, meta["dim"], cmap, registry)
+        for name, meta in infos[0].items()
+    }
+    optimizer = _ShardedOptimizer(cmap, registry)
     engine = HostEmbeddingEngine(
         tables, optimizer, id_keys=id_keys, table_fanout=table_fanout
     )
     engine.remote = True  # server owns checkpointing (see HostStepRunner)
+    engine.shard_map = cmap  # routing-epoch introspection (tests)
     return engine
+
+
+# Placement scheme recorded in shard_layout.json: bucket-range shard
+# maps (embedding/shard_map.py). Markers without the field predate the
+# map (the id%N era) — multi-shard checkpoints from that era cannot be
+# restored under map routing without an offline repartition.
+PLACEMENT_SCHEME = "bucket-range-v1"
 
 
 def validate_shard_layout(checkpoint_dir: str, shard: int,
                           num_shards: int):
-    """Refuse to restore a checkpoint written under a DIFFERENT shard
-    layout: rows live by id % N client-side, so restoring an N-shard
-    checkpoint into an M-shard job would silently re-lazy-init every
-    row whose home moved (trained embeddings reset with no error). A
-    ``shard_layout.json`` marker records the layout; a checkpoint dir
-    holding versions but no marker is treated as num_shards=1 (the
-    pre-shard layout)."""
+    """Refuse to restore a checkpoint written under a DIFFERENT static
+    shard layout or placement scheme: restoring rows onto a shard that
+    no longer homes them would silently re-lazy-init every moved row
+    (trained embeddings reset with no error). A ``shard_layout.json``
+    marker records the layout + placement; a checkpoint dir holding
+    versions but no marker is treated as num_shards=1 (the pre-shard
+    layout, placement-compatible by construction). LIVE topology
+    changes are exempt — they move bytes before flipping the map and
+    the map rides the checkpoint meta; this guard is for the static
+    ``--num_shards`` config changing across a relaunch."""
     import json
     import os
 
@@ -987,21 +2142,34 @@ def validate_shard_layout(checkpoint_dir: str, shard: int,
         if not has_versions:
             os.makedirs(checkpoint_dir, exist_ok=True)
             with open(marker, "w") as fh:
-                json.dump({"shard": shard, "num_shards": num_shards}, fh)
+                json.dump({"shard": shard, "num_shards": num_shards,
+                           "placement": PLACEMENT_SCHEME}, fh)
             return
         recorded = {"shard": 0, "num_shards": 1}  # pre-shard layout
+    recorded_placement = recorded.get(
+        "placement",
+        # Single-shard layouts are identical under every scheme (one
+        # shard owns everything); multi-shard markers without the
+        # field are id%N-era placements.
+        PLACEMENT_SCHEME if int(recorded.get("num_shards", 1)) == 1
+        else "id-mod-n",
+    )
     if (
         int(recorded.get("num_shards", 1)) != num_shards
         or int(recorded.get("shard", 0)) != shard
+        or recorded_placement != PLACEMENT_SCHEME
     ):
         raise SystemExit(
             f"checkpoint {checkpoint_dir} was written as shard "
             f"{recorded.get('shard', 0)}/{recorded.get('num_shards', 1)}"
-            f" but this process is shard {shard}/{num_shards}; "
-            "changing --num_row_service_shards across a restore would "
-            "silently lose the rows whose id%N home moved. Start a "
-            "fresh checkpoint dir (or repartition offline via "
-            "checkpoint.saver, which uses the same id%N placement)."
+            f" (placement {recorded_placement}) but this process is "
+            f"shard {shard}/{num_shards} (placement "
+            f"{PLACEMENT_SCHEME}); changing the static shard layout "
+            "across a restore would silently lose the rows whose home "
+            "moved. Start a fresh checkpoint dir (or repartition "
+            "offline via checkpoint.saver), or grow the fleet LIVE "
+            "through the shard-map controller instead "
+            "(master/row_reshard.py)."
         )
 
 

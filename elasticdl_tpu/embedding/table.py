@@ -128,6 +128,20 @@ class EmbeddingTable:
             [int(i) in self.vectors for i in ids], bool
         )
 
+    def all_ids(self) -> np.ndarray:
+        """Every materialized id, sorted — enumeration without row
+        bytes (live-migration range scans, shard-map erase sweeps)."""
+        return np.array(sorted(self.vectors), np.int64)
+
+    def peek(self, ids) -> np.ndarray:
+        """Read EXISTING rows without materializing or dirtying —
+        what a live migration streams (absent ids raise KeyError: the
+        caller enumerated them, so absence is a logic error)."""
+        out = np.empty((len(list(ids)), self.dim), self.dtype)
+        for i, row_id in enumerate(ids):
+            out[i] = self.vectors[int(row_id)]
+        return out
+
     @property
     def num_rows(self) -> int:
         return len(self.vectors)

@@ -66,9 +66,13 @@ VERSION = "version"
 SNAPSHOT = "snapshot"
 GENERATION = "generation"
 RESIZE = "resize"
+# Row-plane shard-map epochs (master/row_reshard.py): audit + recovery
+# aid riding the same journal. The controller's state file is the
+# authoritative copy — compaction may drop old epoch records.
+SHARD_MAP = "shard_map"
 
 KNOWN_TYPES = (DISPATCH, REPORT, CREATE_TASKS, VERSION, SNAPSHOT,
-               GENERATION, RESIZE)
+               GENERATION, RESIZE, SHARD_MAP)
 
 _HEADER = struct.Struct("<II")  # payload length, crc32(payload)
 
@@ -155,6 +159,11 @@ def validate_record(record: dict) -> Optional[str]:
             return "resize: spec is not a dict"
         if not isinstance(record.get("done"), bool):
             return "resize: non-bool done"
+    elif rtype == SHARD_MAP:
+        if not isinstance(record.get("version"), int):
+            return "shard_map: non-int version"
+        if not isinstance(record.get("map"), dict):
+            return "shard_map: map is not a dict"
     elif rtype == SNAPSHOT:
         state = record.get("state")
         if not isinstance(state, dict):
@@ -415,6 +424,7 @@ class MasterJournal:
             )
             replayed += 1
             start = snap_idx + 1
+        shard_map = None
         for record in records[:start]:
             # Pre-snapshot records still carry fencing/worker facts the
             # snapshot state does not (generation high-water mark).
@@ -423,10 +433,18 @@ class MasterJournal:
             elif record["t"] == VERSION:
                 model_version = max(model_version,
                                     record["model_version"])
+            elif record["t"] == SHARD_MAP:
+                shard_map = record["map"]
         for record in records[start:]:
             rtype = record["t"]
             if rtype == GENERATION:
                 generation = max(generation, record["generation"])
+                continue
+            if rtype == SHARD_MAP:
+                # Newest epoch wins (versions are monotonic by
+                # construction — the authority is the only writer).
+                shard_map = record["map"]
+                replayed += 1
                 continue
             if rtype == VERSION:
                 model_version = max(model_version, record["model_version"])
@@ -489,6 +507,7 @@ class MasterJournal:
             "generation": generation,
             "known_workers": sorted(known_workers),
             "resize": pending_resize,
+            "shard_map": shard_map,
         }
 
 

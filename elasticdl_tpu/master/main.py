@@ -12,6 +12,7 @@ conflicts) — the same assembly the reference exercises via
 ``distributed_train_and_evaluate``.
 """
 
+import os
 import sys
 import time
 
@@ -236,6 +237,7 @@ class Master:
         self._server = None
         self.instance_manager = None
         self.autoscaler = None
+        self.row_reshard = None
         self._k8s_client = k8s_client
         # SIGTERM grace path (main() installs the handler): the run
         # loop exits at the next poll tick and stop() tears the job
@@ -468,6 +470,64 @@ class Master:
                 self.instance_manager.start_workers()
         if getattr(self._args, "autoscale", False):
             self._build_autoscaler()
+        if getattr(self._args, "row_reshard", False):
+            self._build_row_reshard()
+
+    def _build_row_reshard(self):
+        """Row-plane elasticity (master/row_reshard.py): the master
+        hosts the shard-map authority over the --row_service_addr
+        fleet and ticks its policy next to the autoscaler — live
+        range rebalancing off per-shard load plus hot-row replica
+        designation off the shards' pull-frequency top-K."""
+        from elasticdl_tpu.master.row_reshard import (
+            ReshardPolicy,
+            ShardMapController,
+        )
+
+        args = self._args
+        addrs = [
+            a.strip()
+            for a in getattr(args, "row_service_addr", "").split(",")
+            if a.strip()
+        ]
+        if not addrs:
+            logger.warning(
+                "--row_reshard needs --row_service_addr; controller "
+                "disabled"
+            )
+            return
+        state_path = getattr(args, "row_reshard_state", "")
+        if not state_path:
+            journal_dir = getattr(args, "journal_dir", "")
+            if not journal_dir:
+                logger.warning(
+                    "--row_reshard needs --row_reshard_state (or a "
+                    "--journal_dir to default into); controller "
+                    "disabled"
+                )
+                return
+            state_path = os.path.join(journal_dir, "shard_map.json")
+        self.row_reshard = ShardMapController(
+            state_path,
+            journal=self._journal,
+            policy=ReshardPolicy(
+                replica_top_k=int(
+                    getattr(args, "row_replica_top_k", 64)
+                ),
+                replica_count=int(
+                    getattr(args, "row_replica_count", 2)
+                ),
+                cooldown_secs=float(
+                    getattr(args, "row_reshard_cooldown_secs", 30.0)
+                ),
+            ),
+        )
+        if self.row_reshard.map is None:
+            self.row_reshard.bootstrap(addrs)
+        else:
+            # Restarted authority: finish any in-flight migration and
+            # re-distribute the persisted epoch.
+            self.row_reshard.resume()
 
     def _build_autoscaler(self):
         """Closed-loop autoscaling (master/autoscaler.py): pod scaling
@@ -594,6 +654,12 @@ class Master:
                     self.servicer.maybe_complete_resize(live)
                 if self.autoscaler is not None:
                     self.autoscaler.tick()
+                if self.row_reshard is not None:
+                    # Row-plane elasticity: rebalance ranges / refresh
+                    # hot-row replicas (tick() contains its own
+                    # failures — a flaky shard must not kill the run
+                    # loop).
+                    self.row_reshard.tick()
                 # SLO plane: sample the time-series store (if due) and
                 # evaluate the rules on the fresh window.
                 self.metrics_plane.slo_tick()
@@ -611,6 +677,8 @@ class Master:
         return 0
 
     def stop(self):
+        if self.row_reshard is not None:
+            self.row_reshard.close()
         self.metrics_plane.stop()
         self.evaluation_service.stop()
         if self.instance_manager is not None:

@@ -624,6 +624,34 @@ class TieredTable:
             hot = np.array([int(i) in self._hot for i in ids], bool)
             return hot | self._cold.contains(ids)
 
+    def all_ids(self) -> np.ndarray:
+        """Every row id across BOTH tiers, sorted, without reading a
+        single row byte (membership sets + the cold index) — the
+        enumeration live migrations range-scan over."""
+        with self._group.lock:
+            return np.array(
+                sorted(self._hot | set(self._cold.live_ids().tolist())),
+                np.int64,
+            )
+
+    def peek(self, ids) -> np.ndarray:
+        """Read EXISTING rows with NO tier side effects: hot rows from
+        the arena (no recency touch), cold rows straight from segment
+        reads (no promotion, no budget pressure). A live migration
+        streaming a mostly-cold range must not churn the working set
+        through the hot tier (docs/sparse_path.md)."""
+        ids = np.ascontiguousarray(np.asarray(ids, np.int64).ravel())
+        with self._group.lock:
+            hot_mask = np.array(
+                [int(i) in self._hot for i in ids], bool
+            )
+            rows = np.empty((ids.size, self.dim), np.float32)
+            if hot_mask.any():
+                rows[hot_mask] = self._inner.get(ids[hot_mask])
+            if (~hot_mask).any():
+                rows[~hot_mask] = self._cold.get_rows(ids[~hot_mask])
+            return rows
+
     @property
     def num_rows(self) -> int:
         with self._group.lock:

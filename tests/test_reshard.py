@@ -1,0 +1,626 @@
+"""Live row-service resharding + hot-row replication (PR 12).
+
+Shard-map algebra, REDIRECT convergence, the generation-fenced
+migration protocol, replica staleness, tiered-table migration without
+hot-budget churn, the authority's crash-safety artifacts
+(tools/check_reshard.py), and the reshard chaos drill's fast lane.
+docs/sparse_path.md "Live resharding & hot-row replication".
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from elasticdl_tpu.embedding.optimizer import (
+    SGD,
+    Adam,
+    HostOptimizerWrapper,
+)
+from elasticdl_tpu.embedding.row_service import (
+    DirectTransport,
+    HostRowService,
+    make_remote_engine,
+)
+from elasticdl_tpu.embedding.shard_map import (
+    NUM_BUCKETS,
+    ClientShardMap,
+    ShardMap,
+    ShardMapError,
+    bucket_of,
+)
+from elasticdl_tpu.embedding.table import EmbeddingTable
+from elasticdl_tpu.master.row_reshard import (
+    ReshardPolicy,
+    ShardMapController,
+)
+
+DIM = 8
+
+
+# ---- shard-map algebra -------------------------------------------------
+
+
+def test_bootstrap_covers_bucket_space():
+    m = ShardMap.bootstrap(["a", "b", "c"])
+    assert m.version == 1
+    covered = sum(hi - lo for lo, hi, _s in m.ranges)
+    assert covered == NUM_BUCKETS
+    # Vectorized owner lookup agrees with the ranges.
+    for lo, hi, s in m.ranges:
+        assert (m.owner_table[lo:hi] == s).all()
+    # Dense ids spread across all shards.
+    homes = set(m.home_of_ids(np.arange(0, 30000, 17)).tolist())
+    assert homes == {0, 1, 2}
+
+
+def test_move_range_and_split_plan_algebra():
+    m = ShardMap.bootstrap(["a", "b"])
+    lo, hi = m.split_plan(0)
+    m2 = m.add_shard("c")
+    assert m2.version == 2 and m2.buckets_owned(2) == 0
+    m3 = m2.move_range(lo, hi, 2)
+    assert m3.version == 3
+    assert m3.buckets_owned(0) + (hi - lo) == m.buckets_owned(0)
+    assert m3.buckets_owned(2) == hi - lo
+    # Still disjoint + covering (validate runs in the constructor).
+    assert sum(h - l for l, h, _ in m3.ranges) == NUM_BUCKETS
+    # Merge: drain shard 2 back into 0.
+    m4 = m3.move_shard(2, 0)
+    assert m4.buckets_owned(2) == 0
+    assert m4.buckets_owned(0) == m.buckets_owned(0)
+
+
+def test_map_validation_rejects_bad_shapes():
+    with pytest.raises(ShardMapError):
+        ShardMap(1, ["a"], [(0, NUM_BUCKETS - 1, 0)])  # gap at end
+    with pytest.raises(ShardMapError):
+        ShardMap(1, ["a"], [(0, NUM_BUCKETS, 1)])  # unknown shard
+    with pytest.raises(ShardMapError):
+        ShardMap(0, ["a"], [(0, NUM_BUCKETS, 0)])  # version < 1
+    m = ShardMap.bootstrap(["a", "b"])
+    with pytest.raises(ShardMapError):
+        m.move_range(10, 10, 1)  # empty range
+    with pytest.raises(ShardMapError):
+        m.add_shard("a")  # duplicate address
+
+
+def test_serialization_roundtrip_and_client_map_monotonic():
+    m = ShardMap.bootstrap(["a", "b"]).with_replicas(
+        {"items": {7: (1,), 11: (0, 1)}}
+    )
+    again = ShardMap.from_json(
+        json.loads(json.dumps(m.to_json()))
+    )
+    assert again == m
+    assert again.replica_targets("items", 7) == (1,)
+    cmap = ClientShardMap(m)
+    older = ShardMap.bootstrap(["a", "b"])
+    assert not cmap.update(older.to_json())  # stale: rejected
+    newer = m.move_range(0, 8, 1)
+    assert cmap.update(newer.to_json())
+    assert cmap.version == newer.version
+
+
+# ---- fixtures ----------------------------------------------------------
+
+
+def _start_shard(opt=None, **kwargs):
+    svc = HostRowService(
+        {"items": EmbeddingTable("items", DIM)},
+        HostOptimizerWrapper(opt or SGD(lr=0.5)), **kwargs,
+    )
+    return svc.start()
+
+
+def _fleet(n, tmp_path, policy=None, direct=True):
+    shards = [_start_shard() for _ in range(n)]
+    addrs = [f"localhost:{s.port}" for s in shards]
+    by_addr = dict(zip(addrs, shards))
+    factory = (
+        (lambda a: DirectTransport(by_addr[a])) if direct else None
+    )
+    if direct:
+        for s in shards:
+            s.transport_factory = factory
+    ctrl = ShardMapController(
+        str(tmp_path / "shard_map.json"),
+        transport_factory=factory, policy=policy,
+    )
+    ctrl.bootstrap(addrs)
+    return shards, addrs, by_addr, ctrl
+
+
+def _stop(shards):
+    for s in shards:
+        s.stop(0)
+
+
+def _spread_ids(n, seed=5):
+    rng = np.random.RandomState(seed)
+    return np.unique(rng.randint(0, 1_000_000, n).astype(np.int64))
+
+
+# ---- REDIRECT convergence (the satellite's mid-stream bump) ------------
+
+
+def test_map_version_bump_mid_stream_retries_cleanly(tmp_path):
+    """A client routing under epoch v is NOT told about a cutover; its
+    next pulls/pushes to the old home get REDIRECTed and must land on
+    the new home without loss or double-apply — never silently pull
+    from the wrong shard (the old client-side id%N failure mode)."""
+    shards, addrs, by_addr, ctrl = _fleet(2, tmp_path)
+    engine = make_remote_engine(
+        ",".join(addrs), id_keys={"items": "ids"},
+        retries=2, backoff_secs=0.1,
+    )
+    table = engine.tables["items"]
+    ids = _spread_ids(64)
+    before = table.get(ids)
+    assert engine.shard_map.version == 1
+
+    # Live split onto a third shard while the client still holds v1.
+    target = _start_shard()
+    by_addr[f"localhost:{target.port}"] = target
+    target.transport_factory = shards[0].transport_factory
+    ctrl.split(0, new_addr=f"localhost:{target.port}")
+    shards.append(target)
+    assert ctrl.map.version > 1
+
+    # Pull mid-stream: values identical, epoch adopted via REDIRECT.
+    np.testing.assert_array_equal(table.get(ids), before)
+    assert engine.shard_map.version == ctrl.map.version
+
+    # Push after another unannounced change: single application.
+    grads = np.ones((ids.size, DIM), np.float32)
+    engine.optimizer.apply_gradients(table, ids, grads)
+    after = table.get(ids)
+    np.testing.assert_allclose(after, before - 0.5 * grads, rtol=1e-6)
+    # Single-homed: each id materialized on exactly its map home.
+    m = ctrl.map
+    for i in ids.tolist():
+        homes = [
+            k for k, svc in enumerate(shards)
+            if bool(svc._tables["items"].contains([i])[0])
+        ]
+        assert homes == [int(m.home_of_ids([i])[0])]
+    _stop(shards)
+
+
+def test_migration_moves_optimizer_slots_in_lockstep(tmp_path):
+    """Adam: a migrated row's m/v slot bytes land on the target
+    EXACTLY as the source held them (and leave the source) —
+    optimizer state moves with its rows, it is never reset to the
+    lazy slot init."""
+    shards = [_start_shard(opt=Adam(lr=0.05)) for _ in range(2)]
+    addrs = [f"localhost:{s.port}" for s in shards]
+    by_addr = dict(zip(addrs, shards))
+    for s in shards:
+        s.transport_factory = lambda a: DirectTransport(by_addr[a])
+    ctrl = ShardMapController(
+        str(tmp_path / "m2.json"),
+        transport_factory=lambda a: DirectTransport(by_addr[a]),
+    )
+    ctrl.bootstrap(addrs)
+    engine = make_remote_engine(
+        ",".join(addrs), id_keys={"items": "ids"},
+        retries=2, backoff_secs=0.1,
+    )
+    table = engine.tables["items"]
+    ids = _spread_ids(48, seed=9)
+    rng = np.random.RandomState(3)
+    for _seq in range(3):
+        grads = rng.rand(ids.size, DIM).astype(np.float32)
+        engine.optimizer.apply_gradients(table, ids, grads)
+
+    # Source slot bytes for the range about to move.
+    plan_lo, plan_hi = ctrl.map.split_plan(0)
+    b = bucket_of(ids)
+    moved = ids[(b >= plan_lo) & (b < plan_hi)
+                & (ctrl.map.home_of_ids(ids) == 0)]
+    assert moved.size > 0
+    src_slots = {
+        name: np.asarray(view.get(moved.tolist()))
+        for name, view in shards[0].host_tables.items()
+        if name.startswith("items-")
+    }
+    assert src_slots  # Adam has m/v slots
+    # Slots hold real optimizer state, not the lazy init.
+    assert any(np.abs(v).sum() > 0 for v in src_slots.values())
+
+    target = _start_shard(opt=Adam(lr=0.05))
+    by_addr[f"localhost:{target.port}"] = target
+    target.transport_factory = shards[0].transport_factory
+    ctrl.split(0, new_addr=f"localhost:{target.port}")
+    shards.append(target)
+    assert ctrl.map.home_of_ids(moved).tolist() == [2] * moved.size
+    for name, want in src_slots.items():
+        got = np.asarray(
+            target.host_tables[name].get(moved.tolist())
+        )
+        np.testing.assert_array_equal(got, want)
+        # Lockstep erase: the source's slot rows left with the
+        # primary rows.
+        assert not shards[0]._optimizer._slot_tables[name].contains(
+            moved
+        ).any()
+    # Per-table apply counts migrate too (max-adopted): the target's
+    # first post-cutover Adam apply must not bias-correct migrated
+    # state as if it were step 1.
+    assert target._optimizer._steps.get("items") == (
+        shards[0]._optimizer._steps.get("items")
+    )
+    _stop(shards)
+
+
+def test_fenced_pushes_retry_and_apply_exactly_once(tmp_path):
+    """A push landing in the write-fence window between the final
+    migration delta and the cutover must be rejected-without-apply and
+    succeed on retry — one application total."""
+    from elasticdl_tpu.embedding import row_service as rs
+
+    shards, addrs, by_addr, ctrl = _fleet(2, tmp_path)
+    engine = make_remote_engine(
+        ",".join(addrs), id_keys={"items": "ids"},
+        retries=2, backoff_secs=0.1,
+    )
+    table = engine.tables["items"]
+    ids = _spread_ids(32, seed=13)
+    before = table.get(ids)
+    pushed = {"n": 0}
+    import threading
+
+    def racing_push(_svc, _mig, _view, _chunk):
+        # Runs inside migrate_out: fire one concurrent push so the
+        # catch-up/fence path sees live writes.
+        if pushed["n"] == 0:
+            pushed["n"] = 1
+
+            def go():
+                engine.optimizer.apply_gradients(
+                    table, ids,
+                    np.ones((ids.size, DIM), np.float32),
+                )
+
+            t = threading.Thread(target=go, daemon=True)
+            t.start()
+            pushed["thread"] = t
+
+    target = _start_shard()
+    by_addr[f"localhost:{target.port}"] = target
+    target.transport_factory = shards[0].transport_factory
+    rs.set_reshard_chaos_hooks(mid_migrate=racing_push)
+    try:
+        ctrl.split(0, new_addr=f"localhost:{target.port}")
+    finally:
+        rs.set_reshard_chaos_hooks(mid_migrate=None)
+    shards.append(target)
+    assert pushed["n"] == 1
+    pushed["thread"].join(timeout=30)
+    after = table.get(ids)
+    np.testing.assert_allclose(after, before - 0.5, rtol=1e-6)
+    _stop(shards)
+
+
+# ---- hot-row replicas --------------------------------------------------
+
+
+def test_replica_refresh_and_staleness_metric(tmp_path):
+    """A push to a replicated id refreshes the replica copies within
+    the refresh window; replica reads serve the fresh bytes and the
+    row_replica_staleness_seconds histogram observes the lag."""
+    from elasticdl_tpu.observability import default_registry
+
+    policy = ReshardPolicy(replica_min_pulls=2, replica_top_k=8,
+                           replica_count=2)
+    shards, addrs, by_addr, ctrl = _fleet(3, tmp_path, policy=policy)
+    engine = make_remote_engine(
+        ",".join(addrs), id_keys={"items": "ids"},
+        retries=2, backoff_secs=0.1,
+    )
+    table = engine.tables["items"]
+    hot = np.array([5, 9000], np.int64)
+    for _ in range(6):
+        table.get(hot)
+    assert ctrl.update_replicas()
+    m = ctrl.map
+    assert all(m.replica_targets("items", int(i)) for i in hot)
+
+    # Client learns the replica epoch from the piggybacked version
+    # (replica-only epochs never REDIRECT).
+    table.get(hot)
+    assert engine.shard_map.version == m.version
+
+    engine.optimizer.apply_gradients(
+        table, hot, np.ones((hot.size, DIM), np.float32)
+    )
+    want = None
+    deadline = time.time() + 5.0
+    while time.time() < deadline:
+        ok = True
+        for i in hot.tolist():
+            home = int(m.home_of_ids([i])[0])
+            fresh = by_addr[m.shards[home]]._tables["items"].get([i])[0]
+            for r in m.replica_targets("items", i):
+                entry = by_addr[m.shards[r]]._replica_store.get(
+                    "items", {}
+                ).get(i)
+                if entry is None or not np.array_equal(
+                    entry[0], np.asarray(fresh, np.float32)
+                ):
+                    ok = False
+        if ok:
+            break
+        time.sleep(0.05)
+    assert ok, "replica copies did not refresh within the window"
+
+    # Replica-path reads agree with home; read repeatedly so the
+    # round-robin actually exercises replicas.
+    ref = table.get(hot)
+    for _ in range(4):
+        np.testing.assert_allclose(table.get(hot), ref, rtol=1e-6)
+    snap = default_registry().snapshot()["families"]
+    stale = next(
+        f for f in snap
+        if f["name"].endswith("row_replica_staleness_seconds")
+    )
+    assert sum(s["count"] for s in stale["series"]) > 0
+    reads = next(
+        f for f in snap
+        if f["name"].endswith("row_replica_reads_total")
+    )
+    assert sum(s["value"] for s in reads["series"]) > 0
+    _stop(shards)
+
+
+def test_replica_miss_falls_back_to_home(tmp_path):
+    """A designated replica that has not received its refresh yet must
+    not break reads — misses fall back to the authoritative home."""
+    shards, addrs, by_addr, ctrl = _fleet(2, tmp_path)
+    engine = make_remote_engine(
+        ",".join(addrs), id_keys={"items": "ids"},
+        retries=2, backoff_secs=0.1,
+    )
+    table = engine.tables["items"]
+    ids = np.array([123], np.int64)
+    ref = table.get(ids)
+    # Designate a replica by hand WITHOUT warming it: wipe the store.
+    m = ctrl.map.with_replicas({"items": {123: (1,)}})
+    with ctrl._lock:
+        ctrl._map = m
+        ctrl._persist()
+        ctrl._sync_locked()
+    by_addr[addrs[1]]._replica_store.clear()
+    for _ in range(4):  # every rr pick, incl. the replica route
+        np.testing.assert_array_equal(table.get(ids), ref)
+    _stop(shards)
+
+
+# ---- migration with tiered tables --------------------------------------
+
+
+def test_migration_streams_cold_rows_without_promotion(tmp_path):
+    """A tiered source shard migrates a mostly-cold range via segment
+    reads: the hot arena's membership is untouched (no cold row is
+    promoted through the budget by the copy) and the target receives
+    byte-equal rows."""
+    svc = HostRowService(
+        {"items": EmbeddingTable("items", DIM)},
+        HostOptimizerWrapper(SGD(lr=0.5)),
+    )
+    svc.configure_tiering(str(tmp_path / "cold"), hot_budget_rows=32,
+                          background_compact=False)
+    svc.start()
+    target = _start_shard()
+    by_addr = {
+        f"localhost:{svc.port}": svc,
+        f"localhost:{target.port}": target,
+    }
+    svc.transport_factory = lambda a: DirectTransport(by_addr[a])
+    target.transport_factory = svc.transport_factory
+    ctrl = ShardMapController(
+        str(tmp_path / "sm.json"),
+        transport_factory=lambda a: DirectTransport(by_addr[a]),
+    )
+    ctrl.bootstrap([f"localhost:{svc.port}"])
+    ctrl.map  # noqa: B018
+
+    # Materialize 8x the hot budget: most rows live cold. x37 spreads
+    # the ids across the bucket space so the split's upper-half range
+    # actually contains some of them.
+    ids = np.arange(0, 256, dtype=np.int64) * 37
+    rng = np.random.RandomState(7)
+    rows = rng.rand(ids.size, DIM).astype(np.float32)
+    table = svc._tables["items"]
+    table.set(ids, rows)
+    stats = svc.tier_stats()["items"]
+    assert stats["cold_rows"] > 0
+
+    hot_before = set(table._hot)
+    ctrl.split(0, new_addr=f"localhost:{target.port}")
+    # No promotion: the copy read cold rows via segment reads, never
+    # through the hot budget.
+    assert set(table._hot) <= hot_before
+    m = ctrl.map
+    moved = ids[m.home_of_ids(ids) == 1]
+    assert moved.size > 0
+    got = target._tables["items"].get(moved.tolist())
+    np.testing.assert_array_equal(
+        got, rows[np.isin(ids, moved)]
+    )
+    # Source erased its moved rows across BOTH tiers (single-homing).
+    assert not table.contains(moved).any()
+    svc.stop(0)
+    target.stop(0)
+
+
+# ---- checkpoint meta / journal -----------------------------------------
+
+
+def test_shard_map_rides_checkpoint_meta(tmp_path):
+    shards, addrs, by_addr, ctrl = _fleet(2, tmp_path)
+    ckpt = str(tmp_path / "ckpt0")
+    svc = shards[0]
+    svc.configure_checkpoint(ckpt, checkpoint_steps=1,
+                            async_write=False)
+    engine = make_remote_engine(
+        ",".join(addrs), id_keys={"items": "ids"},
+        retries=2, backoff_secs=0.1,
+    )
+    ids = _spread_ids(16, seed=21)
+    engine.optimizer.apply_gradients(
+        engine.tables["items"], ids,
+        np.ones((ids.size, DIM), np.float32),
+    )
+    version = ctrl.map.version
+    port = svc.port
+    svc.stop(0)
+    relaunched = HostRowService(
+        {"items": EmbeddingTable("items", DIM)},
+        HostOptimizerWrapper(SGD(lr=0.5)),
+        checkpoint_dir=ckpt, checkpoint_steps=1,
+    ).start(f"localhost:{port}")
+    assert relaunched._shard_map is not None
+    assert relaunched._shard_map.version == version
+    assert relaunched._shard_id == 0
+    relaunched.stop(0)
+    shards[1].stop(0)
+
+
+def test_shard_map_journal_record(tmp_path):
+    from elasticdl_tpu.master.journal import (
+        MasterJournal,
+        validate_record,
+    )
+    from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+
+    journal = MasterJournal(str(tmp_path / "journal"))
+    journal.open_generation()
+    m = ShardMap.bootstrap(["a", "b"])
+    journal.append("shard_map", version=m.version, map=m.to_json())
+    m2 = m.move_range(0, 8, 1)
+    journal.append("shard_map", version=m2.version, map=m2.to_json())
+    journal.close()
+
+    reopened = MasterJournal(str(tmp_path / "journal"))
+    records = reopened.replay_records()
+    assert all(validate_record(r) is None for r in records)
+    stats = reopened.recover_into(TaskDispatcher({}, {}, {}, 16))
+    assert stats["shard_map"]["version"] == m2.version
+    assert validate_record(
+        {"t": "shard_map", "seq": 1, "version": "x", "map": {}}
+    ) is not None
+
+
+def test_controller_persist_and_resume(tmp_path):
+    shards, addrs, by_addr, ctrl = _fleet(2, tmp_path)
+    target = _start_shard()
+    addr3 = f"localhost:{target.port}"
+    by_addr[addr3] = target
+    target.transport_factory = shards[0].transport_factory
+    ctrl.split(0, new_addr=addr3)
+    shards.append(target)
+    version = ctrl.map.version
+
+    again = ShardMapController(
+        str(tmp_path / "shard_map.json"),
+        transport_factory=lambda a: DirectTransport(by_addr[a]),
+    )
+    assert again.map == ctrl.map
+    assert again.resume() is None  # nothing in flight
+    assert again.map.version == version
+    _stop(shards)
+
+
+# ---- policy units ------------------------------------------------------
+
+
+def test_policy_pick_move_thresholds():
+    policy = ReshardPolicy(imbalance_factor=2.0,
+                           min_rows_per_tick=100)
+    assert policy.pick_move({0: 10, 1: 10}) is None  # under min rows
+    assert policy.pick_move({0: 300, 1: 290}) is None  # balanced
+    assert policy.pick_move({0: 900, 1: 100}) == (0, 1)
+    assert policy.pick_move({0: 500}) is None  # nowhere to move
+
+
+def test_policy_pick_replicas_ring_spread():
+    policy = ReshardPolicy(replica_top_k=2, replica_min_pulls=10,
+                           replica_count=2)
+    out = policy.pick_replicas(
+        {"items": {7: 100, 8: 50, 9: 5}}, 3,
+        home_of=lambda table, i: 0,
+    )
+    assert set(out["items"]) == {7, 8}  # 9 under min_pulls
+    assert out["items"][7] == (1, 2)
+    assert policy.pick_replicas({"items": {7: 100}}, 1,
+                                home_of=lambda t, i: 0) == {}
+
+
+# ---- fsck + drill fast lane --------------------------------------------
+
+
+def _tools():
+    import sys
+
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tools",
+    )
+    if path not in sys.path:
+        sys.path.insert(0, path)
+
+
+def test_check_reshard_fsck(tmp_path):
+    _tools()
+    from check_reshard import check_reshard
+
+    state = str(tmp_path / "sm.json")
+    errors, report = check_reshard(state)
+    assert errors  # missing file
+
+    m = ShardMap.bootstrap(["a", "b"])
+    good = {"map": m.to_json(), "migration": None, "mig_seq": 0}
+    with open(state, "w") as fh:
+        json.dump(good, fh)
+    errors, report = check_reshard(state)
+    assert not errors and report["map_version"] == 1
+    assert not report["migration_in_flight"]
+
+    # Resumable half-moved range (phase copy, source still owns).
+    lo, hi = m.split_plan(0)
+    good["migration"] = {
+        "migration_id": "m1", "source": 0, "target": 1,
+        "lo": lo, "hi": hi, "phase": "copy",
+    }
+    with open(state, "w") as fh:
+        json.dump(good, fh)
+    errors, report = check_reshard(state)
+    assert not errors
+    assert report["migration_in_flight"] and report["resumable"]
+
+    # Phase/ownership inconsistency is an error.
+    good["migration"]["phase"] = "cutover"
+    with open(state, "w") as fh:
+        json.dump(good, fh)
+    errors, report = check_reshard(state)
+    assert errors and not report["resumable"]
+
+    good["migration"]["phase"] = "warp"
+    with open(state, "w") as fh:
+        json.dump(good, fh)
+    errors, _report = check_reshard(state)
+    assert any("unknown" in e for e in errors)
+
+
+def test_reshard_drill_passes(tmp_path):
+    """Fast-lane twin of ``make reshard-smoke``: kills mid-migration
+    and mid-cutover must converge byte-equal to the fault-free twin
+    with no row lost or double-homed."""
+    from elasticdl_tpu.chaos.reshard_drill import run_drill
+
+    report = run_drill(str(tmp_path), seed=7)
+    assert report["passed"], report["problems"]
+    assert len(report["scenarios"]) == 2

@@ -305,8 +305,11 @@ def test_retried_push_after_relaunch_is_deduplicated(tmp_path):
     svc2 = fresh(port)
     try:
         # Client (unaware the reply made it) retries the SAME seq
-        # (seq streams are per-thread now; this thread owns one).
-        opt._local.seq -= 1
+        # (seq streams are per-thread now; this thread owns one). The
+        # engine optimizer is the map-routing scatter; the (client,
+        # seq) stream lives on the per-shard remote optimizer.
+        ropt = opt._reg.optimizer(f"localhost:{port}")
+        ropt._local.seq -= 1
         opt.apply_gradients(table, ids, np.ones((1, DIM), np.float32))
         after = table.get(ids)
         # One application only: -lr * 1.0 = -0.5, not -1.0.
@@ -526,19 +529,27 @@ def _start_shard(port=0, lr=0.5, ckpt=""):
     ).start(f"localhost:{port}")
 
 
-def test_sharded_engine_routes_by_id_mod_n():
-    """2-shard engine: pulls/pushes scatter by id % 2 (the reference
-    worker's PS scatter, worker.py:362-391/570-580) — each server only
-    ever materializes its own rows, values match the single-table
+def test_sharded_engine_routes_by_shard_map():
+    """2-shard engine: pulls/pushes scatter through the bootstrap
+    ``ShardMap`` (bucket ranges, embedding/shard_map.py — the routing
+    that makes live resharding possible) — each server only ever
+    materializes the rows it HOMES, values match the single-table
     reference exactly."""
+    from elasticdl_tpu.embedding.shard_map import ShardMap
+
     shards = [_start_shard(), _start_shard()]
     try:
-        addr = ",".join(f"localhost:{s.port}" for s in shards)
-        engine = make_remote_engine(addr, id_keys={"items": "ids"})
+        addrs = [f"localhost:{s.port}" for s in shards]
+        engine = make_remote_engine(
+            ",".join(addrs), id_keys={"items": "ids"}
+        )
         table = engine.tables["items"]
         assert table.dim == DIM
 
-        ids = np.array([3, 8, 13, 20, 7])
+        smap = ShardMap.bootstrap(addrs)
+        # Ids spanning BOTH shards' bucket ranges.
+        ids = np.array([3, 8, 13, 5000, 7123], np.int64)
+        assert set(smap.home_of_ids(ids).tolist()) == {0, 1}
         rows = table.get(ids)
         ref = EmbeddingTable("items", DIM)
         np.testing.assert_array_equal(rows, ref.get(ids))
@@ -548,13 +559,14 @@ def test_sharded_engine_routes_by_id_mod_n():
         after = table.get(ids)
         np.testing.assert_allclose(after, rows - 0.5 * grads, rtol=1e-6)
 
-        # Placement: every materialized row sits on its id%2 home shard
-        # (the same placement checkpoint/saver.py uses for row file
-        # shards).
+        # Placement: every materialized row sits on its map home.
         for s, svc in enumerate(shards):
             got_ids, _ = svc._tables["items"].to_arrays()
             assert got_ids.size > 0
-            assert all(int(i) % 2 == s for i in got_ids), (s, got_ids)
+            assert all(
+                int(smap.home_of_ids([int(i)])[0]) == s
+                for i in got_ids
+            ), (s, got_ids)
     finally:
         for s in shards:
             s.stop(0)
@@ -664,10 +676,15 @@ def test_two_shard_job_with_shard_restart(tmp_path):
     assert state["killed"] and state["relaunched"] is not None
     live = [shards[0], state["relaunched"]]
     try:
+        from elasticdl_tpu.embedding.shard_map import ShardMap
+
+        smap = ShardMap.bootstrap(addr.split(","))
         for s, svc in enumerate(live):
             ids, _ = svc._tables[deepfm_host.TABLE_NAME].to_arrays()
             assert ids.size > 0
-            assert all(int(i) % 2 == s for i in ids)
+            assert all(
+                int(smap.home_of_ids([int(i)])[0]) == s for i in ids
+            )
     finally:
         for svc in live:
             svc.stop(0)
@@ -710,10 +727,12 @@ def test_sharded_table_concurrent_pull_while_push_disjoint_masks():
         table = engine.tables["items"]
         ref = EmbeddingTable("items", DIM)
 
-        # Disjoint masks spanning all 3 shards each: pulls read ids the
-        # pushes never touch.
-        pull_ids = np.arange(0, 30, dtype=np.int64)          # 0..29
-        push_ids = np.arange(100, 130, dtype=np.int64)       # 100..129
+        # Disjoint masks spanning all 3 shards' bucket ranges each:
+        # pulls read ids the pushes never touch (x271 spreads the ids
+        # across the bucket space — dense small ints would all home on
+        # shard 0 under the bootstrap map's contiguous ranges).
+        pull_ids = np.arange(0, 30, dtype=np.int64) * 271
+        push_ids = np.arange(100, 130, dtype=np.int64) * 271
         grads = np.ones((len(push_ids), DIM), np.float32)
         errors = []
         rounds = 8
@@ -751,20 +770,23 @@ def test_sharded_table_concurrent_pull_while_push_disjoint_masks():
             np.asarray(ref.get(push_ids)) - rounds * 1.0 * grads,
             rtol=1e-6,
         )
-        # Placement held: pushed rows live on their id%3 home shards.
+        # Placement held: pushed rows live on their map home shards.
+        smap = engine.shard_map.get()
         for s, svc in enumerate(shards):
             ids, _ = svc._tables["items"].to_arrays()
-            assert all(int(i) % 3 == s for i in ids), (s, ids)
+            assert all(
+                int(smap.home_of_ids([int(i)])[0]) == s for i in ids
+            ), (s, ids)
     finally:
         for s in shards:
             s.stop(0)
 
 
 def test_sharded_export_dense_stride_interleave_n3_nondivisible():
-    """PR 7 satellite: export_dense over N=3 shards with a vocab that
-    divides by neither the shard count nor the chunk — the strided
-    export_range interleave must reassemble every row at its right
-    index (trained rows on their home shards, lazy init elsewhere)."""
+    """export_dense over N=3 shards with a vocab that divides by
+    neither the shard count nor the chunk — the map-routed explicit-id
+    export must reassemble every row at its right index (trained rows
+    on their home shards, lazy init elsewhere)."""
     shards = [_start_shard(), _start_shard(), _start_shard()]
     try:
         addr = ",".join(f"localhost:{s.port}" for s in shards)

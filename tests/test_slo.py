@@ -389,17 +389,27 @@ def test_sharded_freshness_reports_stalest_shard():
         def __init__(self, stamp):
             self.last_applied_at = stamp
 
+    class FakeRegistry:
+        def __init__(self, shards):
+            self._shards = shards
+
+        def tables_named(self, _name):
+            return self._shards
+
+    def sharded(stamps):
+        return _ShardedTable(
+            "t", 4, cmap=None, registry=FakeRegistry(
+                [FakeShard(s) for s in stamps]
+            ),
+        )
+
     # One shard's push pipeline stalled 600s ago: the table-level
     # stamp must be the stale one (max would mask the stall).
-    table = _ShardedTable(
-        [FakeShard(1000.0), FakeShard(1600.0), FakeShard(0.0)],
-        pool=None,
+    assert sharded([1000.0, 1600.0, 0.0]).last_applied_at == (
+        pytest.approx(1000.0)
     )
-    assert table.last_applied_at == pytest.approx(1000.0)
     # No shard ever pushed: unknown, not "freshest possible".
-    assert _ShardedTable(
-        [FakeShard(0.0), FakeShard(0.0)], pool=None
-    ).last_applied_at == 0.0
+    assert sharded([0.0, 0.0]).last_applied_at == 0.0
 
 
 # ---- rule evaluation -----------------------------------------------------

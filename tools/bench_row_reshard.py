@@ -1,0 +1,586 @@
+#!/usr/bin/env python
+"""Live-reshard + hot-row-replica benchmark (BENCH_ROW_RESHARD.json).
+
+Two measurements, each with a committed gate (docs/sparse_path.md
+"Live resharding & hot-row replication"):
+
+**(a) Live split vs checkpoint-restart repartition.** A 2-shard row
+service under continuous pull/push load grows to 3 shards both ways:
+
+- *live*: the shard-map controller's migration protocol — copy +
+  catch-up while serving, brief write fence, cutover by map flip;
+  clients converge via REDIRECT without reconnecting.
+- *ckpt-restart*: the PR 10 shape — drain + checkpoint both shards,
+  stop them, repartition the checkpoints offline onto the 3-shard
+  layout, start 3 fresh services, rebuild the client.
+
+Downtime = the longest gap between consecutive successful pushes
+observed by the load clients ("last pre-move apply → first post-move
+apply"). GATE: live downtime >= 5x lower.
+
+**(b) Zipf(1.1) skewed reads, with vs without hot-row replicas.**
+3 single-worker shards (handler concurrency 1 + a fixed per-pull
+service delay = an explicit per-shard capacity model, since N
+processes on one bench core cannot show real line-rate aggregation —
+ROW_SERVICE_SCALING.json). Closed-loop readers sample ids zipf(1.1):
+without replicas nearly every batch queues on the hot shard; with the
+authority's replica designation, hot-id reads fan across the fleet
+while a concurrent pusher keeps invalidating/refreshing the copies.
+GATES: replicated read throughput >= 1.5x single-home, and p99
+replica staleness (home read-time -> replica apply, the
+row_replica_staleness_seconds histogram) under the default freshness
+SLO (60s — observability/slo.py row-freshness rule).
+"""
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+from elasticdl_tpu.common.log_utils import get_logger  # noqa: E402
+
+logger = get_logger("bench_row_reshard")
+
+TABLE = "bench_rows"
+DIM = 16
+
+# Part (a): pre-materialized table — the checkpoint-restart baseline
+# must pay for moving REAL state, and the live path must prove its
+# downtime is independent of it.
+SPLIT_ROWS = 120_000
+PUSH_SET = 4096
+
+# Part (b) capacity model.
+SKEW_VOCAB = 10_000
+PULL_DELAY_PER_ROW_SECS = 4e-3
+ZIPF_A = 1.1
+FRESHNESS_SLO_SECS = 60.0  # default row-freshness rule threshold
+
+
+def _build_service(lr=0.5, ckpt_dir="", delay_per_row=0.0,
+                   preload_ids=None):
+    from elasticdl_tpu.embedding.optimizer import (
+        SGD,
+        HostOptimizerWrapper,
+    )
+    from elasticdl_tpu.embedding.row_service import HostRowService
+    from elasticdl_tpu.embedding.table import EmbeddingTable
+
+    table = EmbeddingTable(TABLE, DIM)
+    if preload_ids is not None and preload_ids.size:
+        rng = np.random.RandomState(1)
+        table.set(
+            preload_ids,
+            rng.rand(preload_ids.size, DIM).astype(np.float32),
+        )
+    if delay_per_row > 0:
+        table = _DelayTable(table, delay_per_row)
+    svc = HostRowService(
+        {TABLE: table}, HostOptimizerWrapper(SGD(lr=lr))
+    )
+    if ckpt_dir:
+        svc.configure_checkpoint(ckpt_dir, checkpoint_steps=0,
+                                 async_write=False)
+    return svc
+
+
+class _DelayTable:
+    """Per-ROW service delay under the handler's lock: an explicit
+    per-shard capacity stand-in (serving a row costs the shard's
+    single worker a fixed slice of time, so a shard homing the hot
+    rows saturates first — the skew regime the replicas attack)."""
+
+    def __init__(self, inner, delay_per_row):
+        self._inner = inner
+        self._delay = float(delay_per_row)
+
+    def get(self, ids):
+        time.sleep(self._delay * max(1, len(np.asarray(ids).ravel())))
+        return self._inner.get(ids)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+# ---- part (a): live split vs checkpoint-restart ------------------------
+
+
+class _LoadClients:
+    """Continuous pull+push load; successful push completion times
+    feed the downtime metric (max inter-apply gap)."""
+
+    def __init__(self, engine_holder, rng):
+        self._holder = engine_holder
+        self._rng = rng
+        self.applies = []
+        self._stop = threading.Event()
+        self._threads = []
+
+    def start(self):
+        for fn in (self._push_loop, self._pull_loop):
+            t = threading.Thread(target=fn, daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _batch(self):
+        # Small batches from the materialized table: cadence must be
+        # far finer than the downtimes being measured.
+        return np.unique(
+            self._rng.randint(0, SPLIT_ROWS, 16).astype(np.int64)
+        )
+
+    def _push_loop(self):
+        grad_cache = {}
+        while not self._stop.is_set():
+            ids = self._batch()
+            grads = grad_cache.setdefault(
+                ids.size, np.ones((ids.size, DIM), np.float32)
+            )
+            engine = self._holder["engine"]
+            try:
+                engine.optimizer.apply_gradients(
+                    engine.tables[TABLE], ids, grads
+                )
+                self.applies.append(time.monotonic())
+            except Exception:
+                time.sleep(0.01)
+
+    def _pull_loop(self):
+        while not self._stop.is_set():
+            engine = self._holder["engine"]
+            try:
+                engine.tables[TABLE].get(self._batch())
+            except Exception:
+                time.sleep(0.01)
+
+    def stop(self):
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=10)
+
+    def wait_for_applies(self, n: int, timeout: float = 15.0):
+        """Block until the pushers have a real cadence going — the
+        max-gap metric needs applies on BOTH sides of the operation
+        to bracket its hole."""
+        deadline = time.monotonic() + timeout
+        while (len(self.applies) < n
+               and time.monotonic() < deadline):
+            time.sleep(0.02)
+
+    def max_gap(self) -> float:
+        """Longest gap between consecutive successful applies over the
+        WHOLE load run — the operation's hole dominates (steady-state
+        cadence is a couple of ms), and measuring the full run can
+        never miss a hole that straddles the operation's start."""
+        if len(self.applies) < 2:
+            return float("inf")
+        return float(np.max(np.diff(np.asarray(self.applies))))
+
+
+def _preload(shards, addrs):
+    """Materialize SPLIT_ROWS dense rows, each on its bootstrap home
+    (direct server-side set — no clients yet)."""
+    from elasticdl_tpu.embedding.shard_map import ShardMap
+
+    rng = np.random.RandomState(1)
+    ids = np.arange(SPLIT_ROWS, dtype=np.int64)
+    rows = rng.rand(ids.size, DIM).astype(np.float32)
+    home = ShardMap.bootstrap(addrs).home_of_ids(ids)
+    for s, svc in enumerate(shards):
+        keep = home == s
+        svc._tables[TABLE].set(ids[keep], rows[keep])
+
+
+def _bench_live_split(workdir: str, settle: float) -> dict:
+    from elasticdl_tpu.embedding.row_service import make_remote_engine
+    from elasticdl_tpu.master.row_reshard import ShardMapController
+
+    shards = [_build_service() for _ in range(2)]
+    for s in shards:
+        s.start()
+    addrs = [f"localhost:{s.port}" for s in shards]
+    _preload(shards, addrs)
+    ctrl = ShardMapController(
+        os.path.join(workdir, "live", "shard_map.json")
+    )
+    ctrl.bootstrap(addrs)
+    holder = {"engine": make_remote_engine(
+        ",".join(addrs), id_keys={TABLE: "ids"},
+        retries=4, backoff_secs=0.05,
+    )}
+    load = _LoadClients(holder, np.random.RandomState(11))
+    load.start()
+    try:
+        load.wait_for_applies(20)
+        time.sleep(settle)
+        target = _build_service().start()
+        shards.append(target)
+        t0 = time.monotonic()
+        stats = ctrl.split(0, new_addr=f"localhost:{target.port}")
+        split_secs = time.monotonic() - t0
+        time.sleep(settle)
+        downtime = load.max_gap()
+    finally:
+        load.stop()
+        ctrl.close()
+        for s in shards:
+            s.stop(0)
+    return {
+        "downtime_secs": downtime,
+        "split_wall_secs": split_secs,
+        "migrated_rows": stats.get("rows"),
+        "catchup_rounds": stats.get("catchup_rounds"),
+        "applies_observed": len(load.applies),
+    }
+
+
+def _repartition_checkpoints(old_dirs, new_dirs, new_addrs):
+    """Offline N→M repartition (the PR 10 restore path): merge the old
+    shards' checkpoints, re-place every row by the NEW bootstrap map,
+    write one checkpoint per new shard."""
+    from elasticdl_tpu.checkpoint.saver import CheckpointSaver
+    from elasticdl_tpu.embedding.shard_map import ShardMap
+
+    merged = {}
+    version = 0
+    for d in old_dirs:
+        v, _, embeddings = CheckpointSaver(d).restore()
+        version = max(version, v)
+        for name, table in embeddings.items():
+            ids, rows = table.to_arrays()
+            acc = merged.setdefault(name, ([], []))
+            acc[0].append(np.asarray(ids, np.int64))
+            acc[1].append(np.asarray(rows))
+    new_map = ShardMap.bootstrap(new_addrs)
+    for s, d in enumerate(new_dirs):
+        payload = {}
+        for name, (id_parts, row_parts) in merged.items():
+            ids = np.concatenate(id_parts)
+            rows = np.concatenate(row_parts)
+            keep = new_map.home_of_ids(ids) == s
+            payload[name] = (ids[keep], rows[keep])
+        CheckpointSaver(d).save(version, {}, embeddings=payload)
+    return version
+
+
+def _bench_ckpt_restart(workdir: str, settle: float) -> dict:
+    from elasticdl_tpu.embedding.row_service import make_remote_engine
+
+    old_dirs = [
+        os.path.join(workdir, "ckpt", f"old{i}") for i in range(2)
+    ]
+    shards = [_build_service(ckpt_dir=d) for d in old_dirs]
+    for s in shards:
+        s.start()
+    addrs = [f"localhost:{s.port}" for s in shards]
+    _preload(shards, addrs)
+    holder = {"engine": make_remote_engine(
+        ",".join(addrs), id_keys={TABLE: "ids"},
+        retries=4, backoff_secs=0.05,
+    )}
+    load = _LoadClients(holder, np.random.RandomState(11))
+    load.start()
+    new_shards = []
+    placeholders = []
+    try:
+        load.wait_for_applies(20)
+        time.sleep(settle)
+        t0 = time.monotonic()
+        # Drain + durable checkpoint + stop: the repartition reads
+        # frozen state (this is what makes the mechanism a restart).
+        old_ports = [s.port for s in shards]
+        for s in shards:
+            assert s.checkpoint_now()
+            s.stop(0)
+        # Pin the freed ports for the duration: without this the OS
+        # can hand them to the NEW services, and the old client's
+        # pushes "succeed" mid-restart — fabricating zero downtime.
+        from elasticdl_tpu.comm.rpc import RpcServer
+
+        placeholders = [
+            RpcServer(f"localhost:{p}", {}).start() for p in old_ports
+        ]
+        new_dirs = [
+            os.path.join(workdir, "ckpt", f"new{i}") for i in range(3)
+        ]
+        # New fleet on fresh ports; the client is rebuilt (the PR 10
+        # flow restarts the job with the new --row_service_addr).
+        new_shards = [_build_service(ckpt_dir="") for _ in range(3)]
+        for s in new_shards:
+            s.start()
+        new_addrs = [f"localhost:{s.port}" for s in new_shards]
+        _repartition_checkpoints(old_dirs, new_dirs, new_addrs)
+        for s, d in zip(new_shards, new_dirs):
+            s.configure_checkpoint(d, checkpoint_steps=0,
+                                   async_write=False)
+        holder["engine"] = make_remote_engine(
+            ",".join(new_addrs), id_keys={TABLE: "ids"},
+            retries=4, backoff_secs=0.05,
+        )
+        restart_secs = time.monotonic() - t0
+        time.sleep(settle)
+        downtime = load.max_gap()
+    finally:
+        load.stop()
+        for p in placeholders:
+            p.stop(None)
+        for s in new_shards:
+            s.stop(0)
+    return {
+        "downtime_secs": downtime,
+        "restart_wall_secs": restart_secs,
+        "applies_observed": len(load.applies),
+    }
+
+
+# ---- part (b): zipf skew with/without replicas -------------------------
+
+
+def _zipf_samples(rng, n):
+    ranks = np.arange(1, SKEW_VOCAB + 1, dtype=np.float64)
+    p = 1.0 / ranks ** ZIPF_A
+    p /= p.sum()
+    return rng.choice(SKEW_VOCAB, size=n, p=p).astype(np.int64)
+
+
+def _histogram_p99(family_snapshot) -> float:
+    bounds = family_snapshot["buckets"]
+    counts = np.zeros(len(bounds), np.int64)
+    total = 0
+    for series in family_snapshot["series"]:
+        counts += np.asarray(series["buckets"], np.int64)
+        total += series["count"]
+    if not total:
+        return 0.0
+    want = 0.99 * total
+    cum = 0
+    for ub, c in zip(bounds, counts):
+        cum += c
+        if cum >= want:
+            return float(ub)
+    return float(bounds[-1])
+
+
+def _measure_read_throughput(engine, samples, window: float,
+                             clients: int) -> float:
+    rows = [0] * clients
+    stop = threading.Event()
+
+    def reader(k):
+        rng = np.random.RandomState(100 + k)
+        table = engine.tables[TABLE]
+        while not stop.is_set():
+            at = rng.randint(0, len(samples) - 16)
+            # No dedup: serving-style reads hit popular rows
+            # repeatedly — the row-request skew the replicas spread.
+            ids = samples[at:at + 16]
+            table.get(ids)
+            rows[k] += ids.size
+
+    threads = [
+        threading.Thread(target=reader, args=(k,), daemon=True)
+        for k in range(clients)
+    ]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    time.sleep(window)
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+    return sum(rows) / (time.monotonic() - t0)
+
+
+def _bench_skew(workdir: str, window: float, clients: int) -> dict:
+    from elasticdl_tpu.comm import rpc as rpc_mod
+    from elasticdl_tpu.embedding.row_service import make_remote_engine
+    from elasticdl_tpu.master.row_reshard import (
+        ReshardPolicy,
+        ShardMapController,
+    )
+    from elasticdl_tpu.observability import default_registry
+
+    shards = [
+        _build_service(
+            preload_ids=np.arange(SKEW_VOCAB, dtype=np.int64),
+        )
+        for _ in range(3)
+    ]
+    for s in shards:
+        # Single-worker servers + the per-row capacity hook below =
+        # an explicit per-shard capacity model (see module
+        # docstring). Dense zipf ranks put the hot head — and most of
+        # the mass — on shard 0: the hot-shard-caps-fleet-throughput
+        # regime. (Each shard preloads the full vocab; the bootstrap
+        # map install erases everything it does not own.)
+        s.start(max_workers=1)
+    addrs = [f"localhost:{s.port}" for s in shards]
+    ctrl = ShardMapController(
+        os.path.join(workdir, "skew", "shard_map.json"),
+        policy=ReshardPolicy(replica_top_k=512, replica_min_pulls=8,
+                             replica_count=2),
+    )
+    ctrl.bootstrap(addrs)
+    engine = make_remote_engine(
+        ",".join(addrs), id_keys={TABLE: "ids"},
+        retries=4, backoff_secs=0.05,
+    )
+    rng = np.random.RandomState(3)
+    samples = _zipf_samples(rng, 200_000)
+
+    def _capacity_hook(_tag, _service, method, request):
+        # Serving a row costs the shard's single worker a fixed time
+        # slice — replica reads included (a replica is not free
+        # capacity, it is OTHER shards' capacity).
+        if method in ("pull_rows", "pull_replica_rows",
+                      "push_row_grads"):
+            n = len(np.asarray(request.get("ids", ())).ravel())
+            time.sleep(PULL_DELAY_PER_ROW_SECS * max(1, n))
+        return None
+
+    def set_replicas(rep):
+        with ctrl._lock:
+            ctrl._map = ctrl._map.with_replicas(rep)
+            ctrl._persist()
+            ctrl._sync_locked()
+        engine.tables[TABLE].get(samples[:16])  # learn the epoch
+        time.sleep(0.3)  # warm refreshes land / stores prune
+
+    try:
+        # Warm WITHOUT the capacity hook: feed the hot trackers
+        # enough draws that the zipf head clears replica_min_pulls.
+        for at in range(0, 24_000, 16):
+            engine.tables[TABLE].get(samples[at:at + 16])
+        assert ctrl.update_replicas(), "no replica designation formed"
+        designated = ctrl.map.replicas
+        rpc_mod.set_chaos_hooks(server=_capacity_hook)
+        # Staleness phase: a writer hammers the hot set while light
+        # readers exercise the replica path — every push triggers an
+        # async refresh the replicas must re-land, and the
+        # row_replica_staleness_seconds histogram observes the lag.
+        hot = np.unique(samples[:2048])[:16]
+        grads = np.ones((hot.size, DIM), np.float32)
+        t_end = time.monotonic() + 1.5
+        while time.monotonic() < t_end:
+            engine.optimizer.apply_gradients(
+                engine.tables[TABLE], hot, grads
+            )
+            engine.tables[TABLE].get(samples[:16])
+            time.sleep(0.05)
+        # INTERLEAVED phases, medians compared: the bench box drifts
+        # over tens of seconds, and back-to-back S/R pairs see the
+        # same conditions where sequential S,S,S then R,R,R would
+        # charge the drift entirely to one side. Toggling replicas is
+        # itself the mechanism under test (epoch bump + piggybacked
+        # version + warm refresh on designation).
+        singles, reps = [], []
+        for _round in range(3):
+            set_replicas({})
+            singles.append(_measure_read_throughput(
+                engine, samples, window / 2, clients
+            ))
+            set_replicas(designated)
+            reps.append(_measure_read_throughput(
+                engine, samples, window / 2, clients
+            ))
+        single_home = float(np.median(singles))
+        replicated = float(np.median(reps))
+    finally:
+        rpc_mod.set_chaos_hooks(server=None)
+        ctrl.close()
+    stale = next(
+        (f for f in default_registry().snapshot()["families"]
+         if f["name"].endswith("row_replica_staleness_seconds")),
+        None,
+    )
+    staleness_p99 = _histogram_p99(stale) if stale is not None else 0.0
+    replicated_ids = sum(
+        len(per) for per in ctrl.map.replicas.values()
+    )
+    for s in shards:
+        s.stop(0)
+    return {
+        "single_home_rows_per_sec": single_home,
+        "replicated_rows_per_sec": replicated,
+        "speedup": replicated / max(single_home, 1e-9),
+        "replicated_ids": replicated_ids,
+        "replica_staleness_p99_secs": staleness_p99,
+        "zipf_a": ZIPF_A,
+        "vocab": SKEW_VOCAB,
+        "pull_delay_per_row_secs": PULL_DELAY_PER_ROW_SECS,
+        "clients": clients,
+    }
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser("bench_row_reshard")
+    parser.add_argument("--out", default="BENCH_ROW_RESHARD.json")
+    parser.add_argument("--workdir", default="")
+    parser.add_argument("--smoke", action="store_true",
+                        help="Short windows (CI lane); gates still "
+                             "evaluated")
+    args = parser.parse_args(argv)
+
+    import tempfile
+
+    workdir = args.workdir or tempfile.mkdtemp(prefix="edl_reshard_")
+    settle = 0.6 if args.smoke else 1.5
+    window = 1.0 if args.smoke else 3.0
+    clients = 6 if args.smoke else 8
+
+    logger.info("part (a): live split under load ...")
+    live = _bench_live_split(workdir, settle)
+    logger.info("part (a): checkpoint-restart repartition ...")
+    restart = _bench_ckpt_restart(workdir, settle)
+    logger.info("part (b): zipf skew with/without replicas ...")
+    skew = _bench_skew(workdir, window, clients)
+
+    downtime_ratio = (
+        restart["downtime_secs"] / max(live["downtime_secs"], 1e-9)
+    )
+    gates = {
+        "live_downtime_5x_better": downtime_ratio >= 5.0,
+        "replica_speedup_ge_1p5": skew["speedup"] >= 1.5,
+        "replica_staleness_under_slo": (
+            skew["replica_staleness_p99_secs"] < FRESHNESS_SLO_SECS
+        ),
+    }
+    report = {
+        "bench": "row_reshard",
+        "config": {
+            "table": TABLE, "dim": DIM, "split_rows": SPLIT_ROWS,
+            "smoke": bool(args.smoke), "settle_secs": settle,
+            "skew_window_secs": window,
+            "freshness_slo_secs": FRESHNESS_SLO_SECS,
+        },
+        "live_split": live,
+        "ckpt_restart": restart,
+        "downtime_ratio": downtime_ratio,
+        "skew": skew,
+        "gates": gates,
+        "passed": all(gates.values()),
+    }
+    with open(args.out, "w") as fh:
+        json.dump(report, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    logger.info(
+        "downtime: live %.4fs vs ckpt-restart %.3fs (%.1fx); skew "
+        "speedup %.2fx (staleness p99 %.3fs); gates %s -> %s",
+        live["downtime_secs"], restart["downtime_secs"],
+        downtime_ratio, skew["speedup"],
+        skew["replica_staleness_p99_secs"], gates,
+        "PASS" if report["passed"] else "FAIL",
+    )
+    return 0 if report["passed"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

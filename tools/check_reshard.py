@@ -1,0 +1,153 @@
+#!/usr/bin/env python
+"""Fsck for the shard-map authority's reshard artifacts.
+
+``check_reshard(state_path)`` audits the controller state file
+(master/row_reshard.py) the way check_store.py audits cold-tier
+segment dirs:
+
+- the state JSON parses and the map passes the full ShardMap
+  validation (ranges sorted/disjoint/covering, shard indices in
+  bounds, version >= 1);
+- an in-flight migration record — a HALF-MOVED RANGE — is detectable
+  and structurally resumable: known phase, source/target inside the
+  fleet, a well-formed bucket range, and phase-consistent ownership
+  (phase "copy": the map still assigns the range to the source — the
+  flip has not happened; phase "cutover": the persisted map already
+  assigns it to the target — only distribution remains);
+- with ``--probe addr,addr,...`` each live shard's installed epoch is
+  compared against the authority's: a shard AHEAD of the state file
+  means somebody else wrote epochs (split-brain), and a shard behind
+  with no migration in flight means a sync was lost (the next
+  ``resume()``/``sync()`` converges it — reported, not fatal).
+
+Exit 0 when clean; errors print to stderr and exit 1.
+Importable: ``check_reshard(state_path, probe_addrs=None)``.
+"""
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional, Tuple
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+PHASES = ("copy", "cutover")
+
+
+def check_reshard(state_path: str,
+                  probe_addrs: Optional[List[str]] = None
+                  ) -> Tuple[List[str], dict]:
+    from elasticdl_tpu.embedding.shard_map import (
+        NUM_BUCKETS,
+        ShardMap,
+        ShardMapError,
+    )
+
+    errors: List[str] = []
+    report = {
+        "state_path": state_path,
+        "map_version": 0,
+        "num_shards": 0,
+        "migration_in_flight": False,
+        "resumable": False,
+        "shards_probed": 0,
+        "shards_behind": [],
+    }
+    if not os.path.exists(state_path):
+        return [f"{state_path}: no authority state file"], report
+    try:
+        with open(state_path) as fh:
+            state = json.load(fh)
+    except Exception as exc:
+        return [f"{state_path}: unreadable ({exc})"], report
+    try:
+        smap = ShardMap.from_json(state["map"])
+    except (KeyError, ShardMapError, TypeError) as exc:
+        return [f"{state_path}: invalid map ({exc})"], report
+    report["map_version"] = smap.version
+    report["num_shards"] = len(smap.shards)
+
+    mig = state.get("migration")
+    if mig is not None:
+        report["migration_in_flight"] = True
+        resumable = True
+        phase = mig.get("phase")
+        if phase not in PHASES:
+            errors.append(f"migration phase {phase!r} unknown")
+            resumable = False
+        for key in ("source", "target"):
+            s = mig.get(key)
+            if not isinstance(s, int) or not 0 <= s < len(smap.shards):
+                errors.append(f"migration {key} {s!r} outside fleet")
+                resumable = False
+        lo, hi = mig.get("lo"), mig.get("hi")
+        if not (isinstance(lo, int) and isinstance(hi, int)
+                and 0 <= lo < hi <= NUM_BUCKETS):
+            errors.append(f"migration range ({lo!r}, {hi!r}) malformed")
+            resumable = False
+        if resumable:
+            owners = set(
+                int(s) for s in smap.owner_table[lo:hi].tolist()
+            )
+            if phase == "copy" and owners != {int(mig["source"])}:
+                errors.append(
+                    f"phase=copy but buckets [{lo}, {hi}) owned by "
+                    f"{sorted(owners)}, not source {mig['source']} — "
+                    "the flip happened without the record advancing"
+                )
+                resumable = False
+            if phase == "cutover" and owners != {int(mig["target"])}:
+                errors.append(
+                    f"phase=cutover but buckets [{lo}, {hi}) owned by "
+                    f"{sorted(owners)}, not target {mig['target']} — "
+                    "the persisted map predates the flip"
+                )
+                resumable = False
+        report["resumable"] = resumable
+
+    for addr in probe_addrs or []:
+        from elasticdl_tpu.comm.rpc import RpcError, RpcStub
+
+        stub = RpcStub(addr, "RowService", max_retries=1)
+        try:
+            resp = stub.call("get_shard_map")
+        except RpcError as exc:
+            errors.append(f"probe {addr}: unreachable ({exc.code})")
+            continue
+        finally:
+            stub.close()
+        report["shards_probed"] += 1
+        installed = resp.get("map") or {}
+        version = int(installed.get("version", 0))
+        if version > smap.version:
+            errors.append(
+                f"probe {addr}: installed epoch v{version} is AHEAD "
+                f"of the authority's v{smap.version} (split-brain?)"
+            )
+        elif version < smap.version and mig is None:
+            # Lost sync, self-healing via resume()/REDIRECT — surface
+            # it without failing the audit.
+            report["shards_behind"].append(addr)
+    return errors, report
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser("check_reshard")
+    parser.add_argument("state_path")
+    parser.add_argument("--probe", default="",
+                        help="Comma list of shard addrs to compare "
+                             "installed epochs against the state file")
+    args = parser.parse_args(argv)
+    probe = [a.strip() for a in args.probe.split(",") if a.strip()]
+    errors, report = check_reshard(args.state_path, probe or None)
+    print(json.dumps(report, indent=2, sort_keys=True))
+    for err in errors:
+        print(f"ERROR: {err}", file=sys.stderr)
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
