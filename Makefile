@@ -8,7 +8,8 @@ PY ?= python
 	clean lint metrics chaos-smoke chaos-soak chaos-master-smoke \
 	trace-smoke serve-fleet-smoke sparse-smoke sparse-bench \
 	autoscale-smoke autoscale-bench slo-smoke ckpt-bench ckpt-smoke \
-	tiered-smoke tiered-bench reshard-smoke reshard-bench
+	tiered-smoke tiered-bench reshard-smoke reshard-bench \
+	profile-smoke
 
 # Scrape-and-pretty-print a master's /metrics (docs/observability.md).
 METRICS_ADDR ?= localhost:8080
@@ -116,6 +117,23 @@ slo-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m elasticdl_tpu.chaos.slo_drill \
 		--workdir $$workdir --report SLO_DRILL.json \
 	&& $(PY) tools/check_incident.py $$workdir/incidents; \
+	rc=$$?; rm -rf $$workdir; exit $$rc
+
+# Continuous-profiling drill (docs/observability.md "Continuous
+# profiling & exemplars"): a REAL row-service subprocess with an
+# injected named hot function runs --profile_hz 67; its flame windows,
+# spans, and exemplar-stamped push histogram piggyback back over real
+# gRPC. Exits nonzero unless the hot function dominates the captured
+# flame table, the SLO rule fires, the incident bundle passes
+# check_incident.py --require-profile --require-exemplars (profile
+# snapshot valid per check_profile.py, >=1 exemplar trace id resolving
+# in trace.json), and the profiler-overhead pin (<=1% of a busy loop
+# at the default hz) holds. Fast-lane equivalent:
+# tests/test_profile_plane.py::test_profile_drill_fast_lane.
+profile-smoke:
+	workdir=$$(mktemp -d /tmp/edl_profile.XXXXXX); \
+	JAX_PLATFORMS=cpu $(PY) -m elasticdl_tpu.chaos.profile_drill \
+		--workdir $$workdir --report PROFILE_DRILL.json; \
 	rc=$$?; rm -rf $$workdir; exit $$rc
 
 # Checkpoint-plane bench (docs/fault_tolerance.md "Checkpoint

@@ -203,6 +203,7 @@ class CheckpointHook:
             "checkpoint_stall_seconds",
             "Step/push-path time spent capturing + enqueuing a "
             "checkpoint (the part the hot path actually waits on)",
+            exemplars=True,
         )
 
     def flush(self):

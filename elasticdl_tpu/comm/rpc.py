@@ -72,6 +72,16 @@ class RpcError(RuntimeError):
         self.code = code
 
 
+class InvalidRequest(ValueError):
+    """Handler-side request validation failure: the payload itself is
+    malformed (wrong shape, wrong dtype, unknown table). Surfaces to
+    the client as INVALID_ARGUMENT — non-retryable, distinct from the
+    INTERNAL a handler *bug* produces — so e.g. a push whose gradient
+    block disagrees with the table's dim is rejected cleanly before it
+    can reach the native apply kernels (which would trust the shape
+    and read out of bounds)."""
+
+
 # ---- chaos injection seam (chaos/interceptors.py installs) -------------
 #
 # _client_hook(service, method, request) -> None
@@ -173,6 +183,14 @@ class _GenericService(grpc.GenericRpcHandler):
                 try:
                     response = handler(request)
                     return response if response is not None else {}
+                except InvalidRequest as exc:
+                    # Malformed payload, not a server fault: reject
+                    # with the argument-validation status so clients
+                    # neither retry it nor read it as a handler bug.
+                    span.set(error="INVALID_ARGUMENT")
+                    context.abort(
+                        grpc.StatusCode.INVALID_ARGUMENT, str(exc)
+                    )
                 except Exception as exc:
                     # surface handler errors to the client
                     context.abort(
@@ -251,6 +269,10 @@ def _client_metrics():
             "backoff sleeps, so retried calls read as N fast attempts "
             "rather than one slow server)",
             ["service", "method"],
+            # Observations happen inside the rpc/<method> span, so the
+            # ambient trace id stamps each sampled slow attempt — the
+            # burn-rate rule's exemplar source (docs/observability.md).
+            exemplars=True,
         ),
         registry.gauge(
             "rpc_inflight",

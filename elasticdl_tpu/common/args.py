@@ -244,6 +244,21 @@ def add_train_params(parser):
     parser.add_argument("--profile_start_step", type=non_neg_int,
                         default=5)
     parser.add_argument("--profile_steps", type=pos_int, default=5)
+    # Continuous profiling plane (observability/profiler.py;
+    # docs/observability.md "Continuous profiling & exemplars"): an
+    # always-on sampling profiler whose flame-table windows ride the
+    # metrics piggyback into the master's /profile endpoint.
+    parser.add_argument("--profile_hz", type=float, default=0.0,
+                        help="Always-on sampling-profiler rate (Hz) "
+                             "for master and workers; flame-table "
+                             "windows serve on the master's /profile "
+                             "endpoint. ~67 is the intended default "
+                             "rate; 0 (default) = off")
+    parser.add_argument("--profile_window_secs", type=pos_float,
+                        default=10.0,
+                        help="Sampling-profiler window length: stacks "
+                             "fold per window, windows ride the "
+                             "metrics piggyback to the master")
     parser.add_argument("--task_timeout_secs", type=pos_float, default=300.0)
     parser.add_argument("--journal_dir", default="",
                         help="Master write-ahead job-state journal "

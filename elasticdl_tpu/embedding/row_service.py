@@ -50,7 +50,12 @@ from typing import Callable, Dict, Optional
 import numpy as np
 
 from elasticdl_tpu.common.log_utils import get_logger
-from elasticdl_tpu.comm.rpc import RpcError, RpcServer, RpcStub
+from elasticdl_tpu.comm.rpc import (
+    InvalidRequest,
+    RpcError,
+    RpcServer,
+    RpcStub,
+)
 from elasticdl_tpu.embedding.host_engine import HostEmbeddingEngine
 from elasticdl_tpu.embedding.shard_map import (
     ClientShardMap,
@@ -187,11 +192,18 @@ class HostRowService:
         from elasticdl_tpu.observability import default_registry
 
         registry = metrics_registry or default_registry()
+        # exemplars: slow pulls/pushes stamp their row_pull/row_push
+        # span's trace id onto the observation (explicitly — the span
+        # closes before the handler observes), so an SLO breach on
+        # these histograms names concrete offending traces
+        # (docs/observability.md "Continuous profiling & exemplars").
         self._m_pull = registry.histogram(
             "row_service_pull_seconds", "pull_rows handler latency",
+            exemplars=True,
         )
         self._m_push = registry.histogram(
             "row_service_push_seconds", "push_row_grads handler latency",
+            exemplars=True,
         )
         self._m_pulled = registry.counter(
             "row_service_pulled_rows_total", "Rows served to pulls",
@@ -208,6 +220,7 @@ class HostRowService:
             "checkpoint_stall_seconds",
             "Step/push-path time spent capturing + enqueuing a "
             "checkpoint (the part the hot path actually waits on)",
+            exemplars=True,
         )
         # Reshard plane (docs/sparse_path.md "Live resharding"):
         self._m_map_version = registry.gauge(
@@ -363,16 +376,84 @@ class HostRowService:
         with self._lock:
             return self._table_versions[table]
 
+    # ---- request validation (the malformed-grads guard) ----------------
+    #
+    # The native apply kernels (native/row_store.cc, the fused Pallas
+    # path's host bookkeeping) trust the (n_ids, dim) shape they are
+    # handed — a wrong-dim or wrong-count grad block read/written past
+    # the arena segfaults the whole shard (observed while driving
+    # PR 11). Validate every inbound block BEFORE it can reach an
+    # apply; InvalidRequest surfaces as a clean INVALID_ARGUMENT to
+    # the client instead of a dead process.
+
+    def _validated_table(self, request: dict):
+        name = request.get("table")
+        table = self._tables.get(name) if isinstance(name, str) else None
+        if table is None:
+            raise InvalidRequest(
+                f"unknown table {name!r} (serving "
+                f"{sorted(self._tables)})"
+            )
+        return name, table
+
+    @staticmethod
+    def _validated_ids(request: dict) -> np.ndarray:
+        raw = request.get("ids")
+        if raw is None:
+            raise InvalidRequest("ids missing")
+        try:
+            ids = np.asarray(raw, np.int64)
+        except (ValueError, TypeError, OverflowError) as exc:
+            raise InvalidRequest(f"ids not an int64 vector: {exc}")
+        if ids.ndim != 1:
+            raise InvalidRequest(
+                f"ids must be 1-D, got shape {ids.shape}"
+            )
+        return ids
+
+    @staticmethod
+    def _validated_grads(request: dict, ids: np.ndarray, table,
+                         table_name: str) -> np.ndarray:
+        if np.unique(ids).size != ids.size:
+            # The apply contract is one update per id; the Python
+            # wrapper raises a plain ValueError here (read as a server
+            # bug) and the native path would silently double-apply.
+            raise InvalidRequest("ids must be unique per push")
+        raw = request.get("grads")
+        if raw is None:
+            raise InvalidRequest("grads missing")
+        try:
+            grads = np.asarray(raw, np.float32)
+        except (ValueError, TypeError) as exc:
+            # Ragged nests / non-numeric payloads land here.
+            raise InvalidRequest(f"grads not a float32 block: {exc}")
+        if grads.ndim != 2:
+            raise InvalidRequest(
+                f"grads must be 2-D (n_ids, dim), got shape "
+                f"{grads.shape}"
+            )
+        expected = (int(ids.size), int(table.dim))
+        if tuple(grads.shape) != expected:
+            raise InvalidRequest(
+                f"grads shape {tuple(grads.shape)} != "
+                f"(len(ids), dim) = {expected} for table "
+                f"{table_name!r}"
+            )
+        return grads
+
     def _pull_rows(self, request: dict) -> dict:
         t0 = time.monotonic()
-        table = self._tables[request["table"]]
-        ids = np.asarray(request["ids"], np.int64)
+        table_name, table = self._validated_table(request)
+        ids = self._validated_ids(request)
         # Ambient span: nests under the RPC server span (role
         # rowservice) so lock-wait + store time is attributable
         # separately from wire/serde time; free with no recorder.
+        # Kept by name past its exit: the latency observation below
+        # stamps the span's trace id as the histogram exemplar.
         tiered = hasattr(table, "prefault")
-        with tracing.span("row_pull", table=request["table"],
-                          rows=int(ids.size)):
+        pull_span = tracing.span("row_pull", table=table_name,
+                                 rows=int(ids.size))
+        with pull_span:
             if tiered:
                 # Fault this pull's cold rows with the DISK READ
                 # outside the service lock: concurrent pushes wait on
@@ -403,7 +484,8 @@ class HostRowService:
                 # handlers).
                 self._track_hot(request["table"], ids)
         self._m_pulled.inc(ids.size)
-        self._m_pull.observe(time.monotonic() - t0)
+        self._m_pull.observe(time.monotonic() - t0,
+                             trace_id=pull_span.trace_id)
         # applied_at rides every pull so readers can observe row
         # freshness without an extra RPC (0.0 = never pushed).
         # map_version rides too: a replica-only epoch changes no
@@ -459,13 +541,19 @@ class HostRowService:
 
     def _push_row_grads(self, request: dict) -> dict:
         t0 = time.monotonic()
-        table = self._tables[request["table"]]
+        table_name, table = self._validated_table(request)
         client = request.get("client", "")
         seq = int(request.get("seq", -1))
-        ids = np.asarray(request["ids"], np.int64)
+        ids = self._validated_ids(request)
+        # Shape/dtype-gate the grad block BEFORE any lock or apply: a
+        # malformed block must bounce as INVALID_ARGUMENT, never reach
+        # the native kernels (segfault) or the Python apply (partial
+        # mutation under the lock).
+        grads = self._validated_grads(request, ids, table, table_name)
         prefault = getattr(table, "prefault_group", None)
-        with tracing.span("row_push", table=request["table"],
-                          rows=int(ids.size)):
+        push_span = tracing.span("row_push", table=table_name,
+                                 rows=int(ids.size))
+        with push_span:
             if prefault is not None:
                 # Cold reads for evicted rows (and their optimizer
                 # slots) OUTSIDE the service lock; a duplicate push
@@ -489,13 +577,9 @@ class HostRowService:
                         # semantics).
                         self._m_dup.inc()
                         return {"duplicate": True}
-                self._optimizer.apply_gradients(
-                    table,
-                    ids,
-                    np.asarray(request["grads"], np.float32),
-                )
-                self._table_versions[request["table"]] += 1
-                self._applied_at[request["table"]] = time.time()
+                self._optimizer.apply_gradients(table, ids, grads)
+                self._table_versions[table_name] += 1
+                self._applied_at[table_name] = time.time()
                 if client and seq >= 0:
                     # Record only AFTER apply succeeds: a failed apply
                     # must leave the seq unburned so the client's retry
@@ -529,7 +613,8 @@ class HostRowService:
                 # eviction's cold writes run with the lock released.
                 table.maybe_sweep()
         self._m_pushed.inc(ids.size)
-        self._m_push.observe(time.monotonic() - t0)
+        self._m_push.observe(time.monotonic() - t0,
+                             trace_id=push_span.trace_id)
         if (
             self._saver is not None and self._checkpoint_steps
             and version % self._checkpoint_steps == 0
@@ -2232,6 +2317,26 @@ def main(argv=None):
                              "many entries (served on /traces next to "
                              "/metrics; tools/dump_metrics.py "
                              "--traces); 0 (default) = tracing off")
+    parser.add_argument("--profile_hz", type=float, default=0.0,
+                        help="Always-on sampling profiler rate (Hz); "
+                             "flame windows serve on /profile next to "
+                             "/metrics and piggyback to the master "
+                             "with --master_addr. 0 (default) = off")
+    parser.add_argument("--profile_window_secs", type=float,
+                        default=10.0,
+                        help="Sampling-profiler window length (secs)")
+    parser.add_argument("--master_addr", default="",
+                        help="Report this shard's registry snapshot "
+                             "(plus spans/profile windows) into the "
+                             "master's cluster view every "
+                             "--metrics_report_secs, keyed "
+                             "rowservice-<shard_id> — how master-side "
+                             "SLO rules and incident bundles see the "
+                             "row plane. Empty (default) = standalone")
+    parser.add_argument("--metrics_report_secs", type=float,
+                        default=15.0,
+                        help="Master telemetry report interval (with "
+                             "--master_addr)")
     args = parser.parse_args(argv)
 
     module, _ = load_model_zoo_module(args.model_zoo, args.model_def)
@@ -2275,21 +2380,57 @@ def main(argv=None):
         tracing.install_recorder(
             tracing.FlightRecorder(args.flight_recorder)
         )
+    from elasticdl_tpu.observability import profiler as profiler_mod
+
+    profiler_mod.maybe_start_from_args(
+        args, "rowservice", str(args.shard_id)
+    )
     if args.metrics_port >= 0:
-        # A row-service pod reports to no master, so its registry
-        # (row_service_* counters/latency) is scrapeable directly —
-        # without this its metrics would be write-only. /traces serves
-        # the flight recorder the same way when one is installed.
+        # A row-service pod reports to no master by default, so its
+        # registry (row_service_* counters/latency) is scrapeable
+        # directly — without this its metrics would be write-only.
+        # /traces serves the flight recorder the same way when one is
+        # installed, and /profile the sampling profiler's own flame
+        # windows (tools/dump_metrics.py --profile).
         from elasticdl_tpu.observability import (
             MetricsHTTPServer,
             default_registry,
             render_prometheus,
         )
 
+        def _local_profile(params: dict):
+            prof = profiler_mod.profiler()
+            if prof is None:
+                return {"error": "profiler off (--profile_hz 0)"}
+            merged = profiler_mod.merge_windows(
+                prof.snapshot_windows(include_open=True)
+            )
+            if merged is None:
+                return {"error": "no samples yet"}
+            return {
+                "component": f"rowservice-{args.shard_id}",
+                "window": merged,
+                "folded": profiler_mod.folded_text(merged["samples"]),
+                "pprof": profiler_mod.pprof_json(merged),
+            }
+
         MetricsHTTPServer(
             lambda: render_prometheus(default_registry().snapshot()),
             port=args.metrics_port,
             traces=lambda: {"spans": tracing.recorder_spans()},
+            json_routes={"/profile": _local_profile},
+            render_openmetrics=lambda: render_prometheus(
+                default_registry().snapshot(), exemplars=True
+            ),
+        ).start()
+    if args.master_addr:
+        from elasticdl_tpu.observability.reporter import (
+            ComponentMetricsReporter,
+        )
+
+        ComponentMetricsReporter(
+            args.master_addr, "rowservice", args.shard_id,
+            interval_secs=args.metrics_report_secs,
         ).start()
     service.wait()
 

@@ -209,6 +209,12 @@ class Master:
             tracing.install_recorder(
                 tracing.FlightRecorder(recorder_spans)
             )
+        # Continuous profiling (observability/profiler.py): flame-table
+        # windows from this process land on /profile next to the
+        # piggybacked worker/component profiles.
+        from elasticdl_tpu.observability import profiler as _profiler
+
+        _profiler.maybe_start_from_args(args, "master")
         self.servicer = MasterServicer(
             self.task_dispatcher,
             self.evaluation_service,

@@ -26,6 +26,12 @@ layer a shared measurement substrate instead:
                    ``elasticdl_tpu trace`` CLI;
 - ``critical_path``: per-step critical-path and straggler-attribution
                    reports over collected span trees;
+- ``profiler``:    the continuous-profiling plane — an always-on
+                   sampling profiler folding Python stacks into
+                   bounded flame tables, windows piggybacked to the
+                   master's ``ProfileStore`` and served on
+                   ``/profile`` (folded text, pprof-style JSON,
+                   differential views, span-derived phase stacks);
 - ``timeseries``:  the master-side ring time-series store sampling the
                    registries above (counters as rates, gauges as-is,
                    histograms as rolling quantiles; hot + downsampled
@@ -46,6 +52,10 @@ from elasticdl_tpu.observability.aggregator import (  # noqa: F401
 from elasticdl_tpu.observability.exposition import (  # noqa: F401
     MetricsHTTPServer,
     render_prometheus,
+)
+from elasticdl_tpu.observability.profiler import (  # noqa: F401
+    ProfileStore,
+    SamplingProfiler,
 )
 from elasticdl_tpu.observability.registry import (  # noqa: F401
     MetricsRegistry,

@@ -271,6 +271,7 @@ class MetricsPlane:
 
     def __init__(self, registry: Optional[MetricsRegistry] = None,
                  ttl_secs: float = 60.0, summary_writer=None):
+        from elasticdl_tpu.observability.profiler import ProfileStore
         from elasticdl_tpu.observability.tracing import TraceCollector
 
         self.registry = registry or default_registry()
@@ -279,6 +280,10 @@ class MetricsPlane:
         # same worker snapshots the cluster view merges (a "spans" key
         # next to "families"); the collector dedups by span id.
         self.traces = TraceCollector()
+        # Continuous-profiling collection: flame-table windows ride the
+        # same snapshots under a "profiles" key (and the master's own
+        # profiler folds in via pull_local); served on /profile.
+        self.profiles = ProfileStore()
         # The SLO plane (optional, see enable_timeseries/enable_slo):
         # a time-series store periodically sampling this plane, and a
         # rule engine evaluated right after each sample.
@@ -296,6 +301,9 @@ class MetricsPlane:
         spans = snapshot.pop("spans", None) if snapshot else None
         if spans:
             self.traces.ingest(spans)
+        profiles = snapshot.pop("profiles", None) if snapshot else None
+        if profiles:
+            self.profiles.ingest(str(worker_id), profiles)
         self.cluster.ingest(worker_id, snapshot)
 
     def remove_worker(self, worker_id):
@@ -306,10 +314,20 @@ class MetricsPlane:
         self.cluster.remove_worker(worker_id)
         if self.timeseries is not None:
             self.timeseries.drop_source(str(worker_id))
+        self.profiles.drop_source(str(worker_id))
 
     def render(self) -> str:
         return render_prometheus(
             self.registry.snapshot(), self.cluster.snapshots()
+        )
+
+    def render_openmetrics(self) -> str:
+        """The OpenMetrics form (histogram exemplars included) served
+        when a scraper's Accept asks for it — exemplars are illegal in
+        the classic 0.0.4 text the default render emits."""
+        return render_prometheus(
+            self.registry.snapshot(), self.cluster.snapshots(),
+            exemplars=True,
         )
 
     def trace_spans(self) -> list:
@@ -407,13 +425,38 @@ class MetricsPlane:
                         "firing": []}
             return self.slo.render()
 
-        return {"/timeseries": timeseries_route, "/alerts": alerts_route}
+        def profile_route(params: dict):
+            # /profile?component=<key>&window=<secs>[&base=<secs back>]
+            # [&spans=0]: the flame view of one component (folded text
+            # + pprof-style JSON), optionally differential against the
+            # same-length window ending `base` seconds earlier, with
+            # the component's trace spans folded in as `phases;...`
+            # pseudo-stacks (device/phase attribution). No component =
+            # the list of components with profile data.
+            component = params.get("component")
+            if component is None:
+                self.profiles.pull_local()
+                return {"components": self.profiles.components()}
+            window = float(params.get("window") or 60.0)
+            base = params.get("base")
+            spans = None
+            if params.get("spans", "1") != "0":
+                spans = self.trace_spans()
+            return self.profiles.render(
+                component, window_secs=window,
+                base_secs=float(base) if base else None,
+                spans=spans,
+            )
+
+        return {"/timeseries": timeseries_route, "/alerts": alerts_route,
+                "/profile": profile_route}
 
     def serve(self, port: int = 0, host: str = "") -> MetricsHTTPServer:
         self._http = MetricsHTTPServer(
             self.render, port=port, host=host,
             traces=self.render_traces,
             json_routes=self._json_routes(),
+            render_openmetrics=self.render_openmetrics,
         ).start()
         return self._http
 

@@ -27,6 +27,13 @@ from elasticdl_tpu.common.log_utils import get_logger
 logger = get_logger("metrics_http")
 
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+# Exemplars are only legal in the OpenMetrics wire format — a classic
+# 0.0.4 parser rejects the mid-line `#` — so /metrics serves them only
+# to clients that ASK via Accept (exactly Prometheus's negotiation),
+# terminated by the mandatory `# EOF`.
+OPENMETRICS_CONTENT_TYPE = (
+    "application/openmetrics-text; version=1.0.0; charset=utf-8"
+)
 
 
 def _escape_help(text: str) -> str:
@@ -58,25 +65,51 @@ def _label_str(labelnames, labelvalues, extra: Dict[str, str]) -> str:
     return "{%s}" % ",".join(parts) if parts else ""
 
 
+def _exemplar_suffix(series: dict, index: int) -> str:
+    """OpenMetrics exemplar rendering for one bucket line:
+    `` # {trace_id="..."} value timestamp`` — how a scrape links a
+    histogram bucket to one concrete trace (docs/observability.md
+    "Continuous profiling & exemplars"). Empty when the series carries
+    no exemplar for that bucket."""
+    exemplars = series.get("exemplars")
+    if not exemplars:
+        return ""
+    entry = exemplars.get(str(index))
+    if not entry:
+        return ""
+    value, trace_id, ts = entry
+    return (
+        f' # {{trace_id="{_escape_label_value(str(trace_id))}"}}'
+        f" {_format_value(float(value))} {float(ts):.3f}"
+    )
+
+
 def _render_series(lines, family: dict, series: dict,
-                   extra: Dict[str, str]):
+                   extra: Dict[str, str], exemplars: bool = False):
     name = family["name"]
     labelnames = family.get("labelnames", [])
     values = series.get("labels", [])
     if family["kind"] == "histogram":
         cumulative = 0
-        for ub, n in zip(family["buckets"], series["buckets"]):
+        for i, (ub, n) in enumerate(
+            zip(family["buckets"], series["buckets"])
+        ):
             cumulative += n
             le = {"le": _format_value(ub)}
+            suffix = _exemplar_suffix(series, i) if exemplars else ""
             lines.append(
                 f"{name}_bucket"
                 f"{_label_str(labelnames, values, {**extra, **le})}"
-                f" {cumulative}"
+                f" {cumulative}{suffix}"
             )
+        suffix = (
+            _exemplar_suffix(series, len(family["buckets"]))
+            if exemplars else ""
+        )
         lines.append(
             f"{name}_bucket"
             f"{_label_str(labelnames, values, {**extra, 'le': '+Inf'})}"
-            f" {series['count']}"
+            f" {series['count']}{suffix}"
         )
         lines.append(
             f"{name}_sum{_label_str(labelnames, values, extra)}"
@@ -96,12 +129,19 @@ def _render_series(lines, family: dict, series: dict,
 def render_prometheus(
     local_snapshot: Optional[dict] = None,
     worker_snapshots: Optional[Dict[int, dict]] = None,
+    exemplars: bool = False,
 ) -> str:
     """Render the master-local snapshot plus per-worker snapshots.
 
     Families appearing in several snapshots (every worker instruments
     the same code) emit ONE ``# HELP``/``# TYPE`` header; worker series
     carry a ``worker`` label, master-local series none.
+
+    ``exemplars=True`` renders captured histogram exemplars as
+    OpenMetrics bucket-line suffixes — ONLY legal on the OpenMetrics
+    content type (the /metrics handler negotiates via Accept); the
+    classic 0.0.4 rendering must stay exemplar-free or standard
+    Prometheus parsers reject the whole scrape.
     """
     # family name -> (family dict, [(series, extra_labels)])
     merged: Dict[str, tuple] = {}
@@ -127,7 +167,8 @@ def render_prometheus(
         lines.append(f"# HELP {name} {_escape_help(family.get('help', ''))}")
         lines.append(f"# TYPE {name} {family['kind']}")
         for owning_family, series, extra in series_list:
-            _render_series(lines, owning_family, series, extra)
+            _render_series(lines, owning_family, series, extra,
+                           exemplars=exemplars)
     return "\n".join(lines) + "\n"
 
 
@@ -135,6 +176,9 @@ class _Handler(BaseHTTPRequestHandler):
     # Populated per-server via functools.partial-style subclassing in
     # MetricsHTTPServer.start().
     render: Callable[[], str] = staticmethod(lambda: "")
+    # OpenMetrics rendering (with exemplars) served when the client's
+    # Accept names it; None = classic only.
+    render_openmetrics: Optional[Callable[[], str]] = None
     traces: Optional[Callable[[], dict]] = None
     # path -> callable(query_params_dict) -> JSON-able object; how the
     # SLO plane mounts /timeseries and /alerts without this module
@@ -152,7 +196,13 @@ class _Handler(BaseHTTPRequestHandler):
         path, _, query = self.path.partition("?")
         routes = type(self).json_routes
         if path == "/metrics":
+            om = type(self).render_openmetrics
+            accept = self.headers.get("Accept", "") or ""
             try:
+                if om is not None and "openmetrics" in accept:
+                    body = (om() + "# EOF\n").encode("utf-8")
+                    self._reply(body, OPENMETRICS_CONTENT_TYPE)
+                    return
                 body = type(self).render().encode("utf-8")
             except Exception as exc:
                 self.send_error(500, f"{type(exc).__name__}: {exc}")
@@ -200,8 +250,11 @@ class MetricsHTTPServer:
                  host: str = "",
                  traces: Optional[Callable[[], dict]] = None,
                  json_routes: Optional[
-                     Dict[str, Callable[[dict], object]]] = None):
+                     Dict[str, Callable[[dict], object]]] = None,
+                 render_openmetrics: Optional[
+                     Callable[[], str]] = None):
         self._render = render
+        self._render_openmetrics = render_openmetrics
         self._traces = traces
         self._json_routes = dict(json_routes or {})
         self._host = host
@@ -212,6 +265,10 @@ class MetricsHTTPServer:
     def start(self) -> "MetricsHTTPServer":
         handler = type("_BoundHandler", (_Handler,), {
             "render": staticmethod(self._render),
+            "render_openmetrics": (
+                staticmethod(self._render_openmetrics)
+                if self._render_openmetrics is not None else None
+            ),
             "traces": (
                 staticmethod(self._traces)
                 if self._traces is not None else None

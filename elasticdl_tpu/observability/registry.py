@@ -19,12 +19,29 @@ metric is a bug, not a merge.
 """
 
 import threading
+import time
 import uuid
 from typing import Callable, Dict, Optional, Sequence, Tuple
 
 COUNTER = "counter"
 GAUGE = "gauge"
 HISTOGRAM = "histogram"
+
+# Lazily bound to tracing.current_trace_id on the first exemplar-enabled
+# observation (tracing imports nothing from here, so the import is
+# safe; lazy keeps registry import-light for the many modules that
+# never enable exemplars).
+_ambient_trace_id: Optional[Callable[[], Optional[str]]] = None
+
+
+def _trace_id_now() -> Optional[str]:
+    global _ambient_trace_id
+    fn = _ambient_trace_id
+    if fn is None:
+        from elasticdl_tpu.observability.tracing import current_trace_id
+
+        fn = _ambient_trace_id = current_trace_id
+    return fn()
 
 # Default latency buckets (seconds): 100µs .. ~2min, roughly 3x apart —
 # spans a single fused device step up to a straggling task.
@@ -46,6 +63,13 @@ class _Series:
             self.bucket_counts = [0] * len(family.buckets)
             self.sum = 0.0
             self.count = 0
+            # Exemplars (opt-in per family): bucket index -> (value,
+            # trace_id, unix ts) of the latest trace-linked observation
+            # landing there — OpenMetrics-shaped, O(1) per observe, so
+            # an alert's "p99 burned" can name one concrete offending
+            # trace (docs/observability.md "Continuous profiling &
+            # exemplars"). Index len(buckets) = the +Inf overflow.
+            self.exemplars: Dict[int, tuple] = {}
 
     # ---- counter / gauge ----------------------------------------------
 
@@ -78,28 +102,49 @@ class _Series:
 
     # ---- histogram -----------------------------------------------------
 
-    def observe(self, value: float):
+    def observe(self, value: float, trace_id: Optional[str] = None):
+        """``trace_id`` links this observation to a trace (exemplar-
+        enabled families only). None falls back to the thread's
+        innermost open span — call sites whose span already closed
+        pass the id explicitly."""
         if self._family.kind != HISTOGRAM:
             raise ValueError("observe() is histogram-only")
         value = float(value)
         with self._lock:
+            idx = len(self._family.buckets)
             for i, ub in enumerate(self._family.buckets):
                 if value <= ub:
                     self.bucket_counts[i] += 1
+                    idx = i
                     break
             self.sum += value
             self.count += 1
+            if self._family.exemplars:
+                if trace_id is None:
+                    trace_id = _trace_id_now()
+                if trace_id:
+                    self.exemplars[idx] = (
+                        value, str(trace_id), time.time()
+                    )
 
     # ---- snapshot ------------------------------------------------------
 
     def _snapshot_locked(self, label_values: Tuple[str, ...]) -> dict:
         if self._family.kind == HISTOGRAM:
-            return {
+            out = {
                 "labels": list(label_values),
                 "buckets": list(self.bucket_counts),
                 "sum": float(self.sum),
                 "count": int(self.count),
             }
+            if self.exemplars:
+                # str keys: the snapshot must stay msgpack/json-safe
+                # end to end (piggyback RPCs, incident bundles).
+                out["exemplars"] = {
+                    str(i): [float(v), tid, float(ts)]
+                    for i, (v, tid, ts) in self.exemplars.items()
+                }
+            return out
         value = self.value
         if self._fn is not None:
             try:
@@ -118,12 +163,18 @@ class MetricFamily:
 
     def __init__(self, registry: "MetricsRegistry", name: str, kind: str,
                  help_text: str, labelnames: Sequence[str],
-                 buckets: Sequence[float] = DEFAULT_BUCKETS):
+                 buckets: Sequence[float] = DEFAULT_BUCKETS,
+                 exemplars: bool = False):
         self.name = name
         self.kind = kind
         self.help = help_text
         self.labelnames = tuple(labelnames)
         self.buckets = tuple(sorted(float(b) for b in buckets))
+        # Exemplar capture (histograms only): opt-in because every
+        # enabled observe pays a thread-local read; idempotent
+        # re-declaration ORs the flag (several call sites may declare
+        # one family, any of them opting in wins).
+        self.exemplars = bool(exemplars) and kind == HISTOGRAM
         self._lock = registry._lock
         self._series: Dict[Tuple[str, ...], _Series] = {}
         if not self.labelnames:
@@ -159,8 +210,8 @@ class MetricFamily:
     def set_function(self, fn: Callable[[], float]):
         self.labels().set_function(fn)
 
-    def observe(self, value: float):
-        self.labels().observe(value)
+    def observe(self, value: float, trace_id: Optional[str] = None):
+        self.labels().observe(value, trace_id=trace_id)
 
     def snapshot(self) -> dict:
         with self._lock:
@@ -203,7 +254,8 @@ class MetricsRegistry:
 
     def _family(self, name: str, kind: str, help_text: str,
                 labelnames: Sequence[str],
-                buckets: Sequence[float] = DEFAULT_BUCKETS) -> MetricFamily:
+                buckets: Sequence[float] = DEFAULT_BUCKETS,
+                exemplars: bool = False) -> MetricFamily:
         full = f"{self.namespace}_{name}" if self.namespace else name
         with self._lock:
             family = self._families.get(full)
@@ -221,9 +273,12 @@ class MetricsRegistry:
                         f"histogram {full} re-declared with buckets "
                         f"{tuple(buckets)}; existing is {family.buckets}"
                     )
+                if exemplars and kind == HISTOGRAM:
+                    family.exemplars = True
                 return family
             family = MetricFamily(
-                self, full, kind, help_text, labelnames, buckets
+                self, full, kind, help_text, labelnames, buckets,
+                exemplars=exemplars,
             )
             self._families[full] = family
             return family
@@ -239,8 +294,9 @@ class MetricsRegistry:
     def histogram(self, name: str, help_text: str = "",
                   labelnames: Sequence[str] = (),
                   buckets: Sequence[float] = DEFAULT_BUCKETS,
-                  ) -> MetricFamily:
-        return self._family(name, HISTOGRAM, help_text, labelnames, buckets)
+                  exemplars: bool = False) -> MetricFamily:
+        return self._family(name, HISTOGRAM, help_text, labelnames,
+                            buckets, exemplars=exemplars)
 
     def snapshot(self) -> dict:
         """Plain-dict snapshot of every family (msgpack/json-safe) —

@@ -2,15 +2,22 @@
 view.
 
 Workers piggyback registry snapshots on RPCs they already make; a
-process with no task loop (the serving router, a predict replica) has
-nothing to piggyback on, so this thread periodically pushes the
-snapshot over the master's ``report_metrics`` RPC instead. The master
-keys it ``<component>-<id>`` — same TTL aging, same exposition
-(``worker="router-0"`` / ``worker="serving-1"`` labels), same
-time-series sampling as any worker, which is what lets master-side SLO
-rules (e.g. the default ``row-freshness`` rule over the replicas'
-``edl_tpu_row_freshness_seconds``) watch the whole fleet
+process with no task loop (the serving router, a predict replica, a
+row-service shard) has nothing to piggyback on, so this thread
+periodically pushes the snapshot over the master's ``report_metrics``
+RPC instead. The master keys it ``<component>-<id>`` — same TTL aging,
+same exposition (``worker="router-0"`` / ``worker="serving-1"``
+labels), same time-series sampling as any worker, which is what lets
+master-side SLO rules (e.g. the default ``row-freshness`` rule over
+the replicas' ``edl_tpu_row_freshness_seconds``) watch the whole fleet
 (docs/observability.md "Time series").
+
+Like the worker's piggyback, the snapshot carries the process's trace
+spans (``spans`` key) and continuous-profiling windows (``profiles``
+key) when a flight recorder / sampling profiler is installed — cursors
+commit only on a CONFIRMED delivery, so spans/windows offered on a
+failed report are re-offered next interval instead of being lost with
+the outage they describe.
 """
 
 import threading
@@ -42,21 +49,39 @@ class ComponentMetricsReporter(threading.Thread):
         self._registry = registry or default_registry()
         self._stop = threading.Event()
         self._stub = None
+        self._span_cursor = 0
+        self._profile_cursor = 0
         self.reports_sent = 0
 
     def send_once(self):
         from elasticdl_tpu.comm.rpc import RpcStub
+        from elasticdl_tpu.observability import profiler, tracing
 
         if self._stub is None:
             self._stub = RpcStub(
                 self._master_addr, "elasticdl_tpu.Master"
             )
+        snapshot = self._registry.snapshot()
+        spans, span_offer = tracing.spans_since(self._span_cursor)
+        if spans:
+            snapshot["spans"] = spans
+        windows, profile_offer = profiler.windows_since(
+            self._profile_cursor
+        )
+        if windows:
+            snapshot["profiles"] = windows
         try:
             self._stub.call(
                 "report_metrics", component=self._component,
                 component_id=self._component_id,
-                metrics=self._registry.snapshot(),
+                metrics=snapshot,
             )
+            # Confirmed delivery: advance past what this report
+            # carried (the master dedups re-offers anyway — by span id
+            # and by window (seq, t0) — but the cursors keep re-sends
+            # bounded).
+            self._span_cursor = span_offer
+            self._profile_cursor = profile_offer
             self.reports_sent += 1
         except Exception as exc:
             logger.warning(

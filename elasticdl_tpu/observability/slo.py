@@ -522,13 +522,22 @@ class IncidentRecorder:
                                 # breach (hot tier)
             journal_tail.json   # last N master-journal records (when
                                 # the master runs with --journal_dir)
+            profile.json        # per-component flame-table windows
+                                # from the ProfileStore (when the fleet
+                                # runs with --profile_hz) — WHICH CODE
+                                # was burning when the rule fired
+            exemplars.json      # the breached series' exemplar traces
+                                # (value + trace id), resolvable
+                                # against trace.json
     """
 
     def __init__(self, out_dir: str,
                  metrics_plane=None,
                  store: Optional[TimeSeriesStore] = None,
                  journal_tail_fn: Optional[Callable[[], list]] = None,
+                 profile_store=None,
                  window_secs: float = 900.0,
+                 profile_window_secs: float = 120.0,
                  cooldown_secs: float = 300.0,
                  background: bool = True,
                  clock: Callable[[], float] = time.time):
@@ -536,7 +545,13 @@ class IncidentRecorder:
         self.metrics_plane = metrics_plane
         self.store = store
         self.journal_tail_fn = journal_tail_fn
+        # Default to the plane's store so every --incident_dir master
+        # bundles profiles once any component runs with --profile_hz.
+        if profile_store is None and metrics_plane is not None:
+            profile_store = getattr(metrics_plane, "profiles", None)
+        self.profile_store = profile_store
         self.window_secs = float(window_secs)
+        self.profile_window_secs = float(profile_window_secs)
         self.cooldown_secs = float(cooldown_secs)
         # Captures serialize thousands of spans + a long series window
         # to disk — by default that happens on a daemon thread, NOT on
@@ -656,9 +671,68 @@ class IncidentRecorder:
                 lambda: list(self.journal_tail_fn()), [],
             )
         self._write_json(path, "journal_tail.json", {"records": tail})
+        profile = {"window_secs": self.profile_window_secs,
+                   "components": {}}
+        if self.profile_store is not None:
+            profile = stage(
+                "profile",
+                lambda: self.profile_store.bundle_capture(
+                    window_secs=self.profile_window_secs
+                ),
+                profile,
+            )
+        self._write_json(path, "profile.json", profile)
+        self._write_json(path, "exemplars.json", stage(
+            "exemplar",
+            lambda: self._collect_exemplars(alert_state),
+            {"series": alert_state.get("series"), "exemplars": []},
+        ))
         self.bundles.append(path)
         logger.warning("incident bundle written: %s (%d spans)",
                        path, len(spans))
+
+    def _collect_exemplars(self, alert_state: dict) -> dict:
+        """The breached rule's exemplar traces: scan the master-local
+        registry and every live cluster snapshot for the rule's series
+        family, collecting each series' exemplars (bucket bound, value,
+        trace id, timestamp). These trace ids resolve against
+        ``trace.json`` — the metric→trace rung the bundle exists for."""
+        family_name = str(alert_state.get("series") or "")
+        out = []
+        if not family_name or self.metrics_plane is None:
+            return {"series": family_name, "exemplars": out}
+        sources = {"": self.metrics_plane.registry.snapshot()}
+        sources.update({
+            str(wid): snap
+            for wid, snap in
+            self.metrics_plane.cluster.snapshots().items()
+        })
+        for source, snapshot in sorted(sources.items()):
+            for family in (snapshot or {}).get("families", []):
+                if family.get("name") != family_name:
+                    continue
+                buckets = family.get("buckets") or []
+                for series in family.get("series", []):
+                    for idx, entry in sorted(
+                        (series.get("exemplars") or {}).items()
+                    ):
+                        try:
+                            i = int(idx)
+                            value, trace_id, ts = entry
+                        except (TypeError, ValueError):
+                            continue
+                        out.append({
+                            "source": source,
+                            "labels": list(series.get("labels", [])),
+                            "bucket_le": (
+                                float(buckets[i]) if i < len(buckets)
+                                else None  # +Inf overflow
+                            ),
+                            "value": float(value),
+                            "trace_id": str(trace_id),
+                            "ts": float(ts),
+                        })
+        return {"series": family_name, "exemplars": out}
 
     @staticmethod
     def _write_json(bundle: str, name: str, payload):

@@ -92,6 +92,15 @@ def current_ctx() -> Optional[dict]:
     return {"trace_id": trace_id, "span_id": span_id}
 
 
+def current_trace_id() -> Optional[str]:
+    """Trace id of the innermost open span, or None — what
+    exemplar-enabled histograms stamp onto sampled observations
+    (``registry.py``): two thread-local reads, cheap enough for any
+    hot path."""
+    stack = getattr(_local, "stack", None)
+    return stack[-1][0] if stack else None
+
+
 def _new_id() -> str:
     # uuid4 = urandom: identity never derives from wall-clock (chaos
     # same-seed byte-identity must survive a recorder being installed).

@@ -346,6 +346,9 @@ class HostRowResolver:
             "row_freshness_seconds",
             "Push-to-servable latency: age of the row service's last "
             "applied push at serving-read time",
+            # Observed inside the row_resolve span: a stale read's
+            # exemplar names the serving request that saw it.
+            exemplars=True,
         )
 
     def resolve(self, features: dict) -> dict:

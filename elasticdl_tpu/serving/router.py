@@ -1239,6 +1239,16 @@ def main(argv=None) -> int:
         "--replica_slo_error_ratio", type=float, default=0.05,
         help="Per-replica windowed error-ratio objective",
     )
+    parser.add_argument(
+        "--profile_hz", type=float, default=0.0,
+        help="Always-on sampling profiler rate (Hz); flame windows "
+             "piggyback to the master with --master_addr and serve "
+             "on the master's /profile as router-<id>. 0 = off",
+    )
+    parser.add_argument(
+        "--profile_window_secs", type=float, default=10.0,
+        help="Sampling-profiler window length (secs)",
+    )
     args = parser.parse_args(argv)
 
     if args.flight_recorder > 0:
@@ -1246,6 +1256,9 @@ def main(argv=None) -> int:
         tracing.install_recorder(
             tracing.FlightRecorder(args.flight_recorder)
         )
+    from elasticdl_tpu.observability import profiler as _profiler
+
+    _profiler.maybe_start_from_args(args, "router", str(args.router_id))
 
     addrs = [a.strip() for a in args.replicas.split(",") if a.strip()]
     server = RouterServer(

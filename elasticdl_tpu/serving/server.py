@@ -206,6 +206,9 @@ class BatchingPredictor:
         self._m_latency = registry.histogram(
             "serving_request_seconds",
             "End-to-end predict latency (enqueue to reply)",
+            # Observed inside the request span: slow requests carry
+            # their trace id as an OpenMetrics exemplar.
+            exemplars=True,
         )
         self._m_batch_seconds = registry.histogram(
             "serving_batch_seconds",
@@ -910,6 +913,16 @@ def main(argv=None) -> int:
         "--metrics_report_secs", type=float, default=15.0,
         help="Master telemetry report interval (with --master_addr)",
     )
+    parser.add_argument(
+        "--profile_hz", type=float, default=0.0,
+        help="Always-on sampling profiler rate (Hz); flame windows "
+             "piggyback to the master with --master_addr and serve "
+             "on the master's /profile as serving-<id>. 0 = off",
+    )
+    parser.add_argument(
+        "--profile_window_secs", type=float, default=10.0,
+        help="Sampling-profiler window length (secs)",
+    )
     args = parser.parse_args(argv)
 
     if args.flight_recorder > 0:
@@ -919,6 +932,11 @@ def main(argv=None) -> int:
         tracing.install_recorder(
             tracing.FlightRecorder(args.flight_recorder)
         )
+    from elasticdl_tpu.observability import profiler as _profiler
+
+    _profiler.maybe_start_from_args(
+        args, "serving", str(args.replica_id)
+    )
 
     from elasticdl_tpu.serving.model_store import ModelStore
 

@@ -212,6 +212,13 @@ def build_worker(args, master_client=None) -> Worker:
         tracing.install_recorder(
             tracing.FlightRecorder(recorder_spans)
         )
+    # Continuous profiling: windows piggyback to the master inside the
+    # same metrics snapshots as spans (observability/profiler.py).
+    from elasticdl_tpu.observability import profiler as _profiler
+
+    _profiler.maybe_start_from_args(
+        args, "worker", str(args.worker_id)
+    )
     import jax as _jax
 
     checkpoint_hook = None

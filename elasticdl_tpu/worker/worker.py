@@ -106,6 +106,11 @@ class Worker:
         # dedups by span id, so an ambiguous failure resends harmlessly).
         self._trace_cursor = 0
         self._trace_cursor_offered = 0
+        # Same offered/committed discipline for continuous-profiling
+        # windows (observability/profiler.py): the store dedups by
+        # (seq, t0), so an ambiguous failure resends harmlessly.
+        self._profile_cursor = 0
+        self._profile_cursor_offered = 0
         self._task_data = TaskDataService(
             master_client, data_reader, model_spec.dataset_fn,
             minibatch_size, prefetch_depth=prefetch_depth,
@@ -342,12 +347,21 @@ class Worker:
         )
         if spans:
             snapshot["spans"] = spans
+        from elasticdl_tpu.observability import profiler
+
+        windows, self._profile_cursor_offered = profiler.windows_since(
+            self._profile_cursor
+        )
+        if windows:
+            snapshot["profiles"] = windows
         return snapshot
 
     def _metrics_delivered(self):
         """The RPC carrying the last snapshot succeeded — its spans
-        reached the master; advance the ring cursor past them."""
+        and profile windows reached the master; advance the cursors
+        past them."""
         self._trace_cursor = self._trace_cursor_offered
+        self._profile_cursor = self._profile_cursor_offered
 
     def _master_call(self, fn, description: str):
         """Run a master RPC, riding out transient unavailability up to

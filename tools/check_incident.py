@@ -25,7 +25,16 @@ human-readable errors, empty = pass):
   at least one series with at least one point, and the rule's own
   series family present when the store sampled it;
 - ``journal_tail.json``: present and well-formed (an empty record list
-  is fine — journal-less masters still bundle).
+  is fine — journal-less masters still bundle);
+- ``profile.json``: when present and non-empty, every component's
+  flame window passes ``tools/check_profile.py``; with
+  ``--require-profile`` an empty/missing capture FAILS (a fleet run
+  with ``--profile_hz`` must leave flame tables in its black box);
+- ``exemplars.json``: when present, well-formed exemplar entries
+  (value + trace id per breached-series bucket); with
+  ``--require-exemplars`` at least one entry must exist AND resolve to
+  a span recorded in ``trace.json`` — the metric→trace link the
+  bundle exists for.
 
 Stdlib only, importable from tests (``check_incident(path)``).
 """
@@ -34,6 +43,11 @@ import json
 import os
 import sys
 from typing import List, Optional
+
+try:
+    from tools.check_profile import check_bundle_profile
+except ImportError:  # executed as a script from inside tools/
+    from check_profile import check_bundle_profile
 
 
 def _load(bundle: str, name: str, errors: List[str]) -> Optional[dict]:
@@ -95,7 +109,59 @@ def _check_trace_events(trace: dict, errors: List[str]):
         )
 
 
-def check_incident(bundle: str) -> List[str]:
+def _trace_ids_in(trace: Optional[dict]) -> set:
+    """Trace ids of every span event in a chrome_trace payload (the
+    exporter stamps them into event args)."""
+    ids = set()
+    for ev in (trace or {}).get("traceEvents", []) or []:
+        if isinstance(ev, dict) and ev.get("ph") == "X":
+            tid = (ev.get("args") or {}).get("trace_id")
+            if tid:
+                ids.add(str(tid))
+    return ids
+
+
+def _check_exemplars(payload, trace: Optional[dict],
+                     require: bool, errors: List[str]):
+    if not isinstance(payload, dict):
+        errors.append("exemplars.json: not an object")
+        return
+    entries = payload.get("exemplars")
+    if not isinstance(entries, list):
+        errors.append("exemplars.json: 'exemplars' not a list")
+        return
+    trace_ids = set()
+    for i, entry in enumerate(entries):
+        if not isinstance(entry, dict):
+            errors.append(f"exemplars.json: entry {i} not an object")
+            continue
+        if not entry.get("trace_id"):
+            errors.append(f"exemplars.json: entry {i} has no trace_id")
+            continue
+        if not isinstance(entry.get("value"), (int, float)):
+            errors.append(
+                f"exemplars.json: entry {i} has no numeric value"
+            )
+        trace_ids.add(str(entry["trace_id"]))
+    if not require:
+        return
+    if not trace_ids:
+        errors.append(
+            "exemplars.json: no exemplar captured for the breached "
+            "series (are its histograms exemplar-enabled and traced?)"
+        )
+        return
+    resolved = trace_ids & _trace_ids_in(trace)
+    if not resolved:
+        errors.append(
+            "exemplars.json: no exemplar trace id resolves to a span "
+            f"in trace.json ({len(trace_ids)} exemplar trace ids, "
+            f"{len(_trace_ids_in(trace))} trace ids in the timeline)"
+        )
+
+
+def check_incident(bundle: str, require_profile: bool = False,
+                   require_exemplars: bool = False) -> List[str]:
     errors: List[str] = []
     if not os.path.isdir(bundle):
         return [f"{bundle}: not a directory"]
@@ -147,6 +213,33 @@ def check_incident(bundle: str) -> List[str]:
     tail = _load(bundle, "journal_tail.json", errors)
     if tail is not None and not isinstance(tail.get("records"), list):
         errors.append("journal_tail.json: 'records' not a list")
+
+    # Continuous-profiling additions (older bundles predate them:
+    # absent files only fail under the require flags).
+    profile_path = os.path.join(bundle, "profile.json")
+    if os.path.exists(profile_path):
+        profile = _load(bundle, "profile.json", errors)
+        if profile is not None:
+            has_components = bool(profile.get("components"))
+            if has_components:
+                errors.extend(check_bundle_profile(profile))
+            elif require_profile:
+                errors.append(
+                    "profile.json: no component carries profile "
+                    "windows (is anything running --profile_hz?)"
+                )
+    elif require_profile:
+        errors.append("profile.json: missing")
+
+    exemplars_path = os.path.join(bundle, "exemplars.json")
+    if os.path.exists(exemplars_path):
+        exemplars = _load(bundle, "exemplars.json", errors)
+        if exemplars is not None:
+            _check_exemplars(
+                exemplars, trace, require_exemplars, errors
+            )
+    elif require_exemplars:
+        errors.append("exemplars.json: missing")
     return errors
 
 
@@ -166,8 +259,23 @@ def newest_bundle(parent: str) -> Optional[str]:
 
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
+    require_profile = "--require-profile" in argv
+    require_exemplars = "--require-exemplars" in argv
+    unknown = [
+        a for a in argv
+        if a.startswith("--")
+        and a not in ("--require-profile", "--require-exemplars")
+    ]
+    if unknown:
+        # A typo'd flag must fail loudly, not silently run the check
+        # without the strictness it was meant to enforce.
+        print(f"check_incident: unknown flag(s) {unknown}",
+              file=sys.stderr)
+        return 2
+    argv = [a for a in argv if not a.startswith("--")]
     if len(argv) != 1:
-        print("usage: check_incident.py INCIDENT_DIR", file=sys.stderr)
+        print("usage: check_incident.py [--require-profile] "
+              "[--require-exemplars] INCIDENT_DIR", file=sys.stderr)
         return 2
     path = argv[0]
     if os.path.isdir(path) and not os.path.exists(
@@ -180,7 +288,10 @@ def main(argv=None) -> int:
                   file=sys.stderr)
             return 1
         path = bundle
-    errors = check_incident(path)
+    errors = check_incident(
+        path, require_profile=require_profile,
+        require_exemplars=require_exemplars,
+    )
     if errors:
         for err in errors:
             print(f"check_incident: {err}", file=sys.stderr)

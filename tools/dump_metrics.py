@@ -7,6 +7,7 @@ Usage::
     python tools/dump_metrics.py http://host:port --raw  # exposition text
     python tools/dump_metrics.py localhost:8080 --traces # + span trees
     python tools/dump_metrics.py localhost:8080 --alerts # + /alerts
+    python tools/dump_metrics.py localhost:8080 --profile rowservice-0
     python tools/dump_metrics.py localhost:8080 --watch 5  # live redraw
     make metrics METRICS_ADDR=localhost:8080
 
@@ -33,7 +34,10 @@ import urllib.request
 
 _SAMPLE_RE = re.compile(
     r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
-    r"(?P<labels>\{.*\})?\s+(?P<value>\S+)$"
+    r"(?P<labels>\{.*?\})?\s+(?P<value>\S+)"
+    # Optional OpenMetrics exemplar suffix on histogram bucket lines:
+    # ` # {trace_id="..."} value ts` (docs/observability.md).
+    r"(?P<exemplar>\s+#\s+\{.*\}\s+\S+(\s+\S+)?)?$"
 )
 
 
@@ -179,6 +183,88 @@ def fetch_alerts(addr: str, timeout: float = 10.0) -> dict:
         return json.loads(resp.read().decode("utf-8"))
 
 
+def fetch_profile(addr: str, component: str, window: float,
+                  timeout: float = 10.0) -> dict:
+    """The continuous-profiling plane's /profile body for one
+    component (docs/observability.md "Continuous profiling &
+    exemplars")."""
+    import urllib.parse as _parse
+
+    query = _parse.urlencode(
+        {"component": component, "window": window}
+    )
+    with urllib.request.urlopen(
+        sibling_url(addr, f"/profile?{query}"), timeout=timeout
+    ) as resp:
+        return json.loads(resp.read().decode("utf-8"))
+
+
+def print_profile(profile: dict, top: int = 20, out=None):
+    """Top-N frames by self time (self/total %), then the heaviest
+    folded stacks — the terminal flame graph."""
+    import importlib.util as _importlib_util
+    import os as _os
+
+    out = out if out is not None else sys.stdout
+    if profile.get("error"):
+        out.write(f"no profile: {profile['error']}\n")
+        for comp in profile.get("components", []):
+            out.write(
+                f"  available: {comp.get('component')!r} "
+                f"({comp.get('role')}/{comp.get('instance')}, "
+                f"{comp.get('windows')} windows)\n"
+            )
+        return
+    # Reuse the profiler's own reductions when importable (running
+    # from the repo); fall back to a local load so the tool also works
+    # copied around standalone.
+    try:
+        from elasticdl_tpu.observability.profiler import top_frames
+    except ImportError:
+        spec = _importlib_util.spec_from_file_location(
+            "_edl_profiler",
+            _os.path.join(
+                _os.path.dirname(_os.path.dirname(
+                    _os.path.abspath(__file__)
+                )),
+                "elasticdl_tpu", "observability", "profiler.py",
+            ),
+        )
+        mod = _importlib_util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        top_frames = mod.top_frames
+    window = profile.get("window") or {}
+    samples = window.get("samples") or {}
+    total = sum(samples.values())
+    out.write(
+        f"component {profile.get('component')!r}: "
+        f"{window.get('sample_count', 0)} passes / {total} samples "
+        f"over {float(window.get('t1', 0)) - float(window.get('t0', 0)):.1f}s "
+        f"at {window.get('hz', 0):g} Hz "
+        f"(threads: {window.get('threads')})\n"
+    )
+    out.write(f"{'self%':>7} {'total%':>7}  frame\n")
+    for row in top_frames(samples, top=top):
+        out.write(
+            f"{row['self_pct']:>6.2f}% {row['total_pct']:>6.2f}%  "
+            f"{row['frame']}\n"
+        )
+    out.write("\nheaviest stacks:\n")
+    heaviest = sorted(
+        samples.items(), key=lambda kv: (-kv[1], kv[0])
+    )[:10]
+    for stack, count in heaviest:
+        share = 100.0 * count / total if total else 0.0
+        out.write(f"  {share:5.1f}%  {stack}\n")
+    diff = profile.get("diff")
+    if diff:
+        out.write("\nvs base window (share deltas):\n")
+        for row in diff[:10]:
+            out.write(
+                f"  {row['delta_frac'] * 100:+6.2f}%  {row['stack']}\n"
+            )
+
+
 def print_alerts(alerts: dict, out=None):
     """One line per rule: state, value, human detail."""
     out = out if out is not None else sys.stdout
@@ -238,6 +324,19 @@ def dump_once(args) -> int:
             return 1
         sys.stdout.write("\n---- alerts ----\n")
         print_alerts(alerts)
+    if args.profile is not None:
+        try:
+            profile = fetch_profile(
+                args.addr, args.profile, args.profile_window,
+                timeout=args.timeout,
+            )
+        except OSError as exc:
+            print(f"profile fetch failed: {exc} (endpoint serves "
+                  "/profile when something runs --profile_hz)",
+                  file=sys.stderr)
+            return 1
+        sys.stdout.write("\n---- profile ----\n")
+        print_profile(profile, top=args.profile_top)
     return 0
 
 
@@ -253,6 +352,16 @@ def main(argv=None) -> int:
     parser.add_argument("--alerts", action="store_true",
                         help="Also fetch /alerts and print the SLO "
                              "rule states")
+    parser.add_argument("--profile", default=None, metavar="COMPONENT",
+                        help="Also fetch /profile for this component "
+                             "('' = the master itself, '3' = worker "
+                             "3, 'rowservice-0' etc.) and print the "
+                             "top folded stacks (self/total %%)")
+    parser.add_argument("--profile_window", type=float, default=60.0,
+                        help="Profile window to merge (seconds back "
+                             "from now)")
+    parser.add_argument("--profile_top", type=int, default=20,
+                        help="How many frames/stacks to print")
     parser.add_argument("--watch", type=float, default=0.0,
                         metavar="SECS",
                         help="Redraw every SECS seconds until "
