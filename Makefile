@@ -9,7 +9,7 @@ PY ?= python
 	trace-smoke serve-fleet-smoke sparse-smoke sparse-bench \
 	autoscale-smoke autoscale-bench slo-smoke ckpt-bench ckpt-smoke \
 	tiered-smoke tiered-bench reshard-smoke reshard-bench \
-	profile-smoke
+	profile-smoke failover-smoke failover-bench
 
 # Scrape-and-pretty-print a master's /metrics (docs/observability.md).
 METRICS_ADDR ?= localhost:8080
@@ -211,11 +211,13 @@ reshard-bench:
 # between a row-service delta save and its base compaction, and the
 # end-of-run shard relaunch restores across the base+delta chain.
 # The row checkpoint dir the drill leaves behind is then fsck'd.
-# Runs the tiered-storage drill first (tiered-smoke), so the chaos
-# lane also fsck's cold-tier segment stores via check_store.py.
-# Tier-1 safe (~15s on CPU). docs/chaos.md.
+# Runs the tiered-storage drill first (tiered-smoke) so the chaos
+# lane also fsck's cold-tier segment stores via check_store.py, and
+# the master-kill drill (chaos-master-smoke) so the journal fsck —
+# including the eval-round / relaunch / fence record kinds — runs in
+# this lane too. docs/chaos.md.
 CHAOS_SEED ?= 7
-chaos-smoke: tiered-smoke
+chaos-smoke: tiered-smoke chaos-master-smoke
 	workdir=$$(mktemp -d /tmp/edl_chaos.XXXXXX); \
 	JAX_PLATFORMS=cpu $(PY) -m elasticdl_tpu chaos run \
 		--seed $(CHAOS_SEED) --workdir $$workdir \
@@ -236,6 +238,33 @@ chaos-master-smoke:
 		--workdir $$workdir \
 		--report CHAOS_master_r01.json \
 	&& $(PY) tools/check_journal.py $$workdir/r0/faulted/journal; \
+	rc=$$?; rm -rf $$workdir; exit $$rc
+
+# Hot-standby failover drill (docs/fault_tolerance.md "Hot standby &
+# failover"): REAL master processes over a shared journal — a warm
+# standby SIGKILLed into service mid-lease, mid-eval-round, and
+# mid-resize-barrier, plus a partitioned zombie primary that must be
+# provably fenced (stale_master on RPC, appends rejected under the
+# journal flock). Gates: takeover downtime >=5x lower than a
+# restart-and-replay baseline on the same kill schedule (median over
+# the kills), sub-second, zero task loss/duplication, the open eval
+# round surviving, final dispatcher state field-equal to a fault-free
+# twin, and the journal fsck'ing clean. Fast-lane equivalent:
+# tests/test_failover.py (in-process); the standby-mode process drill
+# runs as tests/test_failover.py::test_failover_drill_standby_mode
+# (slow lane).
+failover-smoke:
+	workdir=$$(mktemp -d /tmp/edl_failover.XXXXXX); \
+	JAX_PLATFORMS=cpu $(PY) -m elasticdl_tpu.chaos.failover_drill run \
+		--workdir $$workdir --report $$workdir/FAILOVER_DRILL.json; \
+	rc=$$?; rm -rf $$workdir; exit $$rc
+
+# Same drill, committing the report with the downtime gates
+# (FAILOVER_DRILL.json at the repo root).
+failover-bench:
+	workdir=$$(mktemp -d /tmp/edl_failover.XXXXXX); \
+	JAX_PLATFORMS=cpu $(PY) -m elasticdl_tpu.chaos.failover_drill run \
+		--workdir $$workdir --report FAILOVER_DRILL.json; \
 	rc=$$?; rm -rf $$workdir; exit $$rc
 
 # Randomized soak: N seed-derived plans; a failure prints the seed

@@ -245,6 +245,23 @@ def build_channel(addr: str) -> grpc.Channel:
     return grpc.insecure_channel(addr, options=_CHANNEL_OPTIONS)
 
 
+def decorrelated_jitter(prev: float, base: float = 0.05,
+                        cap: float = 2.0, rand=None) -> float:
+    """Next reconnect/retry delay, AWS-style decorrelated jitter:
+    ``min(cap, uniform(base, prev * 3))``. Unlike fixed or plainly
+    exponential backoff, two clients that failed at the same instant
+    (a master failover fails the WHOLE fleet at once) decorrelate
+    within a round or two instead of hammering the new server in
+    lockstep forever — the thundering-herd fix the failover drill
+    leans on. ``prev <= 0`` (first failure) returns ``base`` so the
+    first retry stays fast."""
+    if prev <= 0.0:
+        return float(base)
+    rand = rand if rand is not None else _random.random
+    lo, hi = float(base), max(float(base), prev * 3.0)
+    return min(float(cap), lo + (hi - lo) * rand())
+
+
 def _retry_counter():
     from elasticdl_tpu.observability import default_registry
 
@@ -294,10 +311,24 @@ class RpcStub:
     def __init__(self, target, service_name: str, max_retries: int = 2,
                  backoff_base: float = 0.05, backoff_cap: float = 2.0):
         if isinstance(target, str):
-            self._target = target
-            self._channel = build_channel(target)
+            # Re-resolve list: a comma-separated target names every
+            # address the service may answer on (e.g. a primary
+            # master and its hot standbys). Calls go to ONE address;
+            # reconnect() rotates to the next — the client-side half
+            # of master failover (docs/fault_tolerance.md "Hot
+            # standby & failover").
+            self._targets = [
+                a.strip() for a in target.split(",") if a.strip()
+            ]
+            if not self._targets:
+                raise ValueError(f"empty RPC target {target!r}")
+            self._target_idx = 0
+            self._target = self._targets[0]
+            self._channel = build_channel(self._target)
             self._owns_channel = True
         else:
+            self._targets = []
+            self._target_idx = 0
             self._target = None
             self._channel = target
             self._owns_channel = False
@@ -326,14 +357,18 @@ class RpcStub:
             return self._methods[name]
 
     def reconnect(self):
-        """Drop the channel and build a fresh one to the same target —
-        the same remedy MasterClient.reconnect applies on the worker's
-        master ride-out: a gRPC channel whose connection attempts were
+        """Drop the channel and build a fresh one — the same remedy
+        MasterClient.reconnect applies on the worker's master
+        ride-out: a gRPC channel whose connection attempts were
         REFUSED for a few seconds (server not up yet, or relaunching)
         can wedge its subchannel permanently, while a fresh channel to
-        the now-listening server connects immediately. Long external
-        retry loops (row_service._call_with_retry) call this between
-        attempts. No-op for stubs wrapping a caller-owned channel."""
+        the now-listening server connects immediately. With a
+        multi-address target the rebuild also ROTATES to the next
+        address (re-resolve): after a master failover the old address
+        refuses forever while a standby answers on the next one. Long
+        external retry loops (row_service._call_with_retry) call this
+        between attempts. No-op for stubs wrapping a caller-owned
+        channel."""
         if not self._owns_channel or self._target is None:
             return
         with self._lock:
@@ -341,8 +376,18 @@ class RpcStub:
                 self._channel.close()
             except Exception:  # a half-dead channel must not block retry
                 pass
+            if len(self._targets) > 1:
+                self._target_idx = (
+                    (self._target_idx + 1) % len(self._targets)
+                )
+                self._target = self._targets[self._target_idx]
             self._channel = build_channel(self._target)
             self._methods = {}
+
+    @property
+    def target(self) -> Optional[str]:
+        """The address calls currently go to (telemetry/tests)."""
+        return self._target
 
     def _metrics_for(self, method: str):
         from elasticdl_tpu.observability import default_registry

@@ -268,6 +268,27 @@ def add_train_params(parser):
                              "task accounting survives the crash. "
                              "Point at a volume that outlives the "
                              "master pod; empty (default) disables")
+    add_bool_param(parser, "--standby", False,
+                   help_msg="Run this master as a HOT STANDBY "
+                             "(docs/fault_tolerance.md 'Hot standby "
+                             "& failover'): tail --journal_dir into "
+                             "a continuously-replayed warm state and "
+                             "heartbeat --primary_addr; on missed "
+                             "heartbeats fence the old incarnation "
+                             "and take over serving. Requires "
+                             "--journal_dir on storage shared with "
+                             "the primary")
+    parser.add_argument("--primary_addr", default="",
+                        help="Standby role: the primary master "
+                             "address to heartbeat (defaults to "
+                             "--master_addr)")
+    parser.add_argument("--standby_heartbeat_secs", type=pos_float,
+                        default=1.0,
+                        help="Standby role: primary heartbeat cadence")
+    parser.add_argument("--standby_miss_threshold", type=int,
+                        default=3,
+                        help="Standby role: consecutive missed "
+                             "heartbeats before takeover")
     parser.add_argument("--master_reattach_grace", type=pos_float,
                         default=60.0,
                         help="How long a worker rides out master "

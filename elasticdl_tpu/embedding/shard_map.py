@@ -216,6 +216,46 @@ class ShardMap:
             raise ShardMapError(f"shard {addr} already in the map")
         return self._bump(shards=self.shards + [str(addr)])
 
+    def retire_shard(self, shard: int) -> "ShardMap":
+        """Drop a drained shard's slot from the address list (the
+        compaction step after a ``merge``): it must own zero buckets
+        and appear in no replica set. Every shard index above it
+        shifts down one — the authority re-distributes the new epoch
+        with each server's new ``shard_id``, and stale clients
+        converge through the usual version fencing."""
+        shard = int(shard)
+        if not 0 <= shard < len(self.shards):
+            raise ShardMapError(f"unknown shard {shard}")
+        if len(self.shards) < 2:
+            raise ShardMapError("cannot retire the last shard")
+        if self.buckets_owned(shard):
+            raise ShardMapError(
+                f"shard {shard} still owns "
+                f"{self.buckets_owned(shard)} bucket(s); merge it "
+                "away first"
+            )
+        for table, per in self.replicas.items():
+            for i, reps in per.items():
+                if shard in reps:
+                    raise ShardMapError(
+                        f"shard {shard} still replicates "
+                        f"{table}:{i}; refresh replicas first"
+                    )
+        shards = [a for s, a in enumerate(self.shards) if s != shard]
+        ranges = [
+            (lo, hi, s - 1 if s > shard else s)
+            for lo, hi, s in self.ranges
+        ]
+        replicas = {
+            table: {
+                i: tuple(s - 1 if s > shard else s for s in reps)
+                for i, reps in per.items()
+            }
+            for table, per in self.replicas.items()
+        }
+        return self._bump(ranges=ranges, shards=shards,
+                          replicas=replicas)
+
     def split_plan(self, shard: int) -> Tuple[int, int]:
         """The upper half of ``shard``'s largest range — what a split
         migrates away. Raises when the shard owns a single bucket

@@ -8,6 +8,18 @@ Counterpart of the reference's ``master/evaluation_service.py``:
   after ``start_delay_secs`` (reference ``_EvaluationTrigger`` :52-85),
 - workers report *raw model outputs and labels*; metrics are computed on
   the master (reference evaluation_utils.py:50-97) in chunks.
+
+Crash survival (master/journal.py): with a journal attached, round
+state is event-sourced — ``eval_round`` open/task_done/close events
+plus per-task ``eval_fold`` records carrying the raw outputs/labels
+(ndarrays ride the journal's msgpack serde the same way they ride
+checkpoints). A recovered (or hot-standby) master rebuilds the OPEN
+round — accumulated outputs, completed count, folded task ids,
+``_last_eval_version`` — via ``restore_recovered``, so a master death
+mid-round costs nothing: the surviving eval tasks re-report against
+the restored round and it closes with the same metrics a never-killed
+master would have produced. Fold records cost one journal fsync per
+eval task report — eval-task granularity, not step granularity.
 """
 
 import threading
@@ -78,16 +90,20 @@ class EvaluationJob:
         )
 
     def report_evaluation_metrics(self, outputs, labels,
-                                  task_id: int = -1):
+                                  task_id: int = -1) -> bool:
+        """Fold one task's raw outputs; False iff this task id already
+        folded (at-least-once re-send — callers must not journal or
+        re-count it)."""
         if task_id >= 0:
             if task_id in self._folded_tasks:
                 logger.info(
                     "eval task %d outputs already folded; ignoring "
                     "duplicate report", task_id,
                 )
-                return
+                return False
             self._folded_tasks.add(task_id)
         self.evaluation_metrics.update(outputs, labels)
+        return True
 
 
 class EvaluationService:
@@ -114,6 +130,12 @@ class EvaluationService:
         self.completed_results: Dict[int, Dict[str, float]] = {}
         self._trigger_thread = None
         self._stop = threading.Event()
+        # Write-ahead journal (master/journal.py): round open/fold/
+        # task_done/close events write through so an open round
+        # survives a master crash. Attached AFTER construction (and
+        # after restore_recovered on a recovery path), mirroring
+        # TaskDispatcher.attach_journal.
+        self._journal = None
         if eval_only:
             # Evaluation-only jobs: the dispatcher queued the EVALUATION
             # tasks at construction; open the job that will collect their
@@ -122,6 +144,98 @@ class EvaluationService:
                 self._metrics_fns, model_version=-1,
                 total_tasks=self._count_eval_tasks(),
             )
+
+    # ---- journal (master/journal.py) -----------------------------------
+
+    def attach_journal(self, journal):
+        """Write round events through ``journal`` from now on. On a
+        recovery path, call ``restore_recovered`` FIRST — the restore
+        must not re-append the events it is replaying."""
+        with self._lock:
+            self._journal = journal
+
+    def restore_recovered(self, state: Optional[dict]):
+        """Install the journal's replayed eval carry (see
+        ``journal.new_eval_state``): the open round — completed count,
+        folded task ids, re-folded raw outputs — plus
+        ``_last_eval_version`` and the completed-results history. The
+        journal must not be attached yet."""
+        if not state:
+            return
+        with self._lock:
+            if self._journal is not None:
+                raise RuntimeError(
+                    "detach the journal before restore_recovered"
+                )
+            self._last_eval_version = int(
+                state.get("last_eval_version", self._last_eval_version)
+            )
+            for version, metrics in (state.get("results") or {}).items():
+                self.completed_results[int(version)] = dict(metrics)
+            open_round = state.get("open")
+            if open_round is None:
+                return
+            job = self._eval_job
+            if job is None:
+                # total_tasks -1 only happens for eval-only rounds,
+                # whose job is rebuilt at construction (the branch
+                # below); a journaled open round always recorded it.
+                job = EvaluationJob(
+                    self._metrics_fns,
+                    model_version=int(open_round.get("model_version",
+                                                     -1)),
+                    total_tasks=int(open_round.get("total_tasks", -1)),
+                )
+                self._eval_job = job
+            # Eval-only jobs keep the constructed job (same config by
+            # construction) and replay progress onto it.
+            job._completed_tasks = max(
+                job._completed_tasks,
+                int(open_round.get("completed", 0)),
+            )
+            for task_id, outputs, labels in open_round.get("folds", []):
+                job.report_evaluation_metrics(
+                    outputs, labels, task_id=int(task_id)
+                )
+            if job.finished():
+                # Crash window between the final task's REPORT record
+                # and the round's close record: replay counted the
+                # round complete, so close it HERE — no completion
+                # will ever arrive again (the reports all resolved).
+                results = job.evaluation_metrics.result()
+                self.completed_results[job.model_version] = results
+                self._eval_job = None
+                logger.info(
+                    "closed recovered eval round @version %d: %s",
+                    job.model_version, results,
+                )
+                if self._summary_writer is not None:
+                    self._summary_writer.write_eval_metrics(
+                        job.model_version, results
+                    )
+                return
+            remaining = self._task_d.count_tasks(TaskType.EVALUATION)
+            if job._completed_tasks + remaining < job._total_tasks:
+                # Crash window between the round's open record and its
+                # create_tasks record: the journal opened a round whose
+                # tasks never existed — unfinishable. Drop it (the
+                # round is lost, not wedged; the next version report
+                # re-triggers) rather than block evaluation forever.
+                logger.warning(
+                    "dropping recovered eval round @version %d: only "
+                    "%d task(s) outstanding + %d complete of %d (the "
+                    "crash preceded its task creation)",
+                    job.model_version, remaining,
+                    job._completed_tasks, job._total_tasks,
+                )
+                self._eval_job = None
+                return
+        logger.info(
+            "restored open eval round @version %d: %d/%s task(s) "
+            "complete, %d fold(s) re-applied",
+            job.model_version, job._completed_tasks,
+            job._total_tasks, len(open_round.get("folds", [])),
+        )
 
     # ---- triggers ------------------------------------------------------
 
@@ -165,6 +279,16 @@ class EvaluationService:
                 self._metrics_fns, model_version, total_tasks=num_tasks
             )
             self._last_eval_version = model_version
+            if self._journal is not None:
+                # Inside the lock and BEFORE create_tasks below, so
+                # the journal's order (open, then create_tasks)
+                # matches the state-mutation order replay re-runs.
+                self._journal.append(
+                    "eval_round", event="open",
+                    model_version=int(model_version),
+                    total_tasks=int(num_tasks),
+                    last_eval_version=int(model_version),
+                )
         self._task_d.create_tasks(TaskType.EVALUATION, model_version)
         return True
 
@@ -183,9 +307,17 @@ class EvaluationService:
         with self._lock:
             if self._eval_job is None:
                 return False
-            self._eval_job.report_evaluation_metrics(
+            folded = self._eval_job.report_evaluation_metrics(
                 outputs, labels, task_id=task_id
             )
+            if folded and self._journal is not None:
+                # First applications only: a duplicate fold was
+                # ignored above and must not re-fold on replay either.
+                self._journal.append(
+                    "eval_fold", task_id=int(task_id),
+                    outputs=np.asarray(outputs),
+                    labels=np.asarray(labels),
+                )
             return True
 
     def complete_task(
@@ -210,6 +342,11 @@ class EvaluationService:
                     model_version, self._eval_job.model_version,
                 )
                 return None
+            # No journal append for the count itself: round progress
+            # rides the dispatcher's REPORT record (task_type/
+            # model_version/requeued fields), so the resolution and
+            # the completion are ONE fsynced record — a crash cannot
+            # separate them and wedge the round.
             self._eval_job.complete_task()
             if not self._eval_job.finished():
                 return None
@@ -217,6 +354,16 @@ class EvaluationService:
             version = self._eval_job.model_version
             self.completed_results[version] = results
             self._eval_job = None
+            if self._journal is not None:
+                # Close supersedes the round's folds/task_done records
+                # — a recovered master keeps the results, not the
+                # round (journal-side state folds it the same way).
+                self._journal.append(
+                    "eval_round", event="close",
+                    model_version=int(version),
+                    results={str(k): float(v)
+                             for k, v in results.items()},
+                )
         logger.info("Eval @version %d: %s", version, results)
         if self._summary_writer is not None:
             self._summary_writer.write_eval_metrics(version, results)

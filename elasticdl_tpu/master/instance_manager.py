@@ -109,6 +109,7 @@ class InstanceManager:
         row_service_resource_request: str = "cpu=1,memory=4096Mi",
         row_service_resource_limit: str = "",
         num_row_service_shards: int = 1,
+        journal=None,
     ):
         self._task_d = task_dispatcher
         self._client = k8s_client
@@ -141,6 +142,13 @@ class InstanceManager:
         # pods be recognized (name mismatch) instead of cascading.
         self._multihost = multihost
         self._generation = 0
+        # Master write-ahead journal (master/journal.py): gang and
+        # row-service relaunch generations append as ``relaunch``
+        # records, so a recovered master adopts pods under their TRUE
+        # (generation-suffixed) names instead of discarding their
+        # death events as stale — the former "known limitation" in
+        # docs/fault_tolerance.md.
+        self._journal = journal
         # Host-tier row service (reference PS pod lifecycle: fixed
         # per-shard service names, relaunch on death —
         # k8s_instance_manager.py:303-308). One pod per shard (rows by
@@ -159,6 +167,27 @@ class InstanceManager:
         self._row_service_pods: Dict[int, str] = {}  # shard -> pod name
         self._rs_generation: Dict[int, int] = {}
         self._rs_relaunch_count = 0
+
+    def _journal_relaunch(self, kind: str, generation: int,
+                          shard: int = -1):
+        """Persist a relaunch-generation bump BEFORE the replacement
+        pod is created: a master crash between the bump and the
+        create leaves the journal naming a pod that may not exist —
+        harmless (its absence surfaces as watch events / straggler
+        timeouts) — while the reverse order would leave a live pod
+        the recovered master cannot recognize."""
+        if self._journal is None:
+            return
+        try:
+            self._journal.append(
+                "relaunch", kind=str(kind),
+                generation=int(generation), shard=int(shard),
+            )
+        except Exception as exc:
+            # A fenced/failed append must not abort the relaunch path
+            # (the pod plane is still this incarnation's to clean up);
+            # the fencing rejection surfaces on the RPC plane.
+            logger.warning("journal relaunch append failed: %s", exc)
 
     # ---- pod creation ---------------------------------------------------
 
@@ -192,7 +221,7 @@ class InstanceManager:
 
     # ---- master-restart adoption (master/journal.py recovery) ----------
 
-    def adopt_workers(self, worker_ids):
+    def adopt_workers(self, worker_ids, gang_generation: int = 0):
         """Track already-running worker pods instead of creating them
         (a recovered master re-attaches to the job it crashed out of).
         Pod names are reconstructed from the deterministic naming
@@ -201,20 +230,14 @@ class InstanceManager:
         normal dead-worker path. The fresh-id counter advances past
         every adopted id so relaunches never reuse one.
 
-        Known limitation: multihost gang-restart generations are not
-        journaled, so a master restart AFTER a gang restart
-        reconstructs suffix-less pod names that won't match the live
-        ``-gN`` pods — their death events would be discarded as
-        stale. Until generations persist, a recovered multihost
-        master is safer gang-restarting than adopting."""
-        if self._multihost:
-            logger.warning(
-                "adopting multihost workers after a master restart: "
-                "pre-crash gang-restart generations are unknown; if "
-                "the job had gang-restarted, adopted pod names will "
-                "not match and dead peers won't be detected"
-            )
+        ``gang_generation`` is the journal's replayed multihost
+        gang-restart generation (``relaunch`` records): pods live
+        under ``-gN``-suffixed names after a gang restart, and
+        adopting them suffix-less would discard their death events as
+        stale (the pre-journal known limitation)."""
         with self._lock:
+            self._generation = max(self._generation,
+                                   int(gang_generation))
             top = self._num_workers
             for wid in worker_ids:
                 name = get_worker_pod_name(self._job_name, wid)
@@ -224,27 +247,27 @@ class InstanceManager:
                 top = max(top, int(wid) + 1)
             self._next_worker_id = itertools.count(top)
         logger.info(
-            "adopted %d running worker pod(s) after master restart",
-            len(self._worker_pods),
+            "adopted %d running worker pod(s) after master restart "
+            "(gang generation %d)",
+            len(self._worker_pods), self._generation,
         )
 
-    def adopt_row_service(self):
+    def adopt_row_service(self, generations: Optional[Dict[int, int]]
+                          = None):
         """Track the (still-running) per-shard row-service pods after
         a master restart; their stable Services already exist.
-
-        Same limitation as adopt_workers: pre-crash relaunch
-        generations are not journaled, so a shard that had already
-        been relaunched is tracked under its gen-0 name and its next
-        death event would be discarded as stale."""
+        ``generations`` is the journal's replayed per-shard relaunch
+        map (``relaunch`` records): a shard that relaunched before
+        the crash lives under its bumped pod-name generation, and its
+        next death is only detected when we track that name."""
         if self._row_service_command is None:
             return
-        logger.warning(
-            "adopting row-service pods after a master restart: "
-            "pre-crash relaunch generations are unknown; a shard "
-            "that had relaunched before the crash won't have its "
-            "next death detected"
-        )
         with self._lock:
+            for shard, generation in (generations or {}).items():
+                self._rs_generation[int(shard)] = max(
+                    self._rs_generation.get(int(shard), 0),
+                    int(generation),
+                )
             for shard in range(self._num_rs_shards):
                 self._row_service_pods[shard] = (
                     get_row_service_pod_name(
@@ -253,6 +276,11 @@ class InstanceManager:
                         shard=shard,
                     )
                 )
+        logger.info(
+            "adopted %d row-service pod(s) after master restart "
+            "(relaunch generations %s)",
+            self._num_rs_shards, dict(self._rs_generation),
+        )
 
     # ---- row service (PS-pod lifecycle) --------------------------------
 
@@ -325,6 +353,7 @@ class InstanceManager:
                 self._rs_generation.get(shard, 0) + 1
             )
             generation = self._rs_generation[shard]
+        self._journal_relaunch("row_service", generation, shard=shard)
         logger.warning(
             "Row service shard %d pod died; relaunching "
             "(generation %d)", shard, generation,
@@ -430,9 +459,11 @@ class InstanceManager:
                 return
             self._relaunch_count += 1
             self._generation += 1
+            generation = self._generation
             live = dict(self._worker_pods)
             live.pop(worker_id, None)
             self._worker_pods.clear()
+        self._journal_relaunch("gang", generation)
         logger.info(
             "Multi-host gang restart (generation %d): worker %d died; "
             "deleting %d peer(s), relaunching all %d with original ids",

@@ -148,6 +148,16 @@ class Master:
             eval_only=bool(validation_data and not training_data),
             summary_writer=tb_service,
         )
+        if self._journal is not None:
+            # Round state is event-sourced onto the same journal:
+            # restore the replayed open round FIRST (a recovered
+            # master resumes it instead of dropping the metrics),
+            # then attach for write-through.
+            if self._recovery_stats is not None:
+                self.evaluation_service.restore_recovered(
+                    self._recovery_stats.get("eval")
+                )
+            self.evaluation_service.attach_journal(self._journal)
         # Telemetry plane: master-local registry (dispatcher gauges,
         # straggler counter) + worker snapshot aggregation + /metrics;
         # selected aggregates mirror into TensorBoard each run tick.
@@ -452,6 +462,7 @@ class Master:
                     self._args, "row_service_resource_limit", ""
                 ),
                 num_row_service_shards=self._num_row_service_shards(),
+                journal=self._journal,
             )
             self.instance_manager.start_watch()
             if self._recovery_stats is not None:
@@ -463,11 +474,15 @@ class Master:
                 # died during the outage surface as watch events /
                 # straggler timeouts and recover through the normal
                 # paths.
+                relaunch = self._recovery_stats.get("relaunch") or {}
                 self.instance_manager.adopt_workers(
                     self._recovery_stats["known_workers"]
-                    or list(range(self._args.num_workers))
+                    or list(range(self._args.num_workers)),
+                    gang_generation=int(relaunch.get("gang", 0)),
                 )
-                self.instance_manager.adopt_row_service()
+                self.instance_manager.adopt_row_service(
+                    relaunch.get("row_service")
+                )
             else:
                 # Row service first (reference Master.prepare starts PS
                 # pods before workers, master.py:202-205); workers
@@ -704,6 +719,148 @@ class Master:
         return self._server.port if self._server else None
 
 
+def run_standby(args, k8s_client=None) -> int:
+    """``--standby`` role (docs/fault_tolerance.md "Hot standby &
+    failover"): heartbeat the primary and watch its journal; on missed
+    heartbeats, FENCE the old incarnation and promote into a full
+    ``Master`` on this warm process.
+
+    The expensive part of restart-and-replay is the cold start — pod
+    reschedule, interpreter boot, imports, model-spec load — so this
+    role pays all of it up front and keeps the journal's page cache
+    warm by tailing it. Promotion replays snapshot + tail (bounded by
+    the snapshot cadence) through the same ``Master`` construction a
+    restart uses, so the promoted master has the FULL feature set
+    (metrics plane, autoscaler, k8s adoption of running pods). The
+    embedded-control-plane variant with a continuously-replayed warm
+    dispatcher is ``master/standby.StandbyMaster`` (what the failover
+    drill runs); both share the fence + recovery code paths.
+    """
+    import time as _time
+
+    from elasticdl_tpu.comm.rpc import RpcStub
+    from elasticdl_tpu.master.journal import MasterJournal
+    from elasticdl_tpu.observability import default_registry
+
+    journal_dir = getattr(args, "journal_dir", "")
+    if not journal_dir:
+        logger.error("--standby requires --journal_dir (shared with "
+                     "the primary)")
+        return 2
+    primary = getattr(args, "primary_addr", "") or args.master_addr
+    heartbeat_secs = float(
+        getattr(args, "standby_heartbeat_secs", 1.0)
+    )
+    miss_threshold = int(getattr(args, "standby_miss_threshold", 3))
+    journal = MasterJournal(journal_dir)
+    registry = default_registry()
+    m_heartbeat = registry.histogram(
+        "master_primary_heartbeat_seconds",
+        "Primary heartbeat round-trip observed by the standby (the "
+        "default SLO ruleset alerts on its ABSENCE)",
+    )
+    m_lag = registry.gauge(
+        "master_standby_lag_records",
+        "Journal records appended since the standby last looked",
+    )
+    m_failover = registry.histogram(
+        "master_failover_seconds",
+        "Hot-standby takeover latency: primary declared dead -> "
+        "promoted master serving",
+    )
+    # Pre-warm the expensive import path (model zoo + spec) so
+    # promotion does not pay it.
+    try:
+        get_model_spec(
+            model_zoo=args.model_zoo, model_def=args.model_def,
+            dataset_fn=args.dataset_fn, loss=args.loss,
+            optimizer=args.optimizer,
+            eval_metrics_fn=args.eval_metrics_fn,
+            callbacks=args.callbacks,
+            custom_data_reader=args.custom_data_reader,
+        )
+    except Exception as exc:
+        logger.warning("standby spec pre-warm failed: %s", exc)
+    # Report into the primary's cluster view so the master-side
+    # absence rule on the heartbeat series can fire when this standby
+    # dies (failover protection gone).
+    from elasticdl_tpu.observability.reporter import (
+        ComponentMetricsReporter,
+    )
+
+    reporter = ComponentMetricsReporter(primary, "standby")
+    reporter.start()
+    stub = RpcStub(primary, SERVICE_NAME, max_retries=0)
+    misses = 0
+    last_seen_seq = 0
+    last_seen_size = -1
+    logger.info(
+        "standby: heartbeating %s every %.2fs (takeover after %d "
+        "misses), tailing %s", primary, heartbeat_secs,
+        miss_threshold, journal.path,
+    )
+    while True:
+        t0 = _time.monotonic()
+        try:
+            stub.call("ping", timeout=max(0.5, heartbeat_secs))
+            m_heartbeat.observe(_time.monotonic() - t0)
+            misses = 0
+        except Exception:
+            misses += 1
+            logger.warning("primary heartbeat missed (%d/%d)",
+                           misses, miss_threshold)
+            try:
+                stub.reconnect()
+            except Exception:
+                pass
+        # Lag telemetry + page-cache warmth: tail the journal each
+        # beat, but only when the file actually changed (a stat per
+        # beat, not a full decode — snapshots carry eval folds).
+        try:
+            size = os.path.getsize(journal.path)
+        except OSError:
+            size = -1
+        if size >= 0 and size != last_seen_size:
+            last_seen_size = size
+            try:
+                # last_seq hops frame headers and decodes ONLY the
+                # final record — no per-beat snapshot/ndarray decode.
+                seq = journal.last_seq()
+                m_lag.set(float(max(0, seq - last_seen_seq)))
+                last_seen_seq = max(last_seen_seq, seq)
+            except Exception:
+                pass
+        if misses >= miss_threshold:
+            break
+        _time.sleep(heartbeat_secs)
+    t_detect = _time.monotonic()
+    stub.close()
+    reporter.stop()
+    # Fence FIRST: a partitioned-but-alive primary must be locked out
+    # of the journal before the promoted master trusts its replay.
+    last_gen = 0
+    try:
+        for record in journal.replay_records():
+            if record["t"] in ("generation", "fence"):
+                last_gen = max(last_gen, int(record["generation"]))
+    except Exception:
+        logger.exception("journal scan before fencing failed")
+    fence_gen = journal.publish_fence(last_gen + 1)
+    journal.close()
+    logger.warning(
+        "standby taking over: fence generation %d published; "
+        "promoting into a full master", fence_gen,
+    )
+    master = Master(args, k8s_client=k8s_client)
+    if master._journal is not None:
+        master._journal.append(
+            "fence", generation=master._journal.generation
+        )
+    master.prepare()
+    m_failover.observe(_time.monotonic() - t_detect)
+    return master.run()
+
+
 def main(argv=None):
     args = parse_master_args(argv)
     k8s_client = None
@@ -717,6 +874,8 @@ def main(argv=None):
             )
         except k8s_mod.K8sUnavailableError as exc:
             logger.warning("k8s unavailable (%s); running master-only", exc)
+    if getattr(args, "standby", False):
+        return run_standby(args, k8s_client=k8s_client)
     master = Master(args, k8s_client=k8s_client)
     master.prepare()
     # Graceful pod eviction: without a handler, SIGTERM kills the

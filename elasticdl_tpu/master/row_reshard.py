@@ -213,6 +213,13 @@ class ShardMapController:
         self._mig_seq = 0
         self._last_rates: Dict[int, int] = {}
         self._last_action_at = 0.0
+        # Drained-but-not-retired shards (merge leaves the slot in the
+        # address list): [{"shard", "addr", "epoch"}], persisted so a
+        # restarted authority still retires them. Quiescence baselines
+        # (traffic totals + clock) stay in-memory — they re-arm after
+        # a restart, which only delays retirement by one window.
+        self._drained: List[dict] = []
+        self._drained_baseline: Dict[int, dict] = {}
         from elasticdl_tpu.observability import default_registry
 
         registry = default_registry()
@@ -239,6 +246,7 @@ class ShardMapController:
             MigrationRecord.from_json(mig) if mig else None
         )
         self._mig_seq = int(state.get("mig_seq", 0))
+        self._drained = list(state.get("drained", []))
 
     def _persist(self):
         """Publish the authority's truth with the checkpoint publish
@@ -249,6 +257,7 @@ class ShardMapController:
                 self._migration.to_json() if self._migration else None
             ),
             "mig_seq": self._mig_seq,
+            "drained": list(self._drained),
         }
         tmp = self.state_path + ".tmp"
         os.makedirs(os.path.dirname(self.state_path) or ".",
@@ -458,14 +467,122 @@ class ShardMapController:
 
     def merge(self, source: int, target: int) -> List[dict]:
         """Drain the source shard into ``target`` (one move per owned
-        range; the drained shard stays addressable until ops retire
-        it)."""
+        range). The drained slot stays in the address list until the
+        tick's compaction step retires it — once every client has
+        converged past the drained shard's last epoch (see
+        ``_maybe_retire_locked``)."""
         out = []
         for lo, hi in list(self._map.ranges_of(source)):
             # Each constituent move already counts in
             # row_reshard_migrations_total{kind=move}.
             out.append(self.move_range(source, lo, hi, target))
+        with self._lock:
+            self._drained.append({
+                "shard": int(source),
+                "addr": self._map.shards[int(source)],
+                # Clients at epochs below this could still route ids
+                # to the drained shard; retirement waits until no one
+                # does (quiescence) and every server converged past.
+                "epoch": int(self._map.version),
+            })
+            self._persist()
         return out
+
+    def _maybe_retire_locked(self, stats: Dict[int, dict],
+                             now: float) -> Optional[int]:
+        """Compaction: retire ONE drained shard per tick once it is
+        provably unreferenced — every reachable server installed an
+        epoch >= the drain epoch, and the drained shard served ZERO
+        pulls/pushes for a full policy cooldown window (a client
+        still holding a pre-drain map would route ids at it, so
+        sustained silence is the observable form of "every client has
+        converged past the drained shard's last epoch"). Returns the
+        retired index or None. Caller holds the lock."""
+        for record in list(self._drained):
+            shard = int(record["shard"])
+            if shard >= len(self._map.shards) or (
+                self._map.shards[shard] != record["addr"]
+            ):
+                # Index no longer names the drained address (map
+                # evolved unexpectedly, e.g. hand-edited state) —
+                # drop the stale record instead of retiring the
+                # wrong shard.
+                self._drained.remove(record)
+                self._persist()
+                continue
+            if self._map.buckets_owned(shard):
+                # Re-split onto the drained slot: it is live again.
+                self._drained.remove(record)
+                self._drained_baseline.pop(shard, None)
+                self._persist()
+                continue
+            behind = [
+                s for s, per in stats.items()
+                if per.get("map_version", 0) < record["epoch"]
+            ]
+            if behind:
+                continue
+            per = stats.get(shard)
+            traffic = (
+                (per.get("pulled_rows", 0) + per.get("pushed_rows", 0))
+                if per is not None else None
+            )
+            baseline = self._drained_baseline.get(shard)
+            if baseline is None or (
+                traffic is not None and traffic != baseline["traffic"]
+            ):
+                # (Re-)arm the quiescence window; an unreachable
+                # drained shard (ops already killed the process)
+                # quiesces trivially (traffic None == None holds).
+                self._drained_baseline[shard] = {
+                    "traffic": traffic, "t": now,
+                }
+                continue
+            if now - baseline["t"] < self.policy.cooldown_secs:
+                continue
+            m = self._map
+            # Replica designation may still point at the drained slot
+            # (ring-order spread counts every slot): filter the
+            # drained MEMBER out of each set — the surviving replicas
+            # keep serving the hot reads (dropping whole entries
+            # would collapse the fan-in onto the home until the next
+            # update_replicas tick).
+            replicas = {}
+            for table, per_table in m.replicas.items():
+                kept = {}
+                for i, reps in per_table.items():
+                    filtered = tuple(s for s in reps if s != shard)
+                    if filtered:
+                        kept[i] = filtered
+                if kept:
+                    replicas[table] = kept
+            if replicas != m.replicas:
+                m = m.with_replicas(replicas)
+            self._map = m.retire_shard(shard)
+            self._drained.remove(record)
+            self._drained_baseline.pop(shard, None)
+            # Surviving drained records + baselines shift down past
+            # the removed slot; per-index rate history is stale now.
+            for other in self._drained:
+                if int(other["shard"]) > shard:
+                    other["shard"] = int(other["shard"]) - 1
+            self._drained_baseline = {
+                (s - 1 if s > shard else s): b
+                for s, b in self._drained_baseline.items()
+            }
+            self._last_rates = {}
+            self._persist()
+            self._m_epochs.inc()
+            self._m_migrations.labels("retire").inc()
+            self._sync_locked()
+            logger.info(
+                "retired drained shard %d (%s) from the map (v%d): "
+                "%d shard(s) remain",
+                shard, record["addr"], self._map.version,
+                len(self._map.shards),
+            )
+            return shard
+        return None
 
     # ---- autoscaler hook (the policy tick) -----------------------------
 
@@ -539,6 +656,13 @@ class ShardMapController:
             if behind:
                 with self._lock:
                     self._sync_locked(behind)
+            # Compaction: retire a drained (merged-away) shard once
+            # clients provably converged past its last epoch.
+            with self._lock:
+                retired = self._maybe_retire_locked(stats, now)
+            if retired is not None:
+                self._last_action_at = now
+                return f"retire:{retired}"
             primed = bool(self._last_rates)
             totals = {
                 s: per.get("pulled_rows", 0) + per.get("pushed_rows", 0)

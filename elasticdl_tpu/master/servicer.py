@@ -58,6 +58,11 @@ class MasterServicer:
             "Workers that re-registered after a master restart "
             "(their last-seen generation predates ours)",
         )
+        self._m_fenced = self.metrics_plane.registry.counter(
+            "master_fenced_requests_total",
+            "RPCs rejected because this incarnation was fenced by a "
+            "hot-standby takeover (split-brain guard)", ["method"],
+        )
         self._lock = threading.Lock()
         self._worker_liveness: Dict[int, float] = {}
         # Workers already counted as re-attached to this generation.
@@ -119,6 +124,27 @@ class MasterServicer:
         if snapshot:
             self.metrics_plane.ingest(worker_id, snapshot)
 
+    def _stale_master_reject(self, method: str) -> Optional[dict]:
+        """Fencing pre-check (master/journal.py hot-standby takeover):
+        once a newer incarnation fenced this one, every state-mutating
+        handler must reject — a zombie primary that kept dispatching
+        or acking would fork the job's truth. The journal append
+        itself is the authoritative guard (it re-checks under the
+        flock and raises); this pre-check turns that hard error into a
+        clean ``stale_master`` response workers re-resolve on."""
+        if self._journal is None or not self._journal.is_fenced():
+            return None
+        self._m_fenced.labels(method).inc()
+        logger.error(
+            "FENCED: %s rejected — this master (generation %d) was "
+            "superseded by a hot-standby takeover (fence generation "
+            "%d); refusing to serve", method, self.generation,
+            self._journal.fence_generation(),
+        )
+        return {"accepted": False, "fenced": True, "stale_master": True,
+                "task": None, "finished": False,
+                "generation": self.generation}
+
     def _note_worker_generation(self, worker_id: int, request: dict):
         """Re-attach detection: a worker reporting a last-seen
         generation below ours rode out a master restart."""
@@ -139,6 +165,9 @@ class MasterServicer:
             )
 
     def get_task(self, request: dict) -> dict:
+        fenced = self._stale_master_reject("get_task")
+        if fenced is not None:
+            return fenced
         worker_id = int(request.get("worker_id", -1))
         self._record_liveness(worker_id)
         self._ingest_metrics(worker_id, request)
@@ -165,6 +194,13 @@ class MasterServicer:
                 "generation": self.generation, **extra}
 
     def report_task_result(self, request: dict) -> dict:
+        fenced = self._stale_master_reject("report_task_result")
+        if fenced is not None:
+            # Rejected unresolved: the worker re-resolves to the live
+            # master, whose dispatcher (journal-recovered, leases
+            # intact) applies it — or answers it from the resolved
+            # ledger if an earlier attempt already landed there.
+            return fenced
         task_id = int(request["task_id"])
         err_reason = request.get("err_reason", "")
         success = not err_reason
@@ -210,6 +246,9 @@ class MasterServicer:
         return {"accepted": True, "generation": self.generation}
 
     def report_evaluation_metrics(self, request: dict) -> dict:
+        fenced = self._stale_master_reject("report_evaluation_metrics")
+        if fenced is not None:
+            return fenced
         if self._eval_service is None:
             return {"accepted": False}
         # The one handler that does real compute (metric fold over raw
@@ -274,6 +313,9 @@ class MasterServicer:
         return True
 
     def report_version(self, request: dict) -> dict:
+        fenced = self._stale_master_reject("report_version")
+        if fenced is not None:
+            return fenced
         version = int(request["model_version"])
         worker_id = int(request.get("worker_id", -1))
         self._record_liveness(worker_id)
@@ -283,8 +325,13 @@ class MasterServicer:
             self.model_version = max(self.model_version, version)
         if advanced and self._journal is not None:
             # Model-version high-water mark: recovery re-arms eval
-            # triggering and TensorBoard publishing from it.
-            self._journal.append("version", model_version=version)
+            # triggering and TensorBoard publishing from it. The
+            # worker id rides along so replay also restores the
+            # dispatcher's per-worker version map (SSP bookkeeping).
+            self._journal.append(
+                "version", model_version=version,
+                worker_id=int(worker_id),
+            )
         self._task_d.record_worker_version(worker_id, version)
         if self._eval_service is not None:
             self._eval_service.add_evaluation_task_if_needed(version)
@@ -377,6 +424,9 @@ class MasterServicer:
         directive. Fenced by resize_id: an ack for anything but the
         pending barrier is rejected, so a late ack from before a master
         restart or a superseded resize cannot complete the wrong one."""
+        fenced = self._stale_master_reject("report_resize")
+        if fenced is not None:
+            return fenced
         worker_id = int(request.get("worker_id", -1))
         resize_id = int(request.get("resize_id", -1))
         self._record_liveness(worker_id)

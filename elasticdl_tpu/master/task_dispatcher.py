@@ -421,10 +421,18 @@ class TaskDispatcher:
                 # Appended after the mutation completes (still inside
                 # the lock): a snapshot triggered by this append must
                 # capture the post-report state, and replay re-derives
-                # the requeue decision from the same inputs.
+                # the requeue decision from the same inputs. The
+                # task's type/version and the requeue verdict ride
+                # along so the eval plane's round progress is ATOMIC
+                # with the resolution (journal.apply_eval_report_record
+                # — a separate append would leave a crash window that
+                # wedges the round).
                 self._journal.append(
                     "report", task_id=int(task_id),
                     success=bool(success), err_reason=str(err_reason),
+                    task_type=str(task.type),
+                    model_version=int(task.model_version),
+                    requeued=bool(requeued),
                 )
             todo_undroppable = [
                 t for t in self._todo
@@ -475,6 +483,17 @@ class TaskDispatcher:
                 and not self._doing
                 and not self._epochs_pending_locked()
             )
+
+    def count_tasks(self, task_type: str) -> int:
+        """Tasks of ``task_type`` currently queued or leased (the
+        eval plane's recovery sanity check)."""
+        with self._lock:
+            n = sum(1 for t in self._todo if t.type == task_type)
+            n += sum(
+                1 for t, _wid, _s in self._doing.values()
+                if t.type == task_type
+            )
+            return n
 
     def queue_depths(self) -> Tuple[int, int]:
         """(todo, doing) sizes for queue-health consumers (the

@@ -52,6 +52,12 @@ class ComponentMetricsReporter(threading.Thread):
         self._span_cursor = 0
         self._profile_cursor = 0
         self.reports_sent = 0
+        # Decorrelated-jitter backoff after failed reports: a master
+        # failover fails EVERY component's report at the same instant,
+        # and per-interval retries in lockstep would stampede the
+        # promoted standby (comm/rpc.decorrelated_jitter). Reset on
+        # the first confirmed delivery.
+        self._retry_delay = 0.0
 
     def send_once(self):
         from elasticdl_tpu.comm.rpc import RpcStub
@@ -83,18 +89,31 @@ class ComponentMetricsReporter(threading.Thread):
             self._span_cursor = span_offer
             self._profile_cursor = profile_offer
             self.reports_sent += 1
+            self._retry_delay = 0.0
         except Exception as exc:
+            from elasticdl_tpu.comm.rpc import decorrelated_jitter
+
+            self._retry_delay = decorrelated_jitter(
+                self._retry_delay,
+                base=0.5, cap=self._interval,
+            )
             logger.warning(
-                "%s-%d master metrics report failed: %s",
-                self._component, self._component_id, exc,
+                "%s-%d master metrics report failed (backing off "
+                "%.2fs extra): %s",
+                self._component, self._component_id,
+                self._retry_delay, exc,
             )
             try:
+                # The rebuild also rotates a multi-address master
+                # target (failover re-resolve).
                 self._stub.reconnect()
             except Exception:
                 self._stub = None
 
     def run(self):
-        while not self._stop.wait(self._interval):
+        # The jittered extra delay decorrelates the fleet's retries
+        # after an outage hits everyone at once.
+        while not self._stop.wait(self._interval + self._retry_delay):
             self.send_once()
 
     def stop(self):

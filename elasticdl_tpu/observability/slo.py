@@ -179,6 +179,18 @@ def default_rules() -> List[SLORule]:
                         "has gone silent (not scaled away)",
         ),
         SLORule(
+            name="primary-heartbeat-absent",
+            kind=ABSENCE,
+            series="edl_tpu_master_primary_heartbeat_seconds",
+            staleness_secs=120.0,
+            description="the hot standby stopped confirming primary "
+                        "heartbeats (it reports them into the cluster "
+                        "view via ComponentMetricsReporter): either "
+                        "the standby died or it can no longer see the "
+                        "primary — failover protection is gone "
+                        "(docs/fault_tolerance.md)",
+        ),
+        SLORule(
             name="row-freshness",
             kind=THRESHOLD,
             series="edl_tpu_row_freshness_seconds",

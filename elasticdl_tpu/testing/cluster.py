@@ -146,6 +146,11 @@ class MiniCluster:
         self.eval_service = EvaluationService(
             self.dispatcher, metrics_fns, **self._eval_config
         )
+        if self._journal is not None:
+            # Eval rounds are event-sourced onto the same journal
+            # (open/fold/task_done/close records) so restart_master
+            # recovers an open round intact.
+            self.eval_service.attach_journal(self._journal)
         # Telemetry: in-process tests share ONE process registry across
         # master and workers (production is one worker per process);
         # per-worker keying comes from each client's worker_id at report
@@ -301,7 +306,8 @@ class MiniCluster:
             journal=self._journal,
         )
         stats = recover_master_state(
-            self._journal, dispatcher, servicer=servicer
+            self._journal, dispatcher, servicer=servicer,
+            eval_service=eval_service,
         )
         self.dispatcher = dispatcher
         self.eval_service = eval_service

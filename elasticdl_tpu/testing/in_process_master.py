@@ -57,6 +57,14 @@ class InProcessMaster:
                 ):
                     raise
                 attempt += 1
+        if isinstance(resp, dict) and resp.get("stale_master"):
+            # Transport parity with MasterClient: a fenced zombie's
+            # answer is surfaced as a retryable failure, never
+            # trusted (the caller's ride-out/rebind takes over).
+            raise RpcError(
+                "master is fenced (superseded by a hot-standby "
+                "takeover)", code="UNAVAILABLE",
+            )
         gen = resp.get("generation") if isinstance(resp, dict) else None
         if gen is not None:
             self.last_generation = max(self.last_generation, int(gen))

@@ -19,13 +19,28 @@ from elasticdl_tpu.master.servicer import SERVICE_NAME
 class MasterClient:
     def __init__(self, addr: str, worker_id: int,
                  connect_timeout: float = 300.0, retries: int = 3):
-        self._addr = addr
-        # The channel is owned here (RpcStub only closes channels it
-        # created itself) — close() must release it.
-        self._channel = wait_for_channel_ready(
-            addr, timeout=connect_timeout, retries=retries
+        # ``addr`` may be a comma-separated re-resolve list: the
+        # primary master's advertised address plus its hot standbys
+        # (docs/fault_tolerance.md "Hot standby & failover"). The
+        # multi-target rotation lives in RpcStub (ONE implementation);
+        # this constructor only blocks until some master is reachable
+        # and hands the stub the list reordered to start there.
+        # max_retries=0: every caller of this client already has its
+        # own (longer) ride-out loop (task_data_service /
+        # Worker._master_call) that reconnects AND rotates the address
+        # list between attempts — in-stub retries would only hammer a
+        # dead target before the rotation gets a chance (the
+        # comm/rpc.py layering rule, and a measured chunk of failover
+        # downtime).
+        addrs = [a.strip() for a in addr.split(",") if a.strip()]
+        if not addrs:
+            raise ValueError(f"empty master address {addr!r}")
+        reachable = self._wait_any_ready(addrs, connect_timeout,
+                                         retries)
+        self._stub = RpcStub(
+            ",".join(addrs[reachable:] + addrs[:reachable]),
+            SERVICE_NAME, max_retries=0,
         )
-        self._stub = RpcStub(self._channel, SERVICE_NAME)
         self._worker_id = worker_id
         # Master incarnation fence (master/journal.py): responses stamp
         # the master's generation; requests echo the last one seen so a
@@ -40,25 +55,60 @@ class MasterClient:
         # LATEST offer; absent from a response = none pending for us.
         self.pending_resize = None
 
+    @staticmethod
+    def _wait_any_ready(addrs, connect_timeout: float,
+                        retries: int) -> int:
+        """Block until SOME address answers (a worker may start while
+        the primary is mid-failover); returns its index. The probe
+        channel is discarded — the stub owns its own."""
+        last_exc = None
+        for _attempt in range(max(1, retries)):
+            for idx, addr in enumerate(addrs):
+                try:
+                    channel = wait_for_channel_ready(
+                        addr,
+                        timeout=max(
+                            1.0, connect_timeout / max(1, retries)
+                            / len(addrs),
+                        ),
+                        retries=1,
+                    )
+                    channel.close()
+                    return idx
+                except Exception as exc:
+                    last_exc = exc
+        raise TimeoutError(
+            f"no master reachable at {addrs}: {last_exc}"
+        )
+
     def reconnect(self):
-        """Drop the channel and build a fresh one to the same address
-        (non-blocking: the next call fails fast if the master is still
-        down). Needed to re-attach to a RELAUNCHED master: a gRPC
-        channel whose reconnect attempts were refused for a few
+        """Drop the channel and build a fresh one (non-blocking: the
+        next call fails fast if the master is still down), rotating to
+        the next address of the re-resolve list (RpcStub.reconnect).
+        Needed to re-attach to a RELAUNCHED or failed-over master: a
+        gRPC channel whose reconnect attempts were refused for a few
         seconds can wedge its subchannel permanently, while a fresh
         channel to the restarted server connects immediately — the
         worker's outage ride-out loops call this between retries."""
-        from elasticdl_tpu.comm.rpc import build_channel
+        self._stub.reconnect()
 
-        try:
-            self._stub.close()
-            self._channel.close()
-        except Exception:  # a half-dead channel must not block retry
-            pass
-        self._channel = build_channel(self._addr)
-        self._stub = RpcStub(self._channel, SERVICE_NAME)
+    @property
+    def current_addr(self) -> str:
+        return self._stub.target
 
     def _note_generation(self, resp: dict):
+        from elasticdl_tpu.comm.rpc import RpcError
+
+        if resp.get("stale_master"):
+            # A fenced zombie answered: its state is no longer the
+            # job's truth. Surface as a retryable failure so the
+            # ride-out loops reconnect (rotating to the promoted
+            # standby) instead of trusting the response.
+            raise RpcError(
+                f"master at {self.current_addr} is fenced "
+                "(superseded by a hot-standby takeover)",
+                code="UNAVAILABLE",
+            )
         gen = resp.get("generation")
         if gen is not None:
             self.last_generation = max(self.last_generation, int(gen))
@@ -112,7 +162,8 @@ class MasterClient:
         }
         if metrics:
             fields["metrics"] = metrics
-        self._stub.call("report_version", **fields)
+        resp = self._stub.call("report_version", **fields)
+        self._note_generation(resp)
 
     def report_resize(self, resize_id: int,
                       status: str = "applied") -> bool:
@@ -130,4 +181,3 @@ class MasterClient:
 
     def close(self):
         self._stub.close()
-        self._channel.close()

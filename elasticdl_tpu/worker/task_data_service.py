@@ -74,11 +74,12 @@ class TaskDataService:
         # window means re-attaching with no work lost.
         self._reattach_grace = max(float(master_reattach_grace), 0.1)
 
-    def _wait(self):
+    def _wait(self, secs: float = None):
+        secs = self._wait_sleep_secs if secs is None else secs
         if self._on_wait is not None:
-            self._on_wait(self._wait_sleep_secs)
+            self._on_wait(secs)
         else:
-            time.sleep(self._wait_sleep_secs)
+            time.sleep(secs)
 
     def task_stream(self) -> Iterator[Tuple[object, Optional[Iterator]]]:
         """Yield ``(task, batch_iter)`` pairs until the job is finished.
@@ -87,12 +88,11 @@ class TaskDataService:
         TRAIN_END_CALLBACK yielded for the worker to run callbacks). The
         caller must consume ``batch_iter`` fully, then report the task.
         """
-        from elasticdl_tpu.comm.rpc import RpcError
+        from elasticdl_tpu.comm.rpc import RpcError, decorrelated_jitter
 
-        max_failures = max(1, int(
-            self._reattach_grace / max(self._wait_sleep_secs, 0.1)
-        ))
         rpc_failures = 0
+        retry_delay = 0.0
+        outage_deadline = None
         last_generation = getattr(self._master, "last_generation", None)
         while True:
             # One root span per task cycle — opened BEFORE get_task so
@@ -116,26 +116,45 @@ class TaskDataService:
                         self._on_metrics_delivered()
                 except RpcError as exc:
                     span.discard()
+                    now = time.monotonic()
+                    if outage_deadline is None:
+                        # Time-based grace (not attempt-counted): the
+                        # jittered backoff below makes attempt counts
+                        # an unreliable clock.
+                        outage_deadline = now + self._reattach_grace
                     rpc_failures += 1
                     logger.warning(
-                        "get_task RPC failed (%d/%d): %s",
-                        rpc_failures, max_failures, exc,
+                        "get_task RPC failed (%d, %.0fs of grace "
+                        "left): %s",
+                        rpc_failures, max(0.0, outage_deadline - now),
+                        exc,
                     )
-                    if rpc_failures >= max_failures:
+                    if now >= outage_deadline:
                         logger.warning(
                             "master unreachable for the full reattach "
                             "grace (%.0fs); treating job as finished",
                             self._reattach_grace,
                         )
                         return
-                    # _wait (not sleep): multi-host workers must keep
-                    # ticking the barrier during the backoff or they
-                    # strand peers mid-collective.
-                    self._wait()
+                    # Decorrelated-jitter backoff (comm/rpc.py): a
+                    # master failover fails the WHOLE fleet at the
+                    # same instant, and a fixed retry interval would
+                    # hammer the promoted standby in lockstep forever
+                    # (thundering herd). _wait (not sleep): multi-host
+                    # workers must keep ticking the barrier during the
+                    # backoff or they strand peers mid-collective.
+                    retry_delay = decorrelated_jitter(
+                        retry_delay,
+                        base=min(0.2, self._wait_sleep_secs),
+                        cap=2.0 * self._wait_sleep_secs,
+                    )
+                    self._wait(retry_delay)
                     # Fresh channel per retry (MasterClient.reconnect):
                     # a channel whose reconnects were refused for a few
                     # seconds can wedge permanently; re-attaching to a
-                    # RELAUNCHED master needs a rebuild.
+                    # RELAUNCHED (or failed-over: the rebuild rotates
+                    # the re-resolve address list) master needs a
+                    # rebuild.
                     reconnect = getattr(self._master, "reconnect", None)
                     if reconnect is not None:
                         reconnect()
@@ -157,6 +176,8 @@ class TaskDataService:
                     )
                 last_generation = generation
                 rpc_failures = 0
+                retry_delay = 0.0
+                outage_deadline = None
                 if task is None:
                     if finished:
                         span.discard()

@@ -370,9 +370,14 @@ class Worker:
         bounded retry absorbs blips; this absorbs a master restart. A
         non-retryable code or an exhausted grace re-raises (the task
         loop's error handling takes over)."""
-        from elasticdl_tpu.comm.rpc import RETRYABLE_CODES, RpcError
+        from elasticdl_tpu.comm.rpc import (
+            RETRYABLE_CODES,
+            RpcError,
+            decorrelated_jitter,
+        )
 
         deadline = time.monotonic() + self._master_reattach_grace
+        retry_delay = 0.0
         while True:
             try:
                 return fn()
@@ -384,13 +389,19 @@ class Worker:
                     "%s failed (%s); retrying while the master "
                     "recovers", description, exc,
                 )
+                # Decorrelated jitter (comm/rpc.py): a failover fails
+                # every worker's report at once; fixed intervals would
+                # stampede the promoted standby in lockstep.
+                retry_delay = decorrelated_jitter(
+                    retry_delay, base=0.2, cap=2.0
+                )
                 # _wait_tick, not sleep: multi-host workers must keep
                 # participating in barrier ticks during the ride-out
                 # or they strand peers mid-collective. (If a stop was
                 # requested, WorkerStopped propagates and _run's
                 # handler exits the task loop — a stopping worker
                 # gives up reporting through an outage.)
-                self._wait_tick(2.0)
+                self._wait_tick(retry_delay)
                 # Fresh channel per retry: a channel refused for a few
                 # seconds can wedge; reconnecting is what actually
                 # re-attaches to the relaunched master.

@@ -12,10 +12,15 @@ Validates (returning a list of human-readable errors, empty = pass):
 - framing: every byte accounted for by intact length+CRC32 frames;
   torn/trailing bytes are reported with the offset and size (recovery
   would silently truncate them — fsck's job is to surface the loss);
-- every record passes the structural check (``validate_record``);
+- every record passes the structural check (``validate_record``) —
+  including the eval-round (``eval_round``/``eval_fold``), relaunch-
+  generation, and takeover ``fence`` kinds;
 - ``seq`` strictly increases across the file;
 - ``generation`` fences strictly increase (a replayed incarnation
   must never reuse a generation);
+- takeover ``fence`` records strictly increase, and no generation
+  below a published fence ever appends after it — a violation means
+  a fenced zombie incarnation wrote to the journal (split-brain);
 - dispatch ``task_id``s strictly increase (the counter survives
   restarts by construction — reuse would break report fencing);
 - report/tail consistency: every ``report`` names a task id known to
@@ -38,6 +43,7 @@ sys.path.insert(
 def check_journal(path: str) -> List[str]:
     from elasticdl_tpu.master.journal import (
         DISPATCH,
+        FENCE,
         GENERATION,
         JOURNAL_FILE,
         REPORT,
@@ -53,6 +59,7 @@ def check_journal(path: str) -> List[str]:
     errors: List[str] = []
     last_seq = None
     last_generation = None
+    last_fence = None
     last_dispatch_id = None
     known_tasks = set()
     consumed = 0
@@ -72,6 +79,17 @@ def check_journal(path: str) -> List[str]:
             )
         last_seq = seq
         rtype = record["t"]
+        if last_fence is not None and rtype in (GENERATION, DISPATCH):
+            # Anything a fenced incarnation could write carries its
+            # generation; dispatches and generation fences are the
+            # state-bearing ones worth auditing.
+            generation = record.get("generation")
+            if generation is not None and generation < last_fence:
+                errors.append(
+                    f"record @{offset}: generation {generation} "
+                    f"appended after fence {last_fence} — a fenced "
+                    "zombie incarnation wrote to the journal"
+                )
         if rtype == GENERATION:
             generation = record["generation"]
             if (last_generation is not None
@@ -81,6 +99,14 @@ def check_journal(path: str) -> List[str]:
                     f"({last_generation} -> {generation})"
                 )
             last_generation = generation
+        elif rtype == FENCE:
+            fence = record["generation"]
+            if last_fence is not None and fence <= last_fence:
+                errors.append(
+                    f"record @{offset}: fence records are "
+                    f"non-monotonic ({last_fence} -> {fence})"
+                )
+            last_fence = fence
         elif rtype == SNAPSHOT:
             state = record["state"]
             # The snapshot supersedes history: its doing set and
