@@ -9,7 +9,7 @@ PY ?= python
 	trace-smoke serve-fleet-smoke sparse-smoke sparse-bench \
 	autoscale-smoke autoscale-bench slo-smoke ckpt-bench ckpt-smoke \
 	tiered-smoke tiered-bench reshard-smoke reshard-bench \
-	profile-smoke failover-smoke failover-bench
+	profile-smoke failover-smoke failover-bench quake-smoke fsck
 
 # Scrape-and-pretty-print a master's /metrics (docs/observability.md).
 METRICS_ADDR ?= localhost:8080
@@ -212,12 +212,14 @@ reshard-bench:
 # end-of-run shard relaunch restores across the base+delta chain.
 # The row checkpoint dir the drill leaves behind is then fsck'd.
 # Runs the tiered-storage drill first (tiered-smoke) so the chaos
-# lane also fsck's cold-tier segment stores via check_store.py, and
-# the master-kill drill (chaos-master-smoke) so the journal fsck —
+# lane also fsck's cold-tier segment stores via check_store.py, the
+# master-kill drill (chaos-master-smoke) so the journal fsck —
 # including the eval-round / relaunch / fence record kinds — runs in
-# this lane too. docs/chaos.md.
+# this lane too, and the zero-RPO quake drill (quake-smoke) so
+# check_pushlog.py audits real SIGKILLed incarnations' write-ahead
+# push logs. docs/chaos.md.
 CHAOS_SEED ?= 7
-chaos-smoke: tiered-smoke chaos-master-smoke
+chaos-smoke: tiered-smoke chaos-master-smoke quake-smoke
 	workdir=$$(mktemp -d /tmp/edl_chaos.XXXXXX); \
 	JAX_PLATFORMS=cpu $(PY) -m elasticdl_tpu chaos run \
 		--seed $(CHAOS_SEED) --workdir $$workdir \
@@ -266,6 +268,37 @@ failover-bench:
 	JAX_PLATFORMS=cpu $(PY) -m elasticdl_tpu.chaos.failover_drill run \
 		--workdir $$workdir --report FAILOVER_DRILL.json; \
 	rc=$$?; rm -rf $$workdir; exit $$rc
+
+# Zero-RPO quake drill (docs/fault_tolerance.md "Zero-RPO row
+# plane"): REAL row-service processes with the write-ahead push log —
+# a shard is SIGKILLed mid-push-storm and the relaunched fleet must
+# converge byte-equal (rows + slots + step counters) to a fault-free
+# twin with NO external replay (acked-push RPO = 0); a composed
+# scenario SIGKILLs the master AND a migration source in the same
+# window and requires standby takeover, WAL replay, and the resume()d
+# migration to all converge; durable-ack p99 push must stay <=1.5x a
+# no-log baseline at the default group window. Every dead
+# incarnation's log is fsck'd by check_pushlog.py (in-drill and again
+# here over the tree), then the umbrella fsck audits the whole
+# workdir. Fast-lane equivalent:
+# tests/test_pushlog.py::test_quake_drill_fast_lane +
+# tests/test_failover.py::test_composed_master_and_shard_kill.
+quake-smoke:
+	workdir=$$(mktemp -d /tmp/edl_quake.XXXXXX); \
+	JAX_PLATFORMS=cpu $(PY) -m elasticdl_tpu.chaos.quake_drill run \
+		--workdir $$workdir --report QUAKE_DRILL.json \
+	&& $(PY) tools/check_pushlog.py $$workdir \
+	&& $(PY) tools/fsck.py $$workdir; \
+	rc=$$?; rm -rf $$workdir; exit $$rc
+
+# Umbrella fsck: discover every auditable artifact (master journals,
+# checkpoint chains, cold stores, push logs, incident bundles,
+# shard-map state files) under FSCK_DIR and run the matching
+# tools/check_*.py validator — until this target, each drill wired
+# its own subset. CI runs it over the repo tree on every push.
+FSCK_DIR ?= .
+fsck:
+	$(PY) tools/fsck.py $(FSCK_DIR)
 
 # Randomized soak: N seed-derived plans; a failure prints the seed
 # that reproduces it (slow lane — not part of tier-1).

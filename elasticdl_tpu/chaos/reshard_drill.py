@@ -18,10 +18,15 @@ hot-row replication"):
    fresh controller built from the state file ``resume()``s: it
    re-distributes the persisted epoch and releases the target.
 
-After each scenario the remaining schedule replays, and the final
-state must be **byte-equal to a fault-free twin** driven by the same
-seeded schedule with the same (un-killed) splits — rows, optimizer
-slots, across every shard. The row-conservation invariant spans
+After each scenario the *driver* pushes the remaining schedule (the
+suffix past the restored checkpoint — modeling a trainer retrying
+work the dead shard never durably acked; this drill's services run
+without the write-ahead push log, and once ``--push_log_dir`` is on,
+acked pushes replay from the shard's OWN WAL and re-driving them is
+forbidden — see ``chaos/quake_drill.py`` and docs/chaos.md "Relaunch
+contract"), and the final state must be **byte-equal to a fault-free
+twin** driven by the same seeded schedule with the same (un-killed)
+splits — rows, optimizer slots, across every shard. The row-conservation invariant spans
 source, target, AND replicas: every id lives on exactly ONE home
 shard (no loss, no double-homing), and every hot-row replica copy
 matches its home's bytes. The authority state file is fsck'd by

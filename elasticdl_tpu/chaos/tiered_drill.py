@@ -10,10 +10,21 @@ must restore to a consistent table.
    the cold store but the hot arena still holds them — the duplicate-
    record window. The relaunch restores from the checkpoint chain into
    a FRESH cold dir (the cold tier is a spill cache; a dead
-   incarnation's spill is never resurrected), replays the pushes the
-   kill lost, and must land **byte-equal** to a fault-free twin driven
-   by the same seeded schedule — rows, optimizer slots, and Adam step
-   counters included.
+   incarnation's spill is never resurrected), the *driver* re-pushes
+   the schedule suffix past the restored version, and the end state
+   must land **byte-equal** to a fault-free twin driven by the same
+   seeded schedule — rows, optimizer slots, and Adam step counters
+   included.
+
+   Contract note: this drill's service runs checkpoints WITHOUT the
+   write-ahead push log, so the kill legitimately loses applied
+   pushes back to the restored version and the driver models a
+   trainer retrying the *unacked* suffix. Once ``--push_log_dir`` is
+   configured, that external re-drive is FORBIDDEN — acked pushes
+   survive kills on their own (restore-chain → WAL-tail replay), and
+   ``chaos/quake_drill.py`` (``make quake-smoke``) pins exactly that:
+   byte-equality with no re-driven pushes (docs/fault_tolerance.md
+   "Zero-RPO row plane", docs/chaos.md "Relaunch contract").
 2. **Kill mid-compaction** — same service shape, killed from the cold
    store's mid-compact hook: the victim segment's live rows are
    re-appended to the tail but the victim file still exists. Same

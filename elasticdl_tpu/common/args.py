@@ -231,6 +231,21 @@ def add_train_params(parser):
                              "per table-holding step), so the service "
                              "checkpoints at roughly the cadence the "
                              "user asked for in model versions")
+    parser.add_argument("--row_service_push_log",
+                        choices=["durable", "applied", "off"],
+                        default="durable",
+                        help="Write-ahead push log mode for launched "
+                             "row-service pods (with --checkpoint_dir; "
+                             "docs/fault_tolerance.md 'Zero-RPO row "
+                             "plane'): durable (default, acked-push "
+                             "RPO=0), applied (RPO = one group "
+                             "window; for media with slow fsync), "
+                             "off (pre-WAL checkpoint-bounded loss)")
+    parser.add_argument("--row_service_push_log_group_ms", type=float,
+                        default=2.0,
+                        help="Group-commit window for the row-service "
+                             "push log (one fsync covers every push "
+                             "landing within it)")
     add_bool_param(parser, "--fuse_task_steps", False,
                    "Scan a whole task's minibatches in one XLA program "
                    "(removes per-step host dispatch)")

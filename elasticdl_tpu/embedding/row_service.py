@@ -314,6 +314,13 @@ class HostRowService:
         self._saver = None
         self._ckpt_writer = None
         self._ckpt_planner = None
+        # Write-ahead push log (storage/pushlog.py): None until
+        # configure_push_log. With it, every APPLIED push is framed
+        # into the group-commit queue under the same lock that applied
+        # it, so the log is a total order of this shard's applies and
+        # a relaunch replays the tail through the normal apply path —
+        # no acked write is ever lost (zero RPO in durable-ack mode).
+        self._push_log = None
         # Serializes the busy-check/plan/capture/submit sequence:
         # concurrent push handlers at consecutive checkpoint versions
         # must not interleave inside the planner, or two deltas name
@@ -559,6 +566,8 @@ class HostRowService:
                 # slots) OUTSIDE the service lock; a duplicate push
                 # merely promotes rows it would have touched anyway.
                 prefault(ids)
+            duplicate = False
+            wal_ticket = None
             with self._lock:
                 # Ownership + fence checks BEFORE any mutation: a
                 # redirected/fenced push applies nothing, so the
@@ -574,35 +583,69 @@ class HostRowService:
                     if seq <= self._applied_seq.get(key, -1):
                         # Retried push whose first attempt DID apply
                         # before the reply was lost (at-most-once
-                        # semantics).
+                        # semantics). The duplicate ack still honors
+                        # the durable-ack contract below: the FIRST
+                        # attempt's WAL record may be queued unfsynced.
                         self._m_dup.inc()
-                        return {"duplicate": True}
-                self._optimizer.apply_gradients(table, ids, grads)
-                self._table_versions[table_name] += 1
-                self._applied_at[table_name] = time.time()
-                if client and seq >= 0:
-                    # Record only AFTER apply succeeds: a failed apply
-                    # must leave the seq unburned so the client's retry
-                    # is not dropped as a duplicate (the gradient would
-                    # be lost).
-                    self._applied_seq[_client_key(client)] = seq
-                self._push_count += 1
-                version = self._push_count
-                self._stat_pushed_rows += int(ids.size)
-                mig = self._out_migration
-                if mig is not None:
-                    # Applied writes landing in the moving range feed
-                    # the catch-up delta — the migration's own dirty
-                    # tracking (the checkpoint's sets stay untouched).
-                    b = bucket_of(ids)
-                    in_range = (b >= mig["lo"]) & (b < mig["hi"])
-                    if in_range.any():
-                        mig["touched"].setdefault(
-                            request["table"], set()
-                        ).update(ids[in_range].tolist())
-                refresh_ids = self._replicated_ids_locked(
-                    request["table"], ids
-                )
+                        duplicate = True
+                if not duplicate:
+                    self._optimizer.apply_gradients(table, ids, grads)
+                    self._table_versions[table_name] += 1
+                    self._applied_at[table_name] = time.time()
+                    if client and seq >= 0:
+                        # Record only AFTER apply succeeds: a failed
+                        # apply must leave the seq unburned so the
+                        # client's retry is not dropped as a duplicate
+                        # (the gradient would be lost).
+                        self._applied_seq[_client_key(client)] = seq
+                    self._push_count += 1
+                    version = self._push_count
+                    self._stat_pushed_rows += int(ids.size)
+                    if self._push_log is not None:
+                        # Enqueue under the SAME lock that applied:
+                        # log order == apply order == version order.
+                        # The fsync wait (durable ack) happens after
+                        # the lock is released.
+                        wal_ticket = self._push_log.append(
+                            version=version, client=client or "",
+                            seq=seq, table=table_name, ids=ids,
+                            grads=grads,
+                            applied_at=self._applied_at[table_name],
+                            map_version=(
+                                self._shard_map.version
+                                if self._shard_map is not None else 0
+                            ),
+                        )
+                    mig = self._out_migration
+                    if mig is not None:
+                        # Applied writes landing in the moving range
+                        # feed the catch-up delta — the migration's own
+                        # dirty tracking (the checkpoint's sets stay
+                        # untouched).
+                        b = bucket_of(ids)
+                        in_range = (b >= mig["lo"]) & (b < mig["hi"])
+                        if in_range.any():
+                            mig["touched"].setdefault(
+                                request["table"], set()
+                            ).update(ids[in_range].tolist())
+                    refresh_ids = self._replicated_ids_locked(
+                        request["table"], ids
+                    )
+            if duplicate:
+                if (self._push_log is not None
+                        and self._push_log.ack == "durable"):
+                    # Ack the retry only once the original attempt's
+                    # record is durable — a duplicate ack is still an
+                    # ack, and zero RPO covers it too.
+                    self._push_log.barrier()
+                return {"duplicate": True}
+            if wal_ticket is not None and self._push_log.ack == "durable":
+                # Durable ack: the reply leaves only after the group
+                # commit covering this record fsyncs. A failed commit
+                # raises — the client must NOT treat this push as
+                # durable (the shard's WAL disk is broken and the
+                # error is loud by design).
+                wal_ticket.wait(timeout=60.0)
             if refresh_ids is not None:
                 # Async push-driven replica refresh: enqueue OUTSIDE
                 # the lock; the refresher thread reads fresh rows and
@@ -1281,6 +1324,131 @@ class HostRowService:
         self._restore_latest()
         return self
 
+    # ---- write-ahead push log (zero-RPO state plane) --------------------
+
+    def configure_push_log(self, log_dir: str, group_ms: float = 2.0,
+                           ack: str = "durable",
+                           segment_max_bytes: int = 8 << 20):
+        """Attach the write-ahead push log (storage/pushlog.py) and
+        replay its tail: every record past the restored checkpoint
+        version is re-applied through the normal apply path, where the
+        checkpointed (client, seq) dedup map makes replay idempotent
+        and the installed shard map filters ranges that migrated away.
+
+        Must run AFTER ``configure_checkpoint`` (restore-chain first,
+        then the log tail) and after ``configure_tiering``. With no
+        checkpoint configured the whole log replays — the log alone is
+        a valid (unbounded) durability story; the checkpoint chain is
+        what lets it truncate.
+
+        ``ack="durable"`` (default): push replies wait for the group
+        commit covering their record — acked-push RPO = 0.
+        ``ack="applied"``: replies return after the in-memory apply;
+        RPO = one ``group_ms`` window.
+        """
+        from elasticdl_tpu.observability import default_registry
+        from elasticdl_tpu.storage.pushlog import PushLog
+
+        if self._push_log is not None:
+            self._push_log.close()
+        log = PushLog(
+            log_dir, group_ms=group_ms, ack=ack,
+            segment_max_bytes=segment_max_bytes,
+        )
+        m_replayed = default_registry().counter(
+            "row_push_log_replayed_records_total",
+            "Push-log records re-applied on relaunch (past the "
+            "restored checkpoint version)",
+        )
+        with self._lock:
+            restored = self._push_count
+        replayed = covered = 0
+        for record in log.replay_records():
+            if self._replay_push_record(record):
+                replayed += 1
+            else:
+                covered += 1
+        if replayed:
+            m_replayed.inc(replayed)
+        for table in self._tables.values():
+            # Tiered tables: replay deferred every budget sweep; one
+            # sweep per table now brings the hot arena back under
+            # budget before serving starts.
+            sweep = getattr(table, "maybe_sweep", None)
+            if sweep is not None:
+                sweep()
+        # Sealed segments at or below the restored tip are covered by
+        # the chain already — reclaim them now rather than re-scanning
+        # them on every future relaunch.
+        log.truncate_through(restored)
+        self._push_log = log
+        logger.info(
+            "Row service push log at %s (ack=%s, group %.1fms): "
+            "replayed %d record(s) past checkpoint version %d "
+            "(%d already covered/filtered)",
+            log_dir, ack, group_ms, replayed, restored, covered,
+        )
+        return self
+
+    def _replay_push_record(self, record: dict) -> bool:
+        """Re-apply one logged push on relaunch. Returns whether it
+        mutated state (False = covered by the restored checkpoint,
+        deduped, or fully migrated away). The push version advances
+        either way: the log is a total order of this shard's applies,
+        and checkpoint versions must keep counting from where the
+        dead incarnation stopped."""
+        version = int(record["v"])
+        table_name = str(record["table"])
+        with self._lock:
+            if version <= self._push_count:
+                return False  # the restored chain already holds it
+            applied = False
+            table = self._tables.get(table_name)
+            if table is None:
+                logger.warning(
+                    "push-log record v%d names unknown table %r; "
+                    "skipped (different model module?)",
+                    version, table_name,
+                )
+            else:
+                ids = np.asarray(record["ids"], np.int64)
+                grads = np.asarray(record["grads"], np.float32)
+                if self._shard_map is not None:
+                    # Ranges that migrated away between the record and
+                    # the checkpointed map belong to another shard now
+                    # — the cutover already moved (or erased) them.
+                    own = self._shard_map.owns(self._shard_id, ids)
+                    ids, grads = ids[own], grads[own]
+                client = str(record.get("client") or "")
+                seq = int(record.get("seq", -1))
+                dup = bool(
+                    client and seq >= 0
+                    and seq <= self._applied_seq.get(
+                        _client_key(client), -1
+                    )
+                )
+                if ids.size and not dup:
+                    prefault = getattr(table, "prefault_group", None)
+                    if prefault is not None:
+                        # Tiered tables: fault the rows (and slots)
+                        # back hot before the apply — replay runs
+                        # single-threaded at startup, so doing the
+                        # disk read under the lock contends with
+                        # nobody.
+                        prefault(ids)
+                    self._optimizer.apply_gradients(table, ids, grads)
+                    self._table_versions[table_name] += 1
+                    self._applied_at[table_name] = max(
+                        self._applied_at.get(table_name, 0.0),
+                        float(record.get("applied_at", 0.0)),
+                    )
+                    self._stat_pushed_rows += int(ids.size)
+                    applied = True
+                if client and seq >= 0 and not dup:
+                    self._applied_seq[_client_key(client)] = seq
+            self._push_count = version
+        return applied
+
     def _checkpoint(self, version: int, blocking: bool = False) -> bool:
         """Capture/write split: ONE lock acquisition across the whole
         capture so rows, optimizer slots, step counters, and the seq
@@ -1354,6 +1522,16 @@ class HostRowService:
                     self._saver.save(
                         version, {}, embeddings=captured, meta=meta
                     )
+                log = self._push_log
+                if log is not None:
+                    # The version is durable (save/save_delta fsync +
+                    # publish before returning) — sealed log segments
+                    # it covers are now reclaimable. Truncation is
+                    # fenced to THIS point by construction: it only
+                    # ever runs on the writer thread, after the
+                    # publish, against the chain element that covers
+                    # the reclaimed records (saver chain meta).
+                    log.truncate_through(int(version))
             except BaseException:
                 # A failed write must put the drained rows back into
                 # the dirty sets (or they vanish from every future
@@ -1469,6 +1647,15 @@ class HostRowService:
             ev = self._server.stop(grace)
             if ev is not None:
                 ev.wait((grace or 0) + 30.0)
+        if self._push_log is not None:
+            try:
+                # Drain the group-commit queue (one final fsync covers
+                # it) AFTER the handlers drained — SIGTERM is always
+                # clean: every push the server ever acked (or even
+                # just applied) is on disk before the process exits.
+                self._push_log.close()
+            except BaseException as exc:
+                logger.error("push-log drain on stop failed: %s", exc)
         if self._ckpt_writer is not None:
             try:
                 # Land any queued checkpoint write and retire the
@@ -2287,6 +2474,24 @@ def main(argv=None):
                              "handler instead of the background "
                              "writer (debugging / deterministic "
                              "schedules)")
+    parser.add_argument("--push_log_dir", default="",
+                        help="Write-ahead push log directory "
+                             "(storage/pushlog.py): every applied "
+                             "push is group-committed to disk and "
+                             "replayed on relaunch, so acked pushes "
+                             "survive SIGKILL independently of "
+                             "checkpoint cadence "
+                             "(docs/fault_tolerance.md 'Zero-RPO row "
+                             "plane'). Empty (default) = off")
+    parser.add_argument("--push_log_group_ms", type=float, default=2.0,
+                        help="Group-commit window: one fsync covers "
+                             "every push landing within it")
+    parser.add_argument("--push_log_ack", default="durable",
+                        choices=["durable", "applied"],
+                        help="durable (default): push replies wait "
+                             "for the covering fsync (RPO=0). "
+                             "applied: reply after the in-memory "
+                             "apply (RPO = one group window)")
     parser.add_argument("--hot_budget_rows", type=int, default=0,
                         help="Tiered storage: max rows/table resident "
                              "in the hot in-memory arena; colder rows "
@@ -2373,8 +2578,36 @@ def main(argv=None):
             delta_chain_max=args.checkpoint_delta_chain,
             async_write=not args.checkpoint_sync,
         )
+    if args.push_log_dir:
+        # AFTER checkpoint config: restore the chain first, then
+        # replay the log tail through the normal apply path.
+        service.configure_push_log(
+            args.push_log_dir, group_ms=args.push_log_group_ms,
+            ack=args.push_log_ack,
+        )
     service.start(args.addr, tag=f"rowservice/{args.shard_id}")
     logger.info("Row service serving on %s", args.addr)
+    import signal
+
+    def _graceful(_sig, _frame):
+        # Planned eviction: drain handlers, land a durable checkpoint,
+        # and flush the push-log queue — SIGTERM is always clean (a
+        # SIGKILL loses at most unacked/applied-ack records inside one
+        # group window; durable acks lose nothing either way).
+        logger.warning(
+            "SIGTERM: draining row service (checkpoint + push-log "
+            "flush)"
+        )
+        try:
+            service.checkpoint_now()
+        except BaseException as exc:
+            logger.error("drain checkpoint failed: %s", exc)
+        service.stop(grace=5.0)
+
+    try:
+        signal.signal(signal.SIGTERM, _graceful)
+    except ValueError:
+        pass  # not the main thread (embedded use)
     if args.flight_recorder > 0:
         tracing.set_process_role("rowservice", str(args.shard_id))
         tracing.install_recorder(

@@ -35,8 +35,75 @@ from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
 logger = get_logger("master")
 
 
+def build_dispatcher(args, spec) -> TaskDispatcher:
+    """The job's TaskDispatcher from its parsed args + model spec —
+    shards, sizing, deferred train-end callback, max-steps bounds.
+    Factored out of ``Master.__init__`` so the ``--standby`` role can
+    keep a warm continuously-replayed dispatcher built from the
+    IDENTICAL config (the contract every journal-replay path depends
+    on) and hand it over at promotion."""
+    reader_params = parse_data_reader_params(
+        getattr(args, "data_reader_params", "")
+    )
+    reader_of = lambda origin: create_data_reader(  # noqa: E731
+        data_origin=origin,
+        custom_reader=spec.custom_data_reader,
+        **reader_params,
+    )
+    training_data = getattr(args, "training_data", "")
+    validation_data = getattr(args, "validation_data", "")
+    prediction_data = getattr(args, "prediction_data", "")
+    dispatcher = TaskDispatcher(
+        training_shards=(
+            reader_of(training_data).create_shards()
+            if training_data else {}
+        ),
+        evaluation_shards=(
+            reader_of(validation_data).create_shards()
+            if validation_data else {}
+        ),
+        prediction_shards=(
+            reader_of(prediction_data).create_shards()
+            if prediction_data else {}
+        ),
+        records_per_task=(
+            args.minibatch_size * args.num_minibatches_per_task
+        ),
+        num_epochs=getattr(args, "num_epochs", 1),
+    )
+    if training_data:
+        # Queue the train-end callback task when the job drains so a
+        # worker runs on_train_end (SavedModelExporter etc. — reference
+        # task_dispatcher.py:206-241).
+        dispatcher.add_deferred_callback(
+            dispatcher.create_train_end_callback_task
+        )
+    if getattr(args, "max_steps", 0):
+        dispatcher.set_max_steps(args.max_steps, args.minibatch_size)
+    # MaxStepsStopping callback also bounds dispatch
+    # (reference callbacks.py:57-98).
+    from elasticdl_tpu.callbacks import MaxStepsStopping, find_callback
+
+    cbs = spec.callbacks_fn() if spec.callbacks_fn else []
+    ms = find_callback(cbs, MaxStepsStopping)
+    # CLI --max_steps wins over the callback (same precedence as
+    # LocalExecutor).
+    if ms is not None and not getattr(args, "max_steps", 0):
+        dispatcher.set_max_steps(ms.max_steps, args.minibatch_size)
+    return dispatcher
+
+
 class Master:
-    def __init__(self, args, k8s_client=None):
+    def __init__(self, args, k8s_client=None, warm_state=None):
+        """``warm_state`` (the ``--standby`` promotion handover):
+        ``{"dispatcher": a continuously-replayed TaskDispatcher,
+        "stats": its replay carry}``. With it, construction SKIPS the
+        cold journal replay — the standby already folded every record
+        into the dispatcher it hands over, and the caller already
+        published the fence — and only opens the new generation +
+        re-arms around the warm state. Without it (the default),
+        behavior is unchanged: fresh dispatcher, full recovery replay
+        when the journal has state."""
         self._args = args
         self._spec = get_model_spec(
             model_zoo=args.model_zoo,
@@ -48,58 +115,12 @@ class Master:
             callbacks=args.callbacks,
             custom_data_reader=args.custom_data_reader,
         )
-        reader_params = parse_data_reader_params(
-            getattr(args, "data_reader_params", "")
-        )
-        reader_of = lambda origin: create_data_reader(
-            data_origin=origin,
-            custom_reader=self._spec.custom_data_reader,
-            **reader_params,
-        )
-        training_data = getattr(args, "training_data", "")
         validation_data = getattr(args, "validation_data", "")
-        prediction_data = getattr(args, "prediction_data", "")
-        self.task_dispatcher = TaskDispatcher(
-            training_shards=(
-                reader_of(training_data).create_shards()
-                if training_data else {}
-            ),
-            evaluation_shards=(
-                reader_of(validation_data).create_shards()
-                if validation_data else {}
-            ),
-            prediction_shards=(
-                reader_of(prediction_data).create_shards()
-                if prediction_data else {}
-            ),
-            records_per_task=(
-                args.minibatch_size * args.num_minibatches_per_task
-            ),
-            num_epochs=getattr(args, "num_epochs", 1),
-        )
-        if training_data:
-            # Queue the train-end callback task when the job drains so a
-            # worker runs on_train_end (SavedModelExporter etc. — reference
-            # task_dispatcher.py:206-241).
-            self.task_dispatcher.add_deferred_callback(
-                self.task_dispatcher.create_train_end_callback_task
-            )
-        if getattr(args, "max_steps", 0):
-            self.task_dispatcher.set_max_steps(
-                args.max_steps, args.minibatch_size
-            )
-        # MaxStepsStopping callback also bounds dispatch
-        # (reference callbacks.py:57-98).
-        from elasticdl_tpu.callbacks import MaxStepsStopping, find_callback
-
-        cbs = self._spec.callbacks_fn() if self._spec.callbacks_fn else []
-        ms = find_callback(cbs, MaxStepsStopping)
-        # CLI --max_steps wins over the callback (same precedence as
-        # LocalExecutor).
-        if ms is not None and not getattr(args, "max_steps", 0):
-            self.task_dispatcher.set_max_steps(
-                ms.max_steps, args.minibatch_size
-            )
+        training_data = getattr(args, "training_data", "")
+        if warm_state is not None:
+            self.task_dispatcher = warm_state["dispatcher"]
+        else:
+            self.task_dispatcher = build_dispatcher(args, self._spec)
 
         # Master crash recovery (master/journal.py): with --journal_dir
         # the dispatcher writes every dispatch/report through a
@@ -115,9 +136,29 @@ class Master:
         self._journal = None
         self._recovery_stats = None
         journal_dir = getattr(args, "journal_dir", "")
+        if warm_state is not None and not journal_dir:
+            raise ValueError(
+                "warm_state handover requires --journal_dir (the "
+                "standby replays FROM it)"
+            )
         if journal_dir:
             self._journal = MasterJournal(journal_dir)
-            if self._journal.has_state():
+            if warm_state is not None:
+                # Warm promotion: no replay — the handed-over
+                # dispatcher IS the replayed state (tail included; the
+                # caller drained it after publishing the fence). Open
+                # our generation above the fence, stamp the fence
+                # record, and re-attach write-through.
+                stats = dict(warm_state["stats"])
+                stats["known_workers"] = sorted(
+                    stats["known_workers"]
+                )
+                generation = self._journal.open_generation()
+                self._journal.append("fence", generation=generation)
+                self.task_dispatcher.attach_journal(self._journal)
+                stats["generation"] = generation
+                self._recovery_stats = stats
+            elif self._journal.has_state():
                 self._recovery_stats = recover_master_state(
                     self._journal, self.task_dispatcher
                 )
@@ -373,7 +414,25 @@ class Master:
             cmd += ["--checkpoint_dir", f"{ckpt}/{subdir}",
                     "--checkpoint_steps", str(steps),
                     "--keep_checkpoint_max",
-                    str(getattr(self._args, "keep_checkpoint_max", 3)),
+                    str(getattr(self._args, "keep_checkpoint_max", 3))]
+            push_log = str(getattr(
+                self._args, "row_service_push_log", "durable"
+            ))
+            if push_log != "off":
+                # Zero-RPO by default wherever durability is
+                # configured at all: the write-ahead push log rides
+                # next to the checkpoint chain, so a SIGKILLed shard
+                # pod loses no acked push (docs/fault_tolerance.md
+                # "Zero-RPO row plane"). --row_service_push_log
+                # applied|off tunes/disables it (slow-fsync media).
+                cmd += ["--push_log_dir", f"{ckpt}/{subdir}_pushlog",
+                        "--push_log_ack", push_log,
+                        "--push_log_group_ms",
+                        str(getattr(
+                            self._args,
+                            "row_service_push_log_group_ms", 2.0,
+                        ))]
+            cmd += [
                     # Layout guard: a relaunch with a different
                     # --num_row_service_shards must fail loudly, not
                     # silently lose the rows whose id%N home moved
@@ -721,25 +780,27 @@ class Master:
 
 def run_standby(args, k8s_client=None) -> int:
     """``--standby`` role (docs/fault_tolerance.md "Hot standby &
-    failover"): heartbeat the primary and watch its journal; on missed
-    heartbeats, FENCE the old incarnation and promote into a full
-    ``Master`` on this warm process.
+    failover"): keep a WARM continuously-replayed dispatcher by
+    tailing the primary's journal, heartbeat the primary, and on
+    missed heartbeats FENCE the old incarnation and promote into a
+    full ``Master`` that ADOPTS the warm dispatcher.
 
-    The expensive part of restart-and-replay is the cold start — pod
-    reschedule, interpreter boot, imports, model-spec load — so this
-    role pays all of it up front and keeps the journal's page cache
-    warm by tailing it. Promotion replays snapshot + tail (bounded by
-    the snapshot cadence) through the same ``Master`` construction a
-    restart uses, so the promoted master has the FULL feature set
-    (metrics plane, autoscaler, k8s adoption of running pods). The
-    embedded-control-plane variant with a continuously-replayed warm
-    dispatcher is ``master/standby.StandbyMaster`` (what the failover
-    drill runs); both share the fence + recovery code paths.
+    Two costs used to sit between detection and serving: the cold
+    start (pod reschedule, interpreter boot, imports, model-spec
+    load) and the full journal replay. This role pays the first up
+    front and AMORTIZES the second across the standby's lifetime —
+    each poll folds only the appended tail into the warm dispatcher
+    (``StandbyMaster.poll_journal``: incremental read cursor +
+    seq-gated ``apply_replay``), so promotion replays nothing but the
+    last partial poll. ``Master(args, warm_state=...)`` then skips
+    ``recover_master_state`` entirely and re-arms the full feature
+    set (metrics plane, autoscaler, k8s adoption of running pods)
+    around the handed-over state — pinned by
+    ``tests/test_failover.py::test_warm_handover_skips_full_replay``.
     """
     import time as _time
 
-    from elasticdl_tpu.comm.rpc import RpcStub
-    from elasticdl_tpu.master.journal import MasterJournal
+    from elasticdl_tpu.master.standby import StandbyMaster
     from elasticdl_tpu.observability import default_registry
 
     journal_dir = getattr(args, "journal_dir", "")
@@ -752,35 +813,43 @@ def run_standby(args, k8s_client=None) -> int:
         getattr(args, "standby_heartbeat_secs", 1.0)
     )
     miss_threshold = int(getattr(args, "standby_miss_threshold", 3))
-    journal = MasterJournal(journal_dir)
     registry = default_registry()
-    m_heartbeat = registry.histogram(
-        "master_primary_heartbeat_seconds",
-        "Primary heartbeat round-trip observed by the standby (the "
-        "default SLO ruleset alerts on its ABSENCE)",
-    )
-    m_lag = registry.gauge(
-        "master_standby_lag_records",
-        "Journal records appended since the standby last looked",
-    )
     m_failover = registry.histogram(
         "master_failover_seconds",
         "Hot-standby takeover latency: primary declared dead -> "
         "promoted master serving",
     )
-    # Pre-warm the expensive import path (model zoo + spec) so
-    # promotion does not pay it.
-    try:
-        get_model_spec(
-            model_zoo=args.model_zoo, model_def=args.model_def,
-            dataset_fn=args.dataset_fn, loss=args.loss,
-            optimizer=args.optimizer,
-            eval_metrics_fn=args.eval_metrics_fn,
-            callbacks=args.callbacks,
-            custom_data_reader=args.custom_data_reader,
+    # Pre-warm the expensive import path (model zoo + spec); the spec
+    # also feeds the warm dispatcher factory below — the standby MUST
+    # build dispatchers from the identical job config the primary
+    # used, or its replay diverges. Bounded retries: a transient
+    # zoo/volume read error at pod start must not one-shot the
+    # process and silently strip the job's failover protection.
+    spec = None
+    for attempt in range(5):
+        try:
+            spec = get_model_spec(
+                model_zoo=args.model_zoo, model_def=args.model_def,
+                dataset_fn=args.dataset_fn, loss=args.loss,
+                optimizer=args.optimizer,
+                eval_metrics_fn=args.eval_metrics_fn,
+                callbacks=args.callbacks,
+                custom_data_reader=args.custom_data_reader,
+            )
+            break
+        except Exception as exc:
+            logger.warning(
+                "standby spec load failed (attempt %d/5): %s",
+                attempt + 1, exc,
+            )
+            _time.sleep(2.0)
+    if spec is None:
+        logger.error(
+            "standby cannot load the model spec; exiting (the spec "
+            "builds the warm dispatcher — without it promotion would "
+            "diverge from the primary's replay)"
         )
-    except Exception as exc:
-        logger.warning("standby spec pre-warm failed: %s", exc)
+        return 2
     # Report into the primary's cluster view so the master-side
     # absence rule on the heartbeat series can fire when this standby
     # dies (failover protection gone).
@@ -790,72 +859,46 @@ def run_standby(args, k8s_client=None) -> int:
 
     reporter = ComponentMetricsReporter(primary, "standby")
     reporter.start()
-    stub = RpcStub(primary, SERVICE_NAME, max_retries=0)
-    misses = 0
-    last_seen_seq = 0
-    last_seen_size = -1
+    # The warm tail: StandbyMaster's poll/heartbeat halves only — the
+    # promotion itself goes through Master(warm_state=) below so the
+    # CLI role keeps the full production assembly (assemble/serve_addr
+    # are the embedded path's concern and stay unused here).
+    standby = StandbyMaster(
+        journal_dir,
+        dispatcher_factory=lambda: build_dispatcher(args, spec),
+        assemble=None,
+        primary_addr=primary,
+        serve_addr="",
+        heartbeat_secs=heartbeat_secs,
+        miss_threshold=miss_threshold,
+    )
     logger.info(
         "standby: heartbeating %s every %.2fs (takeover after %d "
-        "misses), tailing %s", primary, heartbeat_secs,
-        miss_threshold, journal.path,
+        "misses), warm-tailing %s", primary, heartbeat_secs,
+        miss_threshold, standby._journal.path,
     )
     while True:
-        t0 = _time.monotonic()
-        try:
-            stub.call("ping", timeout=max(0.5, heartbeat_secs))
-            m_heartbeat.observe(_time.monotonic() - t0)
-            misses = 0
-        except Exception:
-            misses += 1
-            logger.warning("primary heartbeat missed (%d/%d)",
-                           misses, miss_threshold)
-            try:
-                stub.reconnect()
-            except Exception:
-                pass
-        # Lag telemetry + page-cache warmth: tail the journal each
-        # beat, but only when the file actually changed (a stat per
-        # beat, not a full decode — snapshots carry eval folds).
-        try:
-            size = os.path.getsize(journal.path)
-        except OSError:
-            size = -1
-        if size >= 0 and size != last_seen_size:
-            last_seen_size = size
-            try:
-                # last_seq hops frame headers and decodes ONLY the
-                # final record — no per-beat snapshot/ndarray decode.
-                seq = journal.last_seq()
-                m_lag.set(float(max(0, seq - last_seen_seq)))
-                last_seen_seq = max(last_seen_seq, seq)
-            except Exception:
-                pass
-        if misses >= miss_threshold:
+        standby.heartbeat()
+        standby.poll_journal()
+        if standby._misses >= miss_threshold:
             break
         _time.sleep(heartbeat_secs)
     t_detect = _time.monotonic()
-    stub.close()
     reporter.stop()
-    # Fence FIRST: a partitioned-but-alive primary must be locked out
-    # of the journal before the promoted master trusts its replay.
-    last_gen = 0
-    try:
-        for record in journal.replay_records():
-            if record["t"] in ("generation", "fence"):
-                last_gen = max(last_gen, int(record["generation"]))
-    except Exception:
-        logger.exception("journal scan before fencing failed")
-    fence_gen = journal.publish_fence(last_gen + 1)
-    journal.close()
+    standby.stop()
+    # Fence FIRST (a partitioned-but-alive primary must be locked out
+    # of the journal before the promoted master trusts its replay),
+    # drain the race, release the journal — StandbyMaster.hand_over
+    # keeps this ordering in ONE place with the embedded take_over.
+    warm = standby.hand_over()
     logger.warning(
         "standby taking over: fence generation %d published; "
-        "promoting into a full master", fence_gen,
+        "promoting the WARM dispatcher into a full master "
+        "(%d record(s) were warm-replayed over this standby's "
+        "lifetime)", warm["fence_generation"],
+        warm["stats"]["replayed"],
     )
-    master = Master(args, k8s_client=k8s_client)
-    if master._journal is not None:
-        master._journal.append(
-            "fence", generation=master._journal.generation
-        )
+    master = Master(args, k8s_client=k8s_client, warm_state=warm)
     master.prepare()
     m_failover.observe(_time.monotonic() - t_detect)
     return master.run()

@@ -271,6 +271,36 @@ class StandbyMaster:
 
     # ---- takeover --------------------------------------------------------
 
+    def _fence_and_drain(self) -> int:
+        """The shared front half of every takeover: catch the tail,
+        publish the fence (zombie locked out), then drain records
+        that won the race against the fence publish (seq-gated, so
+        the drain cannot double-apply). ONE copy of this ordering —
+        ``take_over`` (embedded: assembles + serves) and
+        ``hand_over`` (CLI: feeds ``Master(warm_state=…)``) must not
+        drift on it."""
+        self.poll_journal()
+        fence_gen = self._journal.publish_fence(
+            self._carry["generation"] + 1
+        )
+        self.poll_journal()
+        return fence_gen
+
+    def hand_over(self) -> dict:
+        """The NON-serving half of a takeover: fence + drain, then
+        release the journal — returning ``{"dispatcher", "stats",
+        "fence_generation"}`` for a caller that finishes promotion
+        itself (``master/main.py run_standby`` feeds this straight
+        into ``Master(warm_state=…)``, which opens the post-fence
+        generation and re-arms the full production assembly)."""
+        fence_gen = self._fence_and_drain()
+        self._journal.close()
+        return {
+            "dispatcher": self._dispatcher,
+            "stats": dict(self._carry),
+            "fence_generation": fence_gen,
+        }
+
     def take_over(self) -> dict:
         """Fence the old incarnation and start serving. Sequence:
         catch the tail → publish the fence (zombie locked out) → catch
@@ -288,14 +318,7 @@ class StandbyMaster:
             return now
 
         t = t_detect
-        self.poll_journal()
-        t = _mark("tail_replay", t)
-        fence_gen = self._journal.publish_fence(
-            self._carry["generation"] + 1
-        )
-        # After the fence no append can land; one more poll drains
-        # records that won the race against the fence publish.
-        self.poll_journal()
+        fence_gen = self._fence_and_drain()
         t = _mark("fence", t)
         self.generation = self._journal.open_generation()
         self._journal.append("fence", generation=self.generation)

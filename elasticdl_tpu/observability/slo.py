@@ -191,6 +191,23 @@ def default_rules() -> List[SLORule]:
                         "(docs/fault_tolerance.md)",
         ),
         SLORule(
+            name="row-push-log-fsync-stall",
+            kind=THRESHOLD,
+            series="edl_tpu_row_push_log_fsync_seconds",
+            aggregation="p99",
+            op=">",
+            value=0.25,
+            window_secs=300.0,
+            min_count=10,
+            description="push-log group commits stalling >250ms at "
+                        "p99: durable-ack pushes are paying the "
+                        "stall directly, and in applied-ack mode the "
+                        "RPO window is growing past its group-ms "
+                        "budget — usually a sick WAL disk "
+                        "(docs/fault_tolerance.md 'Zero-RPO row "
+                        "plane')",
+        ),
+        SLORule(
             name="row-freshness",
             kind=THRESHOLD,
             series="edl_tpu_row_freshness_seconds",

@@ -11,11 +11,20 @@ storage").
   slot tables demoting/promoting in lockstep with their primary, and
   dirty tracking that spans both tiers so delta checkpoints stay
   correct.
+- ``pushlog.PushLog`` — the row plane's zero-RPO write-ahead log:
+  group-committed CRC-framed records of applied pushes, replayed
+  through the normal apply path on relaunch, truncation fenced to
+  durable checkpoint publish (docs/fault_tolerance.md "Zero-RPO row
+  plane").
 """
 
 from elasticdl_tpu.storage.cold_store import (  # noqa: F401
     ColdRowStore,
     ColdStoreError,
+)
+from elasticdl_tpu.storage.pushlog import (  # noqa: F401
+    PushLog,
+    PushLogError,
 )
 from elasticdl_tpu.storage.tiered import (  # noqa: F401
     TierGroup,

@@ -740,6 +740,264 @@ def test_controller_keeps_drained_shard_while_laggards_exist(tmp_path):
     )
 
 
+# ---- composed multi-plane kill (quake drill fast lane) -------------------
+
+
+def _build_row_service(ckpt_dir, log_dir):
+    from elasticdl_tpu.embedding.optimizer import Adam
+    from elasticdl_tpu.embedding.row_service import HostRowService
+    from elasticdl_tpu.native.row_store import (
+        make_host_optimizer,
+        make_host_table,
+    )
+
+    svc = HostRowService(
+        {"rows": make_host_table("rows", 8)},
+        make_host_optimizer(Adam(lr=0.01)),
+    )
+    svc.configure_checkpoint(str(ckpt_dir), checkpoint_steps=4,
+                             delta_chain_max=3, async_write=False)
+    svc.configure_push_log(str(log_dir), group_ms=0.5)
+    return svc
+
+
+def _row_schedule(n, seed=9):
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(n):
+        ids = np.unique(rng.randint(0, 128, 12)).astype(np.int64)
+        out.append((ids, rng.rand(ids.size, 8).astype(np.float32)))
+    return out
+
+
+def _push_rows(svc, schedule, start, end):
+    for seq in range(start, end + 1):
+        ids, grads = schedule[seq - 1]
+        svc._push_row_grads({
+            "table": "rows", "ids": ids, "grads": grads,
+            "client": "trainer", "seq": seq,
+        })
+
+
+def test_composed_master_and_shard_kill(tmp_path):
+    """In-process twin of the quake drill's composed scenario: the
+    MASTER and one ROW SHARD die in the same window — the master
+    mid-lease, the shard mid-storm with group commits queued. Both
+    recoveries (journal replay; checkpoint chain + push-log replay)
+    must converge independently: exactly-once task accounting AND row
+    conservation, with no acked push re-driven from outside."""
+    from elasticdl_tpu.chaos.invariants import RowConservation
+
+    # Fault-free row twin (the byte-equality oracle).
+    schedule = _row_schedule(12)
+    twin = _build_row_service(tmp_path / "twin_ckpt",
+                              tmp_path / "twin_wal")
+    _push_rows(twin, schedule, 1, 12)
+    twin_state = {
+        name: view.to_arrays()
+        for name, view in twin.host_tables.items()
+        if name != "__row_service_seqs__"
+    }
+    twin.stop()
+
+    # Live planes: journaled master + WAL'd row shard.
+    dispatcher, eval_service, journal = journaled_plane(
+        tmp_path, eval_records=0, records=24
+    )
+    svc = _build_row_service(tmp_path / "ckpt", tmp_path / "wal")
+    conservation = RowConservation()
+
+    done = dispatcher.get(0)
+    dispatcher.report(done.task_id, True)
+    leased = dispatcher.get(0)  # held across the kill window
+    _push_rows(svc, schedule, 1, 8)
+
+    # ---- the composed kill window ----
+    conservation.snapshot("composed@push8", {
+        name: view for name, view in svc.host_tables.items()
+        if name != "__row_service_seqs__"
+    })
+    svc._push_log.abandon()     # shard SIGKILL stand-in
+    svc._ckpt_writer.close()
+    journal.close()             # master SIGKILL stand-in
+
+    # ---- both planes recover independently ----
+    dispatcher2, eval2, servicer2, journal2, stats = recover_plane(
+        tmp_path, eval_records=0, records=24
+    )
+    # Exactly-once accounting: the open lease survived, its late
+    # report resolves it once, a duplicate answers from the ledger.
+    doing = dict(dispatcher2.doing_start_times())
+    assert leased.task_id in doing
+    _task, _wid, requeued, duplicate = dispatcher2.apply_report(
+        leased.task_id, True
+    )
+    assert not requeued and not duplicate
+    _task, _wid, _rq, duplicate = dispatcher2.apply_report(
+        leased.task_id, True
+    )
+    assert duplicate
+    resolved_first = {done.task_id, leased.task_id}
+    while True:
+        task = dispatcher2.get(0)
+        if task is None:
+            break
+        assert task.task_id not in resolved_first
+        resolved_first.add(task.task_id)
+        dispatcher2.report(task.task_id, True)
+    assert dispatcher2.finished()
+    state = dispatcher2.export_state()
+    resolved_ids = [row[0] for row in state["resolved"]]
+    assert len(resolved_ids) == len(set(resolved_ids))
+
+    # Row plane: relaunch restores chain + replays the WAL tail; the
+    # storm CONTINUES — acked pushes 1..8 are never re-driven.
+    svc2 = _build_row_service(tmp_path / "ckpt", tmp_path / "wal")
+    assert svc2._push_count == 8
+    check = conservation.check({
+        name: view for name, view in svc2.host_tables.items()
+        if name != "__row_service_seqs__"
+    })
+    assert check.passed, check.details
+    _push_rows(svc2, schedule, 9, 12)
+    final = {
+        name: view.to_arrays()
+        for name, view in svc2.host_tables.items()
+        if name != "__row_service_seqs__"
+    }
+    for name in sorted(twin_state):
+        ids_t, rows_t = twin_state[name]
+        ids_f, rows_f = final[name]
+        assert np.array_equal(np.asarray(ids_t), np.asarray(ids_f)), (
+            name
+        )
+        assert np.array_equal(
+            np.asarray(rows_t, np.float64),
+            np.asarray(rows_f, np.float64),
+        ), name
+    svc2.stop()
+    journal2.close()
+
+
+# ---- --standby warm-dispatcher handover ----------------------------------
+
+
+def test_warm_handover_skips_full_replay(tmp_path, monkeypatch):
+    """PR-14 ROADMAP leftover closed: ``--standby`` promotion hands
+    the continuously-replayed WARM dispatcher into ``Master`` instead
+    of cold-constructing one — pinned here: promotion must not re-read
+    the full journal (no ``replay_records``, no
+    ``recover_master_state``) and must adopt the standby's dispatcher
+    object with its state intact."""
+    from elasticdl_tpu.common.args import parse_master_args
+    from elasticdl_tpu.core.model_spec import get_model_spec
+    from elasticdl_tpu.master import journal as journal_mod
+    from elasticdl_tpu.master.main import Master, build_dispatcher
+    from elasticdl_tpu.master.standby import StandbyMaster
+    from elasticdl_tpu.testing.data import (
+        create_mnist_record_file,
+        model_zoo_dir,
+    )
+
+    train = create_mnist_record_file(str(tmp_path / "t.rec"), 64)
+
+    def make_args():
+        return parse_master_args([
+            "--model_zoo", model_zoo_dir(),
+            "--model_def", "mnist.mnist_functional.custom_model",
+            "--training_data", train,
+            "--minibatch_size", "8",
+            "--num_minibatches_per_task", "1",
+            "--job_name", "warmjob",
+            "--journal_dir", str(tmp_path / "journal"),
+            "--master_addr", "localhost:0",
+        ])
+
+    args = make_args()
+    primary = Master(args)
+    for _ in range(3):
+        task = primary.task_dispatcher.get(0)
+        primary.task_dispatcher.report(task.task_id, True)
+    open_lease = primary.task_dispatcher.get(0)
+
+    spec = get_model_spec(
+        model_zoo=args.model_zoo, model_def=args.model_def,
+        dataset_fn=args.dataset_fn, loss=args.loss,
+        optimizer=args.optimizer,
+        eval_metrics_fn=args.eval_metrics_fn,
+        callbacks=args.callbacks,
+        custom_data_reader=args.custom_data_reader,
+    )
+    standby = StandbyMaster(
+        str(tmp_path / "journal"),
+        dispatcher_factory=lambda: build_dispatcher(args, spec),
+        assemble=None,
+        primary_addr="localhost:1", serve_addr="",
+    )
+    assert standby.poll_journal() > 0  # warm tail caught up
+
+    # Primary "dies"; the run_standby promotion sequence: hand_over
+    # (fence + drain + journal release), then the warm dict goes
+    # straight into Master.
+    primary._journal.close()
+    warm = standby.hand_over()
+
+    calls = {"replay_records": 0}
+    orig_replay = journal_mod.MasterJournal.replay_records
+
+    def counting_replay(self, *a, **kw):
+        calls["replay_records"] += 1
+        return orig_replay(self, *a, **kw)
+
+    monkeypatch.setattr(
+        journal_mod.MasterJournal, "replay_records", counting_replay
+    )
+
+    def forbid_cold_recovery(*_a, **_kw):
+        raise AssertionError(
+            "warm handover must not run recover_master_state"
+        )
+
+    monkeypatch.setattr(
+        journal_mod, "recover_master_state", forbid_cold_recovery
+    )
+    promoted = Master(make_args(), warm_state=warm)
+    assert calls["replay_records"] == 0
+    assert promoted.task_dispatcher is standby._dispatcher
+    # The warm state is genuinely the replayed state: resolved work
+    # and the open lease both survived the handover.
+    doing = dict(promoted.task_dispatcher.doing_start_times())
+    assert open_lease.task_id in doing
+    resolved = promoted.task_dispatcher.export_state()["resolved"]
+    assert len(resolved) == 3
+    # Fence honored: the promoted generation rose past the fence.
+    assert promoted._journal.generation > standby._carry["generation"]
+    assert promoted._recovery_stats["generation"] == (
+        promoted._journal.generation
+    )
+    promoted._journal.close()
+
+
+def test_warm_handover_requires_journal_dir(tmp_path):
+    from elasticdl_tpu.common.args import parse_master_args
+    from elasticdl_tpu.master.main import Master
+    from elasticdl_tpu.testing.data import (
+        create_mnist_record_file,
+        model_zoo_dir,
+    )
+
+    train = create_mnist_record_file(str(tmp_path / "t.rec"), 16)
+    args = parse_master_args([
+        "--model_zoo", model_zoo_dir(),
+        "--model_def", "mnist.mnist_functional.custom_model",
+        "--training_data", train,
+        "--minibatch_size", "8",
+        "--job_name", "warmjob2",
+    ])
+    with pytest.raises(ValueError, match="journal_dir"):
+        Master(args, warm_state={"dispatcher": None, "stats": {}})
+
+
 # ---- the drill (slow lane) ----------------------------------------------
 
 
