@@ -9,7 +9,8 @@ PY ?= python
 	trace-smoke serve-fleet-smoke sparse-smoke sparse-bench \
 	autoscale-smoke autoscale-bench slo-smoke ckpt-bench ckpt-smoke \
 	tiered-smoke tiered-bench reshard-smoke reshard-bench \
-	profile-smoke failover-smoke failover-bench quake-smoke fsck
+	profile-smoke failover-smoke failover-bench quake-smoke \
+	usage-smoke fsck
 
 # Scrape-and-pretty-print a master's /metrics (docs/observability.md).
 METRICS_ADDR ?= localhost:8080
@@ -205,6 +206,22 @@ reshard-smoke:
 reshard-bench:
 	JAX_PLATFORMS=cpu $(PY) tools/bench_row_reshard.py
 
+# Workload-attribution drill (docs/observability.md "Workload
+# attribution"): the same seeded push schedule through a live 2->3
+# split runs twice — attribution off (principal kill-switch) and on.
+# Gates: migration/replica-refresh bytes metered ONLY under their own
+# purposes, >=95% of handler time attributed to a non-unknown
+# purpose, attributed p99 push <=1.05x the attribution-off baseline.
+# The committed USAGE_DRILL.json is validated by check_usage.py
+# (also under the fsck umbrella as the "usage" kind).
+usage-smoke:
+	workdir=$$(mktemp -d /tmp/edl_usage.XXXXXX); \
+	JAX_PLATFORMS=cpu $(PY) -m elasticdl_tpu.chaos.usage_drill \
+		--seed $(CHAOS_SEED) --workdir $$workdir \
+		--report USAGE_DRILL.json \
+	&& $(PY) tools/check_usage.py USAGE_DRILL.json; \
+	rc=$$?; rm -rf $$workdir; exit $$rc
+
 # Deterministic chaos plan (kill + stall-row-shard + corrupt-checkpoint)
 # against the in-process cluster; exits nonzero if any recovery
 # invariant fails — the schedule includes a worker kill landing
@@ -217,9 +234,11 @@ reshard-bench:
 # including the eval-round / relaunch / fence record kinds — runs in
 # this lane too, and the zero-RPO quake drill (quake-smoke) so
 # check_pushlog.py audits real SIGKILLed incarnations' write-ahead
-# push logs. docs/chaos.md.
+# push logs, and the workload-attribution drill (usage-smoke) so
+# principal purity survives a live split under the chaos lane too.
+# docs/chaos.md.
 CHAOS_SEED ?= 7
-chaos-smoke: tiered-smoke chaos-master-smoke quake-smoke
+chaos-smoke: tiered-smoke chaos-master-smoke quake-smoke usage-smoke
 	workdir=$$(mktemp -d /tmp/edl_chaos.XXXXXX); \
 	JAX_PLATFORMS=cpu $(PY) -m elasticdl_tpu chaos run \
 		--seed $(CHAOS_SEED) --workdir $$workdir \

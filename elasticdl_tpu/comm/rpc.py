@@ -31,6 +31,14 @@ own intervals); the server handler wrap pops ``_trace_ctx`` and opens
 the server span as its child. With no recorder installed the whole
 machinery is one module-global ``None`` check.
 
+Attribution seam (``observability/principal.py`` + ``usage.py``):
+the ambient workload principal rides each request as a ``_principal``
+field next to ``_trace_ctx``; the server wrap strips it, re-establishes
+it as the handler's ambient principal, tags it onto the server span,
+and meters the request per principal (``edl_tpu_usage_*``). Unlike
+tracing this is always-on; ``principal.set_enabled(False)`` disables
+both halves.
+
 Client-side latency telemetry: ``edl_tpu_rpc_client_seconds`` (one
 histogram observation per send *attempt*, labeled service/method) and
 ``edl_tpu_rpc_inflight`` (gauge) — attempt-scoped on purpose, so a
@@ -48,7 +56,9 @@ import grpc
 
 from elasticdl_tpu.common import tensor_utils
 from elasticdl_tpu.common.constants import GRPC
+from elasticdl_tpu.observability import principal as _principal
 from elasticdl_tpu.observability import tracing as _tracing
+from elasticdl_tpu.observability import usage as _usage
 
 _CHANNEL_OPTIONS = [
     ("grpc.max_send_message_length", GRPC.MAX_SEND_MESSAGE_LENGTH),
@@ -149,13 +159,22 @@ class _GenericService(grpc.GenericRpcHandler):
             return None
 
         def unary_unary(request: dict, context):
-            # Always strip the trace context (handlers must never see
-            # it as a payload field); open the server span as its child
-            # only when this process records.
-            wire_ctx = (
-                request.pop("_trace_ctx", None)
-                if isinstance(request, dict) else None
-            )
+            # Always strip the piggyback fields (handlers must never
+            # see them as payload): the trace context, and the workload
+            # principal riding next to it. The principal becomes the
+            # handler's ambient attribution identity (so internal
+            # fan-outs it triggers self-tag) and the usage meter's
+            # label source; a request carrying neither meters as
+            # ``unknown``.
+            if isinstance(request, dict):
+                wire_ctx = request.pop("_trace_ctx", None)
+                who = _principal.from_wire(
+                    request.pop("_principal", None)
+                )
+            else:
+                wire_ctx = None
+                who = None
+            metered = _principal.enabled()
             if _tracing.enabled():
                 role, instance = _server_trace_identity(
                     self._service_name, self._tag
@@ -163,39 +182,54 @@ class _GenericService(grpc.GenericRpcHandler):
                 span = _tracing.server_span(
                     f"serve/{method}", wire_ctx, role, instance,
                     service=self._service_name,
+                    **_principal.span_attrs(who),
                 )
             else:
                 span = _tracing.NULL_SPAN
-            with span:
-                hook = _server_hook
-                if hook is not None:
-                    verdict = hook(
-                        self._tag, self._service_name, method, request
-                    )
-                    if verdict is not None:
-                        code, detail = verdict
-                        span.set(error=code)
-                        context.abort(
-                            getattr(grpc.StatusCode, code,
-                                    grpc.StatusCode.UNKNOWN),
-                            detail,
+            handle_t0 = time.monotonic()
+            try:
+                with span, _principal.pushed(
+                    principal=who or _principal.NOBODY
+                ):
+                    hook = _server_hook
+                    if hook is not None:
+                        verdict = hook(
+                            self._tag, self._service_name, method,
+                            request
                         )
-                try:
-                    response = handler(request)
-                    return response if response is not None else {}
-                except InvalidRequest as exc:
-                    # Malformed payload, not a server fault: reject
-                    # with the argument-validation status so clients
-                    # neither retry it nor read it as a handler bug.
-                    span.set(error="INVALID_ARGUMENT")
-                    context.abort(
-                        grpc.StatusCode.INVALID_ARGUMENT, str(exc)
-                    )
-                except Exception as exc:
-                    # surface handler errors to the client
-                    context.abort(
-                        grpc.StatusCode.INTERNAL,
-                        f"{type(exc).__name__}: {exc}",
+                        if verdict is not None:
+                            code, detail = verdict
+                            span.set(error=code)
+                            context.abort(
+                                getattr(grpc.StatusCode, code,
+                                        grpc.StatusCode.UNKNOWN),
+                                detail,
+                            )
+                    try:
+                        response = handler(request)
+                        return response if response is not None else {}
+                    except InvalidRequest as exc:
+                        # Malformed payload, not a server fault:
+                        # reject with the argument-validation status
+                        # so clients neither retry it nor read it as
+                        # a handler bug.
+                        span.set(error="INVALID_ARGUMENT")
+                        context.abort(
+                            grpc.StatusCode.INVALID_ARGUMENT, str(exc)
+                        )
+                    except Exception as exc:
+                        # surface handler errors to the client
+                        context.abort(
+                            grpc.StatusCode.INTERNAL,
+                            f"{type(exc).__name__}: {exc}",
+                        )
+            finally:
+                if metered:
+                    # Qualified Service.method: bare method names
+                    # collide across services in the shared families.
+                    _usage.meter_request(
+                        who, f"{self._service_name}.{method}",
+                        time.monotonic() - handle_t0,
                     )
 
         return grpc.unary_unary_rpc_method_handler(
@@ -424,6 +458,13 @@ class RpcStub:
                     # Propagated next to the payload; the server wrap
                     # strips it before the handler runs.
                     fields["_trace_ctx"] = ctx
+            # Workload principal rides next to the trace context but
+            # independently of it — attribution is always-on metering,
+            # not sampling (None when nothing is ambient or the
+            # attribution kill-switch is off).
+            who = _principal.current_wire()
+            if who is not None:
+                fields["_principal"] = who
             delay = self._backoff_base
             attempt = 0
             while True:

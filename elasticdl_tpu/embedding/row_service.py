@@ -64,6 +64,8 @@ from elasticdl_tpu.embedding.shard_map import (
 )
 from elasticdl_tpu.embedding.table import get_slot_table_name
 from elasticdl_tpu.observability import tracing
+from elasticdl_tpu.observability import principal as wl_principal
+from elasticdl_tpu.observability import usage as wl_usage
 
 logger = get_logger("row_service")
 
@@ -450,6 +452,7 @@ class HostRowService:
 
     def _pull_rows(self, request: dict) -> dict:
         t0 = time.monotonic()
+        who = wl_principal.current()
         table_name, table = self._validated_table(request)
         ids = self._validated_ids(request)
         # Ambient span: nests under the RPC server span (role
@@ -459,7 +462,8 @@ class HostRowService:
         # stamps the span's trace id as the histogram exemplar.
         tiered = hasattr(table, "prefault")
         pull_span = tracing.span("row_pull", table=table_name,
-                                 rows=int(ids.size))
+                                 rows=int(ids.size),
+                                 **wl_principal.span_attrs(who))
         with pull_span:
             if tiered:
                 # Fault this pull's cold rows with the DISK READ
@@ -468,7 +472,13 @@ class HostRowService:
                 # pull-ahead turns the fault into prefetch
                 # (storage/tiered.py "Tiered storage").
                 table.prefault(ids)
-            with self._lock:
+            # Explicit acquire/release (not ``with``) so hold time is
+            # measured from acquisition, excluding contention wait —
+            # the per-workload lock-hold meter answers "who OCCUPIES
+            # the lock", not "who waits on it".
+            self._lock.acquire()
+            hold_t0 = time.monotonic()
+            try:
                 reject = self._reshard_reject_locked(ids)
                 if reject is not None:
                     return reject
@@ -479,6 +489,11 @@ class HostRowService:
                 map_version = 0
                 if self._shard_map is not None:
                     map_version = self._shard_map.version
+            finally:
+                self._lock.release()
+                wl_usage.meter_lock_hold(
+                    who, time.monotonic() - hold_t0
+                )
             if tiered:
                 # Budget sweep AFTER releasing the service lock: the
                 # eviction's cold write stalls no handler but this one.
@@ -490,7 +505,10 @@ class HostRowService:
                 # service lock (advisory stats must not serialize
                 # handlers).
                 self._track_hot(request["table"], ids)
+        rows = np.asarray(rows, np.float32)
         self._m_pulled.inc(ids.size)
+        wl_usage.meter_rows(who, "pull_rows", rows=int(ids.size),
+                            nbytes=int(rows.nbytes))
         self._m_pull.observe(time.monotonic() - t0,
                              trace_id=pull_span.trace_id)
         # applied_at rides every pull so readers can observe row
@@ -499,7 +517,7 @@ class HostRowService:
         # ownership, so REDIRECTs alone would never teach clients
         # about it — the piggybacked version lets them fetch the map
         # when it moves (0 = no map installed).
-        return {"rows": np.asarray(rows, np.float32),
+        return {"rows": rows,
                 "applied_at": applied_at,
                 "map_version": map_version}
 
@@ -548,6 +566,7 @@ class HostRowService:
 
     def _push_row_grads(self, request: dict) -> dict:
         t0 = time.monotonic()
+        who = wl_principal.current()
         table_name, table = self._validated_table(request)
         client = request.get("client", "")
         seq = int(request.get("seq", -1))
@@ -559,7 +578,8 @@ class HostRowService:
         grads = self._validated_grads(request, ids, table, table_name)
         prefault = getattr(table, "prefault_group", None)
         push_span = tracing.span("row_push", table=table_name,
-                                 rows=int(ids.size))
+                                 rows=int(ids.size),
+                                 **wl_principal.span_attrs(who))
         with push_span:
             if prefault is not None:
                 # Cold reads for evicted rows (and their optimizer
@@ -568,7 +588,12 @@ class HostRowService:
                 prefault(ids)
             duplicate = False
             wal_ticket = None
-            with self._lock:
+            # Explicit acquire/release for the same reason as
+            # _pull_rows: the lock-hold meter must start at
+            # acquisition, not enqueue.
+            self._lock.acquire()
+            hold_t0 = time.monotonic()
+            try:
                 # Ownership + fence checks BEFORE any mutation: a
                 # redirected/fenced push applies nothing, so the
                 # client's retry (against the new home, or after the
@@ -631,13 +656,22 @@ class HostRowService:
                     refresh_ids = self._replicated_ids_locked(
                         request["table"], ids
                     )
+            finally:
+                self._lock.release()
+                wl_usage.meter_lock_hold(
+                    who, time.monotonic() - hold_t0
+                )
             if duplicate:
                 if (self._push_log is not None
                         and self._push_log.ack == "durable"):
                     # Ack the retry only once the original attempt's
                     # record is durable — a duplicate ack is still an
                     # ack, and zero RPO covers it too.
+                    fsync_t0 = time.monotonic()
                     self._push_log.barrier()
+                    wl_usage.meter_fsync_wait(
+                        who, time.monotonic() - fsync_t0
+                    )
                 return {"duplicate": True}
             if wal_ticket is not None and self._push_log.ack == "durable":
                 # Durable ack: the reply leaves only after the group
@@ -645,7 +679,11 @@ class HostRowService:
                 # raises — the client must NOT treat this push as
                 # durable (the shard's WAL disk is broken and the
                 # error is loud by design).
+                fsync_t0 = time.monotonic()
                 wal_ticket.wait(timeout=60.0)
+                wl_usage.meter_fsync_wait(
+                    who, time.monotonic() - fsync_t0
+                )
             if refresh_ids is not None:
                 # Async push-driven replica refresh: enqueue OUTSIDE
                 # the lock; the refresher thread reads fresh rows and
@@ -656,6 +694,8 @@ class HostRowService:
                 # eviction's cold writes run with the lock released.
                 table.maybe_sweep()
         self._m_pushed.inc(ids.size)
+        wl_usage.meter_rows(who, "push_row_grads", rows=int(ids.size),
+                            nbytes=int(grads.nbytes))
         self._m_push.observe(time.monotonic() - t0,
                              trace_id=push_span.trace_id)
         if (
@@ -878,8 +918,15 @@ class HostRowService:
                 "id": mig_id, "lo": lo, "hi": hi, "touched": {},
             }
         try:
+            # Self-tag the whole outbound stream (bulk chunks,
+            # catch-up deltas, the step ship) as migration traffic:
+            # every ingest_rows RPC below inherits the ambient
+            # principal, so the target's meters bill these bytes to
+            # purpose=migration — never to the client push that
+            # triggered the move.
             with tracing.span("row_migrate_out", migration=mig_id,
-                              lo=lo, hi=hi):
+                              lo=lo, hi=hi), \
+                    wl_principal.pushed(purpose="migration"):
                 # Bulk copy: enumerate once, then chunked reads.
                 for primary, group in views.items():
                     for vname, table in group.items():
@@ -1020,6 +1067,11 @@ class HostRowService:
                 )
             table.set(ids, rows)
             info["rows"] += int(ids.size)
+        # Bills to the wire principal (the source's ambient
+        # purpose=migration rode the RPC here).
+        wl_usage.meter_rows(wl_principal.current(), "ingest_rows",
+                            rows=int(ids.size),
+                            nbytes=int(rows.nbytes))
         return {}
 
     def _ingest_steps(self, request: dict) -> dict:
@@ -1097,6 +1149,9 @@ class HostRowService:
                 # stamp (same discipline as _ShardedTable).
                 applied_at = min(stamps)
         self._m_replica_reads.inc(int(found.sum()))
+        wl_usage.meter_rows(wl_principal.current(), "pull_replica_rows",
+                            rows=int(found.sum()),
+                            nbytes=int(rows.nbytes))
         return {"rows": rows, "found": found, "applied_at": applied_at}
 
     def _replica_refresh(self, request: dict) -> dict:
@@ -1132,6 +1187,9 @@ class HostRowService:
                 store[i] = (rows[k].copy(), applied_at, read_at)
         if read_at:
             self._m_replica_stale.observe(max(0.0, now - read_at))
+        wl_usage.meter_rows(wl_principal.current(), "replica_refresh",
+                            rows=int(ids.size),
+                            nbytes=int(rows.nbytes))
         return {}
 
     def _queue_refresh(self, table: str, ids: np.ndarray):
@@ -1187,19 +1245,23 @@ class HostRowService:
             for s in per.get(i, ()):
                 if s != self._shard_id:
                     targets.setdefault(s, []).append(k)
-        for s, picks in targets.items():
-            sel = np.asarray(picks, np.intp)
-            try:
-                self._transport(shards[s]).call(
-                    "replica_refresh", table=table_name,
-                    ids=ids[sel], rows=rows[sel],
-                    applied_at=applied_at, read_at=read_at,
-                    map_version=map_version,
-                )
-            except Exception as exc:
-                logger.warning(
-                    "replica refresh to shard %d failed: %s", s, exc
-                )
+        # Refreshes run on the dedicated refresher thread (no ambient
+        # principal): self-tag the fan-out so replica bytes bill to
+        # purpose=replica_refresh at the receiving shards.
+        with wl_principal.pushed(purpose="replica_refresh"):
+            for s, picks in targets.items():
+                sel = np.asarray(picks, np.intp)
+                try:
+                    self._transport(shards[s]).call(
+                        "replica_refresh", table=table_name,
+                        ids=ids[sel], rows=rows[sel],
+                        applied_at=applied_at, read_at=read_at,
+                        map_version=map_version,
+                    )
+                except Exception as exc:
+                    logger.warning(
+                        "replica refresh to shard %d failed: %s", s, exc
+                    )
 
     def _warm_replicas(self):
         """On a new map: push this shard's owned, already-materialized
@@ -1363,11 +1425,15 @@ class HostRowService:
         with self._lock:
             restored = self._push_count
         replayed = covered = 0
-        for record in log.replay_records():
-            if self._replay_push_record(record):
-                replayed += 1
-            else:
-                covered += 1
+        # Self-tag the tail replay: its cold faults and apply work
+        # meter as purpose=replay, never as the client traffic the
+        # records originally were.
+        with wl_principal.pushed(purpose="replay"):
+            for record in log.replay_records():
+                if self._replay_push_record(record):
+                    replayed += 1
+                else:
+                    covered += 1
         if replayed:
             m_replayed.inc(replayed)
         for table in self._tables.values():
@@ -1464,7 +1530,11 @@ class HostRowService:
             # state is covered by the next one.
             return False
         try:
-            return self._checkpoint_locked(version, blocking)
+            # Checkpoint capture is system work, not the triggering
+            # push's: re-tag so its time/faults never bill to the
+            # client whose push crossed the interval.
+            with wl_principal.pushed(purpose="checkpoint"):
+                return self._checkpoint_locked(version, blocking)
         finally:
             self._ckpt_trigger.release()
 
@@ -1542,9 +1612,16 @@ class HostRowService:
                 self._ckpt_planner.reset()
                 raise
 
+        def write_tagged():
+            # The writer thread has no ambient principal; the
+            # serialization + IO is checkpoint work.
+            with wl_principal.pushed(purpose="checkpoint"):
+                write()
+
         try:
             ok = self._ckpt_writer.submit(
-                write, label=f"rows-v{version}-{plan}", block=blocking
+                write_tagged, label=f"rows-v{version}-{plan}",
+                block=blocking
             )
         except RuntimeError:
             # Writer closed under us (stop()/re-point racing a push

@@ -249,6 +249,14 @@ class Master:
             self.metrics_plane.enable_slo(
                 rules=rules, incident_recorder=recorder
             )
+        # Workload attribution (observability/principal.py): the
+        # master's own outbound RPCs are control-plane by definition.
+        from elasticdl_tpu.observability import principal as _principal
+
+        _principal.set_process_principal(
+            job=str(getattr(args, "job_name", "") or ""),
+            component="master", purpose="control",
+        )
         # Distributed tracing (observability/tracing.py): with a
         # recorder installed, dispatch spans + collected worker spans
         # serve on /traces next to /metrics.

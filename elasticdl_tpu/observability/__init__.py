@@ -32,6 +32,18 @@ layer a shared measurement substrate instead:
                    master's ``ProfileStore`` and served on
                    ``/profile`` (folded text, pprof-style JSON,
                    differential views, span-derived phase stacks);
+- ``principal``:   the workload-attribution identity — a
+                   ``{job, component, purpose}`` principal (closed
+                   purpose enum) piggybacked on every RPC next to the
+                   trace context, with a thread-local ambient stack
+                   plus a process default so internal fan-outs
+                   self-tag (docs/observability.md "Workload
+                   attribution");
+- ``usage``:       per-principal metering (requests, rows, bytes,
+                   lock-hold, fsync-wait, cold-fault I/O) under
+                   bounded label families, rolled up by the master's
+                   ``/usage`` endpoint into who-pays shares and
+                   per-shard top-K;
 - ``timeseries``:  the master-side ring time-series store sampling the
                    registries above (counters as rates, gauges as-is,
                    histograms as rolling quantiles; hot + downsampled
@@ -52,6 +64,9 @@ from elasticdl_tpu.observability.aggregator import (  # noqa: F401
 from elasticdl_tpu.observability.exposition import (  # noqa: F401
     MetricsHTTPServer,
     render_prometheus,
+)
+from elasticdl_tpu.observability.principal import (  # noqa: F401
+    Principal,
 )
 from elasticdl_tpu.observability.profiler import (  # noqa: F401
     ProfileStore,

@@ -448,8 +448,27 @@ class MetricsPlane:
                 spans=spans,
             )
 
+        def usage_route(params: dict):
+            # /usage[?top=K]: fleet-wide per-principal usage — totals,
+            # shares, per-purpose handler time, top-K consumers per
+            # shard — folded from the master's own registry plus every
+            # live reporter snapshot (observability/usage.py,
+            # docs/observability.md "Workload attribution").
+            top = params.get("top")
+            return self.usage(top_k=int(top) if top else 5)
+
         return {"/timeseries": timeseries_route, "/alerts": alerts_route,
-                "/profile": profile_route}
+                "/profile": profile_route, "/usage": usage_route}
+
+    def usage(self, top_k: int = 5) -> dict:
+        """The ``/usage`` body (also callable in-process: drills and
+        tests read it without HTTP)."""
+        from elasticdl_tpu.observability.usage import summarize_usage
+
+        snapshots = {"": self.registry.snapshot()}
+        for wid, snap in self.cluster.snapshots().items():
+            snapshots[str(wid)] = snap
+        return summarize_usage(snapshots, top_k=top_k)
 
     def serve(self, port: int = 0, host: str = "") -> MetricsHTTPServer:
         self._http = MetricsHTTPServer(

@@ -77,11 +77,19 @@ class ComponentMetricsReporter(threading.Thread):
         if windows:
             snapshot["profiles"] = windows
         try:
-            self._stub.call(
-                "report_metrics", component=self._component,
-                component_id=self._component_id,
-                metrics=snapshot,
-            )
+            from elasticdl_tpu.observability import principal
+
+            # Telemetry pushes are control-plane chatter, tagged as
+            # such so the master's usage meter never files them under
+            # a workload (the reporter thread has no ambient
+            # principal of its own).
+            with principal.pushed(component=self._component,
+                                  purpose="control"):
+                self._stub.call(
+                    "report_metrics", component=self._component,
+                    component_id=self._component_id,
+                    metrics=snapshot,
+                )
             # Confirmed delivery: advance past what this report
             # carried (the master dedups re-offers anyway — by span id
             # and by window (seq, t0) — but the cursors keep re-sends

@@ -207,6 +207,44 @@ def default_rules() -> List[SLORule]:
                         "(docs/fault_tolerance.md 'Zero-RPO row "
                         "plane')",
         ),
+        # Per-workload burn (docs/observability.md "Workload
+        # attribution"): the usage plane's handler-time histogram
+        # carries a bounded ``purpose`` label, so burn accounting can
+        # target one workload class without the others' traffic
+        # diluting (or inflating) its error budget — serving reads
+        # burn against a tight latency bound while training pushes get
+        # a looser one, on the SAME family.
+        SLORule(
+            name="usage-burn-serving-read",
+            kind=BURN_RATE,
+            series="edl_tpu_usage_handler_seconds",
+            labels={"purpose": "serving_read"},
+            latency_threshold=0.25,
+            objective=0.99,
+            long_window_secs=300.0,
+            short_window_secs=60.0,
+            burn_rate_threshold=4.0,
+            min_count=20,
+            description="serving-read row handlers slower than 250ms "
+                        "are burning >4x the 1% error budget — scoped "
+                        "to purpose=serving_read, so a training push "
+                        "storm cannot mask (or trigger) it",
+        ),
+        SLORule(
+            name="usage-burn-training",
+            kind=BURN_RATE,
+            series="edl_tpu_usage_handler_seconds",
+            labels={"purpose": "training"},
+            latency_threshold=1.0,
+            objective=0.99,
+            long_window_secs=300.0,
+            short_window_secs=60.0,
+            burn_rate_threshold=4.0,
+            min_count=20,
+            description="training push/pull handlers slower than 1s "
+                        "are burning >4x the 1% error budget — scoped "
+                        "to purpose=training",
+        ),
         SLORule(
             name="row-freshness",
             kind=THRESHOLD,

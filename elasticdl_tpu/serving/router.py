@@ -1251,6 +1251,16 @@ def main(argv=None) -> int:
     )
     args = parser.parse_args(argv)
 
+    # Workload attribution: the router's control-plane RPCs (metrics
+    # reports) tag as serving traffic for this job.
+    import os as _os
+
+    from elasticdl_tpu.observability import principal as _principal
+
+    _principal.set_process_principal(
+        job=_os.environ.get("ELASTICDL_JOB_NAME", ""),
+        component="router", purpose="serving_read",
+    )
     if args.flight_recorder > 0:
         tracing.set_process_role("router")
         tracing.install_recorder(

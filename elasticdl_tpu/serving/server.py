@@ -925,6 +925,17 @@ def main(argv=None) -> int:
     )
     args = parser.parse_args(argv)
 
+    # Workload attribution: this replica's row pulls (hot-row reads
+    # through make_row_service_tables) and telemetry meter fleet-wide
+    # as serving reads, split from training pushes at the row tier.
+    import os as _os
+
+    from elasticdl_tpu.observability import principal as _principal
+
+    _principal.set_process_principal(
+        job=_os.environ.get("ELASTICDL_JOB_NAME", ""),
+        component="serving", purpose="serving_read",
+    )
     if args.flight_recorder > 0:
         from elasticdl_tpu.observability import tracing
 

@@ -554,6 +554,19 @@ class TieredTable:
                     group._m_faults.inc()
                     group._m_fault_rows.inc(int(sel.size))
                     group._m_fault_secs.observe(time.monotonic() - t0)
+                    # Per-workload attribution: the fault ran on a
+                    # handler thread whose ambient principal the RPC
+                    # wrap established, so the I/O bills to the
+                    # workload whose pull/push faulted the rows.
+                    from elasticdl_tpu.observability import (
+                        principal as wl_principal,
+                        usage as wl_usage,
+                    )
+
+                    wl_usage.meter_cold_fault(
+                        wl_principal.current(), int(sel.size),
+                        time.monotonic() - t0,
+                    )
             return
         # Pathological churn: leave the leftovers to the under-lock
         # fault in get().

@@ -202,6 +202,18 @@ def build_worker(args, master_client=None) -> Worker:
         master_client = MasterClient(
             args.master_addr, worker_id=args.worker_id
         )
+    # Workload attribution (observability/principal.py): every RPC
+    # this process makes — task pulls, row pulls/pushes, reports —
+    # meters fleet-wide under this identity. The job name comes from
+    # the launcher's env (k8s pod spec); unset folds to "unknown".
+    import os as _os
+
+    from elasticdl_tpu.observability import principal as _principal
+
+    _principal.set_process_principal(
+        job=_os.environ.get("ELASTICDL_JOB_NAME", ""),
+        component="worker", purpose="training",
+    )
     recorder_spans = int(getattr(args, "flight_recorder", 0) or 0)
     if recorder_spans > 0:
         # Tracing on: step-phase spans into the process ring; they

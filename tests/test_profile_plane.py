@@ -59,6 +59,7 @@ class FakeClock:
 # ---- sampler semantics ---------------------------------------------------
 
 
+@pytest.mark.perf
 def test_overhead_pin_under_one_percent():
     """The always-on pin: one sampling pass must be cheap enough that
     the default rate costs <= 1% of one core (the PR 4 <5µs span

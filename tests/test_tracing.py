@@ -194,6 +194,7 @@ def test_collector_dedups_and_bounds():
     assert collector.ingest([{"no_id": True}, "junk"]) == 0
 
 
+@pytest.mark.perf
 def test_null_span_overhead_unmeasurable():
     """No recorder installed → the instrumented step loop must pay
     nothing measurable: one module-global read + a shared no-op span.

@@ -16,7 +16,13 @@ Validates (returning a list of human-readable errors, empty = pass):
   (the role tracks Perfetto shows);
 - at least one ``task`` span's subtree forms a single connected tree
   crossing **master → worker → row-service** — the acceptance shape:
-  dispatch, step phases, and row pulls visible in one timeline.
+  dispatch, step phases, and row pulls visible in one timeline;
+- **principal propagation**: any event whose args carry one of the
+  ``principal_job`` / ``principal_component`` / ``principal_purpose``
+  tags carries all three, with the purpose drawn from the closed
+  enum (docs/observability.md "Workload attribution"). Vacuous on
+  principal-free traces — attribution is optional, half a principal
+  is not.
 
 Stdlib only, importable from tests (``check_trace(path)``).
 """
@@ -26,6 +32,14 @@ import sys
 from typing import Dict, List
 
 REQUIRED_ROLES = ("worker", "master", "rowservice")
+PRINCIPAL_KEYS = ("principal_job", "principal_component",
+                  "principal_purpose")
+# Closed purpose enum — mirror of observability/principal.py PURPOSES
+# (+ the "unknown" fallback); stdlib-only tools keep their own copy.
+PRINCIPAL_PURPOSES = frozenset((
+    "training", "serving_read", "migration", "replica_refresh",
+    "replay", "checkpoint", "control", "unknown",
+))
 
 
 def check_trace(path: str,
@@ -70,6 +84,21 @@ def check_trace(path: str,
         if not isinstance(args, dict) or not args.get("span_id"):
             errors.append(f"event {i}: args.span_id missing")
             continue
+        if any(key in args for key in PRINCIPAL_KEYS):
+            missing = [k for k in PRINCIPAL_KEYS if k not in args]
+            if missing:
+                errors.append(
+                    f"event {i} ({ev.get('name')}): partial "
+                    f"principal tags, missing {missing}"
+                )
+            purpose = args.get("principal_purpose")
+            if (purpose is not None
+                    and purpose not in PRINCIPAL_PURPOSES):
+                errors.append(
+                    f"event {i} ({ev.get('name')}): "
+                    f"principal_purpose {purpose!r} outside the "
+                    "closed enum"
+                )
         span = {
             "name": ev.get("name"),
             "role": ev.get("cat"),

@@ -8,6 +8,7 @@ Usage::
     python tools/dump_metrics.py localhost:8080 --traces # + span trees
     python tools/dump_metrics.py localhost:8080 --alerts # + /alerts
     python tools/dump_metrics.py localhost:8080 --profile rowservice-0
+    python tools/dump_metrics.py localhost:8080 --usage   # + /usage
     python tools/dump_metrics.py localhost:8080 --watch 5  # live redraw
     make metrics METRICS_ADDR=localhost:8080
 
@@ -20,6 +21,10 @@ the process runs with ``--flight_recorder N``) and pretty-prints each
 trace as an indented span tree with durations. ``--alerts`` fetches
 ``/alerts`` (the SLO engine's rule states, served when the master runs
 with ``--timeseries_secs > 0``) and renders a firing/ok table.
+``--usage`` fetches ``/usage`` (the workload-attribution rollup, see
+docs/observability.md "Workload attribution") and renders who-pays
+share tables: fleet totals, per-principal shares, per-purpose handler
+time, and the top-K principals per shard.
 ``--watch N`` redraws everything every N seconds until interrupted —
 the terminal equivalent of a dashboard, no curl+jq loop required.
 Stdlib only (urllib), like the endpoints themselves.
@@ -265,6 +270,88 @@ def print_profile(profile: dict, top: int = 20, out=None):
             )
 
 
+def fetch_usage(addr: str, top: int, timeout: float = 10.0) -> dict:
+    """The workload-attribution plane's /usage body
+    (docs/observability.md "Workload attribution")."""
+    with urllib.request.urlopen(
+        sibling_url(addr, f"/usage?top={int(top)}"), timeout=timeout
+    ) as resp:
+        return json.loads(resp.read().decode("utf-8"))
+
+
+def _fmt_bytes(n: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024.0 or unit == "GiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{n:.0f}B"
+        n /= 1024.0
+    return f"{n:.1f}GiB"
+
+
+def print_usage(usage: dict, out=None):
+    """Who-pays tables: totals, per-principal shares sorted by bytes,
+    per-purpose handler time, and top-K principals per shard."""
+    out = out if out is not None else sys.stdout
+    principals = usage.get("principals") or []
+    if usage.get("error") or not principals:
+        out.write(
+            f"no usage data ({usage.get('error', 'nothing metered')};"
+            " are callers principal-tagged?)\n"
+        )
+        return
+    totals = usage.get("totals") or {}
+    out.write(
+        f"totals: {totals.get('requests', 0):.0f} requests, "
+        f"{totals.get('rows', 0):.0f} rows, "
+        f"{_fmt_bytes(float(totals.get('bytes', 0)))}, "
+        f"{float(totals.get('handler_seconds', 0)):.2f}s handler, "
+        f"{float(totals.get('lock_hold_seconds', 0)):.2f}s lock-hold\n"
+    )
+    out.write(
+        f"attributed handler share: "
+        f"{100.0 * float(usage.get('attributed_handler_share', 0)):.1f}%\n\n"
+    )
+    out.write(
+        f"{'job':<16} {'component':<10} {'purpose':<15} "
+        f"{'req%':>6} {'rows%':>6} {'bytes%':>6}  {'bytes':>10}\n"
+    )
+    for row in principals:
+        who = row.get("principal") or {}
+        share = row.get("share") or {}
+        out.write(
+            f"{who.get('job', ''):<16} "
+            f"{who.get('component', ''):<10} "
+            f"{who.get('purpose', ''):<15} "
+            f"{100.0 * float(share.get('requests', 0)):>5.1f}% "
+            f"{100.0 * float(share.get('rows', 0)):>5.1f}% "
+            f"{100.0 * float(share.get('bytes', 0)):>5.1f}%  "
+            f"{_fmt_bytes(float(row.get('bytes', 0))):>10}\n"
+        )
+    purposes = usage.get("purposes") or {}
+    if purposes:
+        out.write("\nhandler time by purpose:\n")
+        for purpose, row in sorted(
+            purposes.items(),
+            key=lambda kv: -float(kv[1].get("handler_seconds", 0)),
+        ):
+            out.write(
+                f"  {purpose:<15} "
+                f"{float(row.get('handler_seconds', 0)):>8.2f}s "
+                f"{100.0 * float(row.get('share', 0)):>5.1f}%\n"
+            )
+    shards = usage.get("shards") or {}
+    for reporter in sorted(shards):
+        out.write(f"\nshard {reporter or '(master)'} top principals:\n")
+        for row in shards[reporter].get("top", []):
+            who = row.get("principal") or {}
+            out.write(
+                f"  {who.get('job', '')}/{who.get('component', '')}"
+                f"/{who.get('purpose', '')}: "
+                f"{row.get('requests', 0):.0f} req, "
+                f"{row.get('rows', 0):.0f} rows, "
+                f"{_fmt_bytes(float(row.get('bytes', 0)))}\n"
+            )
+
+
 def print_alerts(alerts: dict, out=None):
     """One line per rule: state, value, human detail."""
     out = out if out is not None else sys.stdout
@@ -324,6 +411,16 @@ def dump_once(args) -> int:
             return 1
         sys.stdout.write("\n---- alerts ----\n")
         print_alerts(alerts)
+    if args.usage:
+        try:
+            usage = fetch_usage(args.addr, args.usage_top,
+                                timeout=args.timeout)
+        except OSError as exc:
+            print(f"usage fetch failed: {exc} (the master serves "
+                  "/usage from its metrics port)", file=sys.stderr)
+            return 1
+        sys.stdout.write("\n---- usage ----\n")
+        print_usage(usage)
     if args.profile is not None:
         try:
             profile = fetch_profile(
@@ -352,6 +449,13 @@ def main(argv=None) -> int:
     parser.add_argument("--alerts", action="store_true",
                         help="Also fetch /alerts and print the SLO "
                              "rule states")
+    parser.add_argument("--usage", action="store_true",
+                        help="Also fetch /usage and print per-workload "
+                             "share tables (who pays for requests, "
+                             "rows, bytes, lock-hold)")
+    parser.add_argument("--usage_top", type=int, default=5,
+                        help="Top-K principals per shard in the "
+                             "--usage view")
     parser.add_argument("--profile", default=None, metavar="COMPONENT",
                         help="Also fetch /profile for this component "
                              "('' = the master itself, '3' = worker "

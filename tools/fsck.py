@@ -20,6 +20,7 @@ dispatches by artifact signature:
 - pushlog ``MANIFEST.json``          → check_pushlog (row WAL)
 - ``alert.json``                     → check_incident (SLO bundles)
 - ``shard_map.json``                 → check_reshard (authority state)
+- ``USAGE_DRILL.json``               → check_usage (attribution drill)
 
 Exits nonzero if any validator fails. A root with no artifacts passes
 (there is nothing to corrupt). Importable: ``run_fsck(root)``.
@@ -53,6 +54,10 @@ def _classify(root: str) -> List[Tuple[str, str]]:
         if "shard_map.json" in filenames:
             found.append(
                 ("reshard", os.path.join(dirpath, "shard_map.json"))
+            )
+        if "USAGE_DRILL.json" in filenames:
+            found.append(
+                ("usage", os.path.join(dirpath, "USAGE_DRILL.json"))
             )
         if "MANIFEST.json" in filenames:
             try:
@@ -106,11 +111,13 @@ def run_fsck(root: str) -> Tuple[List[str], dict]:
     from check_pushlog import check_one_log
     from check_reshard import check_reshard
     from check_store import check_one_store
+    from check_usage import check_usage
 
     artifacts = _classify(root)
     errors: List[str] = []
     checked = {"journal": 0, "checkpoint": 0, "store": 0,
-               "pushlog": 0, "incident": 0, "reshard": 0}
+               "pushlog": 0, "incident": 0, "reshard": 0,
+               "usage": 0}
     for kind, path in artifacts:
         checked[kind] += 1
         try:
@@ -126,6 +133,8 @@ def run_fsck(root: str) -> Tuple[List[str], dict]:
                 )
             elif kind == "incident":
                 errs = check_incident(path)
+            elif kind == "usage":
+                errs, _report = check_usage(path)
             else:  # reshard
                 errs, _report = check_reshard(path)
         except BaseException as exc:
