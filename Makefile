@@ -10,7 +10,7 @@ PY ?= python
 	autoscale-smoke autoscale-bench slo-smoke ckpt-bench ckpt-smoke \
 	tiered-smoke tiered-bench reshard-smoke reshard-bench \
 	profile-smoke failover-smoke failover-bench quake-smoke \
-	usage-smoke fsck
+	usage-smoke sched-smoke sched-bench fsck
 
 # Scrape-and-pretty-print a master's /metrics (docs/observability.md).
 METRICS_ADDR ?= localhost:8080
@@ -222,6 +222,31 @@ usage-smoke:
 	&& $(PY) tools/check_usage.py USAGE_DRILL.json; \
 	rc=$$?; rm -rf $$workdir; exit $$rc
 
+# Gang-scheduler drill (docs/scheduler.md): two jobs on one fleet, a
+# live priority preemption (checkpoint-now + lease handback), resume,
+# and BOTH jobs' final dense + row state byte-equal to solo control
+# runs — with the journal and every shard WAL fsck'd in-drill. The
+# report is then schema-checked by check_sched.py (and fsck's sched
+# kind on every push via the committed SCHED_DRILL.json).
+sched-smoke:
+	workdir=$$(mktemp -d /tmp/edl_sched.XXXXXX); \
+	JAX_PLATFORMS=cpu $(PY) -m elasticdl_tpu.chaos.sched_drill \
+		--seed $(CHAOS_SEED) --workdir $$workdir \
+		--report SCHED_DRILL.json \
+	&& $(PY) tools/check_sched.py SCHED_DRILL.json; \
+	rc=$$?; rm -rf $$workdir; exit $$rc
+
+# Gang-vs-static utilization + pod-closing autoscale round-trip
+# (docs/scheduler.md "Benchmarks"): one shared arbiter must beat two
+# static fleet halves on the same job mix, and the pod scaler must
+# really spawn then drain a row-service pod around a live
+# split/merge. Gates evaluated in-bench; report BENCH_SCHED.json.
+sched-bench:
+	workdir=$$(mktemp -d /tmp/edl_schedb.XXXXXX); \
+	JAX_PLATFORMS=cpu $(PY) tools/bench_sched.py \
+		--workdir $$workdir --out BENCH_SCHED.json; \
+	rc=$$?; rm -rf $$workdir; exit $$rc
+
 # Deterministic chaos plan (kill + stall-row-shard + corrupt-checkpoint)
 # against the in-process cluster; exits nonzero if any recovery
 # invariant fails — the schedule includes a worker kill landing
@@ -238,7 +263,8 @@ usage-smoke:
 # principal purity survives a live split under the chaos lane too.
 # docs/chaos.md.
 CHAOS_SEED ?= 7
-chaos-smoke: tiered-smoke chaos-master-smoke quake-smoke usage-smoke
+chaos-smoke: tiered-smoke chaos-master-smoke quake-smoke usage-smoke \
+		sched-smoke
 	workdir=$$(mktemp -d /tmp/edl_chaos.XXXXXX); \
 	JAX_PLATFORMS=cpu $(PY) -m elasticdl_tpu chaos run \
 		--seed $(CHAOS_SEED) --workdir $$workdir \

@@ -424,6 +424,30 @@ def add_train_params(parser):
                         default=2,
                         help="Read replicas per hot id (capped at "
                              "fleet size - 1; 0 disables replication)")
+    add_bool_param(parser, "--row_pod_autoscale", False,
+                   "Close the split/merge pod loop (master/"
+                   "autoscaler.py RowServicePodScaler): grow spawns a "
+                   "row-service pod before splitting onto it, and a "
+                   "merged-away pod drains once the shard-map "
+                   "controller retires its slot (needs --row_reshard "
+                   "and k8s)")
+    # Multi-tenant gang scheduling (master/scheduler.py;
+    # docs/scheduler.md): many jobs on one elastic fleet, with
+    # journal-event-sourced job table, priority preemption, and
+    # usage-plane fair share.
+    add_bool_param(parser, "--sched", False,
+                   "Run the multi-job gang scheduler in the master "
+                   "(submit_job RPC + /sched endpoint; job table "
+                   "event-sources onto --journal_dir and survives "
+                   "failover)")
+    parser.add_argument("--usage_max_jobs", type=non_neg_int, default=0,
+                        help="Distinct job labels the usage plane "
+                             "admits before folding new tenants into "
+                             "__other__ (observability/usage.py); 0 "
+                             "(default) keeps the built-in cap of 32. "
+                             "Raise on legitimately multi-job fleets "
+                             "(--sched) so every tenant keeps its own "
+                             "usage series")
 
 
 def add_evaluate_params(parser):

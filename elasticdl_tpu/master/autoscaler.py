@@ -194,6 +194,171 @@ class Autoscaler:
         return direction
 
 
+class RowServicePodScaler:
+    """Closes the PR 12 loop: the shard-map controller could already
+    ``split``/``merge`` ranges across row-service processes, but
+    nothing ever SPAWNED or REMOVED a process — splits were confined
+    to pods that existed at launch. This scaler owns the pod half:
+
+    - ``grow()``  — ``InstanceManager.add_row_service_shard`` (stable
+      Service + pod, journaled before the create), then ``split`` the
+      hottest live shard onto the new pod's service address. Pod
+      first, routes second: the map must never point at an address
+      with nothing behind it.
+    - ``shrink()`` — ``merge`` the coldest scaled pod's shard into the
+      busiest survivor and remember its address as pending. The pod
+      keeps serving: clients holding a pre-drain map still route ids
+      at it until the controller's quiescence check proves otherwise.
+    - ``tick()`` — called after the controller's own tick. When a
+      pending address has left the map (the controller retired the
+      drained slot), the pod has served its last request:
+      ``drain_row_service_shard`` deletes pod + Service without
+      triggering the dead-pod relaunch path. Routes first, pod
+      second — the mirror of grow.
+
+    Decision *policy* (when to grow/shrink) stays with the caller —
+    the master tick, a drill, or an ``Autoscaler`` wired to row
+    telemetry; this class only makes the actions safe."""
+
+    def __init__(self, controller, instance_manager,
+                 address_fn: Callable[[int], str],
+                 metrics_registry=None):
+        from elasticdl_tpu.observability import default_registry
+
+        self._controller = controller
+        self._im = instance_manager
+        self._address_fn = address_fn
+        # Service addresses merged away, awaiting the controller's
+        # retirement proof. Keyed by ADDRESS, not shard index — map
+        # indices shift when a slot is retired.
+        self._pending_drain: set = set()
+        registry = metrics_registry or default_registry()
+        self._m_pods = registry.counter(
+            "master_rowservice_pod_scale_total",
+            "Row-service pods spawned/drained by the pod scaler",
+            ["action"],
+        )
+        self.events: List[dict] = []
+
+    def _addr_to_im_shard(self) -> Dict[str, int]:
+        return {
+            self._address_fn(shard): shard
+            for shard in self._im.row_service_shards()
+        }
+
+    def _traffic_by_shard(self) -> Dict[int, int]:
+        stats = self._controller.poll_stats()
+        return {
+            s: int(per.get("pulled_rows", 0))
+            + int(per.get("pushed_rows", 0))
+            for s, per in stats.items()
+        }
+
+    def grow(self) -> Optional[dict]:
+        """Spawn a pod and split the hottest shard onto it. Returns
+        ``{"im_shard", "addr", "source"}`` or None (row service off /
+        manager stopped / no live map)."""
+        shard_map = self._controller.map
+        if shard_map is None or not shard_map.shards:
+            return None
+        im_shard = self._im.add_row_service_shard()
+        if im_shard is None:
+            return None
+        addr = self._address_fn(im_shard)
+        traffic = self._traffic_by_shard()
+        live = range(len(shard_map.shards))
+        source = max(live, key=lambda s: traffic.get(s, 0))
+        try:
+            self._controller.split(source, new_addr=addr)
+        except Exception:
+            # The pod exists but the routes never moved: tear it back
+            # down rather than leak an unreferenced pod.
+            logger.exception(
+                "split onto new row-service pod %s failed; draining "
+                "the unused pod", addr,
+            )
+            self._im.drain_row_service_shard(im_shard)
+            return None
+        self._m_pods.labels("add").inc()
+        event = {"action": "add", "im_shard": im_shard,
+                 "addr": addr, "source": int(source)}
+        self.events.append(event)
+        logger.info(
+            "row-service pod scale-up: shard %d (%s) split from "
+            "shard %d", im_shard, addr, source,
+        )
+        return event
+
+    def shrink(self) -> Optional[dict]:
+        """Merge the coldest scaler-managed pod's shard into the
+        busiest survivor; the pod itself drains on a later ``tick``
+        once the controller retires the slot. Returns
+        ``{"addr", "source", "target"}`` or None (nothing safely
+        removable)."""
+        shard_map = self._controller.map
+        if shard_map is None or len(shard_map.shards) <= 1:
+            return None
+        by_addr = self._addr_to_im_shard()
+        candidates = [
+            s for s, addr in enumerate(shard_map.shards)
+            if addr in by_addr and addr not in self._pending_drain
+        ]
+        if len(candidates) < 1 or len(shard_map.shards) - len(
+            self._pending_drain
+        ) <= 1:
+            return None
+        traffic = self._traffic_by_shard()
+        source = min(candidates, key=lambda s: traffic.get(s, 0))
+        survivors = [
+            s for s in range(len(shard_map.shards))
+            if s != source
+            and shard_map.shards[s] not in self._pending_drain
+        ]
+        if not survivors:
+            return None
+        target = max(survivors, key=lambda s: traffic.get(s, 0))
+        addr = shard_map.shards[source]
+        self._controller.merge(source, target)
+        self._pending_drain.add(addr)
+        self._m_pods.labels("merge").inc()
+        event = {"action": "merge", "addr": addr,
+                 "source": int(source), "target": int(target)}
+        self.events.append(event)
+        logger.info(
+            "row-service pod scale-down: shard %d (%s) merging into "
+            "shard %d; pod drains after retirement", source, addr,
+            target,
+        )
+        return event
+
+    def tick(self) -> Optional[int]:
+        """Drain the pod behind any pending address the controller
+        has retired from the map. Returns the drained instance-manager
+        shard index, or None."""
+        if not self._pending_drain:
+            return None
+        shard_map = self._controller.map
+        live = set(shard_map.shards) if shard_map is not None else set()
+        by_addr = self._addr_to_im_shard()
+        for addr in sorted(self._pending_drain):
+            if addr in live:
+                continue  # not retired yet: keep serving stale routes
+            self._pending_drain.discard(addr)
+            im_shard = by_addr.get(addr)
+            if im_shard is None:
+                continue  # pod already gone (master restart raced)
+            self._im.drain_row_service_shard(im_shard)
+            self._m_pods.labels("drain").inc()
+            self.events.append({"action": "drain",
+                                "im_shard": im_shard, "addr": addr})
+            logger.info(
+                "row-service pod drained after retirement: shard %d "
+                "(%s)", im_shard, addr,
+            )
+            return im_shard
+        return None
+
+
 # ---- signal extraction ---------------------------------------------------
 
 

@@ -24,6 +24,7 @@ from elasticdl_tpu.platform.k8s_client import (
     build_pod_manifest,
     build_row_service_service_manifest,
     get_row_service_pod_name,
+    get_row_service_service_name,
     get_worker_pod_name,
 )
 
@@ -268,6 +269,13 @@ class InstanceManager:
                     self._rs_generation.get(int(shard), 0),
                     int(generation),
                 )
+                # A shard ADDED after startup (add_row_service_shard
+                # journals generation 0) lives beyond the configured
+                # count; adopt it too, or its next death goes
+                # undetected.
+                self._num_rs_shards = max(
+                    self._num_rs_shards, int(shard) + 1
+                )
             for shard in range(self._num_rs_shards):
                 self._row_service_pods[shard] = (
                     get_row_service_pod_name(
@@ -359,6 +367,79 @@ class InstanceManager:
             "(generation %d)", shard, generation,
         )
         self._start_row_service_pod(shard)
+
+    def add_row_service_shard(self) -> Optional[int]:
+        """Spawn one MORE row-service pod (stable Service + pod) under
+        the next shard index — the autoscaler's pod-closing half of a
+        live ``split`` (row_reshard.ShardMapController): the pod must
+        exist and serve before the shard map routes ranges to it.
+        Journaled as a generation-0 relaunch record BEFORE the create
+        (the same order every relaunch uses), so a recovered master
+        adopts the grown fleet instead of forgetting the extra pod.
+        Returns the new shard index, or None when row service is off
+        or the manager is stopped."""
+        if self._row_service_command is None:
+            return None
+        with self._lock:
+            if self._stopped:
+                return None
+            shard = self._num_rs_shards
+            self._num_rs_shards += 1
+            self._rs_generation.setdefault(shard, 0)
+        self._journal_relaunch(
+            "row_service", self._rs_generation.get(shard, 0),
+            shard=shard,
+        )
+        self._client.create_service(
+            build_row_service_service_manifest(
+                self._job_name, namespace=self._namespace, shard=shard,
+            )
+        )
+        self._start_row_service_pod(shard)
+        logger.info("scaled up row service: added shard %d", shard)
+        return shard
+
+    def drain_row_service_shard(self, shard: int) -> bool:
+        """Tear down one row-service pod + its Service WITHOUT
+        relaunching — the pod-closing half of a completed ``merge``:
+        call only AFTER the shard-map controller retired the shard
+        (tick() returned ``retire:N``), i.e. the map no longer routes
+        any range here and every row moved off. Untracked before
+        deletion so the DELETED watch event matches nothing and the
+        dead-row-service relaunch path never fires (the drain_worker
+        pattern). Returns False when the shard is not tracked."""
+        shard = int(shard)
+        with self._lock:
+            name = self._row_service_pods.pop(shard, None)
+            if name is None:
+                return False
+            self._rs_generation.pop(shard, None)
+            # Shrink the count only from the top — interior indices
+            # stay burned (shard ids never recycle, like worker ids).
+            while (self._num_rs_shards > 1
+                   and (self._num_rs_shards - 1)
+                   not in self._row_service_pods):
+                self._num_rs_shards -= 1
+        try:
+            self._client.delete_pod(name)
+        except Exception as exc:
+            logger.warning("deleting drained row-service pod %s "
+                           "failed: %s", name, exc)
+        try:
+            self._client.delete_service(
+                get_row_service_service_name(self._job_name,
+                                             shard=shard)
+            )
+        except Exception as exc:
+            logger.warning("deleting drained row-service service "
+                           "(shard %d) failed: %s", shard, exc)
+        logger.info("drained row service shard %d (%s)", shard, name)
+        return True
+
+    def row_service_shards(self) -> Dict[int, str]:
+        """shard -> tracked pod name (the pod scaler's view)."""
+        with self._lock:
+            return dict(self._row_service_pods)
 
     # ---- event handling -------------------------------------------------
 

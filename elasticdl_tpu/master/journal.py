@@ -26,6 +26,10 @@ validated, replayable records:
   records persist the instance manager's gang / row-service relaunch
   generations — the two planes that used to die with the master
   (docs/fault_tolerance.md used to list them as known limitations).
+  ``sched`` records event-source the multi-job gang scheduler's job
+  table (master/scheduler.py, docs/scheduler.md): submit / schedule /
+  run / preempt / resume / done / cancel transitions, so the job table
+  survives failover and warm-replays into the standby.
 - **Snapshots + compaction**: every ``snapshot_every`` state-mutating
   records the journal captures the dispatcher's full exported state
   and rewrites the file to ``[snapshot, tail…]`` — replay cost is
@@ -111,13 +115,23 @@ RELAUNCH = "relaunch"
 # Fencing of a prior incarnation at standby takeover: generations must
 # be strictly increasing across fence records (fsck enforces).
 FENCE = "fence"
+# Multi-job gang-scheduler events (master/scheduler.py): the job table
+# (spec, priority, gang size, lifecycle state, preemption counts) is
+# event-sourced here so it survives failover and replays into the
+# standby exactly like the dispatcher/eval/relaunch planes.
+SCHED = "sched"
 
 KNOWN_TYPES = (DISPATCH, REPORT, CREATE_TASKS, VERSION, SNAPSHOT,
                GENERATION, RESIZE, SHARD_MAP, EVAL_ROUND, EVAL_FOLD,
-               RELAUNCH, FENCE)
+               RELAUNCH, FENCE, SCHED)
 
 EVAL_EVENTS = ("open", "close")
 RELAUNCH_KINDS = ("gang", "row_service")
+# Job lifecycle events (ISSUE 17): submitted -> scheduled -> running
+# -> (preempted -> scheduled -> running)* -> done, plus cancel from
+# any non-terminal state.
+SCHED_EVENTS = ("submit", "schedule", "run", "preempt", "resume",
+                "done", "cancel")
 
 _HEADER = struct.Struct("<II")  # payload length, crc32(payload)
 
@@ -224,6 +238,47 @@ def apply_eval_report_record(state: dict, record: dict):
             and model_version != open_round["model_version"]):
         return
     open_round["completed"] += 1
+
+
+def new_sched_state() -> dict:
+    return {"jobs": {}, "preemptions": 0}
+
+
+def apply_sched_record(state: dict, record: dict):
+    """Fold one SCHED event into the job table — the ONE fold function
+    shared by live appends (journal-side mirror), the open-generation
+    scan, and replay, so the three paths cannot drift on the record
+    shape (same discipline as the eval/relaunch planes)."""
+    event = record.get("event")
+    job = str(record.get("job", ""))
+    jobs = state["jobs"]
+    if event == "submit":
+        jobs[job] = {
+            "spec": dict(record.get("spec") or {}),
+            "priority": int(record.get("priority", 0)),
+            "gang_size": int(record.get("gang_size", 1)),
+            "state": "submitted",
+            "preemptions": 0,
+        }
+        return
+    entry = jobs.get(job)
+    if entry is None:
+        # An event for a job the (compacted) prefix no longer names —
+        # replay tolerates it (the snapshot's table supersedes), the
+        # live scheduler never produces it.
+        return
+    if event == "schedule" or event == "resume":
+        entry["state"] = "scheduled"
+    elif event == "run":
+        entry["state"] = "running"
+    elif event == "preempt":
+        entry["state"] = "preempted"
+        entry["preemptions"] = int(entry.get("preemptions", 0)) + 1
+        state["preemptions"] = int(state.get("preemptions", 0)) + 1
+    elif event == "done":
+        entry["state"] = "done"
+    elif event == "cancel":
+        entry["state"] = "cancelled"
 
 
 def new_relaunch_state() -> dict:
@@ -333,6 +388,14 @@ def validate_record(record: dict) -> Optional[str]:
         if (record.get("kind") == "row_service"
                 and not isinstance(record.get("shard"), int)):
             return "relaunch: row_service without int shard"
+    elif rtype == SCHED:
+        if record.get("event") not in SCHED_EVENTS:
+            return f"sched: unknown event {record.get('event')!r}"
+        if not isinstance(record.get("job"), str) or not record["job"]:
+            return "sched: missing job id"
+        if (record.get("event") == "submit"
+                and not isinstance(record.get("spec"), dict)):
+            return "sched: submit without spec dict"
     elif rtype == SNAPSHOT:
         state = record.get("state")
         if not isinstance(state, dict):
@@ -360,6 +423,7 @@ def new_replay_carry() -> dict:
         "shard_map": None,
         "eval": new_eval_state(),
         "relaunch": new_relaunch_state(),
+        "sched": new_sched_state(),
         "seq": 0,
     }
 
@@ -424,6 +488,10 @@ def apply_replay(dispatcher, records: List[dict],
             apply_relaunch_record(carry["relaunch"], record)
             carry["replayed"] += 1
             continue
+        if rtype == SCHED:
+            apply_sched_record(carry["sched"], record)
+            carry["replayed"] += 1
+            continue
         if rtype == SNAPSHOT:
             state = record["state"]
             dispatcher.restore_state(state)
@@ -447,6 +515,15 @@ def apply_replay(dispatcher, records: List[dict],
                         int(k): int(v) for k, v in
                         (relaunch.get("row_service") or {}).items()
                     },
+                }
+            if record.get("sched") is not None:
+                sched = record["sched"]
+                carry["sched"] = {
+                    "jobs": {
+                        str(k): dict(v) for k, v in
+                        (sched.get("jobs") or {}).items()
+                    },
+                    "preemptions": int(sched.get("preemptions", 0)),
                 }
             # Compaction dropped the pre-snapshot dispatch records;
             # the snapshot's leases and version reports still name the
@@ -540,6 +617,7 @@ class MasterJournal:
         # SAME functions replay uses, so they cannot drift.
         self._eval = new_eval_state()
         self._relaunch = new_relaunch_state()
+        self._sched = new_sched_state()
         # (last-checked monotonic time, verdict) for is_fenced().
         self._fence_cache = (0.0, False)
 
@@ -604,6 +682,8 @@ class MasterJournal:
                             self._eval = record["eval"]
                         if record.get("relaunch") is not None:
                             self._relaunch = record["relaunch"]
+                        if record.get("sched") is not None:
+                            self._sched = record["sched"]
                     elif record["t"] == RESIZE:
                         self._pending_resize = _pending_resize_from(
                             record
@@ -618,6 +698,8 @@ class MasterJournal:
                         apply_eval_report_record(self._eval, record)
                     elif record["t"] == RELAUNCH:
                         apply_relaunch_record(self._relaunch, record)
+                    elif record["t"] == SCHED:
+                        apply_sched_record(self._sched, record)
                 size = os.path.getsize(self.path)
                 if size > last_good_end:
                     logger.warning(
@@ -801,6 +883,8 @@ class MasterJournal:
                 apply_eval_report_record(self._eval, fields)
             elif rtype == RELAUNCH:
                 apply_relaunch_record(self._relaunch, fields)
+            elif rtype == SCHED:
+                apply_sched_record(self._sched, fields)
             self._append_locked(rtype, **fields)
             if rtype in (DISPATCH, REPORT):
                 self._since_snapshot += 1
@@ -823,6 +907,7 @@ class MasterJournal:
             "resize": self._pending_resize,
             "eval": self._eval,
             "relaunch": self._relaunch,
+            "sched": self._sched,
         }
         # Compaction: the snapshot supersedes everything before it, so
         # rewrite the file as [generation fence, snapshot] and keep

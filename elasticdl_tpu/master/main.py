@@ -274,6 +274,67 @@ class Master:
         from elasticdl_tpu.observability import profiler as _profiler
 
         _profiler.maybe_start_from_args(args, "master")
+        # Usage-plane tenant cap (observability/usage.py): a multi-job
+        # fleet must not fold real tenants into __other__.
+        from elasticdl_tpu.observability import usage as _usage
+
+        _usage.set_max_jobs(
+            int(getattr(args, "usage_max_jobs", 0) or 0) or None
+        )
+        # Multi-job control plane (master/scheduler.py, --sched): the
+        # gang scheduler's job table event-sources onto the same
+        # journal; cold recovery and the warm-standby handover both
+        # restore it from the replay carry below.
+        self.scheduler = None
+        if getattr(args, "sched", False):
+            from elasticdl_tpu.master.scheduler import GangScheduler
+
+            def sched_slots():
+                # getattr: render()/this closure can run during
+                # __init__ (primary-job adoption below), before the
+                # instance_manager attribute is assigned.
+                im = getattr(self, "instance_manager", None)
+                if im is not None:
+                    return len(im.live_workers)
+                live = len(self.servicer.worker_liveness())
+                return live or int(getattr(args, "num_workers", 1))
+
+            self.scheduler = GangScheduler(
+                sched_slots,
+                journal=self._journal,
+                usage_fn=self.metrics_plane.usage,
+                registry=self.metrics_plane.registry,
+            )
+            if self._recovery_stats is not None:
+                self.scheduler.restore(
+                    self._recovery_stats.get("sched")
+                )
+            # The CLI's own job enters the table like any tenant —
+            # in --sched mode leases come exclusively from the
+            # arbiter, so an unsubmitted primary job would never
+            # dispatch. A fresh start submits it (journaled); after
+            # recovery the entry is already in the restored table and
+            # only the volatile half (the recovered dispatcher) needs
+            # re-binding.
+            primary_job = getattr(args, "job_name", "") or "default"
+            if not self.task_dispatcher.finished():
+                try:
+                    self.scheduler.submit(
+                        primary_job,
+                        gang_size=max(1, int(
+                            getattr(args, "num_workers", 1) or 1
+                        )),
+                        dispatcher=self.task_dispatcher,
+                    )
+                except ValueError:
+                    # Already in the restored table (recovery path):
+                    # re-bind the volatile half only.
+                    self.scheduler.bind_job(
+                        primary_job, dispatcher=self.task_dispatcher
+                    )
+            self.metrics_plane.add_json_route(
+                "/sched", lambda params: self.scheduler.render()
+            )
         self.servicer = MasterServicer(
             self.task_dispatcher,
             self.evaluation_service,
@@ -283,6 +344,7 @@ class Master:
             generation=(
                 self._journal.generation if self._journal else 0
             ),
+            scheduler=self.scheduler,
         )
         if self._recovery_stats is not None:
             # Re-arm the servicer with the recovered high-water marks:
@@ -303,6 +365,7 @@ class Master:
         self.instance_manager = None
         self.autoscaler = None
         self.row_reshard = None
+        self.row_pod_scaler = None
         self._k8s_client = k8s_client
         # SIGTERM grace path (main() installs the handler): the run
         # loop exits at the next poll tick and stop() tears the job
@@ -560,6 +623,34 @@ class Master:
             self._build_autoscaler()
         if getattr(self._args, "row_reshard", False):
             self._build_row_reshard()
+        if (
+            getattr(self._args, "row_pod_autoscale", False)
+            and self.row_reshard is not None
+            and self.instance_manager is not None
+        ):
+            # Pod-closing autoscaling (master/autoscaler.py
+            # RowServicePodScaler): split/merge decisions can now
+            # actually spawn and drain row-service pods instead of
+            # being confined to the launch-time fleet.
+            from elasticdl_tpu.master.autoscaler import (
+                RowServicePodScaler,
+            )
+            from elasticdl_tpu.platform.k8s_client import (
+                ROW_SERVICE_PORT,
+                get_row_service_service_name,
+            )
+
+            job_name = self._args.job_name
+
+            def rs_addr(shard: int) -> str:
+                name = get_row_service_service_name(job_name,
+                                                    shard=shard)
+                return f"{name}:{ROW_SERVICE_PORT}"
+
+            self.row_pod_scaler = RowServicePodScaler(
+                self.row_reshard, self.instance_manager, rs_addr,
+                metrics_registry=self.metrics_plane.registry,
+            )
 
     def _build_row_reshard(self):
         """Row-plane elasticity (master/row_reshard.py): the master
@@ -705,11 +796,20 @@ class Master:
         Signal-handler safe: sets a flag, no locks, no teardown here."""
         self._stop_requested = True
 
+    def _job_finished(self) -> bool:
+        """The run loop's exit gate: the primary dispatcher drained
+        AND (in --sched mode) every scheduler job reached a terminal
+        state — a preempted job still owed a resume must keep the
+        fleet up."""
+        if not self.task_dispatcher.finished():
+            return False
+        return self.scheduler is None or self.scheduler.idle()
+
     def run(self, poll_secs: float = 5.0):
         """Sleep until the dispatcher drains (reference master.py:218-238);
         each tick, kill stragglers (3× mean task time, :487-509)."""
         try:
-            while not self.task_dispatcher.finished():
+            while not self._job_finished():
                 if self._stop_requested:
                     logger.warning(
                         "stop requested (SIGTERM); tearing the job "
@@ -742,18 +842,45 @@ class Master:
                     self.servicer.maybe_complete_resize(live)
                 if self.autoscaler is not None:
                     self.autoscaler.tick()
+                if self.scheduler is not None:
+                    # Multi-job arbitration: completion sweep, gang
+                    # allocation, preemption, resume. A fenced journal
+                    # aborts the tick (JournalFencedError) — a zombie
+                    # arbiter must stop, and the run loop exits on the
+                    # next _job_finished/servicer fence check.
+                    try:
+                        self.scheduler.tick()
+                    except Exception:
+                        logger.exception("scheduler tick failed")
                 if self.row_reshard is not None:
                     # Row-plane elasticity: rebalance ranges / refresh
                     # hot-row replicas (tick() contains its own
-                    # failures — a flaky shard must not kill the run
-                    # loop).
+                    # failures — a flaky shard must not take the master
+                    # loop down).
                     self.row_reshard.tick()
+                if self.row_pod_scaler is not None:
+                    # Pod-closing half of merges: drain the pod behind
+                    # any slot the controller just retired.
+                    try:
+                        self.row_pod_scaler.tick()
+                    except Exception:
+                        logger.exception("row pod scaler tick failed")
                 # SLO plane: sample the time-series store (if due) and
                 # evaluate the rules on the fresh window.
                 self.metrics_plane.slo_tick()
                 self.metrics_plane.publish_tensorboard(
                     self.servicer.model_version
                 )
+            if self.scheduler is not None and not self._stop_requested:
+                # In --sched mode the finished signal flips at the
+                # same arbitration tick that satisfies the exit gate
+                # above — unlike the single-job plane, where workers
+                # observe it the moment the last report lands, a full
+                # poll window before the master exits. Serve the
+                # finished response for a couple of poll intervals so
+                # the fleet learns completion from get_task instead of
+                # burning its reattach grace on a drained job.
+                time.sleep(min(10.0, 2 * poll_secs))
         finally:
             # The last tasks finish during the final poll sleep; flush
             # that interval's aggregates to TensorBoard before stop()

@@ -33,11 +33,16 @@ SERVICE_NAME = "elasticdl_tpu.Master"
 class MasterServicer:
     def __init__(self, task_dispatcher, evaluation_service=None,
                  task_timeout_secs: float = 300.0, metrics_plane=None,
-                 journal=None, generation: int = 0):
+                 journal=None, generation: int = 0, scheduler=None):
         from elasticdl_tpu.observability import MetricsPlane
 
         self._task_d = task_dispatcher
         self._eval_service = evaluation_service
+        # Multi-job mode (master/scheduler.py): when a GangScheduler is
+        # attached, get_task routes through its worker->job binding and
+        # every lease/report is job-scoped; without one, behavior is
+        # the single-job plane unchanged.
+        self._scheduler = scheduler
         # Master incarnation fence (master/journal.py): stamped on every
         # get_task response so workers detect a restart and re-attach;
         # reports carry the generation their task was dispatched under,
@@ -75,7 +80,10 @@ class MasterServicer:
         self._default_task_secs = task_timeout_secs
         self._task_secs_sum = 0.0
         self._task_count = 0
-        self._task_start_times: Dict[int, float] = {}
+        # Keyed (job, task_id): per-job dispatchers number task ids
+        # independently, so a bare int key would collide across jobs
+        # in scheduler mode. The single-job plane uses job "".
+        self._task_start_times: Dict[tuple, float] = {}
         self.model_version = 0
         # ---- live-resize barrier (docs/elasticity.md) ----------------
         # At most one pending resize: {resize_id, spec, direction,
@@ -114,6 +122,8 @@ class MasterServicer:
             "report_version": self.report_version,
             "report_resize": self.report_resize,
             "report_metrics": self.report_metrics,
+            "submit_job": self.submit_job,
+            "sched_status": self.sched_status,
             "ping": lambda req: {"ok": True},
         }
 
@@ -178,10 +188,33 @@ class MasterServicer:
             # Piggybacked like the generation fence: WAIT responses
             # carry it too, so an idle worker still joins the barrier.
             extra["resize"] = offer
+        if self._scheduler is not None:
+            # Multi-job: the gang scheduler decides which job this
+            # worker slot serves right now; the lease carries the job
+            # id so the worker's report routes back to the same
+            # dispatcher (and so a post-preemption rebinding cannot
+            # mis-apply a stale report to the new job).
+            job_id, disp = self._scheduler.lease_for(worker_id)
+            if disp is not None:
+                task = disp.get(worker_id)
+                if task is not None:
+                    with self._lock:
+                        self._task_start_times[
+                            (job_id, task.task_id)
+                        ] = time.time()
+                    return {"task": task.to_dict(), "finished": False,
+                            "job": job_id,
+                            "generation": self.generation, **extra}
+            if self._scheduler.idle() and self._task_d.finished():
+                return {"task": None, "finished": True,
+                        "generation": self.generation, **extra}
+            wait = Task(task_id=-1, type=TaskType.WAIT)
+            return {"task": wait.to_dict(), "finished": False,
+                    "generation": self.generation, **extra}
         task = self._task_d.get(worker_id)
         if task is not None:
             with self._lock:
-                self._task_start_times[task.task_id] = time.time()
+                self._task_start_times[("", task.task_id)] = time.time()
             return {"task": task.to_dict(), "finished": False,
                     "generation": self.generation, **extra}
         if self._task_d.finished():
@@ -205,16 +238,29 @@ class MasterServicer:
         err_reason = request.get("err_reason", "")
         success = not err_reason
         worker_id = int(request.get("worker_id", -1))
+        job_id = str(request.get("job", "") or "")
         self._ingest_metrics(worker_id, request)
         self._note_worker_generation(worker_id, request)
         with self._lock:
-            start = self._task_start_times.pop(task_id, None)
+            start = self._task_start_times.pop((job_id, task_id), None)
+        # Job-scoped routing: the lease carried a job id (scheduler
+        # mode) and the report echoes it, so it applies to the
+        # dispatcher that issued the lease even if this worker has
+        # since been rebound to another gang. A done/cancelled job's
+        # dispatcher still answers from its resolved ledger.
+        dispatcher = self._task_d
+        if job_id and self._scheduler is not None:
+            routed = self._scheduler.dispatcher_of(job_id)
+            if routed is None:
+                return {"accepted": False, "fenced": True,
+                        "generation": self.generation}
+            dispatcher = routed
         # The duplicate flag is decided atomically with the report
         # application (dispatcher lock): a ledger hit means the side
         # effects below already ran on the first application — only
         # the outcome is re-sent. A pre-check here would race a
         # concurrent retry of the same report.
-        task, _worker, requeued, duplicate = self._task_d.apply_report(
+        task, _worker, requeued, duplicate = dispatcher.apply_report(
             task_id, success, err_reason
         )
         if (task is not None and success and start is not None
@@ -294,6 +340,48 @@ class MasterServicer:
                 f"{component}-{component_id}", snapshot
             )
         return {"accepted": True, "generation": self.generation}
+
+    # ---- multi-job control (master/scheduler.py) -----------------------
+
+    def submit_job(self, request: dict) -> dict:
+        """Admit a job into the gang scheduler's table. Fenced like
+        every state mutator: a zombie primary must not grow the job
+        table (the submit journals BEFORE the table mutates, so even
+        a fence that lands mid-handler aborts cleanly)."""
+        fenced = self._stale_master_reject("submit_job")
+        if fenced is not None:
+            return fenced
+        if self._scheduler is None:
+            return {"accepted": False, "error": "scheduler disabled",
+                    "generation": self.generation}
+        job_id = str(request.get("job", "") or "")
+        try:
+            entry = self._scheduler.submit(
+                job_id,
+                spec=request.get("spec") or {},
+                priority=int(request.get("priority", 0)),
+                gang_size=int(request.get("gang_size", 1)),
+            )
+        except ValueError as exc:
+            return {"accepted": False, "error": str(exc),
+                    "generation": self.generation}
+        return {"accepted": True, "job": job_id,
+                "state": entry["state"],
+                "generation": self.generation}
+
+    def sched_status(self, request: dict) -> dict:
+        """Job-table read for clients (``dump_metrics --sched`` talks
+        to the HTTP ``/sched`` route; this is the RPC twin). Reads are
+        not fenced — a stale table is labeled, not hidden."""
+        if self._scheduler is None:
+            return {"enabled": False, "generation": self.generation}
+        out = self._scheduler.render()
+        out["enabled"] = True
+        out["generation"] = self.generation
+        out["fenced"] = bool(
+            self._journal is not None and self._journal.is_fenced()
+        )
+        return out
 
     @staticmethod
     def _valid_snapshot(snapshot) -> bool:
@@ -528,31 +616,55 @@ class MasterServicer:
         threshold = factor * self.average_task_secs()
         now = time.time()
         out = []
-        doing = self._task_d.doing_start_times()
-        for task_id, (worker_id, start) in doing.items():
+        # Composite (job, task_id) keys: in scheduler mode the scan
+        # covers every gang currently holding slots, and per-job task
+        # ids collide across dispatchers.
+        doing = {
+            ("", tid): v
+            for tid, v in self._task_d.doing_start_times().items()
+        }
+        if self._scheduler is not None:
+            for job_id, disp in (
+                self._scheduler.active_dispatchers().items()
+            ):
+                if disp is self._task_d:
+                    continue
+                for tid, v in disp.doing_start_times().items():
+                    doing[(job_id, tid)] = v
+        for key, (worker_id, start) in doing.items():
             if now - start > threshold:
-                out.append((task_id, worker_id))
+                out.append((key, worker_id))
         with self._lock:
             # Count each straggling task once, not once per poll tick —
             # in k8s mode kill_worker recovery is async (the pod DELETED
             # watch event), so a timed-out task stays in the doing set
             # for several ticks before it is re-queued.
             self._straggler_counted &= set(doing)
-            fresh = [t for t, _w in out if t not in self._straggler_counted]
+            fresh = [k for k, _w in out if k not in self._straggler_counted]
             self._straggler_counted.update(fresh)
         if fresh:
             self._m_straggler.inc(len(fresh))
-        return out
+        # Callers act on (task_id, worker_id) — kill_worker only needs
+        # the worker; the job scoping above is for dedup correctness.
+        return [(key[1], worker_id) for key, worker_id in out]
 
     def seed_task_start_times(self, task_ids):
         """Recovery: start the straggler clock now for every lease
         that survived the master crash (the pre-crash start times died
         with the old process; counting from recovery avoids instantly
-        timing out every surviving worker)."""
+        timing out every surviving worker). Bare ints seed the
+        single-job plane (job ""); (job, task_id) pairs seed a
+        scheduler job's leases."""
         now = time.time()
         with self._lock:
             for tid in task_ids:
-                self._task_start_times[int(tid)] = now
+                if isinstance(tid, (tuple, list)):
+                    job_id, raw = tid
+                    self._task_start_times[
+                        (str(job_id), int(raw))
+                    ] = now
+                else:
+                    self._task_start_times[("", int(tid))] = now
 
     def remove_worker_metrics(self, worker_id: int):
         """Drop a departed worker from the cluster view immediately

@@ -467,6 +467,25 @@ class TaskDispatcher:
         for tid in ids:
             self.report(tid, False, err_reason="worker_dead")
 
+    def preempt_leases(self, reason: str = "preempted: gang released"
+                       ) -> int:
+        """Hand every leased task back to the front of the queue —
+        the gang scheduler evicting this job (master/scheduler.py).
+        Rides the graceful-preemption path of ``apply_report`` (the
+        ``preempted`` err_reason prefix), so retry budgets are NOT
+        burned and the resolved ledger keeps late duplicate reports
+        from the evicted workers idempotent. Returns the number of
+        leases handed back."""
+        if not reason.startswith("preempted"):
+            raise ValueError(
+                "preempt reason must start with 'preempted'"
+            )
+        with self._lock:
+            ids = list(self._doing.keys())
+        for tid in ids:
+            self.report(tid, False, err_reason=reason)
+        return len(ids)
+
     # ---- status --------------------------------------------------------
 
     def finished(self) -> bool:

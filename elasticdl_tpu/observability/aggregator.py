@@ -17,7 +17,7 @@ so TensorBoard stays the human view.
 
 import threading
 import time
-from typing import Dict, Optional
+from typing import Callable, Dict, Optional
 
 from elasticdl_tpu.observability.exposition import (
     MetricsHTTPServer,
@@ -294,6 +294,11 @@ class MetricsPlane:
         self._summary_writer = summary_writer
         self._last_published = None
         self._http: Optional[MetricsHTTPServer] = None
+        # Extra JSON routes registered by subsystems that come up
+        # around the plane (e.g. the gang scheduler's /sched):
+        # merged into _json_routes() and live-added to an already
+        # started server.
+        self._extra_routes: Dict[str, Callable] = {}
 
     # ---- ingest / render ----------------------------------------------
 
@@ -457,8 +462,19 @@ class MetricsPlane:
             top = params.get("top")
             return self.usage(top_k=int(top) if top else 5)
 
-        return {"/timeseries": timeseries_route, "/alerts": alerts_route,
-                "/profile": profile_route, "/usage": usage_route}
+        routes = {"/timeseries": timeseries_route,
+                  "/alerts": alerts_route,
+                  "/profile": profile_route, "/usage": usage_route}
+        routes.update(self._extra_routes)
+        return routes
+
+    def add_json_route(self, path: str, fn: Callable[[dict], dict]):
+        """Mount ``fn(params) -> dict`` at ``path`` (e.g. ``/sched``).
+        Works before OR after ``serve()``: the running server's route
+        table is shared by reference, so the mount is live."""
+        self._extra_routes[str(path)] = fn
+        if self._http is not None:
+            self._http._json_routes[str(path)] = fn
 
     def usage(self, top_k: int = 5) -> dict:
         """The ``/usage`` body (also callable in-process: drills and

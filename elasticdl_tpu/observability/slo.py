@@ -258,6 +258,28 @@ def default_rules() -> List[SLORule]:
                         "serving reads are going stale "
                         "(docs/observability.md)",
         ),
+        # Gang-scheduler starvation (master/scheduler.py): submitted
+        # jobs should either schedule or preempt their way in within
+        # an arbitration window. The mean of the submitted-state gauge
+        # staying above 0.5 for the whole window means at least one
+        # job sat admitted-but-never-arbitrated — a wedged tick loop,
+        # a gang larger than the fleet will ever be, or priorities
+        # starving the tail (docs/scheduler.md "Starvation").
+        SLORule(
+            name="sched-job-starved",
+            kind=THRESHOLD,
+            series="edl_tpu_sched_jobs",
+            labels={"state": "submitted"},
+            aggregation="mean",
+            op=">",
+            value=0.5,
+            window_secs=300.0,
+            min_count=10,
+            description="a submitted job has sat unscheduled for the "
+                        "whole evaluation window: the fleet never fit "
+                        "its gang and nothing preempted to admit it "
+                        "(docs/scheduler.md)",
+        ),
     ]
 
 

@@ -55,7 +55,26 @@ from elasticdl_tpu.observability import principal as _principal
 from elasticdl_tpu.observability.registry import default_registry
 
 OTHER_JOB = "__other__"
+# Default job-label budget; a multi-tenant fleet raises it via
+# --usage_max_jobs / set_max_jobs (a legitimately multi-job master
+# must not fold real tenants into __other__).
 MAX_JOBS = 32
+_max_jobs = MAX_JOBS
+
+
+def set_max_jobs(n: Optional[int]):
+    """Override the job-label fold budget (``--usage_max_jobs``).
+    ``None`` or 0 restores the ``MAX_JOBS`` default. Raising the cap
+    takes effect immediately; lowering it does not un-admit jobs
+    already granted a series (their budget is spent)."""
+    global _max_jobs
+    _max_jobs = int(n) if n else MAX_JOBS
+    if _max_jobs <= 0:
+        _max_jobs = MAX_JOBS
+
+
+def max_jobs() -> int:
+    return _max_jobs
 
 # Handler-time buckets: 100µs .. 5s — RPC handlers, not jobs.
 HANDLER_BUCKETS = (0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05,
@@ -71,10 +90,11 @@ _fold_jobs: set = set()
 
 
 def fold_job(job: str, registry=None) -> str:
-    """Bound the free-form job label: the first ``MAX_JOBS`` distinct
-    values pass through, everything after folds to ``__other__``.
-    ``unknown`` and ``__other__`` ride free (absence/overflow values
-    must never consume budget)."""
+    """Bound the free-form job label: the first ``max_jobs()`` distinct
+    values pass through (default ``MAX_JOBS``; --usage_max_jobs
+    raises it), everything after folds to ``__other__``. ``unknown``
+    and ``__other__`` ride free (absence/overflow values must never
+    consume budget)."""
     global _fold_generation, _fold_jobs
     job = str(job)
     if job == _principal.UNKNOWN or job == OTHER_JOB:
@@ -86,7 +106,7 @@ def fold_job(job: str, registry=None) -> str:
             _fold_jobs = set()
         if job in _fold_jobs:
             return job
-        if len(_fold_jobs) < MAX_JOBS:
+        if len(_fold_jobs) < _max_jobs:
             _fold_jobs.add(job)
             return job
         return OTHER_JOB

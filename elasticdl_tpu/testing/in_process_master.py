@@ -34,6 +34,8 @@ class InProcessMaster:
         self.last_generation = -1
         # Resize-directive passthrough, same contract as MasterClient.
         self.pending_resize = None
+        # Job-scoped lease echo, same contract as MasterClient.
+        self.last_job = ""
 
     def rebind(self, servicer):
         """Point at a recovered master (chaos master-kill restart seam
@@ -80,15 +82,18 @@ class InProcessMaster:
         resp = self._call("get_task", request)
         self.pending_resize = resp.get("resize")
         task = Task.from_dict(resp["task"]) if resp.get("task") else None
+        if task is not None:
+            self.last_job = str(resp.get("job", "") or "")
         return task, bool(resp.get("finished"))
 
     def report_task_result(self, task_id: int, err_reason: str = "",
-                           metrics=None) -> bool:
+                           metrics=None, job=None) -> bool:
         request = {
             "task_id": task_id,
             "err_reason": err_reason,
             "worker_id": self._worker_id,
             "generation": self.last_generation,
+            "job": self.last_job if job is None else str(job),
         }
         if metrics:
             request["metrics"] = metrics

@@ -54,6 +54,11 @@ class MasterClient:
         # the next task boundary and acks via report_resize. Tracks the
         # LATEST offer; absent from a response = none pending for us.
         self.pending_resize = None
+        # Job-scoped lease (master/scheduler.py): in multi-job mode the
+        # lease carries the job id and the report must echo it, so it
+        # routes to the dispatcher that issued it even after this
+        # worker is rebound to another gang. "" = single-job plane.
+        self.last_job = ""
 
     @staticmethod
     def _wait_any_ready(addrs, connect_timeout: float,
@@ -125,15 +130,19 @@ class MasterClient:
         self._note_generation(resp)
         self.pending_resize = resp.get("resize")
         task = Task.from_dict(resp["task"]) if resp.get("task") else None
+        if task is not None:
+            self.last_job = str(resp.get("job", "") or "")
         return task, bool(resp.get("finished"))
 
     def report_task_result(self, task_id: int, err_reason: str = "",
-                           metrics: Optional[dict] = None) -> bool:
+                           metrics: Optional[dict] = None,
+                           job: Optional[str] = None) -> bool:
         fields = {
             "task_id": task_id,
             "err_reason": err_reason,
             "worker_id": self._worker_id,
             "generation": self.last_generation,
+            "job": self.last_job if job is None else str(job),
         }
         if metrics:
             # Piggybacked registry snapshot (observability/): the master

@@ -1017,3 +1017,103 @@ def test_failover_drill_standby_mode(tmp_path):
     assert result["zombie"] and result["zombie"]["fenced"]
     assert not result["resize_pending_at_end"]
     assert len(result["downtimes_secs"]) >= 3
+
+
+# ---- gang-scheduler journal plane (ISSUE 17) -----------------------------
+
+
+def _sched_spec(records=8, per_task=4):
+    return {
+        "shards": {"d": [0, records]},
+        "records_per_task": per_task,
+        "num_epochs": 1,
+        "seed": 0,
+    }
+
+
+def _fresh_sched(journal, slots=2):
+    from elasticdl_tpu.master.scheduler import GangScheduler
+    from elasticdl_tpu.observability.registry import MetricsRegistry
+
+    return GangScheduler(
+        slots_fn=lambda: slots, journal=journal,
+        registry=MetricsRegistry(),
+    )
+
+
+def test_sched_records_replay_into_standby_job_table(tmp_path):
+    """The replay carry — the SAME fold the hot standby's continuous
+    replay consumes — must wake with the full job table: a running
+    job, a preempted job with its eviction counted, and a done job.
+    ``restore`` then demotes the in-flight job to preempted (its
+    gang died with the old master; the next tick re-admits it and
+    journals the resume)."""
+    journal = MasterJournal(str(tmp_path / "journal"))
+    journal.open_generation()
+    sched = _fresh_sched(journal)
+    sched.submit("batch", spec=_sched_spec(), priority=1, gang_size=2)
+    sched.tick()
+    sched.submit("urgent", spec=_sched_spec(), priority=9,
+                 gang_size=2)
+    sched.tick()  # preempts batch, admits+runs urgent
+    urgent = sched.dispatcher_of("urgent")
+    while True:
+        task = urgent.get(0)
+        if task is None:
+            break
+        urgent.report(task.task_id, True)
+    sched.tick()  # sweeps urgent to done, resumes batch
+    journal.close()
+    assert check_journal(str(tmp_path / "journal")) == []
+
+    j2 = MasterJournal(str(tmp_path / "journal"))
+    carry = j2.recover_into(make_dispatcher())
+    fold = carry["sched"]
+    assert fold["jobs"]["urgent"]["state"] == "done"
+    assert fold["jobs"]["batch"]["state"] == "running"
+    assert fold["jobs"]["batch"]["preemptions"] == 1
+    assert fold["preemptions"] == 1
+
+    s2 = _fresh_sched(j2)
+    s2.restore(fold)
+    jobs = s2.render()["jobs"]
+    # The replayed running job demotes to preempted (not journaled:
+    # replay must stay idempotent); done stays done.
+    assert jobs["batch"]["state"] == "preempted"
+    assert jobs["urgent"]["state"] == "done"
+    j2.close()
+
+
+def test_fenced_zombie_cannot_mutate_job_table(tmp_path):
+    """A fenced incarnation's submit journals BEFORE the table
+    mutates, so the fence aborts it cleanly: no table entry, no
+    journal record — and the servicer's pre-check turns the same
+    fence into a stale_master response for the RPC plane."""
+    journal_a = MasterJournal(str(tmp_path / "journal"))
+    journal_a.open_generation()
+    sched_a = _fresh_sched(journal_a)
+    sched_a.submit("ok", spec=_sched_spec(), gang_size=1)
+
+    journal_b = MasterJournal(str(tmp_path / "journal"))
+    journal_b.open_generation()  # fences A
+
+    with pytest.raises(JournalFencedError):
+        sched_a.submit("zombie-job", spec=_sched_spec(), gang_size=1)
+    assert "zombie-job" not in sched_a.render()["jobs"]
+
+    servicer = MasterServicer(
+        make_dispatcher(), journal=journal_a,
+        generation=journal_a.generation, scheduler=sched_a,
+    )
+    resp = servicer.submit_job({
+        "job": "zombie-rpc", "spec": _sched_spec(), "gang_size": 1,
+    })
+    assert resp["stale_master"] and not resp["accepted"]
+    assert "zombie-rpc" not in sched_a.render()["jobs"]
+    journal_b.close()
+
+    # The journal's truth: only the pre-fence submit exists.
+    j3 = MasterJournal(str(tmp_path / "journal"))
+    fold = j3.recover_into(make_dispatcher())["sched"]
+    assert set(fold["jobs"]) == {"ok"}
+    j3.close()

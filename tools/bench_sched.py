@@ -1,0 +1,430 @@
+#!/usr/bin/env python
+"""Gang-scheduler + pod-closing-autoscaler benchmark
+(BENCH_SCHED.json).
+
+Two measurements, each with a committed gate (docs/scheduler.md
+"Benchmarks"):
+
+**(a) Fleet utilization: one gang scheduler vs static partitioning.**
+The same back-to-back job mix — two tenants with skewed demand (one
+submits ``HEAVY_JOBS`` gang jobs, the other a single job of the same
+shape) — runs two ways over the same ``SLOTS``-slot fleet:
+
+- *static*: the pre-multi-tenant shape — each tenant owns a fixed
+  half of the fleet; its jobs queue on its own slots while the other
+  half sits idle once its tenant drains.
+- *gang*: ONE ``GangScheduler`` arbitrating the whole fleet; the
+  busy tenant's queue spills onto the idle tenant's slots the moment
+  they free up.
+
+Both sides run the REAL scheduler + dispatcher machinery (static =
+two independent schedulers over disjoint slot halves), one simulated
+task-unit per slot per tick. Utilization = busy slot-ticks over
+total slot-ticks to drain everything. GATE: gang utilization beats
+static.
+
+**(b) Pod-closing autoscaling around a live split/merge.** A real
+2-shard in-process row fleet grown and shrunk by the REAL control
+stack: ``InstanceManager`` (against a fake k8s client whose
+``create_pod``/``delete_pod`` actually start/stop ``HostRowService``
+processes) + ``RowServicePodScaler`` + ``ShardMapController``:
+
+- ``grow()`` spawns a third pod (journal-ordered Service + pod) and
+  live-splits the hottest shard onto it — the map goes to 3 shards
+  with real state behind every address;
+- ``shrink()`` merges the coldest shard back and leaves the pod
+  serving stale routes until the controller's quiescence check
+  retires the slot; the scaler's ``tick()`` then deletes pod +
+  Service via ``drain_row_service_shard``.
+
+GATES: a pod was really created then really deleted (fleet back to
+2 pods, map back to 2 shards), and every row readable after the
+round-trip is byte-identical to before it — growth and drain moved
+routes and state, never corrupted them.
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+from elasticdl_tpu.common.log_utils import get_logger  # noqa: E402
+
+logger = get_logger("bench_sched")
+
+# Part (a): two tenants, skewed demand on one shared fleet.
+SLOTS = 8
+GANG = 4
+TICKS_PER_JOB = 6          # full-gang ticks of work per job
+HEAVY_JOBS = 4             # tenant A's queue; tenant B submits 1
+MAX_TICKS = 2000
+
+# Part (b): the live fleet the pod scaler grows and shrinks.
+TABLE = "bench_sched_rows"
+ROW_DIM = 8
+NUM_ROWS = 512
+RETIRE_COOLDOWN_SECS = 0.3
+RETIRE_WAIT_SECS = 20.0
+
+
+# ---- part (a): utilization ------------------------------------------------
+
+
+def _sim_job_spec(tag: str, idx: int) -> dict:
+    tasks = GANG * TICKS_PER_JOB
+    return {
+        "shards": {f"{tag}{idx}": [0, tasks]},
+        "records_per_task": 1,
+        "num_epochs": 1,
+        "seed": 0,
+    }
+
+
+def _drain(schedulers) -> dict:
+    """Tick-simulate until every scheduler's job table is terminal:
+    each tick, every slot with a lease completes one task-unit.
+    ``schedulers`` = list of (scheduler, worker_ids)."""
+    busy_ticks = 0
+    ticks = 0
+    for _ in range(MAX_TICKS):
+        ticks += 1
+        for sched, _workers in schedulers:
+            sched.tick()
+        busy = 0
+        for sched, workers in schedulers:
+            for w in workers:
+                job_id, disp = sched.lease_for(w)
+                if disp is None:
+                    continue
+                task = disp.get(w)
+                if task is None:
+                    continue
+                disp.report(task.task_id, True)
+                busy += 1
+        busy_ticks += busy
+        done = all(
+            all(e["state"] in ("done", "cancelled")
+                for e in sched.render()["jobs"].values())
+            for sched, _w in schedulers
+        )
+        if done and busy == 0:
+            break
+    return {"ticks": ticks, "busy_slot_ticks": busy_ticks,
+            "utilization": busy_ticks / float(SLOTS * ticks)}
+
+
+def _bench_utilization() -> dict:
+    from elasticdl_tpu.master.scheduler import GangScheduler
+    from elasticdl_tpu.observability.registry import MetricsRegistry
+
+    jobs = (
+        [("a", i, GANG) for i in range(HEAVY_JOBS)]   # busy tenant
+        + [("b", 0, GANG)]                            # light tenant
+    )
+
+    # Static: each tenant boxed into its own half of the fleet.
+    half = SLOTS // 2
+    reg = MetricsRegistry()
+    static_a = GangScheduler(slots_fn=lambda: half, registry=reg)
+    static_b = GangScheduler(slots_fn=lambda: half, registry=reg)
+    for tag, idx, gang in jobs:
+        sched = static_a if tag == "a" else static_b
+        sched.submit(f"{tag}{idx}", spec=_sim_job_spec(tag, idx),
+                     gang_size=min(gang, half))
+    static = _drain([
+        (static_a, range(half)),
+        (static_b, range(half, SLOTS)),
+    ])
+
+    # Gang: one arbiter over the whole fleet.
+    gang_sched = GangScheduler(slots_fn=lambda: SLOTS, registry=reg)
+    for tag, idx, gang in jobs:
+        gang_sched.submit(f"{tag}{idx}", spec=_sim_job_spec(tag, idx),
+                          gang_size=gang)
+    gang = _drain([(gang_sched, range(SLOTS))])
+
+    return {
+        "jobs": len(jobs),
+        "slots": SLOTS,
+        "static": static,
+        "gang": gang,
+        "speedup": (gang["utilization"]
+                    / max(static["utilization"], 1e-9)),
+    }
+
+
+# ---- part (b): pod-closing autoscaling ------------------------------------
+
+
+class _RowServiceK8s:
+    """Fake k8s client that makes pods REAL: ``create_pod`` for a
+    rowservice replica starts an in-process ``HostRowService``;
+    ``delete_pod`` stops it. The instance manager and pod scaler run
+    unmodified against it."""
+
+    def __init__(self):
+        self.ports = {}           # shard -> live port
+        self._services = {}       # shard -> HostRowService
+        self.created = []
+        self.deleted = []
+        self.service_manifests = []
+        self.deleted_services = []
+
+    def _shard_of(self, manifest) -> int:
+        from elasticdl_tpu.platform.k8s_client import (
+            ELASTICDL_REPLICA_INDEX_KEY,
+        )
+
+        return int(
+            manifest["metadata"]["labels"][ELASTICDL_REPLICA_INDEX_KEY]
+        )
+
+    def create_pod(self, manifest):
+        from elasticdl_tpu.embedding.optimizer import (
+            SGD,
+            HostOptimizerWrapper,
+        )
+        from elasticdl_tpu.embedding.row_service import HostRowService
+        from elasticdl_tpu.embedding.table import EmbeddingTable
+        from elasticdl_tpu.platform.k8s_client import (
+            ELASTICDL_REPLICA_TYPE_KEY,
+        )
+
+        labels = manifest["metadata"]["labels"]
+        if labels.get(ELASTICDL_REPLICA_TYPE_KEY) != "rowservice":
+            return
+        shard = self._shard_of(manifest)
+        svc = HostRowService(
+            {TABLE: EmbeddingTable(TABLE, ROW_DIM)},
+            HostOptimizerWrapper(SGD(lr=0.5)),
+        ).start("localhost:0")
+        self._services[shard] = svc
+        self.ports[shard] = svc.port
+        self.created.append(manifest["metadata"]["name"])
+
+    def delete_pod(self, name):
+        self.deleted.append(name)
+        for shard, svc in list(self._services.items()):
+            pod_prefix = name
+            # Pod names embed the shard (``...-rowservice-sN[-gG]``);
+            # match by the shard whose tracked pod this is.
+            if f"-s{shard}" in pod_prefix or (
+                shard == 0 and "-s" not in pod_prefix
+            ):
+                self._services.pop(shard)
+                self.ports.pop(shard, None)
+                try:
+                    svc.stop(0)
+                except Exception:
+                    pass
+        return True
+
+    def create_service(self, manifest):
+        self.service_manifests.append(manifest)
+
+    def delete_service(self, name):
+        self.deleted_services.append(name)
+
+    def stop_all(self):
+        for svc in self._services.values():
+            try:
+                svc.stop(0)
+            except Exception:
+                pass
+
+
+def _bench_pod_closing(workdir: str) -> dict:
+    from elasticdl_tpu.embedding.row_service import make_remote_engine
+    from elasticdl_tpu.embedding.shard_map import NUM_BUCKETS
+    from elasticdl_tpu.master.autoscaler import RowServicePodScaler
+    from elasticdl_tpu.master.instance_manager import InstanceManager
+    from elasticdl_tpu.master.row_reshard import (
+        ReshardPolicy,
+        ShardMapController,
+    )
+    from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+    from elasticdl_tpu.observability.registry import MetricsRegistry
+
+    out = {"problems": []}
+    fake = _RowServiceK8s()
+    manager = InstanceManager(
+        TaskDispatcher({}, shuffle=False), fake,
+        job_name="benchsched", image_name="img",
+        worker_command=lambda w: ["worker"], num_workers=0,
+        row_service_command=lambda s: ["rs"],
+        num_row_service_shards=2,
+    )
+    manager.start_row_service()
+
+    controller = ShardMapController(
+        os.path.join(workdir, "shard_map.json"),
+        policy=ReshardPolicy(
+            # The bench drives split/merge explicitly; silence the
+            # controller's own move policy and keep retirement quick.
+            min_rows_per_tick=10**9,
+            replica_count=0,
+            cooldown_secs=RETIRE_COOLDOWN_SECS,
+        ),
+    )
+    scaler = RowServicePodScaler(
+        controller, manager,
+        address_fn=lambda shard: f"localhost:{fake.ports[shard]}",
+        metrics_registry=MetricsRegistry(),
+    )
+    engine = None
+    try:
+        controller.bootstrap([
+            f"localhost:{fake.ports[0]}", f"localhost:{fake.ports[1]}",
+        ])
+        stride = NUM_BUCKETS // NUM_ROWS
+        ids = np.arange(NUM_ROWS, dtype=np.int64) * stride
+        grads = (
+            (ids[:, None] + np.arange(ROW_DIM)[None, :]) % 32
+        ).astype(np.float32)
+        engine = make_remote_engine(
+            f"localhost:{fake.ports[0]},localhost:{fake.ports[1]}",
+            id_keys={TABLE: "ids"}, retries=6, backoff_secs=0.1,
+        )
+        engine.optimizer.apply_gradients(engine.tables[TABLE],
+                                         ids, grads)
+        before = np.asarray(engine.tables[TABLE].get(ids),
+                            dtype=np.float32).tobytes()
+        out["pods_initial"] = len(manager.row_service_shards())
+
+        grew = scaler.grow()
+        out["grow"] = grew
+        out["map_shards_after_grow"] = len(controller.map.shards)
+        out["pods_after_grow"] = len(manager.row_service_shards())
+        if grew is None:
+            out["problems"].append("grow() did nothing")
+            return out
+        if out["map_shards_after_grow"] != 3:
+            out["problems"].append(
+                f"map has {out['map_shards_after_grow']} shards "
+                "after grow, want 3"
+            )
+        # Reads straddle the moved ranges: clients converge onto the
+        # grown map via redirect, proving real state sits behind the
+        # new pod's address.
+        mid = np.asarray(engine.tables[TABLE].get(ids),
+                         dtype=np.float32).tobytes()
+        if mid != before:
+            out["problems"].append("rows changed across the split")
+
+        shrunk = scaler.shrink()
+        out["shrink"] = shrunk
+        if shrunk is None:
+            out["problems"].append("shrink() did nothing")
+            return out
+        # Converge the client onto the merged map WHILE the drained
+        # pod still serves: its moved ranges answer with a redirect
+        # carrying the new map. After the pod is deleted there is
+        # nobody left at the stale address to redirect from.
+        engine.tables[TABLE].get(ids)
+        # The merged pod keeps serving until the controller proves
+        # quiescence; poll tick + scaler.tick until the drain lands.
+        drained = None
+        deadline = time.monotonic() + RETIRE_WAIT_SECS
+        while time.monotonic() < deadline:
+            controller.tick()
+            drained = scaler.tick()
+            if drained is not None:
+                break
+            time.sleep(RETIRE_COOLDOWN_SECS / 2)
+        out["drained_im_shard"] = drained
+        out["map_shards_final"] = len(controller.map.shards)
+        out["pods_final"] = len(manager.row_service_shards())
+        out["pods_created"] = list(fake.created)
+        out["pods_deleted"] = list(fake.deleted)
+        out["scaler_events"] = list(scaler.events)
+        if drained is None:
+            out["problems"].append(
+                "controller never retired the merged shard; pod "
+                "was not drained"
+            )
+            return out
+        if out["map_shards_final"] != 2:
+            out["problems"].append(
+                f"map has {out['map_shards_final']} shards after "
+                "drain, want 2"
+            )
+        if out["pods_final"] != 2:
+            out["problems"].append(
+                f"{out['pods_final']} pods tracked after drain, "
+                "want 2"
+            )
+        if not fake.deleted:
+            out["problems"].append("no pod was actually deleted")
+        after = np.asarray(engine.tables[TABLE].get(ids),
+                           dtype=np.float32).tobytes()
+        out["rows_intact"] = after == before
+        if not out["rows_intact"]:
+            out["problems"].append(
+                "rows diverged across the grow/shrink round-trip"
+            )
+    finally:
+        if engine is not None:
+            engine.close()
+        controller.close()
+        manager.stop()
+        fake.stop_all()
+    return out
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser("bench_sched")
+    parser.add_argument("--out", default="BENCH_SCHED.json")
+    parser.add_argument("--workdir", default="")
+    args = parser.parse_args(argv)
+
+    workdir = args.workdir or tempfile.mkdtemp(prefix="edl_sched_")
+
+    logger.info("part (a): gang vs static-partition utilization ...")
+    utilization = _bench_utilization()
+    logger.info(
+        "utilization: gang %.3f vs static %.3f (%.2fx)",
+        utilization["gang"]["utilization"],
+        utilization["static"]["utilization"],
+        utilization["speedup"],
+    )
+    logger.info("part (b): pod-closing grow/shrink round-trip ...")
+    pod_closing = _bench_pod_closing(workdir)
+
+    gates = {
+        "gang_beats_static": (
+            utilization["gang"]["utilization"]
+            > utilization["static"]["utilization"]
+        ),
+        "pod_spawned_and_drained": (
+            not pod_closing["problems"]
+            and bool(pod_closing.get("pods_deleted"))
+        ),
+        "rows_intact": bool(pod_closing.get("rows_intact")),
+    }
+    report = {
+        "bench": "sched",
+        "utilization": utilization,
+        "pod_closing": pod_closing,
+        "gates": gates,
+        "passed": all(gates.values()),
+    }
+    with open(args.out, "w") as fh:
+        json.dump(report, fh, indent=2, sort_keys=True, default=str)
+        fh.write("\n")
+    logger.info(
+        "bench_sched: %s (gates %s); report %s",
+        "PASS" if report["passed"] else "FAIL", gates, args.out,
+    )
+    return 0 if report["passed"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

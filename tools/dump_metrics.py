@@ -279,6 +279,57 @@ def fetch_usage(addr: str, top: int, timeout: float = 10.0) -> dict:
         return json.loads(resp.read().decode("utf-8"))
 
 
+def fetch_sched(addr: str, timeout: float = 10.0) -> dict:
+    """The gang scheduler's /sched body (docs/scheduler.md): job
+    table, slot allocation, fair-share vs consumed usage share,
+    preemption counts."""
+    with urllib.request.urlopen(
+        sibling_url(addr, "/sched"), timeout=timeout
+    ) as resp:
+        return json.loads(resp.read().decode("utf-8"))
+
+
+def print_sched(sched: dict, out=None):
+    """The job table: one row per job with lifecycle state, gang vs
+    allocated slots, fair-share target vs actually-consumed usage
+    share, and preemption counts."""
+    out = out if out is not None else sys.stdout
+    jobs = sched.get("jobs") or {}
+    if sched.get("error") or not jobs:
+        out.write(
+            f"no scheduler data ({sched.get('error', 'no jobs')};"
+            " master needs --sched)\n"
+        )
+        return
+    slots = sched.get("slots") or {}
+    out.write(
+        f"slots: {slots.get('allocated', 0)}/{slots.get('total', 0)} "
+        f"allocated, {sched.get('preemptions', 0)} preemption(s) "
+        "total\n\n"
+    )
+    out.write(
+        f"{'job':<16} {'state':<10} {'prio':>4} {'gang':>4} "
+        f"{'alloc':>5} {'bound':>5} {'todo':>5} {'doing':>5} "
+        f"{'preempt':>7} {'fair%':>6} {'used%':>6}\n"
+    )
+    order = sorted(
+        jobs.items(),
+        key=lambda kv: (-int(kv[1].get("priority", 0)), kv[0]),
+    )
+    for job, row in order:
+        out.write(
+            f"{job:<16} {row.get('state', ''):<10} "
+            f"{row.get('priority', 0):>4} "
+            f"{row.get('gang_size', 0):>4} "
+            f"{row.get('allocated_slots', 0):>5} "
+            f"{row.get('bound_workers', 0):>5} "
+            f"{row.get('todo', 0):>5} {row.get('doing', 0):>5} "
+            f"{row.get('preemptions', 0):>7} "
+            f"{100.0 * float(row.get('fair_share', 0)):>5.1f}% "
+            f"{100.0 * float(row.get('usage_share', 0)):>5.1f}%\n"
+        )
+
+
 def _fmt_bytes(n: float) -> str:
     for unit in ("B", "KiB", "MiB", "GiB"):
         if abs(n) < 1024.0 or unit == "GiB":
@@ -421,6 +472,15 @@ def dump_once(args) -> int:
             return 1
         sys.stdout.write("\n---- usage ----\n")
         print_usage(usage)
+    if args.sched:
+        try:
+            sched = fetch_sched(args.addr, timeout=args.timeout)
+        except OSError as exc:
+            print(f"sched fetch failed: {exc} (the master serves "
+                  "/sched only with --sched)", file=sys.stderr)
+            return 1
+        sys.stdout.write("\n---- sched ----\n")
+        print_sched(sched)
     if args.profile is not None:
         try:
             profile = fetch_profile(
@@ -456,6 +516,11 @@ def main(argv=None) -> int:
     parser.add_argument("--usage_top", type=int, default=5,
                         help="Top-K principals per shard in the "
                              "--usage view")
+    parser.add_argument("--sched", action="store_true",
+                        help="Also fetch /sched and print the gang "
+                             "scheduler's job table (state, gang vs "
+                             "allocated slots, fair-share vs consumed "
+                             "usage, preemptions)")
     parser.add_argument("--profile", default=None, metavar="COMPONENT",
                         help="Also fetch /profile for this component "
                              "('' = the master itself, '3' = worker "

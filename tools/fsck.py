@@ -21,6 +21,7 @@ dispatches by artifact signature:
 - ``alert.json``                     → check_incident (SLO bundles)
 - ``shard_map.json``                 → check_reshard (authority state)
 - ``USAGE_DRILL.json``               → check_usage (attribution drill)
+- ``SCHED_DRILL.json``               → check_sched (gang-sched drill)
 
 Exits nonzero if any validator fails. A root with no artifacts passes
 (there is nothing to corrupt). Importable: ``run_fsck(root)``.
@@ -58,6 +59,10 @@ def _classify(root: str) -> List[Tuple[str, str]]:
         if "USAGE_DRILL.json" in filenames:
             found.append(
                 ("usage", os.path.join(dirpath, "USAGE_DRILL.json"))
+            )
+        if "SCHED_DRILL.json" in filenames:
+            found.append(
+                ("sched", os.path.join(dirpath, "SCHED_DRILL.json"))
             )
         if "MANIFEST.json" in filenames:
             try:
@@ -110,6 +115,7 @@ def run_fsck(root: str) -> Tuple[List[str], dict]:
     from check_journal import check_journal
     from check_pushlog import check_one_log
     from check_reshard import check_reshard
+    from check_sched import check_sched
     from check_store import check_one_store
     from check_usage import check_usage
 
@@ -117,7 +123,7 @@ def run_fsck(root: str) -> Tuple[List[str], dict]:
     errors: List[str] = []
     checked = {"journal": 0, "checkpoint": 0, "store": 0,
                "pushlog": 0, "incident": 0, "reshard": 0,
-               "usage": 0}
+               "usage": 0, "sched": 0}
     for kind, path in artifacts:
         checked[kind] += 1
         try:
@@ -135,6 +141,8 @@ def run_fsck(root: str) -> Tuple[List[str], dict]:
                 errs = check_incident(path)
             elif kind == "usage":
                 errs, _report = check_usage(path)
+            elif kind == "sched":
+                errs, _report = check_sched(path)
             else:  # reshard
                 errs, _report = check_reshard(path)
         except BaseException as exc:
