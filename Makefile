@@ -10,7 +10,7 @@ PY ?= python
 	autoscale-smoke autoscale-bench slo-smoke ckpt-bench ckpt-smoke \
 	tiered-smoke tiered-bench reshard-smoke reshard-bench \
 	profile-smoke failover-smoke failover-bench quake-smoke \
-	usage-smoke sched-smoke sched-bench fsck
+	usage-smoke sched-smoke sched-bench stream-smoke fsck
 
 # Scrape-and-pretty-print a master's /metrics (docs/observability.md).
 METRICS_ADDR ?= localhost:8080
@@ -236,6 +236,24 @@ sched-smoke:
 	&& $(PY) tools/check_sched.py SCHED_DRILL.json; \
 	rc=$$?; rm -rf $$workdir; exit $$rc
 
+# Streaming-ingestion drill (docs/online_learning.md): a live
+# file-tail stream trains through real workers into the real 2-shard
+# row fleet while a worker SIGKILL + row-shard SIGKILL + master crash
+# land in ONE window. Gates: resume from the journaled watermark
+# (never re-ack), read-your-writes for every committed offset across
+# both kills, final rows byte-equal to a kill-free twin, and the
+# streaming tenant surviving a gang-scheduler preemption with a
+# monotone watermark. Report schema-checked by check_stream.py (and
+# fsck's stream kind on every push via the committed
+# STREAM_DRILL.json).
+stream-smoke:
+	workdir=$$(mktemp -d /tmp/edl_stream.XXXXXX); \
+	JAX_PLATFORMS=cpu $(PY) -m elasticdl_tpu.chaos.stream_drill run \
+		--seed $(CHAOS_SEED) --workdir $$workdir \
+		--report STREAM_DRILL.json \
+	&& $(PY) tools/check_stream.py STREAM_DRILL.json; \
+	rc=$$?; rm -rf $$workdir; exit $$rc
+
 # Gang-vs-static utilization + pod-closing autoscale round-trip
 # (docs/scheduler.md "Benchmarks"): one shared arbiter must beat two
 # static fleet halves on the same job mix, and the pod scaler must
@@ -264,7 +282,7 @@ sched-bench:
 # docs/chaos.md.
 CHAOS_SEED ?= 7
 chaos-smoke: tiered-smoke chaos-master-smoke quake-smoke usage-smoke \
-		sched-smoke
+		sched-smoke stream-smoke
 	workdir=$$(mktemp -d /tmp/edl_chaos.XXXXXX); \
 	JAX_PLATFORMS=cpu $(PY) -m elasticdl_tpu chaos run \
 		--seed $(CHAOS_SEED) --workdir $$workdir \

@@ -109,7 +109,7 @@ def _free_ports(n: int) -> List[int]:
 def _serve(args) -> int:
     from elasticdl_tpu.comm.rpc import RpcServer
     from elasticdl_tpu.embedding import row_service as rs_mod
-    from elasticdl_tpu.embedding.optimizer import Adam
+    from elasticdl_tpu.embedding.optimizer import SGD, Adam
     from elasticdl_tpu.embedding.row_service import (
         SERVICE_NAME,
         HostRowService,
@@ -119,9 +119,15 @@ def _serve(args) -> int:
         make_host_table,
     )
 
+    # SGD is for drills whose byte-equality gate compares runs with
+    # DIFFERENT apply interleavings (stream_drill.py): Adam's per-table
+    # step counter makes row state order-dependent even when every row
+    # sees exactly one update.
+    opt = (SGD(lr=0.01) if getattr(args, "optimizer", "adam") == "sgd"
+           else Adam(lr=0.01))
     svc = HostRowService(
         {TABLE: make_host_table(TABLE, DIM)},
-        make_host_optimizer(Adam(lr=0.01)),
+        make_host_optimizer(opt),
     )
     if args.checkpoint_dir:
         svc.configure_checkpoint(
@@ -192,7 +198,8 @@ class RowFleet:
               push_log_dir: str = "", ack: str = "durable",
               group_ms: float = 2.0,
               die_after_migrate_chunks: int = 0,
-              checkpoint_steps: int = CHECKPOINT_STEPS
+              checkpoint_steps: int = CHECKPOINT_STEPS,
+              optimizer: str = "adam",
               ) -> subprocess.Popen:
         cmd = [
             sys.executable, "-m", "elasticdl_tpu.chaos.quake_drill",
@@ -200,6 +207,7 @@ class RowFleet:
             "--checkpoint_steps", str(checkpoint_steps),
             "--push_log_group_ms", str(group_ms),
             "--push_log_ack", ack,
+            "--optimizer", optimizer,
         ]
         if checkpoint_dir:
             cmd += ["--checkpoint_dir", checkpoint_dir]
@@ -916,6 +924,8 @@ def main(argv=None) -> int:
                        choices=["durable", "applied"])
     serve.add_argument("--die_after_migrate_chunks", type=int,
                        default=0)
+    serve.add_argument("--optimizer", default="adam",
+                       choices=["adam", "sgd"])
 
     run = sub.add_parser("run")
     run.add_argument("--workdir", required=True)

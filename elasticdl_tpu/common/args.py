@@ -440,6 +440,30 @@ def add_train_params(parser):
                    "(submit_job RPC + /sched endpoint; job table "
                    "event-sources onto --journal_dir and survives "
                    "failover)")
+    # Streaming ingestion (master/stream_ingest.py + data/stream.py;
+    # docs/online_learning.md): online/continual learning from an
+    # append-only record stream instead of a finite shard table.
+    parser.add_argument("--stream_dir", default="",
+                        help="Directory of *.edlstream append-only "
+                             "partitions (data/stream.py). Non-empty "
+                             "switches the dispatcher to streaming "
+                             "mode: unbounded offset-ranged tasks, "
+                             "journaled watermarks, watermark-"
+                             "triggered eval, /stream endpoint")
+    parser.add_argument("--stream_max_todo", type=pos_int, default=64,
+                        help="Backpressure bound: stop generating "
+                             "stream tasks while the todo queue holds "
+                             "this many (stream_ingest_backpressure_"
+                             "seconds meters the stall)")
+    parser.add_argument("--stream_eval_every_records", type=non_neg_int,
+                        default=0,
+                        help="Open an eval round each time this many "
+                             "stream records commit past the watermark "
+                             "(replaces epoch-end eval in streaming "
+                             "mode; 0 disables)")
+    parser.add_argument("--stream_poll_secs", type=pos_float,
+                        default=0.5,
+                        help="Stream tail poll + pump cadence")
     parser.add_argument("--usage_max_jobs", type=non_neg_int, default=0,
                         help="Distinct job labels the usage plane "
                              "admits before folding new tenants into "

@@ -71,6 +71,7 @@ class ReaderType:
     RECORD_FILE = "RecordFile"
     TEXT = "Text"
     TABLE = "Table"  # row-range table service (ODPS-equivalent)
+    STREAM = "Stream"  # append-only record stream (data/stream.py)
 
 
 class MetricsDictKey:

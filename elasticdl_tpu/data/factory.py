@@ -30,6 +30,17 @@ def create_data_reader(data_origin: str, custom_reader=None, **kwargs):
     reader_type = kwargs.pop("reader_type", None)
     # Table origins (sqlite/csv-table/ODPS) route by URL scheme
     # (reference data_reader_factory.py: ODPS selected by env+path).
+    # Stream origins (data/stream.py): tail of append-only partitions,
+    # selected by scheme or explicit reader_type.
+    if reader_type == ReaderType.STREAM or data_origin.startswith(
+        "stream://"
+    ):
+        from elasticdl_tpu.data.stream import StreamDataReader
+
+        stream_dir = data_origin
+        if stream_dir.startswith("stream://"):
+            stream_dir = stream_dir[len("stream://"):]
+        return StreamDataReader(stream_dir=stream_dir, **kwargs)
     if reader_type == ReaderType.TABLE or data_origin.startswith(
         ("table+sqlite://", "table+csv://", "table+rpc://", "odps://")
     ):

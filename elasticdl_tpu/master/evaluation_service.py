@@ -127,6 +127,12 @@ class EvaluationService:
         self._lock = threading.Lock()
         self._eval_job: Optional[EvaluationJob] = None
         self._last_eval_version = -1
+        # Watermark-based trigger (streaming ingestion,
+        # docs/online_learning.md): rounds open every N committed
+        # stream records instead of every N model versions — a stream
+        # has no epochs, so epoch-end eval never fires there.
+        self._eval_watermark_records = 0
+        self._last_eval_watermark = 0
         self.completed_results: Dict[int, Dict[str, float]] = {}
         self._trigger_thread = None
         self._stop = threading.Event()
@@ -266,6 +272,36 @@ class EvaluationService:
         # versions at a coarser granularity than every step.
         if model_version - max(self._last_eval_version, 0) >= self._eval_steps:
             return self.try_to_create_new_job(model_version)
+        return False
+
+    def configure_watermark_eval(self, every_records: int,
+                                 start_at: int = 0):
+        """Arm the watermark trigger: one eval round per
+        ``every_records`` committed stream records. ``start_at`` seeds
+        the marker (the ingestor passes the recovered committed total
+        so a master restart does not fire a spurious burst)."""
+        with self._lock:
+            self._eval_watermark_records = int(every_records)
+            self._last_eval_watermark = max(
+                self._last_eval_watermark, int(start_at)
+            )
+
+    def add_watermark_eval_if_needed(self, committed_records: int,
+                                     model_version: int = -1) -> bool:
+        """Watermark trigger, called by the stream ingestor's pump as
+        committed watermarks advance (the streaming replacement for
+        epoch-end / step-based triggering). The marker only advances
+        when a round actually opens, so progress made while a previous
+        round is still running re-triggers as soon as it closes."""
+        if self._eval_watermark_records <= 0:
+            return False
+        if (committed_records - self._last_eval_watermark
+                < self._eval_watermark_records):
+            return False
+        if self.try_to_create_new_job(model_version):
+            with self._lock:
+                self._last_eval_watermark = int(committed_records)
+            return True
         return False
 
     def try_to_create_new_job(self, model_version: int) -> bool:

@@ -120,10 +120,19 @@ FENCE = "fence"
 # event-sourced here so it survives failover and replays into the
 # standby exactly like the dispatcher/eval/relaunch planes.
 SCHED = "sched"
+# Streaming-ingestion events (master/stream_ingest.py,
+# docs/online_learning.md): partition registration and offset-ranged
+# task generation. The committed watermark itself rides REPORT records
+# (``stream_partition``/``stream_start``/``stream_end`` stamped by the
+# dispatcher) so offset commit is atomic with task resolution — a
+# crash cannot ack an offset whose task never resolved, and a
+# relaunched pipeline resumes from the journaled watermark, never
+# re-acking.
+STREAM = "stream"
 
 KNOWN_TYPES = (DISPATCH, REPORT, CREATE_TASKS, VERSION, SNAPSHOT,
                GENERATION, RESIZE, SHARD_MAP, EVAL_ROUND, EVAL_FOLD,
-               RELAUNCH, FENCE, SCHED)
+               RELAUNCH, FENCE, SCHED, STREAM)
 
 EVAL_EVENTS = ("open", "close")
 RELAUNCH_KINDS = ("gang", "row_service")
@@ -132,6 +141,11 @@ RELAUNCH_KINDS = ("gang", "row_service")
 # any non-terminal state.
 SCHED_EVENTS = ("submit", "schedule", "run", "preempt", "resume",
                 "done", "cancel")
+# Stream-plane events (ISSUE 18): "register" introduces a partition,
+# "tasks" records one offset-ranged task generation (replay re-enqueues
+# it — stream tasks come from the live tail, not CREATE_TASKS' epoch
+# walk, so the journal is their only deterministic source).
+STREAM_EVENTS = ("register", "tasks")
 
 _HEADER = struct.Struct("<II")  # payload length, crc32(payload)
 
@@ -296,6 +310,86 @@ def apply_relaunch_record(state: dict, record: dict):
         )
 
 
+def new_stream_state() -> dict:
+    """Per-partition ingestion progress: ``next`` (first offset no
+    task has been generated for), ``committed`` (exclusive watermark —
+    every offset below it resolved successfully and durably), and
+    ``pending`` (resolved ranges still ahead of the contiguous
+    committed prefix, {start: end} — tasks complete out of order)."""
+    return {"partitions": {}}
+
+
+def _stream_partition(state: dict, partition: str) -> dict:
+    part = state["partitions"].get(partition)
+    if part is None:
+        part = {"committed": 0, "next": 0, "pending": {}}
+        state["partitions"][partition] = part
+    return part
+
+
+def advance_stream_watermark(part: dict, start: int, end: int):
+    """Fold one successfully-resolved offset range [start, end) into a
+    partition's watermark: record it as pending, then advance
+    ``committed`` across the contiguous resolved prefix. Shared by the
+    dispatcher's live accounting and every journal fold path so the
+    watermark algebra cannot drift. Idempotent for replayed ranges at
+    or below the watermark (recovery re-folds are no-ops)."""
+    start, end = int(start), int(end)
+    if end <= start or end <= int(part["committed"]):
+        return
+    pending = part["pending"]
+    prev = pending.get(start)
+    if prev is None or prev < end:
+        pending[start] = end
+    committed = int(part["committed"])
+    while committed in pending:
+        committed = pending.pop(committed)
+    part["committed"] = committed
+
+
+def apply_stream_record(state: dict, record: dict):
+    """Fold one STREAM event — the ONE fold function shared by live
+    appends (journal-side mirror), the open-generation scan, and
+    replay (same discipline as the eval/relaunch/sched planes)."""
+    partition = str(record.get("partition", ""))
+    part = _stream_partition(state, partition)
+    if record.get("event") == "tasks":
+        part["next"] = max(int(part["next"]), int(record.get("end", 0)))
+
+
+def apply_stream_report_record(state: dict, record: dict):
+    """Fold one REPORT record's offset-commit side effect. The commit
+    rides the REPORT record itself (``stream_*`` fields stamped by the
+    dispatcher) rather than a second append, so a crash between "task
+    resolved" and "watermark advanced" is impossible — they are one
+    fsynced record. A failed or re-queued task commits nothing: its
+    range stays uncommitted until the retry resolves."""
+    partition = record.get("stream_partition")
+    if not partition or not record.get("success") \
+            or record.get("requeued"):
+        return
+    advance_stream_watermark(
+        _stream_partition(state, str(partition)),
+        record.get("stream_start", 0), record.get("stream_end", 0),
+    )
+
+
+def normalize_stream_state(state) -> dict:
+    """Snapshot/json round-trip normalization (pending keys may come
+    back as strings from json-sourced snapshots)."""
+    out = new_stream_state()
+    for partition, part in (state or {}).get("partitions", {}).items():
+        out["partitions"][str(partition)] = {
+            "committed": int(part.get("committed", 0)),
+            "next": int(part.get("next", 0)),
+            "pending": {
+                int(k): int(v)
+                for k, v in (part.get("pending") or {}).items()
+            },
+        }
+    return out
+
+
 def _frame(payload: bytes) -> bytes:
     return _HEADER.pack(len(payload), zlib.crc32(payload)) + payload
 
@@ -348,6 +442,12 @@ def validate_record(record: dict) -> Optional[str]:
             return "report: non-int task_id"
         if not isinstance(record.get("success"), bool):
             return "report: non-bool success"
+        if "stream_partition" in record:
+            if not isinstance(record["stream_partition"], str):
+                return "report: non-str stream_partition"
+            for key in ("stream_start", "stream_end"):
+                if not isinstance(record.get(key), int):
+                    return f"report: non-int {key}"
     elif rtype == CREATE_TASKS:
         if not isinstance(record.get("task_type"), str):
             return "create_tasks: non-str task_type"
@@ -396,6 +496,18 @@ def validate_record(record: dict) -> Optional[str]:
         if (record.get("event") == "submit"
                 and not isinstance(record.get("spec"), dict)):
             return "sched: submit without spec dict"
+    elif rtype == STREAM:
+        if record.get("event") not in STREAM_EVENTS:
+            return f"stream: unknown event {record.get('event')!r}"
+        if not isinstance(record.get("partition"), str) \
+                or not record["partition"]:
+            return "stream: missing partition"
+        if record.get("event") == "tasks":
+            for key in ("start", "end"):
+                if not isinstance(record.get(key), int):
+                    return f"stream: tasks without int {key}"
+            if record["end"] <= record["start"]:
+                return "stream: empty tasks range"
     elif rtype == SNAPSHOT:
         state = record.get("state")
         if not isinstance(state, dict):
@@ -424,6 +536,7 @@ def new_replay_carry() -> dict:
         "eval": new_eval_state(),
         "relaunch": new_relaunch_state(),
         "sched": new_sched_state(),
+        "stream": new_stream_state(),
         "seq": 0,
     }
 
@@ -492,6 +605,23 @@ def apply_replay(dispatcher, records: List[dict],
             apply_sched_record(carry["sched"], record)
             carry["replayed"] += 1
             continue
+        if rtype == STREAM:
+            apply_stream_record(carry["stream"], record)
+            # Stream tasks are generated from the live tail, not the
+            # epoch walk — replay re-enqueues them from the journal so
+            # the subsequent DISPATCH records find the same todo queue
+            # the dead master had.
+            if record.get("event") == "tasks":
+                dispatcher.create_stream_tasks(
+                    record["partition"], record["start"], record["end"],
+                    model_version=record.get("model_version", -1),
+                )
+            else:
+                dispatcher.register_stream_partition(
+                    record["partition"]
+                )
+            carry["replayed"] += 1
+            continue
         if rtype == SNAPSHOT:
             state = record["state"]
             dispatcher.restore_state(state)
@@ -525,6 +655,10 @@ def apply_replay(dispatcher, records: List[dict],
                     },
                     "preemptions": int(sched.get("preemptions", 0)),
                 }
+            if record.get("stream") is not None:
+                carry["stream"] = normalize_stream_state(
+                    record["stream"]
+                )
             # Compaction dropped the pre-snapshot dispatch records;
             # the snapshot's leases and version reports still name the
             # workers this job had.
@@ -571,10 +705,11 @@ def apply_replay(dispatcher, records: List[dict],
                 record["task_id"], record["success"],
                 err_reason=record.get("err_reason", ""),
             )
-            # The eval-completion side effect rides the same record
-            # (atomic with the resolution — a crash cannot separate
-            # them).
+            # The eval-completion and stream-commit side effects ride
+            # the same record (atomic with the resolution — a crash
+            # cannot separate them).
             apply_eval_report_record(carry["eval"], record)
+            apply_stream_report_record(carry["stream"], record)
             carry["replayed"] += 1
     return carry
 
@@ -618,6 +753,7 @@ class MasterJournal:
         self._eval = new_eval_state()
         self._relaunch = new_relaunch_state()
         self._sched = new_sched_state()
+        self._stream = new_stream_state()
         # (last-checked monotonic time, verdict) for is_fenced().
         self._fence_cache = (0.0, False)
 
@@ -684,6 +820,10 @@ class MasterJournal:
                             self._relaunch = record["relaunch"]
                         if record.get("sched") is not None:
                             self._sched = record["sched"]
+                        if record.get("stream") is not None:
+                            self._stream = normalize_stream_state(
+                                record["stream"]
+                            )
                     elif record["t"] == RESIZE:
                         self._pending_resize = _pending_resize_from(
                             record
@@ -691,15 +831,18 @@ class MasterJournal:
                     elif record["t"] in (EVAL_ROUND, EVAL_FOLD):
                         apply_eval_record(self._eval, record)
                     elif record["t"] == REPORT:
-                        # Round progress rides report records — the
-                        # scan must fold it like append/replay do, or
-                        # this incarnation's next snapshot regresses
-                        # the mirrored completed count.
+                        # Round progress and stream commits ride
+                        # report records — the scan must fold them
+                        # like append/replay do, or this incarnation's
+                        # next snapshot regresses the mirrored state.
                         apply_eval_report_record(self._eval, record)
+                        apply_stream_report_record(self._stream, record)
                     elif record["t"] == RELAUNCH:
                         apply_relaunch_record(self._relaunch, record)
                     elif record["t"] == SCHED:
                         apply_sched_record(self._sched, record)
+                    elif record["t"] == STREAM:
+                        apply_stream_record(self._stream, record)
                 size = os.path.getsize(self.path)
                 if size > last_good_end:
                     logger.warning(
@@ -877,14 +1020,18 @@ class MasterJournal:
             elif rtype in (EVAL_ROUND, EVAL_FOLD):
                 apply_eval_record(self._eval, {"t": rtype, **fields})
             elif rtype == REPORT:
-                # Eval-round completion rides the report record (see
-                # apply_eval_report_record) — mirror it here so the
-                # snapshot's eval state carries the progress.
+                # Eval-round completion and stream-offset commits ride
+                # the report record (see apply_eval_report_record /
+                # apply_stream_report_record) — mirror them here so
+                # the snapshot carries the progress.
                 apply_eval_report_record(self._eval, fields)
+                apply_stream_report_record(self._stream, fields)
             elif rtype == RELAUNCH:
                 apply_relaunch_record(self._relaunch, fields)
             elif rtype == SCHED:
                 apply_sched_record(self._sched, fields)
+            elif rtype == STREAM:
+                apply_stream_record(self._stream, {"t": rtype, **fields})
             self._append_locked(rtype, **fields)
             if rtype in (DISPATCH, REPORT):
                 self._since_snapshot += 1
@@ -908,6 +1055,7 @@ class MasterJournal:
             "eval": self._eval,
             "relaunch": self._relaunch,
             "sched": self._sched,
+            "stream": self._stream,
         }
         # Compaction: the snapshot supersedes everything before it, so
         # rewrite the file as [generation fence, snapshot] and keep

@@ -53,6 +53,24 @@ def build_dispatcher(args, spec) -> TaskDispatcher:
     training_data = getattr(args, "training_data", "")
     validation_data = getattr(args, "validation_data", "")
     prediction_data = getattr(args, "prediction_data", "")
+    if getattr(args, "stream_dir", ""):
+        # Streaming mode (master/stream_ingest.py): no finite training
+        # shard table — tasks are generated from the stream tail by the
+        # StreamIngestor, so the dispatcher starts empty and never
+        # finishes until the stream closes. Eval shards still come from
+        # --validation_data; rounds open on watermark progress instead
+        # of epoch end.
+        return TaskDispatcher(
+            training_shards={},
+            evaluation_shards=(
+                reader_of(validation_data).create_shards()
+                if validation_data else {}
+            ),
+            records_per_task=(
+                args.minibatch_size * args.num_minibatches_per_task
+            ),
+            streaming=True,
+        )
     dispatcher = TaskDispatcher(
         training_shards=(
             reader_of(training_data).create_shards()
@@ -186,7 +204,14 @@ class Master:
                 args, "evaluation_start_delay_secs", 0
             ),
             throttle_secs=getattr(args, "evaluation_throttle_secs", 0),
-            eval_only=bool(validation_data and not training_data),
+            # A streaming job trains without --training_data, and its
+            # dispatcher holds the eval shards back for the watermark
+            # trigger — eval_only would open a round whose tasks were
+            # never queued and wedge every later trigger behind it.
+            eval_only=bool(
+                validation_data and not training_data
+                and not getattr(args, "stream_dir", "")
+            ),
             summary_writer=tb_service,
         )
         if self._journal is not None:
@@ -361,6 +386,35 @@ class Master:
                 self.servicer.rearm_resize(
                     self._recovery_stats["resize"]
                 )
+        # Streaming ingestion (master/stream_ingest.py): tail the
+        # --stream_dir partitions into the streaming dispatcher. Built
+        # AFTER the servicer so watermark-triggered eval rounds carry
+        # the live model version, and after recovery so the ingestor's
+        # eval marker seeds from the RESTORED committed watermark (a
+        # relaunch resumes pumping from the journaled cursors — offsets
+        # below the watermark are never re-tasked).
+        self.stream_ingestor = None
+        if getattr(args, "stream_dir", ""):
+            from elasticdl_tpu.data.stream import FileTailStream
+            from elasticdl_tpu.master.stream_ingest import (
+                StreamIngestor,
+            )
+
+            self.stream_ingestor = StreamIngestor(
+                FileTailStream(args.stream_dir),
+                self.task_dispatcher,
+                max_todo=int(getattr(args, "stream_max_todo", 64)),
+                eval_service=self.evaluation_service,
+                eval_every_records=int(
+                    getattr(args, "stream_eval_every_records", 0)
+                ),
+                model_version_fn=lambda: self.servicer.model_version,
+                metrics_registry=self.metrics_plane.registry,
+            )
+            self.metrics_plane.add_json_route(
+                "/stream",
+                lambda params: self.stream_ingestor.render(),
+            )
         self._server = None
         self.instance_manager = None
         self.autoscaler = None
@@ -527,6 +581,12 @@ class Master:
         """Start services: eval trigger, RPC server, worker pods
         (reference Master.prepare, master.py:184-216)."""
         self.evaluation_service.start_time_trigger()
+        if self.stream_ingestor is not None:
+            self.stream_ingestor.start(
+                interval_secs=float(
+                    getattr(self._args, "stream_poll_secs", 0.5)
+                )
+            )
         self._server = RpcServer(
             f"[::]:{self._master_port()}",
             {SERVICE_NAME: self.servicer.handlers()},
@@ -892,6 +952,8 @@ class Master:
         return 0
 
     def stop(self):
+        if self.stream_ingestor is not None:
+            self.stream_ingestor.stop()
         if self.row_reshard is not None:
             self.row_reshard.close()
         self.metrics_plane.stop()

@@ -67,9 +67,21 @@ def default_dispatcher_factory(spec: dict):
     """Build a ``TaskDispatcher`` from a submitted job spec:
     ``{"shards": {name: [start, end]}, "records_per_task": int,
     "num_epochs": int}`` — the portable subset a journal-replayed
-    table can rebuild on any incarnation."""
+    table can rebuild on any incarnation. A streaming job
+    (docs/online_learning.md) declares ``{"stream": true}`` instead of
+    shards: its task queue comes from the live tail, so the rebuilt
+    dispatcher starts empty in streaming mode and the journal's STREAM
+    records / the re-bound ingestor repopulate it."""
     from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
 
+    if spec.get("stream"):
+        return TaskDispatcher(
+            training_shards={},
+            records_per_task=int(spec.get("records_per_task", 1)),
+            shuffle=False,
+            seed=int(spec.get("seed", 0)),
+            streaming=True,
+        )
     shards = {
         str(name): (int(lo), int(hi))
         for name, (lo, hi) in (spec.get("shards") or {}).items()

@@ -21,6 +21,12 @@ from typing import Callable, Dict, List, Optional, Tuple
 from elasticdl_tpu.common.constants import MAX_TASK_RETRIES, TaskType
 from elasticdl_tpu.common.log_utils import get_logger
 from elasticdl_tpu.common.task import Task
+from elasticdl_tpu.master.journal import (
+    _stream_partition,
+    advance_stream_watermark,
+    new_stream_state,
+    normalize_stream_state,
+)
 
 logger = get_logger("task_dispatcher")
 
@@ -63,6 +69,7 @@ class TaskDispatcher:
         shuffle: bool = True,
         seed: int = 0,
         metrics_registry=None,
+        streaming: bool = False,
     ):
         self._lock = threading.Lock()
         self._training_shards = dict(training_shards or {})
@@ -94,6 +101,16 @@ class TaskDispatcher:
         # journaled/exported (a fence only outlives its pod by the
         # grace window, and replay equivalence must not depend on it).
         self._fenced_workers = set()
+        # Streaming-ingestion mode (master/stream_ingest.py,
+        # docs/online_learning.md): tasks come from a live stream tail
+        # instead of the epoch walk, so ``finished`` stays False while
+        # the stream is open and per-partition watermark state rides
+        # this dispatcher's snapshots. The watermark algebra is shared
+        # with the journal's fold functions — one implementation for
+        # live accounting, append-time mirroring, and replay.
+        self._streaming = bool(streaming)
+        self._stream_closed = False
+        self._stream = new_stream_state()
         self.counters = JobCounters()
         # task_id -> (task, worker_id, requeued): the idempotent-report
         # ledger (see RESOLVED_LEDGER_SIZE above). OrderedDict as a
@@ -150,7 +167,11 @@ class TaskDispatcher:
         if self._training_shards:
             self.create_tasks(TaskType.TRAINING)
             self._epochs_todo -= 1
-        elif self._evaluation_shards:
+        elif self._evaluation_shards and not self._streaming:
+            # Streaming jobs hold their eval shards for
+            # watermark-triggered rounds (master/stream_ingest.py) —
+            # auto-queuing them here would run an eval round before
+            # the stream committed anything.
             self.create_tasks(TaskType.EVALUATION)
         elif self._prediction_shards:
             self.create_tasks(TaskType.PREDICTION)
@@ -221,6 +242,90 @@ class TaskDispatcher:
                 Task(shard_name=name, start=0, end=0,
                      type=TaskType.TRAIN_END_CALLBACK)
             )
+
+    # ---- streaming mode (master/stream_ingest.py) ----------------------
+
+    @property
+    def is_streaming(self) -> bool:
+        return self._streaming
+
+    def register_stream_partition(self, partition: str):
+        """Introduce a stream partition (idempotent). Journaled so a
+        recovered master knows the partition set even before its first
+        task lands."""
+        partition = str(partition)
+        with self._lock:
+            self._streaming = True
+            if partition in self._stream["partitions"]:
+                return
+            _stream_partition(self._stream, partition)
+            if self._journal is not None:
+                self._journal.append(
+                    "stream", event="register", partition=partition
+                )
+
+    def create_stream_tasks(self, partition: str, start: int, end: int,
+                            model_version: int = -1) -> int:
+        """Queue offset-ranged TRAINING tasks covering ``[start, end)``
+        of ``partition``, split at ``records_per_task``. One STREAM
+        journal event covers the whole range: stream tasks come from
+        the live tail (not CREATE_TASKS' epoch walk), so replay
+        re-enqueues them from this record and the subsequent DISPATCH
+        records must find the identical todo queue — the split is
+        deterministic in (start, end, records_per_task). Ranges at or
+        below the partition's ``next`` cursor are clipped (idempotent
+        for an ingestor retrying after a lost ack). Returns the number
+        of tasks queued."""
+        partition = str(partition)
+        with self._lock:
+            self._streaming = True
+            part = _stream_partition(self._stream, partition)
+            start = max(int(start), int(part["next"]))
+            end = int(end)
+            if end <= start:
+                return 0
+            tasks = []
+            for begin in range(start, end, self._records_per_task):
+                tasks.append(Task(
+                    shard_name=partition,
+                    start=begin,
+                    end=min(begin + self._records_per_task, end),
+                    type=TaskType.TRAINING,
+                    model_version=int(model_version),
+                    extended_config={"stream": True},
+                ))
+            self._todo.extend(tasks)
+            part["next"] = end
+            if self._journal is not None:
+                self._journal.append(
+                    "stream", event="tasks", partition=partition,
+                    start=int(start), end=int(end),
+                    model_version=int(model_version),
+                )
+            return len(tasks)
+
+    def close_stream(self):
+        """No more stream tasks will be generated: ``finished`` may
+        fire once the queues drain (a drill's clean shutdown, or an
+        operator retiring the streaming job — the gang scheduler's
+        completion sweep then marks the job done)."""
+        with self._lock:
+            self._stream_closed = True
+
+    def stream_progress(self) -> Dict[str, dict]:
+        """Per-partition {committed, next, pending} — ``committed`` is
+        the exclusive watermark: every offset below it resolved
+        successfully AND its REPORT record is fsynced. The ingestor's
+        resume point and the ``/stream`` endpoint's body."""
+        with self._lock:
+            return {
+                p: {
+                    "committed": int(s["committed"]),
+                    "next": int(s["next"]),
+                    "pending": dict(s["pending"]),
+                }
+                for p, s in self._stream["partitions"].items()
+            }
 
     # ---- worker-facing -------------------------------------------------
 
@@ -417,6 +522,26 @@ class TaskDispatcher:
             self._resolved[task_id] = (task, worker_id, requeued)
             while len(self._resolved) > RESOLVED_LEDGER_SIZE:
                 self._resolved.popitem(last=False)
+            stream_fields = {}
+            if (task.extended_config or {}).get("stream"):
+                # Offset commit is atomic with the resolution: the
+                # stream fields ride the same REPORT record (see
+                # journal.apply_stream_report_record), and the live
+                # watermark advances only on success — a requeued or
+                # failed range stays uncommitted until its retry
+                # resolves, so recovery never re-acks.
+                if success:
+                    advance_stream_watermark(
+                        _stream_partition(
+                            self._stream, task.shard_name
+                        ),
+                        task.start, task.end,
+                    )
+                stream_fields = {
+                    "stream_partition": str(task.shard_name),
+                    "stream_start": int(task.start),
+                    "stream_end": int(task.end),
+                }
             if self._journal is not None:
                 # Appended after the mutation completes (still inside
                 # the lock): a snapshot triggered by this append must
@@ -433,6 +558,7 @@ class TaskDispatcher:
                     task_type=str(task.type),
                     model_version=int(task.model_version),
                     requeued=bool(requeued),
+                    **stream_fields,
                 )
             todo_undroppable = [
                 t for t in self._todo
@@ -490,6 +616,12 @@ class TaskDispatcher:
 
     def finished(self) -> bool:
         with self._lock:
+            if self._streaming and not self._stream_closed:
+                # An open stream is never done — the completion sweep
+                # (gang scheduler) and the servicer's finished RPC must
+                # keep the job live even when the tail is momentarily
+                # drained (todo and doing both empty).
+                return False
             remaining = [
                 t for t in self._todo
                 if not (
@@ -589,6 +721,12 @@ class TaskDispatcher:
             # from a never-crashed one under shuffle=True.
             "rng": [int(version), [int(x) for x in internal], gauss],
             "deferred_pending": len(self._deferred_callbacks),
+            # Stream-plane state rides the dispatcher snapshot so
+            # compaction keeps the committed watermarks (the resume
+            # point) without a separate journal mirror lifecycle.
+            "streaming": bool(self._streaming),
+            "stream_closed": bool(self._stream_closed),
+            "stream": self._stream,
         }
 
     def restore_state(self, state: dict):
@@ -625,6 +763,11 @@ class TaskDispatcher:
             rng = state.get("rng")
             if rng:
                 self._rng.setstate((rng[0], tuple(rng[1]), rng[2]))
+            self._streaming = bool(
+                state.get("streaming", self._streaming)
+            )
+            self._stream_closed = bool(state.get("stream_closed", False))
+            self._stream = normalize_stream_state(state.get("stream"))
             if state.get("deferred_pending", 0) == 0:
                 # The pre-crash dispatcher had already fired its
                 # deferred callbacks (train-end task created); firing

@@ -50,6 +50,7 @@ PURPOSES = (
     "replay",
     "checkpoint",
     "control",
+    "streaming_ingest",
 )
 UNKNOWN = "unknown"
 

@@ -258,6 +258,25 @@ def default_rules() -> List[SLORule]:
                         "serving reads are going stale "
                         "(docs/observability.md)",
         ),
+        # Streaming watermark stall (master/stream_ingest.py): the
+        # oldest uncommitted stream record aging past 5 minutes means
+        # the train→serve loop is open — workers are not resolving
+        # stream tasks (fleet dead/lagging, backpressure wedge, or a
+        # master that stopped pumping). docs/online_learning.md.
+        SLORule(
+            name="stream-watermark-stall",
+            kind=THRESHOLD,
+            series="edl_tpu_stream_ingest_watermark_lag_seconds",
+            aggregation="max",
+            op=">",
+            value=300.0,
+            window_secs=300.0,
+            min_count=5,
+            description="a stream partition's committed watermark has "
+                        "lagged the tail by >5 minutes across the "
+                        "window: online learning has stalled "
+                        "(docs/online_learning.md)",
+        ),
         # Gang-scheduler starvation (master/scheduler.py): submitted
         # jobs should either schedule or preempt their way in within
         # an arbitration window. The mean of the submitted-state gauge

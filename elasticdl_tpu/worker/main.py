@@ -91,7 +91,25 @@ def build_worker(args, master_client=None) -> Worker:
         data_origin=data_origin,
         custom_reader=spec.custom_data_reader,
         **reader_params,
-    )
+    ) if data_origin else None
+    stream_dir = getattr(args, "stream_dir", "")
+    if stream_dir:
+        # Streaming job (docs/online_learning.md): stream-tagged tasks
+        # read the live tail; any batch reader built above becomes the
+        # fallback for watermark-triggered eval tasks.
+        from elasticdl_tpu.data.stream import StreamDataReader
+
+        reader = StreamDataReader(
+            stream_dir=stream_dir, fallback=reader
+        )
+    elif reader is None:
+        # Preserve the historical default: an origin-less worker gets a
+        # record-file reader that fails at first read, not at boot.
+        reader = create_data_reader(
+            data_origin="",
+            custom_reader=spec.custom_data_reader,
+            **reader_params,
+        )
     step_runner = None
     if args.distribution_strategy == DistributionStrategy.MESH:
         from elasticdl_tpu.parallel.mesh import make_mesh, parse_mesh_args

@@ -302,6 +302,12 @@ def test_usage_exposition_golden_file():
         usage.meter_lock_hold(who, 0.002)
         usage.meter_fsync_wait(who, 0.004)
         usage.meter_cold_fault(who, 8, 0.001)
+        # The streaming ingestion purpose (closed-enum member since
+        # the stream plane landed) renders like any other.
+        streamer = principal.Principal(
+            "tenant-a", "master", "streaming_ingest"
+        )
+        usage.meter_request(streamer, "Master.report_task_result", 0.002)
         text = render_prometheus(reg.snapshot())
     golden = (
         pathlib.Path(__file__).parent / "golden"

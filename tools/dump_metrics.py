@@ -403,6 +403,52 @@ def print_usage(usage: dict, out=None):
             )
 
 
+def fetch_stream(addr: str, timeout: float = 10.0) -> dict:
+    """The streaming-ingestion plane's /stream body
+    (docs/online_learning.md): per-partition watermarks, lag, and
+    backpressure."""
+    with urllib.request.urlopen(
+        sibling_url(addr, "/stream"), timeout=timeout
+    ) as resp:
+        return json.loads(resp.read().decode("utf-8"))
+
+
+def print_stream(stream: dict, out=None):
+    """One row per partition: appended end vs generated cursor vs
+    committed watermark, lag in records and seconds, pending
+    (in-flight) ranges; then the ingestor's backpressure totals."""
+    out = out if out is not None else sys.stdout
+    partitions = stream.get("partitions") or {}
+    if stream.get("error") or not partitions:
+        out.write(
+            f"no stream data ({stream.get('error', 'no partitions')};"
+            " master needs --stream_dir)\n"
+        )
+        return
+    out.write(
+        f"{'partition':<16} {'end':>8} {'next':>8} {'committed':>9} "
+        f"{'pending':>7} {'lag':>8} {'lag_secs':>8}\n"
+    )
+    for partition in sorted(partitions):
+        row = partitions[partition]
+        out.write(
+            f"{partition:<16} {row.get('end', 0):>8} "
+            f"{row.get('next', 0):>8} {row.get('committed', 0):>9} "
+            f"{row.get('pending_ranges', 0):>7} "
+            f"{row.get('lag_records', 0):>8} "
+            f"{float(row.get('watermark_lag_seconds', 0.0)):>8.2f}\n"
+        )
+    out.write(
+        f"\nbackpressure: "
+        f"{'YES' if stream.get('backpressured') else 'no'} now, "
+        f"{float(stream.get('backpressure_seconds', 0.0)):.2f}s total "
+        f"(max_todo {stream.get('max_todo', 0)})\n"
+    )
+    every = int(stream.get("eval_every_records", 0) or 0)
+    if every:
+        out.write(f"watermark eval: every {every} records\n")
+
+
 def print_alerts(alerts: dict, out=None):
     """One line per rule: state, value, human detail."""
     out = out if out is not None else sys.stdout
@@ -481,6 +527,15 @@ def dump_once(args) -> int:
             return 1
         sys.stdout.write("\n---- sched ----\n")
         print_sched(sched)
+    if args.stream:
+        try:
+            stream = fetch_stream(args.addr, timeout=args.timeout)
+        except OSError as exc:
+            print(f"stream fetch failed: {exc} (the master serves "
+                  "/stream only with --stream_dir)", file=sys.stderr)
+            return 1
+        sys.stdout.write("\n---- stream ----\n")
+        print_stream(stream)
     if args.profile is not None:
         try:
             profile = fetch_profile(
@@ -521,6 +576,11 @@ def main(argv=None) -> int:
                              "scheduler's job table (state, gang vs "
                              "allocated slots, fair-share vs consumed "
                              "usage, preemptions)")
+    parser.add_argument("--stream", action="store_true",
+                        help="Also fetch /stream and print the "
+                             "streaming-ingestion watermark table "
+                             "(per-partition end/next/committed, lag, "
+                             "backpressure)")
     parser.add_argument("--profile", default=None, metavar="COMPONENT",
                         help="Also fetch /profile for this component "
                              "('' = the master itself, '3' = worker "

@@ -22,6 +22,7 @@ dispatches by artifact signature:
 - ``shard_map.json``                 → check_reshard (authority state)
 - ``USAGE_DRILL.json``               → check_usage (attribution drill)
 - ``SCHED_DRILL.json``               → check_sched (gang-sched drill)
+- ``STREAM_DRILL.json``              → check_stream (streaming drill)
 
 Exits nonzero if any validator fails. A root with no artifacts passes
 (there is nothing to corrupt). Importable: ``run_fsck(root)``.
@@ -63,6 +64,11 @@ def _classify(root: str) -> List[Tuple[str, str]]:
         if "SCHED_DRILL.json" in filenames:
             found.append(
                 ("sched", os.path.join(dirpath, "SCHED_DRILL.json"))
+            )
+        if "STREAM_DRILL.json" in filenames:
+            found.append(
+                ("stream",
+                 os.path.join(dirpath, "STREAM_DRILL.json"))
             )
         if "MANIFEST.json" in filenames:
             try:
@@ -117,13 +123,14 @@ def run_fsck(root: str) -> Tuple[List[str], dict]:
     from check_reshard import check_reshard
     from check_sched import check_sched
     from check_store import check_one_store
+    from check_stream import check_stream
     from check_usage import check_usage
 
     artifacts = _classify(root)
     errors: List[str] = []
     checked = {"journal": 0, "checkpoint": 0, "store": 0,
                "pushlog": 0, "incident": 0, "reshard": 0,
-               "usage": 0, "sched": 0}
+               "usage": 0, "sched": 0, "stream": 0}
     for kind, path in artifacts:
         checked[kind] += 1
         try:
@@ -143,6 +150,8 @@ def run_fsck(root: str) -> Tuple[List[str], dict]:
                 errs, _report = check_usage(path)
             elif kind == "sched":
                 errs, _report = check_sched(path)
+            elif kind == "stream":
+                errs, _report = check_stream(path)
             else:  # reshard
                 errs, _report = check_reshard(path)
         except BaseException as exc:
