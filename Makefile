@@ -10,7 +10,7 @@ PY ?= python
 	autoscale-smoke autoscale-bench slo-smoke ckpt-bench ckpt-smoke \
 	tiered-smoke tiered-bench reshard-smoke reshard-bench \
 	profile-smoke failover-smoke failover-bench quake-smoke \
-	usage-smoke sched-smoke sched-bench stream-smoke fsck
+	usage-smoke sched-smoke sched-bench stream-smoke probe-smoke fsck
 
 # Scrape-and-pretty-print a master's /metrics (docs/observability.md).
 METRICS_ADDR ?= localhost:8080
@@ -254,6 +254,18 @@ stream-smoke:
 	&& $(PY) tools/check_stream.py STREAM_DRILL.json; \
 	rc=$$?; rm -rf $$workdir; exit $$rc
 
+# Synthetic-probe drill (docs/observability.md "Synthetic probing"):
+# kill a row shard, SIGSTOP the serving replica, and crash the master
+# in separate windows — each must red the MATCHING black-box probe
+# within the tick bound while a kill-free twin stays 100% green.
+probe-smoke:
+	workdir=$$(mktemp -d /tmp/edl_probe.XXXXXX); \
+	JAX_PLATFORMS=cpu $(PY) -m elasticdl_tpu.chaos.probe_drill \
+		--seed $(CHAOS_SEED) --workdir $$workdir \
+		--report PROBE_DRILL.json \
+	&& $(PY) tools/check_probe.py PROBE_DRILL.json; \
+	rc=$$?; rm -rf $$workdir; exit $$rc
+
 # Gang-vs-static utilization + pod-closing autoscale round-trip
 # (docs/scheduler.md "Benchmarks"): one shared arbiter must beat two
 # static fleet halves on the same job mix, and the pod scaler must
@@ -282,7 +294,7 @@ sched-bench:
 # docs/chaos.md.
 CHAOS_SEED ?= 7
 chaos-smoke: tiered-smoke chaos-master-smoke quake-smoke usage-smoke \
-		sched-smoke stream-smoke
+		sched-smoke stream-smoke probe-smoke
 	workdir=$$(mktemp -d /tmp/edl_chaos.XXXXXX); \
 	JAX_PLATFORMS=cpu $(PY) -m elasticdl_tpu chaos run \
 		--seed $(CHAOS_SEED) --workdir $$workdir \

@@ -472,6 +472,27 @@ def add_train_params(parser):
                              "Raise on legitimately multi-job fleets "
                              "(--sched) so every tenant keeps its own "
                              "usage series")
+    # Synthetic probing (observability/prober.py;
+    # docs/observability.md "Synthetic probing"): black-box canary
+    # probes against the reserved top-of-int64 id range, the repo's
+    # first outside-in SLIs. Served at /probes; /healthz becomes the
+    # aggregated probe verdict (200/503).
+    add_bool_param(parser, "--probes", False,
+                   "Run the synthetic canary prober inside the master "
+                   "(dispatch/row/stream probes auto-wire from the "
+                   "matching flags; serving needs "
+                   "--probe_serving_addr)")
+    parser.add_argument("--probe_interval_secs", type=pos_float,
+                        default=15.0,
+                        help="Cadence for each registered probe")
+    parser.add_argument("--probe_serving_addr", default="",
+                        help="host:port of a serving router; non-empty "
+                             "registers the serving_freshness probe "
+                             "(canary push -> prediction change)")
+    parser.add_argument("--probe_serving_feature_key", default="",
+                        help="Sparse feature key the serving_freshness "
+                             "probe queries with a canary id (empty = "
+                             "'ids')")
 
 
 def add_evaluate_params(parser):

@@ -111,6 +111,34 @@ def build_dispatcher(args, spec) -> TaskDispatcher:
     return dispatcher
 
 
+class _ProberTenantDispatcher:
+    """Dispatcher stand-in for the synthetic-prober tenant
+    (observability/prober.py PROBER_TENANT): the prober consumes no
+    worker leases and never drains — it registers with the gang
+    scheduler only so preemption/resume of the canary plane is
+    arbitrated and observable like any real job. ``finished()`` is
+    always False; _job_finished() cancels the tenant once it is the
+    only job keeping the scheduler busy, so it cannot wedge master
+    exit. Must be passed explicitly at submit() — a dispatcher-less
+    job would be rebuilt from ``default_dispatcher_factory`` at admit
+    time and cancelled as unloadable."""
+
+    def finished(self) -> bool:
+        return False
+
+    def queue_depths(self):
+        return (0, 0)
+
+    def preempt_leases(self, reason: str = "") -> int:
+        return 0
+
+    def get(self, worker_id):
+        return None
+
+    def apply_report(self, task_id, success, err_reason=""):
+        return None, -1, False, True
+
+
 class Master:
     def __init__(self, args, k8s_client=None, warm_state=None):
         """``warm_state`` (the ``--standby`` promotion handover):
@@ -420,6 +448,11 @@ class Master:
         self.autoscaler = None
         self.row_reshard = None
         self.row_pod_scaler = None
+        # Synthetic canary plane (observability/prober.py, --probes):
+        # built in prepare() once the RPC server's port is known — the
+        # probes go through the PUBLIC wire surfaces, including the
+        # master's own.
+        self.prober = None
         self._k8s_client = k8s_client
         # SIGTERM grace path (main() installs the handler): the run
         # loop exits at the next poll tick and stop() tears the job
@@ -592,6 +625,7 @@ class Master:
             {SERVICE_NAME: self.servicer.handlers()},
         ).start()
         logger.info("Master RPC serving on port %d", self._server.port)
+        self._setup_prober()
         metrics_port = int(getattr(self._args, "metrics_port", -1))
         if metrics_port >= 0:
             self.metrics_plane.serve(port=metrics_port)
@@ -851,6 +885,144 @@ class Master:
             scale_up, scale_down,
         )
 
+    def _setup_prober(self):
+        """Synthetic canary plane (--probes; observability/prober.py):
+        black-box probes on intervals against the reserved canary
+        keyspace, every run tagged with the ``canary`` principal
+        purpose. Wired in prepare() because the dispatch probe targets
+        the master's OWN public RPC port. Mounts ``/probes`` and the
+        aggregated ``/healthz`` verdict, and — in --sched mode —
+        registers the prober as a low-priority tenant so it survives
+        and observes preemption."""
+        args = self._args
+        if not getattr(args, "probes", False):
+            return
+        from elasticdl_tpu.observability import prober as prober_mod
+
+        interval = float(
+            getattr(args, "probe_interval_secs", 15.0) or 15.0
+        )
+        recorder = (
+            self.metrics_plane.slo.incident_recorder
+            if self.metrics_plane.slo is not None else None
+        )
+        sched = prober_mod.ProbeScheduler(
+            registry=self.metrics_plane.registry,
+            incident_recorder=recorder,
+        )
+        # Dispatch plane: through the wire, like a worker would.
+        # worker_id -1 records no liveness; a leased task hands
+        # straight back under the graceful "preempted:" reason (no
+        # retry budget burned).
+        sched.register(
+            "dispatch_roundtrip",
+            prober_mod.make_dispatch_roundtrip_probe(
+                f"localhost:{self._server.port}"
+            ),
+            interval_secs=interval,
+            description="get_task/report_task_result roundtrip "
+                        "against the master's dispatch plane",
+        )
+        # Row tier: read-your-writes + fresh-client reshard
+        # convergence whenever a row-service fleet is addressable.
+        row_addr = getattr(args, "row_service_addr", "") or (
+            self._row_service_addr()
+            if self._k8s_client is not None and self._uses_row_service()
+            else ""
+        )
+        if row_addr:
+            canary_client = prober_mod.RowCanaryClient(row_addr)
+            sched.register(
+                "row_ryw",
+                prober_mod.make_row_ryw_probe(canary_client),
+                interval_secs=interval,
+                description="durable canary push -> immediate pull "
+                            "against the row tier (read-your-writes, "
+                            "RPO=0 from outside)",
+            )
+            sched.register(
+                "reshard_convergence",
+                prober_mod.make_reshard_convergence_probe(row_addr),
+                interval_secs=interval,
+                description="fresh client (no cached map) rides "
+                            "REDIRECTs to a converged canary pull",
+            )
+            serving_addr = getattr(args, "probe_serving_addr", "")
+            if serving_addr:
+                feature_key = (
+                    getattr(args, "probe_serving_feature_key", "")
+                    or "ids"
+                )
+                canary = prober_mod.canary_id(1)
+                predict = prober_mod.make_router_predictor(
+                    serving_addr, feature_key, [canary]
+                )
+
+                def push_canary(sign, _client=canary_client,
+                                _id=canary):
+                    import numpy as np
+
+                    dim = _client.dim()
+                    _client.push(
+                        np.array([_id], np.int64),
+                        np.full((1, dim), sign * 1e-3, np.float32),
+                    )
+
+                sched.register(
+                    "serving_freshness",
+                    prober_mod.make_serving_freshness_probe(
+                        predict, push_canary
+                    ),
+                    interval_secs=interval,
+                    description="canary push -> serving router "
+                                "prediction change (outside-in "
+                                "push-to-servable)",
+                )
+        if getattr(args, "stream_dir", "") and \
+                self.stream_ingestor is not None:
+            append = prober_mod.make_stream_appender(args.stream_dir)
+
+            def canary_watermark():
+                part = self.stream_ingestor.render()["partitions"].get(
+                    prober_mod.CANARY_STREAM_PARTITION
+                )
+                return None if part is None else int(part["committed"])
+
+            sched.register(
+                "stream_watermark",
+                prober_mod.make_stream_watermark_probe(
+                    append, canary_watermark
+                ),
+                interval_secs=interval,
+                description="canary stream append -> committed "
+                            "watermark advances past it",
+            )
+        if self.scheduler is not None:
+            tenant = prober_mod.PROBER_TENANT
+            tenant_disp = _ProberTenantDispatcher()
+            try:
+                self.scheduler.submit(
+                    tenant, spec={"synthetic": True}, priority=-100,
+                    gang_size=1, dispatcher=tenant_disp,
+                    preempt_cb=sched.note_preempted,
+                    resume_cb=sched.note_resumed,
+                )
+            except ValueError:
+                # Already in the journal-restored table (recovery):
+                # re-bind the volatile half only.
+                self.scheduler.bind_job(
+                    tenant, dispatcher=tenant_disp,
+                    preempt_cb=sched.note_preempted,
+                    resume_cb=sched.note_resumed,
+                )
+            sched.note_registered()
+        self.metrics_plane.add_json_route(
+            "/probes", lambda params: sched.render()
+        )
+        self.metrics_plane.set_health(sched.healthz)
+        sched.start(poll_secs=min(1.0, max(0.05, interval / 4.0)))
+        self.prober = sched
+
     def request_stop(self):
         """Ask the run loop to exit at the next tick (SIGTERM path).
         Signal-handler safe: sets a flag, no locks, no teardown here."""
@@ -863,7 +1035,23 @@ class Master:
         fleet up."""
         if not self.task_dispatcher.finished():
             return False
-        return self.scheduler is None or self.scheduler.idle()
+        if self.scheduler is None:
+            return True
+        if not self.scheduler.idle() and self.prober is not None:
+            # The prober tenant never drains by design. When it is the
+            # ONLY job still non-terminal, the real work is done:
+            # retire the canary tenant so it cannot wedge master exit.
+            from elasticdl_tpu.master.scheduler import TERMINAL_STATES
+            from elasticdl_tpu.observability.prober import PROBER_TENANT
+
+            jobs = self.scheduler.export_state()["jobs"]
+            open_jobs = [
+                job_id for job_id, job in jobs.items()
+                if job["state"] not in TERMINAL_STATES
+            ]
+            if open_jobs == [PROBER_TENANT]:
+                self.scheduler.cancel(PROBER_TENANT)
+        return self.scheduler.idle()
 
     def run(self, poll_secs: float = 5.0):
         """Sleep until the dispatcher drains (reference master.py:218-238);
@@ -952,6 +1140,10 @@ class Master:
         return 0
 
     def stop(self):
+        if self.prober is not None:
+            # Before the metrics plane: a probe red landing mid-teardown
+            # must not race the incident recorder's flush.
+            self.prober.stop()
         if self.stream_ingestor is not None:
             self.stream_ingestor.stop()
         if self.row_reshard is not None:

@@ -299,6 +299,9 @@ class MetricsPlane:
         # merged into _json_routes() and live-added to an already
         # started server.
         self._extra_routes: Dict[str, Callable] = {}
+        # /healthz verdict callable (observability/prober.py healthz):
+        # None keeps the exposition layer's static "ok" liveness body.
+        self._health_fn: Optional[Callable[[], dict]] = None
 
     # ---- ingest / render ----------------------------------------------
 
@@ -476,6 +479,15 @@ class MetricsPlane:
         if self._http is not None:
             self._http._json_routes[str(path)] = fn
 
+    def set_health(self, fn: Optional[Callable[[], dict]]):
+        """Mount the aggregated ``/healthz`` verdict (a zero-arg
+        callable returning a dict with an ``ok`` key — unhealthy
+        serves HTTP 503). Live on an already-running server, like
+        ``add_json_route``."""
+        self._health_fn = fn
+        if self._http is not None:
+            self._http.set_health(fn)
+
     def usage(self, top_k: int = 5) -> dict:
         """The ``/usage`` body (also callable in-process: drills and
         tests read it without HTTP)."""
@@ -492,6 +504,7 @@ class MetricsPlane:
             traces=self.render_traces,
             json_routes=self._json_routes(),
             render_openmetrics=self.render_openmetrics,
+            health=self._health_fn,
         ).start()
         return self._http
 

@@ -184,6 +184,13 @@ class _Handler(BaseHTTPRequestHandler):
     # SLO plane mounts /timeseries and /alerts without this module
     # knowing either (docs/observability.md).
     json_routes: Dict[str, Callable[[dict], object]] = {}
+    # Mutable holder {"fn": callable or None}: when set, /healthz
+    # serves fn()'s JSON verdict with HTTP 200/503 on its "ok" key —
+    # how the synthetic-probe plane (observability/prober.py) turns
+    # the static liveness endpoint into an aggregated readiness
+    # verdict. Holder (not a bare callable) so it can be mounted on a
+    # server that already started, like json_routes.
+    health: Dict[str, Optional[Callable[[], dict]]] = {}
 
     def _reply(self, body: bytes, content_type: str):
         self.send_response(200)
@@ -227,7 +234,24 @@ class _Handler(BaseHTTPRequestHandler):
                 return
             self._reply(body, "application/json")
         elif path == "/healthz":
-            self._reply(b"ok\n", "text/plain; charset=utf-8")
+            health_fn = type(self).health.get("fn")
+            if health_fn is None:
+                self._reply(b"ok\n", "text/plain; charset=utf-8")
+                return
+            try:
+                verdict = health_fn()
+            except Exception as exc:
+                self.send_error(500, f"{type(exc).__name__}: {exc}")
+                return
+            body = json.dumps(verdict).encode("utf-8")
+            # An unhealthy verdict must be machine-visible from the
+            # status line alone (load balancers, kubelet probes).
+            status = 200 if verdict.get("ok", True) else 503
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
         else:
             known = ", ".join(
                 ["/metrics", "/traces", "/healthz"] + sorted(routes)
@@ -252,11 +276,13 @@ class MetricsHTTPServer:
                  json_routes: Optional[
                      Dict[str, Callable[[dict], object]]] = None,
                  render_openmetrics: Optional[
-                     Callable[[], str]] = None):
+                     Callable[[], str]] = None,
+                 health: Optional[Callable[[], dict]] = None):
         self._render = render
         self._render_openmetrics = render_openmetrics
         self._traces = traces
         self._json_routes = dict(json_routes or {})
+        self._health = {"fn": health}
         self._host = host
         self._requested_port = int(port)
         self._httpd: Optional[ThreadingHTTPServer] = None
@@ -274,6 +300,7 @@ class MetricsHTTPServer:
                 if self._traces is not None else None
             ),
             "json_routes": self._json_routes,
+            "health": self._health,
         })
         self._httpd = ThreadingHTTPServer(
             (self._host, self._requested_port), handler
@@ -286,6 +313,11 @@ class MetricsHTTPServer:
         self._thread.start()
         logger.info("/metrics serving on port %d", self.port)
         return self
+
+    def set_health(self, fn: Optional[Callable[[], dict]]):
+        """(Re)mount the /healthz verdict callable — live on a running
+        server (the holder dict is shared by reference)."""
+        self._health["fn"] = fn
 
     @property
     def port(self) -> int:

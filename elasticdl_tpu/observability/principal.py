@@ -51,6 +51,7 @@ PURPOSES = (
     "checkpoint",
     "control",
     "streaming_ingest",
+    "canary",
 )
 UNKNOWN = "unknown"
 

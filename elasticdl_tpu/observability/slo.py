@@ -299,6 +299,39 @@ def default_rules() -> List[SLORule]:
                         "its gang and nothing preempted to admit it "
                         "(docs/scheduler.md)",
         ),
+        # Outside-in SLIs (observability/prober.py): the synthetic
+        # canary plane's black-box probes are the first rules whose
+        # inputs come from OUTSIDE the components — a probe failure
+        # means a user-visible contract broke, whatever the white-box
+        # families claim.
+        SLORule(
+            name="probe-failure-burn",
+            kind=BURN_RATE,
+            series="edl_tpu_probe_attempts_total",
+            bad_series="edl_tpu_probe_failures_total",
+            objective=0.99,
+            long_window_secs=300.0,
+            short_window_secs=60.0,
+            burn_rate_threshold=4.0,
+            min_count=4,
+            description="black-box probe failure ratio burns the "
+                        "outside-in availability budget across probes "
+                        "— a user-visible contract (read-your-writes, "
+                        "push-to-servable, reshard convergence, stream "
+                        "watermark, dispatch) is failing from outside "
+                        "(docs/observability.md 'Synthetic probing')",
+        ),
+        SLORule(
+            name="probe-absent",
+            kind=ABSENCE,
+            series="edl_tpu_probe_attempts_total",
+            staleness_secs=120.0,
+            forget_secs=900.0,
+            description="the prober stopped running: probe attempts "
+                        "went stale, so every outside-in SLI above is "
+                        "blind — treat monitoring loss as an incident, "
+                        "not as green",
+        ),
     ]
 
 

@@ -1,0 +1,471 @@
+"""Synthetic probing plane (observability/prober.py): the probe
+pass/fail state machine, failure-reason labeling, canary-principal
+propagation into usage families, the /probes + /healthz HTTP surface,
+the SLO burn rule over probe failures, incident capture on red
+transitions, the drill checker, and an in-process kill-free twin of
+the probe drill (docs/observability.md "Synthetic probing").
+"""
+
+import json
+import pathlib
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from elasticdl_tpu.comm.rpc import (
+    RpcServer,
+    RpcStub,
+    wait_for_channel_ready,
+)
+from elasticdl_tpu.observability import principal, prober, tracing, usage
+from elasticdl_tpu.observability import registry as registry_mod
+from elasticdl_tpu.observability.prober import (
+    ProbeFailure,
+    ProbeScheduler,
+)
+from elasticdl_tpu.observability.registry import MetricsRegistry
+from tools.check_probe import check_probe
+
+REPO_ROOT = pathlib.Path(__file__).parent.parent
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, secs):
+        self.t += secs
+        return self.t
+
+
+def _family(reg, name):
+    return next(
+        f for f in reg.snapshot()["families"] if f["name"] == name
+    )
+
+
+# ---- state machine -------------------------------------------------------
+
+
+def test_red_needs_consecutive_failures_and_clears_on_success():
+    reg = MetricsRegistry()
+    sched = ProbeScheduler(registry=reg, unhealthy_after=2)
+    verdicts = {"fail": False}
+
+    def probe():
+        if verdicts["fail"]:
+            raise ProbeFailure("timeout", "deadline elapsed")
+        return {"polls": 1}
+
+    sched.register("flaky", probe, interval_secs=0)
+    assert sched.run_once("flaky")["status"] == "green"
+    # One failure is a blip, not an outage.
+    verdicts["fail"] = True
+    assert sched.run_once("flaky")["status"] == "green"
+    assert sched.healthz()["ok"] is True
+    # The second consecutive failure crosses unhealthy_after.
+    assert sched.run_once("flaky")["status"] == "red"
+    verdict = sched.healthz()
+    assert verdict["ok"] is False
+    assert verdict["status"] == "degraded"
+    assert verdict["red"] == ["flaky"]
+    # A single success clears the streak and the verdict.
+    verdicts["fail"] = False
+    assert sched.run_once("flaky")["status"] == "green"
+    assert sched.healthz()["ok"] is True
+    assert sched.render()["probes"]["flaky"]["reds"] == 1
+
+
+def test_never_run_probe_does_not_fail_healthz():
+    sched = ProbeScheduler(registry=MetricsRegistry())
+    sched.register("pending", lambda: None, interval_secs=60)
+    assert sched.healthz()["ok"] is True
+    assert sched.render()["probes"]["pending"]["status"] == "init"
+
+
+# ---- reason labeling -----------------------------------------------------
+
+
+def test_failure_reasons_label_the_failure_family():
+    reg = MetricsRegistry()
+    sched = ProbeScheduler(registry=reg, unhealthy_after=99)
+
+    def fail_timeout():
+        raise ProbeFailure("timeout", "deadline")
+
+    def fail_stale():
+        raise ProbeFailure("stale", "watermark stuck")
+
+    def crash():
+        raise ValueError("probe bug")
+
+    sched.register("a", fail_timeout, interval_secs=0)
+    sched.register("b", fail_stale, interval_secs=0)
+    sched.register("c", crash, interval_secs=0)
+    assert sched.run_once("a")["reason"] == "timeout"
+    assert sched.run_once("b")["reason"] == "stale"
+    # A probe bug must label as "exception", not kill the scheduler.
+    assert sched.run_once("c")["reason"] == "exception"
+    fam = _family(reg, "edl_tpu_probe_failures_total")
+    by_labels = {
+        tuple(s["labels"]): s["value"] for s in fam["series"]
+    }
+    assert by_labels[("a", "timeout")] == 1
+    assert by_labels[("b", "stale")] == 1
+    assert by_labels[("c", "exception")] == 1
+    # An off-vocabulary reason folds to "exception" (bounded axis).
+    sched.register(
+        "d", lambda: (_ for _ in ()).throw(
+            ProbeFailure("weird", "unknown reason")
+        ), interval_secs=0,
+    )
+    assert sched.run_once("d")["reason"] == "exception"
+
+
+# ---- canary principal → usage families -----------------------------------
+
+
+def test_probe_traffic_meters_under_the_canary_purpose():
+    def echo(request):
+        return {"who": principal.current().wire()}
+
+    server = RpcServer(
+        "localhost:0", {"Echo": {"echo": echo}}
+    ).start()
+    fresh = MetricsRegistry()
+    old = registry_mod._DEFAULT
+    registry_mod._DEFAULT = fresh
+    old_gen, old_jobs = usage._fold_generation, usage._fold_jobs
+    usage._fold_generation, usage._fold_jobs = fresh.generation, set()
+    try:
+        channel = wait_for_channel_ready(
+            f"localhost:{server.port}", timeout=10, retries=3
+        )
+        stub = RpcStub(channel, "Echo")
+        seen = {}
+
+        def probe():
+            seen.update(stub.call("echo")["who"])
+
+        sched = ProbeScheduler(registry=MetricsRegistry())
+        sched.register("rpc", probe, interval_secs=0)
+        assert sched.run_once("rpc")["ok"]
+        channel.close()
+        # The handler thread saw the canary principal ambiently...
+        assert seen["job"] == prober.CANARY_JOB
+        assert seen["purpose"] == "canary"
+        # ...and metered the request under it, server-side.
+        fam = _family(fresh, "edl_tpu_usage_requests_total")
+        by_labels = {
+            tuple(s["labels"]): s["value"] for s in fam["series"]
+        }
+        assert by_labels[
+            (prober.CANARY_JOB, "prober", "canary", "Echo.echo")
+        ] == 1
+    finally:
+        registry_mod._DEFAULT = old
+        usage._fold_generation, usage._fold_jobs = old_gen, old_jobs
+        server.stop(0)
+        principal.set_process_principal()
+
+
+# ---- /probes + /healthz over HTTP ----------------------------------------
+
+
+def test_probes_and_healthz_endpoints_serve_the_verdict():
+    from elasticdl_tpu.observability.exposition import (
+        MetricsHTTPServer,
+    )
+
+    reg = MetricsRegistry()
+    sched = ProbeScheduler(registry=reg, unhealthy_after=1)
+    verdicts = {"fail": False}
+
+    def probe():
+        if verdicts["fail"]:
+            raise ProbeFailure("rpc_error", "down")
+
+    sched.register("edge", probe, interval_secs=0)
+    sched.run_once("edge")
+    server = MetricsHTTPServer(
+        render=lambda: "", port=0,
+        json_routes={"/probes": lambda params: sched.render()},
+        health=sched.healthz,
+    ).start()
+    try:
+        base = f"http://localhost:{server.port}"
+        with urllib.request.urlopen(f"{base}/probes") as resp:
+            body = json.loads(resp.read())
+        assert body["job"] == prober.CANARY_JOB
+        assert body["canary_id_base"] == prober.CANARY_ID_BASE
+        assert body["probes"]["edge"]["status"] == "green"
+        with urllib.request.urlopen(f"{base}/healthz") as resp:
+            assert resp.status == 200
+            assert json.loads(resp.read())["ok"] is True
+        # Red verdict must be machine-visible from the status line.
+        verdicts["fail"] = True
+        sched.run_once("edge")
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(f"{base}/healthz")
+        assert err.value.code == 503
+        degraded = json.loads(err.value.read())
+        assert degraded["ok"] is False
+        assert degraded["red"] == ["edge"]
+    finally:
+        server.stop()
+
+
+# ---- SLO burn rule over probe failures -----------------------------------
+
+
+def test_probe_failure_burn_rule_fires_on_failing_probe():
+    from elasticdl_tpu.observability.slo import SLOEngine, default_rules
+    from elasticdl_tpu.observability.timeseries import TimeSeriesStore
+
+    rule = next(
+        r for r in default_rules() if r.name == "probe-failure-burn"
+    )
+    assert rule.series == "edl_tpu_probe_attempts_total"
+    assert rule.bad_series == "edl_tpu_probe_failures_total"
+    clock = FakeClock()
+    store = TimeSeriesStore(cadence_secs=5.0, clock=clock)
+    reg = MetricsRegistry()
+    sched = ProbeScheduler(registry=reg, unhealthy_after=2,
+                           clock=clock)
+    verdicts = {"fail": False}
+
+    def probe():
+        if verdicts["fail"]:
+            raise ProbeFailure("stale", "stuck")
+
+    sched.register("canary", probe, interval_secs=0)
+    engine = SLOEngine(store, rules=[rule], metrics_registry=reg,
+                       clock=clock)
+
+    def sample(runs=2):
+        for _ in range(runs):
+            sched.run_once("canary", now=clock())
+        store.sample({"": (reg.snapshot(), None)}, now=clock())
+        clock.advance(10)
+
+    for _ in range(8):
+        sample()
+    assert engine.evaluate()[0]["firing"] is False
+    # Every probe run failing = 100x the 1% budget: both windows burn.
+    verdicts["fail"] = True
+    for _ in range(8):
+        sample()
+    state = engine.evaluate()[0]
+    assert state["firing"] is True
+    assert engine.firing() == ["probe-failure-burn"]
+
+
+# ---- incident capture on red transition ----------------------------------
+
+
+def test_red_transition_captures_one_bundle_with_trace_id(tmp_path):
+    from elasticdl_tpu.observability.slo import IncidentRecorder
+
+    recorder = IncidentRecorder(str(tmp_path), background=False)
+    tracing.install_recorder(tracing.FlightRecorder(64))
+    try:
+        sched = ProbeScheduler(
+            registry=MetricsRegistry(),
+            incident_recorder=recorder, unhealthy_after=2,
+        )
+        sched.register(
+            "dying",
+            lambda: (_ for _ in ()).throw(
+                ProbeFailure("rpc_error", "shard down")
+            ),
+            interval_secs=0, description="row tier RYW",
+        )
+        sched.run_once("dying")
+        assert recorder.bundles == []
+        sched.run_once("dying")  # red transition
+        assert len(recorder.bundles) == 1
+        with open(
+            pathlib.Path(recorder.bundles[0]) / "alert.json"
+        ) as fh:
+            alert = json.load(fh)["alert"]
+        assert alert["rule"] == "probe-dying"
+        assert alert["probe"] == "dying"
+        assert alert["reason"] == "rpc_error"
+        # The bundle carries the failing RUN's trace id, so the
+        # flight-recorder timeline and probe_seconds exemplars
+        # resolve to the same trace.
+        assert alert["trace_id"]
+        # Staying red captures nothing more: one bundle per outage.
+        sched.run_once("dying")
+        assert len(recorder.bundles) == 1
+    finally:
+        tracing.uninstall_recorder()
+
+
+# ---- checker green/red ---------------------------------------------------
+
+
+def test_check_probe_validates_committed_report(tmp_path):
+    report_path = REPO_ROOT / "PROBE_DRILL.json"
+    errors, report = check_probe(str(report_path))
+    assert errors == []
+    assert report["passed"]
+    good = json.loads(report_path.read_text())
+
+    def tampered(mutate):
+        bad = json.loads(json.dumps(good))
+        mutate(bad)
+        bad_path = tmp_path / "PROBE_DRILL.json"
+        bad_path.write_text(json.dumps(bad))
+        return check_probe(str(bad_path))[0]
+
+    # A twin false positive fails.
+    errs = tampered(lambda r: r["twin"].__setitem__("failures", 1))
+    assert any("false positive" in e for e in errs)
+    # A window that never detected fails.
+    errs = tampered(
+        lambda r: r["faulted"]["windows"][0].__setitem__(
+            "within_bound", False
+        )
+    )
+    assert any("row_shard_kill" in e for e in errs)
+    # A missing incident trace id fails.
+    errs = tampered(
+        lambda r: r["faulted"]["incidents"]["row_ryw"].__setitem__(
+            "trace_id", ""
+        )
+    )
+    assert any("trace id" in e for e in errs)
+    # A drill run outside the reserved keyspace fails.
+    errs = tampered(
+        lambda r: r["config"].__setitem__("canary_id_base", 0)
+    )
+    assert any("canary_id_base" in e for e in errs)
+    # Directory form resolves the conventional file name.
+    assert check_probe(str(tmp_path))[0] != []
+
+
+# ---- in-process drill twin -----------------------------------------------
+
+
+def test_in_process_kill_free_twin_stays_green(tmp_path):
+    """A subprocess-free twin of the probe drill: real row service,
+    real stream master + canary worker, real probes — every tick
+    green, then a master crash reds ONLY the dispatch probe and a
+    relaunch re-greens it."""
+    import socket
+
+    from elasticdl_tpu.chaos.probe_drill import _CanaryWorker
+    from elasticdl_tpu.chaos.stream_drill import _Master
+    from elasticdl_tpu.embedding.optimizer import (
+        SGD,
+        HostOptimizerWrapper,
+    )
+    from elasticdl_tpu.embedding.row_service import HostRowService
+    from elasticdl_tpu.embedding.table import EmbeddingTable
+
+    rows = HostRowService(
+        {"twin_rows": EmbeddingTable("twin_rows", 8)},
+        HostOptimizerWrapper(SGD(lr=0.5)),
+        metrics_registry=MetricsRegistry(),
+    ).start()
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        master_port = s.getsockname()[1]
+    journal_dir = tmp_path / "journal"
+    stream_dir = tmp_path / "stream"
+    journal_dir.mkdir()
+    stream_dir.mkdir()
+    master = _Master(str(journal_dir), str(stream_dir), master_port)
+    worker = _CanaryWorker(f"localhost:{master_port}")
+    worker.start()
+    sched = ProbeScheduler(registry=MetricsRegistry(),
+                           unhealthy_after=2)
+    try:
+        addr = f"localhost:{rows.port}"
+        client = prober.RowCanaryClient(addr)
+        sched.register(
+            "row_ryw",
+            prober.make_row_ryw_probe(
+                client,
+                expect_fn=lambda before, grads: (
+                    before - np.float32(0.5) * grads
+                ),
+            ),
+            interval_secs=0,
+        )
+        sched.register(
+            "reshard_convergence",
+            prober.make_reshard_convergence_probe(addr),
+            interval_secs=0,
+        )
+        holder = {"master": master}
+        append = prober.make_stream_appender(str(stream_dir))
+
+        def watermark():
+            part = holder["master"].ingestor.render()[
+                "partitions"
+            ].get(prober.CANARY_STREAM_PARTITION)
+            return None if part is None else int(part["committed"])
+
+        sched.register(
+            "stream_watermark",
+            prober.make_stream_watermark_probe(
+                append, watermark, deadline_secs=5.0,
+            ),
+            interval_secs=0,
+        )
+        sched.register(
+            "dispatch_roundtrip",
+            prober.make_dispatch_roundtrip_probe(
+                f"localhost:{master_port}"
+            ),
+            interval_secs=0,
+        )
+        probes = ("row_ryw", "reshard_convergence",
+                  "stream_watermark", "dispatch_roundtrip")
+
+        def tick():
+            return {name: sched.run_once(name)["ok"]
+                    for name in probes}
+
+        # Kill-free ticks: all green, zero false positives.
+        for _ in range(3):
+            results = tick()
+            assert all(results.values()), results
+        assert sched.healthz()["ok"] is True
+
+        # Master crash: the dispatch probe reds within 2 ticks; the
+        # row probes stay green (independent surfaces).
+        holder["master"].crash()
+        for _ in range(2):
+            results = tick()
+        assert results["dispatch_roundtrip"] is False
+        assert results["row_ryw"] is True
+        assert results["reshard_convergence"] is True
+        red = sched.healthz()["red"]
+        # stream_watermark may red as collateral (no master = no
+        # commits), but the row tier must stay green.
+        assert "dispatch_roundtrip" in red
+        assert set(red) <= {"dispatch_roundtrip", "stream_watermark"}
+
+        # Same-port journal recovery re-greens the verdict.
+        holder["master"] = master = _Master(
+            str(journal_dir), str(stream_dir), master_port
+        )
+        for _ in range(10):
+            if all(tick().values()) and sched.healthz()["ok"]:
+                break
+        assert sched.healthz()["ok"] is True
+    finally:
+        worker.stop()
+        try:
+            master.shutdown()
+        except Exception:
+            pass
+        rows.stop(0)

@@ -38,7 +38,8 @@ PRINCIPAL_KEYS = ("principal_job", "principal_component",
 # (+ the "unknown" fallback); stdlib-only tools keep their own copy.
 PRINCIPAL_PURPOSES = frozenset((
     "training", "serving_read", "migration", "replica_refresh",
-    "replay", "checkpoint", "control", "streaming_ingest", "unknown",
+    "replay", "checkpoint", "control", "streaming_ingest", "canary",
+    "unknown",
 ))
 
 

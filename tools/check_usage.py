@@ -37,7 +37,7 @@ REPORT_NAME = "USAGE_DRILL.json"
 # (+ the "unknown" fallback); stdlib-only tools keep their own copy.
 PURPOSES = (
     "training", "serving_read", "migration", "replica_refresh",
-    "replay", "checkpoint", "control", "streaming_ingest",
+    "replay", "checkpoint", "control", "streaming_ingest", "canary",
 )
 UNKNOWN = "unknown"
 PURITY_WANT = {

@@ -9,6 +9,7 @@ Usage::
     python tools/dump_metrics.py localhost:8080 --alerts # + /alerts
     python tools/dump_metrics.py localhost:8080 --profile rowservice-0
     python tools/dump_metrics.py localhost:8080 --usage   # + /usage
+    python tools/dump_metrics.py localhost:8080 --probes  # + /probes
     python tools/dump_metrics.py localhost:8080 --watch 5  # live redraw
     make metrics METRICS_ADDR=localhost:8080
 
@@ -449,6 +450,65 @@ def print_stream(stream: dict, out=None):
         out.write(f"watermark eval: every {every} records\n")
 
 
+def fetch_probes(addr: str, timeout: float = 10.0) -> dict:
+    """The synthetic-probe plane's /probes body
+    (docs/observability.md "Synthetic probing"): per-probe status,
+    success ratio, latency, and the last failure."""
+    with urllib.request.urlopen(
+        sibling_url(addr, "/probes"), timeout=timeout
+    ) as resp:
+        return json.loads(resp.read().decode("utf-8"))
+
+
+def print_probes(probes: dict, out=None):
+    """One row per probe: green/red status, success ratio, last
+    latency, consecutive failures, and the last failure's reason —
+    the outside-in view of whether the deployment WORKS."""
+    out = out if out is not None else sys.stdout
+    table = probes.get("probes") or {}
+    if probes.get("error") or not table:
+        out.write(
+            f"no probe data ({probes.get('error', 'none registered')};"
+            " master needs --probes)\n"
+        )
+        return
+    red = sorted(
+        name for name, row in table.items()
+        if row.get("status") == "red"
+    )
+    out.write(
+        f"job {probes.get('job', '')!r} (purpose "
+        f"{probes.get('purpose', '')}), canary ids "
+        f"[{probes.get('canary_id_base', 0)}, +"
+        f"{probes.get('canary_id_span', 0)}); "
+        f"{len(red)}/{len(table)} red"
+        f"{': ' + ', '.join(red) if red else ''}\n\n"
+    )
+    out.write(
+        f"{'probe':<20} {'status':<7} {'ok%':>6} {'runs':>6} "
+        f"{'consec':>6} {'lat_ms':>8}  last failure\n"
+    )
+    for name in sorted(table):
+        row = table[name]
+        attempts = int(row.get("attempts", 0))
+        failures = int(row.get("failures", 0))
+        ratio = (
+            100.0 * (attempts - failures) / attempts if attempts
+            else 0.0
+        )
+        last = ""
+        if row.get("last_reason"):
+            last = row["last_reason"]
+            if row.get("last_error"):
+                last += f": {row['last_error'][:60]}"
+        out.write(
+            f"{name:<20} {row.get('status', ''):<7} {ratio:>5.1f}% "
+            f"{attempts:>6} {row.get('consecutive_failures', 0):>6} "
+            f"{float(row.get('last_latency_secs', 0.0)) * 1e3:>8.2f}"
+            f"  {last}\n"
+        )
+
+
 def print_alerts(alerts: dict, out=None):
     """One line per rule: state, value, human detail."""
     out = out if out is not None else sys.stdout
@@ -536,6 +596,15 @@ def dump_once(args) -> int:
             return 1
         sys.stdout.write("\n---- stream ----\n")
         print_stream(stream)
+    if args.probes:
+        try:
+            probes = fetch_probes(args.addr, timeout=args.timeout)
+        except OSError as exc:
+            print(f"probes fetch failed: {exc} (the master serves "
+                  "/probes only with --probes)", file=sys.stderr)
+            return 1
+        sys.stdout.write("\n---- probes ----\n")
+        print_probes(probes)
     if args.profile is not None:
         try:
             profile = fetch_profile(
@@ -581,6 +650,11 @@ def main(argv=None) -> int:
                              "streaming-ingestion watermark table "
                              "(per-partition end/next/committed, lag, "
                              "backpressure)")
+    parser.add_argument("--probes", action="store_true",
+                        help="Also fetch /probes and print the "
+                             "synthetic-probe table (green/red, "
+                             "success ratio, latency, last failure "
+                             "reason)")
     parser.add_argument("--profile", default=None, metavar="COMPONENT",
                         help="Also fetch /profile for this component "
                              "('' = the master itself, '3' = worker "

@@ -23,6 +23,7 @@ dispatches by artifact signature:
 - ``USAGE_DRILL.json``               → check_usage (attribution drill)
 - ``SCHED_DRILL.json``               → check_sched (gang-sched drill)
 - ``STREAM_DRILL.json``              → check_stream (streaming drill)
+- ``PROBE_DRILL.json``               → check_probe (synthetic probes)
 
 Exits nonzero if any validator fails. A root with no artifacts passes
 (there is nothing to corrupt). Importable: ``run_fsck(root)``.
@@ -69,6 +70,11 @@ def _classify(root: str) -> List[Tuple[str, str]]:
             found.append(
                 ("stream",
                  os.path.join(dirpath, "STREAM_DRILL.json"))
+            )
+        if "PROBE_DRILL.json" in filenames:
+            found.append(
+                ("probe",
+                 os.path.join(dirpath, "PROBE_DRILL.json"))
             )
         if "MANIFEST.json" in filenames:
             try:
@@ -119,6 +125,7 @@ def run_fsck(root: str) -> Tuple[List[str], dict]:
     from check_checkpoint import check_checkpoint
     from check_incident import check_incident
     from check_journal import check_journal
+    from check_probe import check_probe
     from check_pushlog import check_one_log
     from check_reshard import check_reshard
     from check_sched import check_sched
@@ -130,7 +137,7 @@ def run_fsck(root: str) -> Tuple[List[str], dict]:
     errors: List[str] = []
     checked = {"journal": 0, "checkpoint": 0, "store": 0,
                "pushlog": 0, "incident": 0, "reshard": 0,
-               "usage": 0, "sched": 0, "stream": 0}
+               "usage": 0, "sched": 0, "stream": 0, "probe": 0}
     for kind, path in artifacts:
         checked[kind] += 1
         try:
@@ -152,6 +159,8 @@ def run_fsck(root: str) -> Tuple[List[str], dict]:
                 errs, _report = check_sched(path)
             elif kind == "stream":
                 errs, _report = check_stream(path)
+            elif kind == "probe":
+                errs, _report = check_probe(path)
             else:  # reshard
                 errs, _report = check_reshard(path)
         except BaseException as exc:
