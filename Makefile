@@ -10,7 +10,8 @@ PY ?= python
 	autoscale-smoke autoscale-bench slo-smoke ckpt-bench ckpt-smoke \
 	tiered-smoke tiered-bench reshard-smoke reshard-bench \
 	profile-smoke failover-smoke failover-bench quake-smoke \
-	usage-smoke sched-smoke sched-bench stream-smoke probe-smoke fsck
+	usage-smoke sched-smoke sched-bench stream-smoke probe-smoke \
+	brownout-smoke fsck
 
 # Scrape-and-pretty-print a master's /metrics (docs/observability.md).
 METRICS_ADDR ?= localhost:8080
@@ -266,6 +267,21 @@ probe-smoke:
 	&& $(PY) tools/check_probe.py PROBE_DRILL.json; \
 	rc=$$?; rm -rf $$workdir; exit $$rc
 
+# Brownout drill (docs/fault_tolerance.md "Graceful degradation"):
+# an fsync_stall fault plan slows every WAL group commit on a real
+# 2-shard row fleet under a mixed principal-tagged workload. With the
+# overload controls on, serving p99 must hold near baseline while the
+# admission gate sheds background purposes and retry budgets cap
+# amplification; a twin run with every control off must show the
+# inversion (no sheds, unbudgeted retry storms, serving starved).
+brownout-smoke:
+	workdir=$$(mktemp -d /tmp/edl_brownout.XXXXXX); \
+	JAX_PLATFORMS=cpu $(PY) -m elasticdl_tpu.chaos.brownout_drill \
+		run --seed $(CHAOS_SEED) --workdir $$workdir \
+		--report BROWNOUT_DRILL.json \
+	&& $(PY) tools/check_overload.py BROWNOUT_DRILL.json; \
+	rc=$$?; rm -rf $$workdir; exit $$rc
+
 # Gang-vs-static utilization + pod-closing autoscale round-trip
 # (docs/scheduler.md "Benchmarks"): one shared arbiter must beat two
 # static fleet halves on the same job mix, and the pod scaler must
@@ -294,7 +310,7 @@ sched-bench:
 # docs/chaos.md.
 CHAOS_SEED ?= 7
 chaos-smoke: tiered-smoke chaos-master-smoke quake-smoke usage-smoke \
-		sched-smoke stream-smoke probe-smoke
+		sched-smoke stream-smoke probe-smoke brownout-smoke
 	workdir=$$(mktemp -d /tmp/edl_chaos.XXXXXX); \
 	JAX_PLATFORMS=cpu $(PY) -m elasticdl_tpu chaos run \
 		--seed $(CHAOS_SEED) --workdir $$workdir \

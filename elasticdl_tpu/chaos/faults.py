@@ -34,6 +34,14 @@ Event kinds (ISSUE 3 tentpole):
                        journal (master/journal.py) while the worker
                        rides the outage out on its RPC retry budget
                        and re-attaches under the bumped generation.
+- ``fsync_stall``    — slow-disk brownout at a storage fsync seam
+                       (ISSUE 20): ``target`` picks the seam —
+                       ``"pushlog"`` stalls the WAL group commit that
+                       durable-ack pushes wait on, ``"checkpoint"``
+                       stalls the saver's shard-file fsyncs, ``""``
+                       stalls both. The overload plane's deadline-
+                       bounded durable waits are what keeps this from
+                       wedging the push path.
 """
 
 import dataclasses
@@ -49,11 +57,15 @@ STALL_SHARD = "stall_shard"
 BLACKHOLE = "blackhole"
 CORRUPT_CHECKPOINT = "corrupt_checkpoint"
 MASTER_KILL = "master_kill"
+FSYNC_STALL = "fsync_stall"
 
 KINDS = (
     KILL_WORKER, RPC_DROP, RPC_ERROR, RPC_DELAY, STALL_SHARD,
-    BLACKHOLE, CORRUPT_CHECKPOINT, MASTER_KILL,
+    BLACKHOLE, CORRUPT_CHECKPOINT, MASTER_KILL, FSYNC_STALL,
 )
+
+# Storage seams an fsync_stall can target ("" = every seam).
+FSYNC_SEAMS = ("pushlog", "checkpoint")
 
 # Site of an RPC fault: client = before the request leaves the stub
 # (exercises stub retry/backoff), server = inside the handler wrap
@@ -105,6 +117,13 @@ class FaultEvent:
             raise ValueError(f"unknown fault site {self.site!r}")
         if self.corrupt_mode not in ("truncate", "garbage", "delete"):
             raise ValueError(f"unknown corrupt_mode {self.corrupt_mode!r}")
+        if self.kind == FSYNC_STALL and self.target not in (
+            ("",) + FSYNC_SEAMS
+        ):
+            raise ValueError(
+                f"fsync_stall target must be one of {FSYNC_SEAMS} "
+                f"(or '' for any), got {self.target!r}"
+            )
         if self.at_call == 0 and self.kind in (
             RPC_DROP, RPC_ERROR, RPC_DELAY
         ) and not (0.0 <= self.probability <= 1.0):
@@ -315,6 +334,9 @@ def describe(plan: FaultPlan) -> str:
                         f" mode={e.corrupt_mode}")
         elif e.kind == STALL_SHARD:
             bits.append(f"shard={e.shard} +{e.delay_secs}s"
+                        f" x{e.duration_calls} from call #{e.at_call}")
+        elif e.kind == FSYNC_STALL:
+            bits.append(f"seam={e.target or 'any'} +{e.delay_secs}s"
                         f" x{e.duration_calls} from call #{e.at_call}")
         else:
             trig = (f"call #{e.at_call}" if e.at_call

@@ -7,8 +7,11 @@ One ``FaultInjector`` owns a ``FaultPlan`` and installs itself into:
   hook inside the ``_GenericService`` handler wrap (server-site delays
   and aborts, including row-service shard stalls by server tag);
 - ``checkpoint/saver.py`` — post-save hook (corrupt the just-published
-  version dir) and post-restore hook (feeds the version-monotonicity
-  invariant checker);
+  version dir), post-restore hook (feeds the version-monotonicity
+  invariant checker), and the shard-file fsync seam (``fsync_stall``
+  slow-disk brownouts);
+- ``storage/pushlog.py`` — group-commit fsync seam (``fsync_stall``
+  stalls the WAL commit thread that durable-ack pushes wait on);
 - ``master/instance_manager.py`` — observer on kill/relaunch events
   (recovery-latency timing for k8s-mode jobs);
 - ``testing/cluster.MiniCluster`` — per-RPC callbacks on
@@ -31,6 +34,7 @@ from typing import Dict, List, Optional
 from elasticdl_tpu.chaos.faults import (
     BLACKHOLE,
     CORRUPT_CHECKPOINT,
+    FSYNC_STALL,
     KILL_WORKER,
     MASTER_KILL,
     RPC_DELAY,
@@ -123,13 +127,16 @@ class FaultInjector:
         from elasticdl_tpu.checkpoint import saver as saver_mod
         from elasticdl_tpu.comm import rpc as rpc_mod
         from elasticdl_tpu.master import instance_manager as im_mod
+        from elasticdl_tpu.storage import pushlog as pushlog_mod
 
         rpc_mod.set_chaos_hooks(
             client=self.client_hook, server=self.server_hook
         )
         saver_mod.set_chaos_hooks(
-            post_save=self.on_save, post_restore=self.on_restore
+            post_save=self.on_save, post_restore=self.on_restore,
+            fsync=self.fsync_hook,
         )
+        pushlog_mod.set_chaos_hooks(fsync=self.fsync_hook)
         im_mod.set_chaos_observer(self.observe_instance_event)
         return self
 
@@ -137,9 +144,11 @@ class FaultInjector:
         from elasticdl_tpu.checkpoint import saver as saver_mod
         from elasticdl_tpu.comm import rpc as rpc_mod
         from elasticdl_tpu.master import instance_manager as im_mod
+        from elasticdl_tpu.storage import pushlog as pushlog_mod
 
         rpc_mod.set_chaos_hooks(None, None)
-        saver_mod.set_chaos_hooks(None, None)
+        saver_mod.set_chaos_hooks(None, None, None)
+        pushlog_mod.set_chaos_hooks(None)
         im_mod.set_chaos_observer(None)
 
     def set_master_restart(self, fn: Optional[callable]):
@@ -302,6 +311,11 @@ class FaultInjector:
                 if event.kind == STALL_SHARD:
                     if tag != f"rowservice/{event.shard}":
                         continue
+                    # Method filter: the brownout drill stalls only
+                    # the push methods so serving reads on the same
+                    # shard stay fast enough to measure shedding.
+                    if event.method and event.method != method:
+                        continue
                     if self._should_fire(idx, event):
                         self._record(idx, event, tag=tag, method=method)
                         delay = max(delay, event.delay_secs)
@@ -326,6 +340,27 @@ class FaultInjector:
         if delay > 0:
             time.sleep(delay)
         return verdict
+
+    # ---- storage fsync seams -------------------------------------------
+
+    def fsync_hook(self, site: str):
+        """Installed into the storage fsync seams: ``site`` is
+        ``"pushlog"`` (WAL group commit, commit thread) or
+        ``"checkpoint"`` (saver shard-file fsync). Sleeps through any
+        matching ``fsync_stall`` window — a slow-disk brownout,
+        counted per-seam like every other windowed event."""
+        delay = 0.0
+        with self._lock:
+            for idx, event in enumerate(self.plan.events):
+                if event.kind != FSYNC_STALL:
+                    continue
+                if event.target and event.target != site:
+                    continue
+                if self._should_fire(idx, event):
+                    self._record(idx, event, site=site)
+                    delay = max(delay, event.delay_secs)
+        if delay > 0:
+            time.sleep(delay)
 
     # ---- in-process (no-RPC) master path -------------------------------
 

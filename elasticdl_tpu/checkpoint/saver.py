@@ -56,16 +56,23 @@ logger = get_logger(__name__)
 # _post_save_hook(checkpoint_dir, version, vdir): after a version dir is
 #   published (fault plans corrupt files here); _post_restore_hook(
 #   checkpoint_dir, version): after a successful restore (the version-
-#   monotonicity invariant checker observes restores here).
+#   monotonicity invariant checker observes restores here);
+# _fsync_hook("checkpoint"): ahead of each shard file's fsync inside
+#   _publish_dir — a fault plan's ``fsync_stall`` sleeps here (slow
+#   checkpoint disk stretches the save, never tears it: publish stays
+#   behind the tmp-dir rename).
 _post_save_hook: Optional[Callable] = None
 _post_restore_hook: Optional[Callable] = None
+_fsync_hook: Optional[Callable] = None
 
 
 def set_chaos_hooks(post_save: Optional[Callable] = None,
-                    post_restore: Optional[Callable] = None):
-    global _post_save_hook, _post_restore_hook
+                    post_restore: Optional[Callable] = None,
+                    fsync: Optional[Callable] = None):
+    global _post_save_hook, _post_restore_hook, _fsync_hook
     _post_save_hook = post_save
     _post_restore_hook = post_restore
+    _fsync_hook = fsync
 
 _VERSION_RE = re.compile(r"^version-(\d+)$")
 _DELTA_RE = re.compile(r"^delta-(\d+)$")
@@ -208,6 +215,9 @@ class CheckpointSaver:
             with open(path, "wb") as f:
                 f.write(blob)
                 f.flush()
+                hook = _fsync_hook
+                if hook is not None:
+                    hook("checkpoint")
                 os.fsync(f.fileno())
             return len(blob)
 

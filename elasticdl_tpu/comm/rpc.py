@@ -44,6 +44,32 @@ histogram observation per send *attempt*, labeled service/method) and
 ``edl_tpu_rpc_inflight`` (gauge) — attempt-scoped on purpose, so a
 call that spent 3s in backoff sleeps and 2ms on the wire reads as
 retries + fast attempts, not as a slow server.
+
+Overload plane (``comm/deadline.py`` + ``comm/overload.py``,
+docs/fault_tolerance.md "Graceful degradation"):
+
+- **Deadline propagation** — the ambient deadline rides each request
+  as a ``_deadline`` field (absolute wall-clock seconds) next to
+  ``_trace_ctx``/``_principal``; the client derives each hop's gRPC
+  timeout from the remaining budget, refuses to send (and to retry)
+  once the budget is spent, and the server wrap re-establishes the
+  wire deadline as the handler's ambient scope — then rejects
+  already-EXPIRED work with a non-retryable DEADLINE_EXCEEDED before
+  the handler runs (and therefore before any service lock).
+- **Priority admission** — ``RpcServer(..., admission=...)`` installs
+  an ``overload.AdmissionController`` in front of every handler:
+  requests classify by the piggybacked principal's purpose, and a
+  saturated server sheds lowest-priority-first with a retryable
+  RESOURCE_EXHAUSTED carrying a retry-after hint in the detail.
+- **Retry budget** — a stub with ``max_retries > 0`` spends one token
+  of the process-wide per-service ``overload.RetryBudget`` per retry;
+  an empty bucket ends the retry loop (metered as
+  ``rpc_retry_budget_exhausted_total``). Shed responses honor the
+  server's retry-after hint instead of the exponential schedule.
+- **Circuit breaker** — per-target ``overload.CircuitBreaker``: after
+  consecutive transport (UNAVAILABLE) failures the stub fails fast
+  without touching the wire until a jittered half-open probe
+  succeeds.
 """
 
 import random as _random
@@ -54,6 +80,8 @@ from typing import Callable, Dict, Optional
 
 import grpc
 
+from elasticdl_tpu.comm import deadline as _deadline
+from elasticdl_tpu.comm import overload as _overload
 from elasticdl_tpu.common import tensor_utils
 from elasticdl_tpu.common.constants import GRPC
 from elasticdl_tpu.observability import principal as _principal
@@ -63,13 +91,32 @@ from elasticdl_tpu.observability import usage as _usage
 _CHANNEL_OPTIONS = [
     ("grpc.max_send_message_length", GRPC.MAX_SEND_MESSAGE_LENGTH),
     ("grpc.max_receive_message_length", GRPC.MAX_RECEIVE_MESSAGE_LENGTH),
+    # Without a local pool, grpc shares subchannels across channels to
+    # the same target: a "fresh" channel built by RpcStub.reconnect()
+    # silently reuses the old refused subchannel still sitting in
+    # connect-backoff, so reconnect() cannot actually un-wedge a stub
+    # — the one job it exists to do. Costs one TCP connection per
+    # channel instead of per (process, target); stubs here are
+    # long-lived and registry-shared, so that is noise.
+    ("grpc.use_local_subchannel_pool", 1),
 ]
 
 # Codes worth a client-side retry: the transport (not the handler)
 # failed, and every control RPC here is safe to re-send — get_task
 # re-asks the dispatcher, reports are idempotent per task id at the
-# servicer, row pushes dedup by (client, seq).
-RETRYABLE_CODES = ("UNAVAILABLE", "DEADLINE_EXCEEDED")
+# servicer, row pushes dedup by (client, seq). RESOURCE_EXHAUSTED is
+# an admission shed: explicitly retryable (the server said "later",
+# with a retry-after hint in the detail), subject to the retry budget
+# like every other retry. A DEADLINE_EXCEEDED is retryable only while
+# the AMBIENT deadline (if any) still has budget — see call().
+RETRYABLE_CODES = ("UNAVAILABLE", "DEADLINE_EXCEEDED",
+                   "RESOURCE_EXHAUSTED")
+
+# Detail marker for the server-side expired-on-arrival rejection:
+# clients must NOT retry it (resending work whose deadline passed can
+# only waste server capacity), even though the code itself is
+# transient for the transport-timeout case.
+EXPIRED_DETAIL = "deadline expired before handling"
 
 
 class RpcError(RuntimeError):
@@ -140,13 +187,18 @@ def _server_trace_identity(service_name: str, tag: str):
 
 class _GenericService(grpc.GenericRpcHandler):
     def __init__(self, service_name: str, handlers: Dict[str, Callable],
-                 tag: str = ""):
+                 tag: str = "", admission=None):
         self._service_name = service_name
         self._handlers = handlers
         # Chaos identity: several servers of the SAME service can run in
         # one process (e.g. N row-service shards in tests); the tag lets
         # a fault plan target one of them ("rowservice/1").
         self._tag = tag
+        # Priority admission gate (overload.AdmissionController or
+        # None): consulted before ANY per-request work — a shed must
+        # cost the saturated server one counter bump and an abort,
+        # nothing more.
+        self._admission = admission
 
     def service(self, handler_call_details):
         # Path format: /<service_name>/<method>
@@ -160,20 +212,43 @@ class _GenericService(grpc.GenericRpcHandler):
 
         def unary_unary(request: dict, context):
             # Always strip the piggyback fields (handlers must never
-            # see them as payload): the trace context, and the workload
-            # principal riding next to it. The principal becomes the
-            # handler's ambient attribution identity (so internal
-            # fan-outs it triggers self-tag) and the usage meter's
-            # label source; a request carrying neither meters as
-            # ``unknown``.
+            # see them as payload): the trace context, the workload
+            # principal riding next to it, and the propagated absolute
+            # deadline. The principal becomes the handler's ambient
+            # attribution identity (so internal fan-outs it triggers
+            # self-tag) and the usage meter's label source; a request
+            # carrying neither meters as ``unknown``. The deadline
+            # becomes the handler's ambient deadline scope, so
+            # internal fan-outs inherit the caller's remaining budget.
             if isinstance(request, dict):
                 wire_ctx = request.pop("_trace_ctx", None)
                 who = _principal.from_wire(
                     request.pop("_principal", None)
                 )
+                wire_deadline = request.pop("_deadline", None)
             else:
                 wire_ctx = None
                 who = None
+                wire_deadline = None
+            if wire_deadline is not None:
+                try:
+                    wire_deadline = float(wire_deadline)
+                except (TypeError, ValueError):
+                    wire_deadline = None
+            # Priority admission: classify by the principal's purpose
+            # and shed BEFORE opening spans or touching the handler
+            # (and therefore before any service lock). The shed is a
+            # retryable RESOURCE_EXHAUSTED with a retry-after hint in
+            # the detail; the admitted slot is released in the finally
+            # below.
+            admission = self._admission
+            if admission is not None:
+                purpose = who.purpose if who is not None else None
+                if not admission.try_acquire(purpose):
+                    code, detail = admission.shed_verdict(purpose)
+                    context.abort(
+                        getattr(grpc.StatusCode, code), detail
+                    )
             metered = _principal.enabled()
             if _tracing.enabled():
                 role, instance = _server_trace_identity(
@@ -190,7 +265,7 @@ class _GenericService(grpc.GenericRpcHandler):
             try:
                 with span, _principal.pushed(
                     principal=who or _principal.NOBODY
-                ):
+                ), _deadline.running_at(wire_deadline):
                     hook = _server_hook
                     if hook is not None:
                         verdict = hook(
@@ -205,6 +280,20 @@ class _GenericService(grpc.GenericRpcHandler):
                                         grpc.StatusCode.UNKNOWN),
                                 detail,
                             )
+                    # Expired-on-arrival rejection — AFTER the chaos
+                    # hook (an injected server-site delay models queue
+                    # time and must count against the budget), BEFORE
+                    # the handler (work nobody is waiting for must not
+                    # queue for the service lock). Non-retryable by
+                    # detail contract: see EXPIRED_DETAIL.
+                    if _deadline.expired():
+                        span.set(error="DEADLINE_EXCEEDED")
+                        context.abort(
+                            grpc.StatusCode.DEADLINE_EXCEEDED,
+                            f"{EXPIRED_DETAIL}: "
+                            f"{self._service_name}.{method} arrived "
+                            "with no budget left",
+                        )
                     try:
                         response = handler(request)
                         return response if response is not None else {}
@@ -224,6 +313,8 @@ class _GenericService(grpc.GenericRpcHandler):
                             f"{type(exc).__name__}: {exc}",
                         )
             finally:
+                if admission is not None:
+                    admission.release()
                 if metered:
                     # Qualified Service.method: bare method names
                     # collide across services in the shared families.
@@ -246,13 +337,20 @@ class RpcServer:
         services: Dict[str, Dict[str, Callable]],
         max_workers: int = 64,
         tag: str = "",
+        admission=None,
     ):
         """``services`` maps service name -> {method name -> handler}.
-        ``tag`` identifies this server instance to chaos fault plans."""
+        ``tag`` identifies this server instance to chaos fault plans.
+        ``admission`` (an ``overload.AdmissionController``) gates every
+        handler of every service on this server by principal purpose —
+        one shared gate per server, because the thing being protected
+        (the worker pool, the service lock) is per-server."""
+        self.admission = admission
         self._server = grpc.server(
             futures.ThreadPoolExecutor(max_workers=max_workers),
             handlers=[
-                _GenericService(name, handlers, tag=tag)
+                _GenericService(name, handlers, tag=tag,
+                                admission=admission)
                 for name, handlers in services.items()
             ],
             options=_CHANNEL_OPTIONS,
@@ -465,13 +563,48 @@ class RpcStub:
             who = _principal.current_wire()
             if who is not None:
                 fields["_principal"] = who
+            # Ambient deadline: stamped on the wire as an absolute
+            # instant, and the source of each attempt's per-hop gRPC
+            # timeout — a multi-hop fan-out under one budget spends
+            # ONE budget, not one per hop.
+            ambient_deadline = _deadline.wire()
+            if ambient_deadline is not None:
+                fields["_deadline"] = ambient_deadline
+            # Per-target circuit breaker (skipped for caller-owned
+            # channels — the stub cannot name the endpoint — and while
+            # the overload kill-switch is off).
+            breaker = None
+            if (self._target is not None
+                    and _overload.controls_enabled()):
+                breaker = _overload.breaker_for(self._target)
+            budget = None
             delay = self._backoff_base
             attempt = 0
             while True:
+                if _deadline.expired():
+                    # The caller's budget is spent: sending (or
+                    # re-sending) is wasted server capacity.
+                    err = RpcError(
+                        f"{self._service_name}.{method} not sent: "
+                        "ambient deadline expired",
+                        code="DEADLINE_EXCEEDED",
+                    )
+                    if traced:
+                        call_span.set(error=err.code,
+                                      attempts=attempt)
+                    raise err
+                breaker_open = breaker is not None and not breaker.allow()
                 attempt_t0 = time.monotonic()
                 m_inflight.inc()
                 try:
                     try:
+                        if breaker_open:
+                            raise RpcError(
+                                f"{self._service_name}.{method} not "
+                                f"sent: breaker open for "
+                                f"{self._target}",
+                                code="UNAVAILABLE",
+                            )
                         hook = _client_hook
                         if hook is not None:
                             # May raise RpcError (injected drop —
@@ -480,11 +613,16 @@ class RpcStub:
                             # death, never caught here).
                             hook(self._service_name, method, fields)
                         result = self._method(method)(
-                            fields, timeout=timeout
+                            fields,
+                            timeout=_deadline.hop_timeout(timeout),
                         )
                         m_latency.observe(
                             time.monotonic() - attempt_t0
                         )
+                        if breaker is not None:
+                            breaker.on_success()
+                        if budget is not None:
+                            budget.on_success()
                         return result
                     except grpc.RpcError as exc:
                         err = RpcError(
@@ -498,15 +636,50 @@ class RpcStub:
                     m_latency.observe(time.monotonic() - attempt_t0)
                 finally:
                     m_inflight.dec()
-                if (err.code not in RETRYABLE_CODES
-                        or attempt >= self._max_retries):
+                # Only a dead TRANSPORT trips the breaker: sheds and
+                # deadline misses are a live server deciding, and a
+                # breaker-open synthetic must not feed back into
+                # itself.
+                if (breaker is not None and not breaker_open
+                        and err.code == "UNAVAILABLE"):
+                    breaker.on_failure()
+                retryable = (err.code in RETRYABLE_CODES
+                             and EXPIRED_DETAIL not in str(err)
+                             and not _deadline.expired())
+                if not retryable or attempt >= self._max_retries:
                     if traced:
                         call_span.set(error=err.code, attempts=attempt + 1)
+                    raise err
+                # Retries spend the process-wide per-service budget —
+                # the retry-storm amplification cap. An empty bucket
+                # ends the loop with the LAST real error.
+                if budget is None and _overload.controls_enabled():
+                    budget = _overload.retry_budget_for(
+                        self._service_name
+                    )
+                if budget is not None and not budget.try_spend():
+                    if traced:
+                        call_span.set(error=err.code,
+                                      attempts=attempt + 1,
+                                      budget_exhausted=True)
                     raise err
                 attempt += 1
                 _retry_counter().labels(
                     self._service_name, method, err.code
                 ).inc()
+                # A shed carries the server's retry-after hint; honor
+                # it (jittered) instead of the exponential schedule —
+                # the server knows its own drain rate. Either way the
+                # sleep never overshoots the ambient deadline.
+                hint = None
+                if err.code == "RESOURCE_EXHAUSTED":
+                    hint = _overload.parse_retry_after(str(err))
+                sleep_for = (hint if hint is not None else delay) * (
+                    0.5 + _random.random()
+                )
+                left = _deadline.remaining()
+                if left is not None:
+                    sleep_for = min(sleep_for, max(0.0, left))
                 # The backoff sleep is its own span so a retried call
                 # reads as [attempt][backoff][attempt], not one opaque
                 # interval (and server time stays distinguishable from
@@ -514,8 +687,9 @@ class RpcStub:
                 with _tracing.span(
                     "rpc.backoff", code=err.code, attempt=attempt
                 ) if traced else _tracing.NULL_SPAN:
-                    time.sleep(delay * (0.5 + _random.random()))
-                delay = min(delay * 2.0, self._backoff_cap)
+                    time.sleep(sleep_for)
+                if hint is None:
+                    delay = min(delay * 2.0, self._backoff_cap)
 
     def close(self):
         if self._owns_channel:

@@ -246,6 +246,25 @@ def add_train_params(parser):
                         help="Group-commit window for the row-service "
                              "push log (one fsync covers every push "
                              "landing within it)")
+    parser.add_argument("--row_service_admission_limit", type=int,
+                        default=0,
+                        help="Priority admission control on launched "
+                             "row-service pods: bound on concurrently "
+                             "admitted handlers; beyond it requests "
+                             "shed lowest-priority-first by principal "
+                             "purpose (docs/fault_tolerance.md "
+                             "'Graceful degradation'). 0 (default) = "
+                             "off")
+    parser.add_argument("--row_service_push_durable_wait_secs",
+                        type=float, default=60.0,
+                        help="Ceiling on the row-service durable-ack "
+                             "fsync wait; a propagated request "
+                             "deadline shrinks it per-push")
+    parser.add_argument("--master_admission_limit", type=int,
+                        default=0,
+                        help="Priority admission control on the "
+                             "master RPC servicer (same ladder as the "
+                             "row plane). 0 (default) = off")
     add_bool_param(parser, "--fuse_task_steps", False,
                    "Scan a whole task's minibatches in one XLA program "
                    "(removes per-step host dispatch)")

@@ -50,11 +50,15 @@ from typing import Callable, Dict, Optional
 import numpy as np
 
 from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.comm import deadline as wl_deadline
+from elasticdl_tpu.comm import overload as wl_overload
 from elasticdl_tpu.comm.rpc import (
+    EXPIRED_DETAIL,
     InvalidRequest,
     RpcError,
     RpcServer,
     RpcStub,
+    decorrelated_jitter,
 )
 from elasticdl_tpu.embedding.host_engine import HostEmbeddingEngine
 from elasticdl_tpu.embedding.shard_map import (
@@ -186,9 +190,16 @@ class HostRowService:
 
     def __init__(self, tables: Dict, optimizer, checkpoint_dir: str = "",
                  checkpoint_steps: int = 0, keep_max: int = 3,
-                 metrics_registry=None):
+                 metrics_registry=None,
+                 push_durable_wait_secs: float = 60.0):
         self._tables = tables
         self._optimizer = optimizer
+        # Ceiling on the durable-ack fsync wait (--push_durable_wait_secs);
+        # a propagated request deadline SHRINKS it per-push (there is no
+        # point fsync-waiting for a caller that stopped listening — the
+        # record is already queued and will land regardless; only the
+        # ack is abandoned).
+        self._push_durable_wait_secs = float(push_durable_wait_secs)
         # Telemetry: served row traffic + handler latency (the row
         # plane's pressure gauges; scrape the serving process).
         from elasticdl_tpu.observability import default_registry
@@ -250,6 +261,12 @@ class HostRowService:
         self._m_replica_reads = registry.counter(
             "row_replica_reads_total",
             "Rows served from this shard's hot-row replica store",
+        )
+        self._m_durable_wait_timeouts = registry.counter(
+            "row_push_durable_wait_timeouts_total",
+            "Durable-ack fsync waits abandoned (wait ceiling or the "
+            "propagated request deadline expired before the covering "
+            "group commit landed; the record itself still commits)",
         )
         self._m_replica_stale = registry.histogram(
             "row_replica_staleness_seconds",
@@ -668,7 +685,7 @@ class HostRowService:
                     # record is durable — a duplicate ack is still an
                     # ack, and zero RPO covers it too.
                     fsync_t0 = time.monotonic()
-                    self._push_log.barrier()
+                    self._durable_wait(self._push_log.barrier)
                     wl_usage.meter_fsync_wait(
                         who, time.monotonic() - fsync_t0
                     )
@@ -680,7 +697,9 @@ class HostRowService:
                 # durable (the shard's WAL disk is broken and the
                 # error is loud by design).
                 fsync_t0 = time.monotonic()
-                wal_ticket.wait(timeout=60.0)
+                self._durable_wait(
+                    lambda budget: wal_ticket.wait(timeout=budget)
+                )
                 wl_usage.meter_fsync_wait(
                     who, time.monotonic() - fsync_t0
                 )
@@ -705,6 +724,39 @@ class HostRowService:
             self._checkpoint(version)
         m = self._shard_map
         return {"map_version": m.version if m is not None else 0}
+
+    def configure_push_durable_wait(self, secs: float) -> None:
+        """Set the durable-ack fsync wait ceiling
+        (``--push_durable_wait_secs``; the zoo factory builds the
+        service before flags are applied, mirroring
+        configure_checkpoint/configure_push_log)."""
+        self._push_durable_wait_secs = float(secs)
+
+    def _durable_wait(self, waiter: Callable[[float], None]) -> None:
+        """Run one durable-ack fsync wait (``waiter(timeout_secs)``)
+        under the configured ceiling, SHRUNK by the propagated request
+        deadline when one is present: a caller that stopped listening
+        gets its error now instead of holding a handler thread for the
+        full ceiling (the record itself is already queued and commits
+        regardless — only the ack is abandoned). A timed-out wait
+        counts in ``row_push_durable_wait_timeouts_total`` and still
+        raises: the client must never learn "durable" from a wait that
+        did not observe the fsync."""
+        from elasticdl_tpu.storage.pushlog import PushLogError
+
+        budget = self._push_durable_wait_secs
+        left = wl_deadline.remaining()
+        if left is not None:
+            budget = min(budget, max(left, 1e-3))
+        try:
+            waiter(budget)
+        except PushLogError as exc:
+            # Only the ran-out-of-time shape is a "timeout"; a commit
+            # WRITE failure (broken WAL disk) is a different, louder
+            # problem and must not hide in this counter.
+            if "did not complete in time" in str(exc):
+                self._m_durable_wait_timeouts.inc()
+            raise
 
     # ---- live resharding: map enforcement ------------------------------
 
@@ -1698,15 +1750,26 @@ class HostRowService:
     # ---- lifecycle / checkpoint ---------------------------------------
 
     def start(self, addr: str = "localhost:0",
-              tag: str = "", max_workers: int = 64) -> "HostRowService":
+              tag: str = "", max_workers: int = 64,
+              admission_limit: int = 0) -> "HostRowService":
         """``tag`` identifies this shard to chaos fault plans (e.g.
         ``rowservice/0``) — several shards of the same service can run
         in one test process and a plan must be able to stall just one.
         ``max_workers`` bounds handler concurrency (the reshard bench
-        runs 1-worker shards to model per-shard capacity)."""
+        runs 1-worker shards to model per-shard capacity).
+        ``admission_limit`` > 0 installs priority admission control
+        (comm/overload.py) in front of every handler: bounded in-flight
+        work, shed lowest-priority-first by principal purpose, so a
+        stalled shard keeps serving reads while background work yields.
+        0 (default) = no admission gate."""
+        admission = None
+        if admission_limit > 0:
+            admission = wl_overload.AdmissionController(
+                admission_limit, tag=tag or SERVICE_NAME,
+            )
         self._server = RpcServer(
             addr, {SERVICE_NAME: self.handlers()}, tag=tag,
-            max_workers=max_workers,
+            max_workers=max_workers, admission=admission,
         ).start()
         logger.info("Row service on port %d", self._server.port)
         return self
@@ -1793,7 +1856,10 @@ class HostRowService:
 # CANCELLED is transient too: a server-initiated GOAWAY during service
 # shutdown cancels in-flight calls, and every method here is safe to
 # retry (pulls are idempotent; pushes are deduped by (client, seq)).
-_TRANSIENT_CODES = ("UNAVAILABLE", "DEADLINE_EXCEEDED", "CANCELLED")
+# RESOURCE_EXHAUSTED is an admission shed — the server said "later"
+# and stamped a retry-after hint into the detail.
+_TRANSIENT_CODES = ("UNAVAILABLE", "DEADLINE_EXCEEDED", "CANCELLED",
+                    "RESOURCE_EXHAUSTED")
 
 
 class ReshardRedirect(Exception):
@@ -1820,31 +1886,81 @@ def _check_reshard(resp: dict):
 
 
 def _call_with_retry(stub: RpcStub, method: str, retries: int,
-                     backoff_secs: float, **fields):
+                     backoff_secs: float, hedge=None, **fields):
     """Ride out a service relaunch (reference workers retry PS RPCs via
     the ≤64 minibatch retry + 3x300s channel waits; here a bounded
-    exponential backoff on the row plane). Only transport-level codes
-    retry — INTERNAL (handler bugs, bad table names) is permanent and
-    surfaces immediately."""
+    decorrelated-jitter backoff on the row plane). Only transport-level
+    codes retry — INTERNAL (handler bugs, bad table names) is permanent
+    and surfaces immediately.
+
+    Every retry spends a token from the shared ``RowService:rideout``
+    budget (comm/overload.py): a patient ride-out of one relaunch
+    sustains on the refill, but a fleet-wide retry storm is RATE-CAPPED
+    instead of amplifying. A denied spend waits for refill rather than
+    abandoning the ride-out — this loop's callers (migration pushes,
+    replica refresh, the worker's row plane) hold correctness on
+    eventually-getting-through, so the budget shapes their traffic
+    instead of failing it. Admission sheds (RESOURCE_EXHAUSTED) carry a
+    server retry-after hint that overrides the local backoff, and an
+    expired ambient deadline (or a server expired-on-arrival verdict)
+    ends the ride-out immediately: nobody is waiting for the answer.
+
+    ``hedge`` (an ``overload.HedgeTimer``) turns each ATTEMPT of an
+    idempotent read into a hedged pair — a second identical send after
+    the tracked p99 delay, first response wins. Hedging rides inside
+    the retry loop (one budgeted attempt = one hedged pair), never
+    around it: two stacked ride-outs would double the worst case."""
     delay = backoff_secs
+    budget = None
+    if wl_overload.controls_enabled():
+        budget = wl_overload.retry_budget_for("RowService:rideout")
     for attempt in range(retries + 1):
         try:
-            return stub.call(method, **fields)
+            if hedge is not None:
+                t0 = time.monotonic()
+                resp = wl_overload.hedged_call(
+                    lambda: stub.call(method, **fields),
+                    lambda: stub.call(method, **fields),
+                    hedge.delay(), service=SERVICE_NAME, method=method,
+                )
+                hedge.observe(time.monotonic() - t0)
+            else:
+                resp = stub.call(method, **fields)
+            if budget is not None:
+                budget.on_success()
+            return resp
         except RpcError as exc:
-            if exc.code not in _TRANSIENT_CODES or attempt == retries:
+            if (exc.code not in _TRANSIENT_CODES
+                    or attempt == retries
+                    or EXPIRED_DETAIL in str(exc)
+                    or wl_deadline.expired()):
                 raise
+            while budget is not None and not budget.try_spend():
+                # Rate-capped, not abandoned: wait out the refill
+                # (~1 token/s) unless the caller's deadline dies first.
+                if wl_deadline.expired():
+                    raise
+                time.sleep(0.25)
+            hint = None
+            if exc.code == "RESOURCE_EXHAUSTED":
+                hint = wl_overload.parse_retry_after(str(exc))
+            sleep_for = delay if hint is None else hint
+            left = wl_deadline.remaining()
+            if left is not None:
+                sleep_for = min(sleep_for, max(left, 0.0))
             logger.warning(
                 "row service %s failed (attempt %d/%d); retrying in %.1fs",
-                method, attempt + 1, retries, delay,
+                method, attempt + 1, retries, sleep_for,
             )
-            time.sleep(delay)
+            time.sleep(sleep_for)
             # Fresh channel per retry: a channel whose connects were
             # refused while the service was (re)starting can wedge
-            # permanently in-container; the retry budget (~4 min) must
-            # actually span a pod relaunch, not spin on a dead channel
-            # (same fix as the worker's master ride-out, PR 5).
+            # permanently in-container; the ride-out window (~4 min)
+            # must actually span a pod relaunch, not spin on a dead
+            # channel (same fix as the worker's master ride-out, PR 5).
             stub.reconnect()
-            delay = min(delay * 2, 30.0)
+            delay = decorrelated_jitter(delay, base=backoff_secs,
+                                        cap=30.0)
 
 
 class _RemoteTable:
@@ -1857,12 +1973,16 @@ class _RemoteTable:
     concurrent_safe = True
 
     def __init__(self, stub: RpcStub, name: str, dim: int,
-                 retries: int = 12, backoff_secs: float = 0.5):
+                 retries: int = 12, backoff_secs: float = 0.5,
+                 hedge=None):
         self._stub = stub
         self.name = name
         self.dim = dim
         self._retries = retries
         self._backoff = backoff_secs
+        # Shared overload.HedgeTimer (None = hedging off): idempotent
+        # reads re-send after the fleet-p99 delay, first response wins.
+        self._hedge = hedge
         # Wall-clock stamp of the service's last applied push as of
         # our newest pull (0.0 = never pushed / never pulled): what
         # serving's HostRowResolver turns into the
@@ -1875,6 +1995,7 @@ class _RemoteTable:
     def get(self, ids) -> np.ndarray:
         resp = _call_with_retry(
             self._stub, "pull_rows", self._retries, self._backoff,
+            hedge=self._hedge,
             table=self.name, ids=np.asarray(ids, np.int64),
         )
         _check_reshard(resp)
@@ -1894,7 +2015,7 @@ class _RemoteTable:
         mask (misses fall back to the home shard caller-side)."""
         resp = _call_with_retry(
             self._stub, "pull_replica_rows", self._retries,
-            self._backoff, table=self.name,
+            self._backoff, hedge=self._hedge, table=self.name,
             ids=np.asarray(ids, np.int64),
         )
         stamp = float(resp.get("applied_at", 0.0) or 0.0)
@@ -2000,12 +2121,16 @@ _FENCE_BACKOFF_SECS = 0.02
 def _run_jobs(pool, jobs):
     """Run job thunks, fanned on the pool only when there is real
     fan-out (a single-target wave — the common case for small pulls
-    and for single-shard fleets — stays inline, no thread hop)."""
+    and for single-shard fleets — stays inline, no thread hop).
+    Pool threads do not inherit thread-locals, so each job is bound to
+    the submitting thread's ambient deadline (comm/deadline.py): a
+    wave fanned out under one 500 ms budget spends ONE budget across
+    every shard leg, and expiry is visible inside each leg's stub."""
     if pool is None or len(jobs) == 1:
         for job in jobs:
             job()
         return
-    futures = [pool.submit(job) for job in jobs]
+    futures = [pool.submit(wl_deadline.bind(job)) for job in jobs]
     for f in futures:
         f.result()
 
@@ -2018,7 +2143,8 @@ class _ShardRegistry:
     where they materialize. Shared by every table and the optimizer of
     one engine, plus the fan-out pool."""
 
-    def __init__(self, retries: int, backoff_secs: float):
+    def __init__(self, retries: int, backoff_secs: float,
+                 hedge_reads: bool = False):
         self._retries = retries
         self._backoff = backoff_secs
         self._lock = threading.Lock()
@@ -2026,6 +2152,13 @@ class _ShardRegistry:
         self._tables: Dict = {}
         self._optimizers: Dict = {}
         self._pool = None
+        # Tail-tolerant hedging for idempotent reads (opt-in): one
+        # shared p99 tracker for the whole fleet — the hedge delay
+        # models "this read is slower than the fleet's tail", not one
+        # shard's own (a stalled shard must not teach itself that
+        # stalls are normal).
+        self._hedge = (wl_overload.HedgeTimer()
+                       if hedge_reads else None)
 
     def stub(self, addr: str) -> RpcStub:
         with self._lock:
@@ -2044,7 +2177,8 @@ class _ShardRegistry:
             table = self._tables.get(key)
         if table is None:
             table = _RemoteTable(
-                self.stub(addr), name, dim, self._retries, self._backoff
+                self.stub(addr), name, dim, self._retries,
+                self._backoff, hedge=self._hedge,
             )
             with self._lock:
                 table = self._tables.setdefault(key, table)
@@ -2390,6 +2524,7 @@ def make_remote_engine(
     addr: str, id_keys: Dict[str, str],
     retries: int = 12, backoff_secs: float = 0.5,
     table_fanout: bool = True,
+    hedge_reads: bool = False,
 ) -> HostEmbeddingEngine:
     """Client-side engine over running `HostRowService` shard(s).
 
@@ -2405,11 +2540,15 @@ def make_remote_engine(
     with materialize lazily in its registry. Pulls/pushes retry with
     bounded backoff across a shard relaunch; the default budget (0.5s
     doubling, capped 30s, 12 retries ≈ 4 minutes) spans a real pod
-    relaunch like the reference workers' 3x300s channel waits."""
+    relaunch like the reference workers' 3x300s channel waits.
+    ``hedge_reads`` opts idempotent pulls/replica reads into
+    tail-tolerant hedging (comm/overload.py): re-send after the
+    fleet-p99 delay, first response wins."""
     addrs = [a.strip() for a in addr.split(",") if a.strip()]
     if not addrs:
         raise ValueError("empty row-service address")
-    registry = _ShardRegistry(retries, backoff_secs)
+    registry = _ShardRegistry(retries, backoff_secs,
+                              hedge_reads=hedge_reads)
     stubs = [registry.stub(a) for a in addrs]
     infos = [
         _call_with_retry(stub, "table_info", retries, backoff_secs)[
@@ -2569,6 +2708,21 @@ def main(argv=None):
                              "for the covering fsync (RPO=0). "
                              "applied: reply after the in-memory "
                              "apply (RPO = one group window)")
+    parser.add_argument("--push_durable_wait_secs", type=float,
+                        default=60.0,
+                        help="Ceiling on the durable-ack fsync wait "
+                             "in the push path; a propagated request "
+                             "deadline shrinks it per-push. Abandoned "
+                             "waits count in "
+                             "row_push_durable_wait_timeouts_total")
+    parser.add_argument("--admission_limit", type=int, default=0,
+                        help="Priority admission control: bound on "
+                             "concurrently admitted handlers; beyond "
+                             "it, requests shed lowest-priority-first "
+                             "by principal purpose with a retryable "
+                             "RESOURCE_EXHAUSTED + retry-after hint "
+                             "(docs/fault_tolerance.md 'Graceful "
+                             "degradation'). 0 (default) = off")
     parser.add_argument("--hot_budget_rows", type=int, default=0,
                         help="Tiered storage: max rows/table resident "
                              "in the hot in-memory arena; colder rows "
@@ -2662,7 +2816,9 @@ def main(argv=None):
             args.push_log_dir, group_ms=args.push_log_group_ms,
             ack=args.push_log_ack,
         )
-    service.start(args.addr, tag=f"rowservice/{args.shard_id}")
+    service.configure_push_durable_wait(args.push_durable_wait_secs)
+    service.start(args.addr, tag=f"rowservice/{args.shard_id}",
+                  admission_limit=args.admission_limit)
     logger.info("Row service serving on %s", args.addr)
     import signal
 

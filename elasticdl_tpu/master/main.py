@@ -598,6 +598,16 @@ class Master:
                     "--shard_id", str(shard),
                     "--num_shards",
                     str(self._num_row_service_shards())]
+        admission = int(getattr(
+            self._args, "row_service_admission_limit", 0
+        ))
+        if admission > 0:
+            cmd += ["--admission_limit", str(admission)]
+        durable_wait = float(getattr(
+            self._args, "row_service_push_durable_wait_secs", 60.0
+        ))
+        if durable_wait != 60.0:
+            cmd += ["--push_durable_wait_secs", str(durable_wait)]
         return cmd
 
     def _master_addr_for_workers(self) -> str:
@@ -620,9 +630,24 @@ class Master:
                     getattr(self._args, "stream_poll_secs", 0.5)
                 )
             )
+        admission = None
+        admission_limit = int(getattr(
+            self._args, "master_admission_limit", 0
+        ))
+        if admission_limit > 0:
+            from elasticdl_tpu.comm import overload
+
+            # One gate for every master handler: the thing being
+            # protected (the servicer lock, the worker pool) is
+            # per-server, and the ladder keeps control/serving traffic
+            # ahead of background reporting when the master saturates.
+            admission = overload.AdmissionController(
+                admission_limit, tag="master",
+            )
         self._server = RpcServer(
             f"[::]:{self._master_port()}",
             {SERVICE_NAME: self.servicer.handlers()},
+            admission=admission,
         ).start()
         logger.info("Master RPC serving on port %d", self._server.port)
         self._setup_prober()

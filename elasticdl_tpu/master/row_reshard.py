@@ -68,7 +68,12 @@ class RideOutTransport:
     """Default shard transport: rides out a shard relaunch with the
     row-service client's own bounded-backoff + channel-rebuild retry
     (a resharding authority faces restarting shards as a matter of
-    course — a wedged channel must not fail a resumable migration)."""
+    course — a wedged channel must not fail a resumable migration).
+    Delegating to ``_call_with_retry`` also puts every migration RPC
+    under the shared ``RowService:rideout`` retry budget and its
+    decorrelated-jitter backoff (comm/overload.py): background
+    migration traffic is rate-capped during an overload instead of
+    amplifying into it."""
 
     def __init__(self, addr: str, retries: int = 8,
                  backoff_secs: float = 0.25):

@@ -332,6 +332,61 @@ def default_rules() -> List[SLORule]:
                         "blind — treat monitoring loss as an incident, "
                         "not as green",
         ),
+        # Overload plane (comm/overload.py, docs/fault_tolerance.md
+        # "Graceful degradation"): shedding BACKGROUND purposes under
+        # pressure is the design working, so no rule fires on it —
+        # these rules fire on the shapes that mean the design is NOT
+        # working: serving reads being shed (priority inversion),
+        # clients giving up because the shared retry budget drained
+        # (sustained overload, not a blip), breakers stuck open.
+        SLORule(
+            name="overload-serving-shed",
+            kind=THRESHOLD,
+            series="edl_tpu_overload_shed_total",
+            labels={"purpose": "serving_read"},
+            aggregation="rate",
+            op=">",
+            value=0.0,
+            window_secs=60.0,
+            min_count=1,
+            description="the admission gate shed serving reads — the "
+                        "one purpose load shedding exists to protect. "
+                        "Background purposes are already fully shed "
+                        "and the fleet is STILL saturated: add "
+                        "capacity or cut the serving limit "
+                        "(docs/fault_tolerance.md 'Graceful "
+                        "degradation')",
+        ),
+        SLORule(
+            name="rpc-retry-budget-exhausted",
+            kind=THRESHOLD,
+            series="edl_tpu_rpc_retry_budget_exhausted_total",
+            aggregation="rate",
+            op=">",
+            value=0.5,
+            window_secs=300.0,
+            min_count=5,
+            description="clients are abandoning retries faster than "
+                        "the token bucket refills, sustained across "
+                        "the window: the dependency is in prolonged "
+                        "overload and unbudgeted callers would be "
+                        "amplifying it (docs/fault_tolerance.md)",
+        ),
+        SLORule(
+            name="rpc-breaker-open",
+            kind=THRESHOLD,
+            series="edl_tpu_rpc_breaker_state",
+            aggregation="min",
+            op=">=",
+            value=1.0,
+            window_secs=120.0,
+            min_count=5,
+            description="a client circuit breaker has not closed for "
+                        "a whole window (state 1=open/2=half-open "
+                        "throughout): its target is persistently "
+                        "unreachable and every caller is failing "
+                        "fast, not slow (docs/fault_tolerance.md)",
+        ),
     ]
 
 

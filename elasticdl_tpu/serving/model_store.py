@@ -611,7 +611,9 @@ class ModelStore:
             version = self._bundle_version(path)
             if version < 0 or version in self._rejected:
                 continue
-            _, next_retry = self._load_failures.get(version, (0, 0.0))
+            next_retry = self._load_failures.get(
+                version, (0, 0.0, 0.0)
+            )[1]
             if time.monotonic() < next_retry:
                 continue
             if best is None or version > best[0]:
@@ -692,13 +694,29 @@ class ModelStore:
         try:
             model = self._load(path)
         except Exception:
-            failures, _ = self._load_failures.get(version, (0, 0.0))
-            failures += 1
-            backoff = min(
-                self._poll_seconds * (2 ** failures), 300.0
+            failures, _, prev = self._load_failures.get(
+                version, (0, 0.0, 0.0)
             )
+            failures += 1
+            # Decorrelated jitter (comm/rpc.py), not plain doubling:
+            # many replicas watching one bad bundle directory would
+            # otherwise re-load it in lockstep forever.
+            from elasticdl_tpu.comm import overload
+            from elasticdl_tpu.comm.rpc import decorrelated_jitter
+
+            backoff = decorrelated_jitter(
+                prev, base=self._poll_seconds, cap=300.0
+            )
+            if (overload.controls_enabled()
+                    and not overload.retry_budget_for(
+                        "ModelStore:load"
+                    ).try_spend()):
+                # Budget-denied: rate-cap further with the shared
+                # serving retry budget instead of abandoning (the
+                # next bundle version clears the failure entirely).
+                backoff = max(backoff, self._poll_seconds * 4)
             self._load_failures[version] = (
-                failures, time.monotonic() + backoff
+                failures, time.monotonic() + backoff, backoff
             )
             logger.exception(
                 "Failed to load bundle %s (version %d, attempt %d); "
@@ -707,6 +725,11 @@ class ModelStore:
             )
             self._m_reloads.labels(result="error").inc()
             return False
+        if version in self._load_failures:
+            from elasticdl_tpu.comm import overload
+
+            if overload.controls_enabled():
+                overload.retry_budget_for("ModelStore:load").on_success()
         self._load_failures.pop(version, None)
         self._swap(model)
         self._m_reloads.labels(result="ok").inc()

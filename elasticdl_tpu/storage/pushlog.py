@@ -86,6 +86,19 @@ class PushLogError(RuntimeError):
     body, write/fsync failure on the commit thread, closed log)."""
 
 
+# ---- chaos seam (chaos/interceptors.py installs) -----------------------
+# _fsync_hook("pushlog"): runs on the commit thread ahead of each group
+# commit's write+fsync; a fault plan's ``fsync_stall`` sleeps here so a
+# slow-WAL-disk brownout lands exactly where durable-ack waiters feel
+# it (the seam the brownout drill drives).
+_fsync_hook: Optional[Callable[[str], None]] = None
+
+
+def set_chaos_hooks(fsync: Optional[Callable[[str], None]] = None):
+    global _fsync_hook
+    _fsync_hook = fsync
+
+
 def _segment_name(seg: int) -> str:
     return f"pushlog-{seg:06d}.wal"
 
@@ -396,7 +409,7 @@ class PushLog:
             self._cond.notify()
         return ticket
 
-    def barrier(self) -> None:
+    def barrier(self, timeout: float = 60.0) -> None:
         """Block until everything appended so far is durable (the
         duplicate-push ack path: a retry must not ack before its
         original record's fsync lands). Waits on the NEWEST ticket
@@ -407,7 +420,7 @@ class PushLog:
         with self._cond:
             ticket = self._last_ticket
         if ticket is not None:
-            ticket.wait(timeout=60.0)
+            ticket.wait(timeout=timeout)
         if self._broken is not None:
             raise PushLogError(
                 f"push log broken: {self._broken}"
@@ -445,6 +458,9 @@ class PushLog:
             t0 = time.monotonic()
             error: Optional[BaseException] = None
             try:
+                hook = _fsync_hook
+                if hook is not None:
+                    hook("pushlog")
                 blob = b"".join(
                     encode_record(t.record) for t in batch
                 )

@@ -148,6 +148,19 @@ class TaskDataService:
                         base=min(0.2, self._wait_sleep_secs),
                         cap=2.0 * self._wait_sleep_secs,
                     )
+                    # Retry budget (comm/overload.py): the poll loop
+                    # must SURVIVE the full reattach grace — a denied
+                    # spend stretches this round's wait (rate-capping
+                    # the fleet-wide storm on the promoted standby)
+                    # instead of abandoning the ride-out.
+                    from elasticdl_tpu.comm import overload
+
+                    if overload.controls_enabled():
+                        budget = overload.retry_budget_for(
+                            "Master:rideout"
+                        )
+                        if not budget.try_spend():
+                            retry_delay = max(retry_delay, 1.0)
                     self._wait(retry_delay)
                     # Fresh channel per retry (MasterClient.reconnect):
                     # a channel whose reconnects were refused for a few
@@ -175,6 +188,16 @@ class TaskDataService:
                         last_generation, generation, rpc_failures,
                     )
                 last_generation = generation
+                if rpc_failures:
+                    # A recovered poll refunds a sliver of retry
+                    # budget — sustained health restores the fleet's
+                    # headroom for the next outage.
+                    from elasticdl_tpu.comm import overload
+
+                    if overload.controls_enabled():
+                        overload.retry_budget_for(
+                            "Master:rideout"
+                        ).on_success()
                 rpc_failures = 0
                 retry_delay = 0.0
                 outage_deadline = None

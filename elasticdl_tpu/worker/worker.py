@@ -395,6 +395,17 @@ class Worker:
                 retry_delay = decorrelated_jitter(
                     retry_delay, base=0.2, cap=2.0
                 )
+                # Retry budget (comm/overload.py): the ride-out must
+                # SURVIVE the grace window, so a denied spend
+                # stretches the wait (rate-capping the storm on the
+                # recovering master) instead of abandoning.
+                from elasticdl_tpu.comm import overload
+
+                if overload.controls_enabled():
+                    if not overload.retry_budget_for(
+                        "Master:rideout"
+                    ).try_spend():
+                        retry_delay = max(retry_delay, 1.0)
                 # _wait_tick, not sleep: multi-host workers must keep
                 # participating in barrier ticks during the ride-out
                 # or they strand peers mid-collective. (If a stop was

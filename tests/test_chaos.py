@@ -171,6 +171,86 @@ class TestFaultInjector:
             injector.injected[0]["tag"] == "rowservice/1"
         )
 
+    def test_stall_shard_method_filter(self):
+        # A method-scoped stall (the brownout drill stalls only the
+        # push methods) must not count — let alone delay — the
+        # serving-read methods on the same shard.
+        plan = FaultPlan(events=[FaultEvent(
+            kind="stall_shard", shard=0, method="push_row_grads",
+            at_call=1, delay_secs=0.0, duration_calls=2,
+        )])
+        injector = FaultInjector(plan)
+        for _ in range(3):
+            injector.server_hook(
+                "rowservice/0", "RowService", "pull_rows", {}
+            )
+        assert injector.injected == []
+        injector.server_hook(
+            "rowservice/0", "RowService", "push_row_grads", {}
+        )
+        assert [e["method"] for e in injector.injected] == [
+            "push_row_grads"
+        ]
+
+    def test_fsync_stall_target_validated_and_described(self):
+        from elasticdl_tpu.chaos.faults import describe
+
+        with pytest.raises(ValueError, match="fsync_stall target"):
+            FaultEvent(kind="fsync_stall", target="floppy")
+        plan = FaultPlan(events=[FaultEvent(
+            kind="fsync_stall", target="pushlog", at_call=1,
+            delay_secs=0.25,
+        )])
+        assert "seam=pushlog" in describe(plan)
+
+    def test_fsync_stall_matches_only_its_seam(self):
+        plan = FaultPlan(events=[FaultEvent(
+            kind="fsync_stall", target="checkpoint", at_call=1,
+            delay_secs=0.0,
+        )])
+        injector = FaultInjector(plan)
+        injector.fsync_hook("pushlog")
+        assert injector.injected == []
+        injector.fsync_hook("checkpoint")
+        assert [e["site"] for e in injector.injected] == ["checkpoint"]
+        # target="" matches every seam.
+        any_plan = FaultPlan(events=[FaultEvent(
+            kind="fsync_stall", at_call=1, delay_secs=0.0, max_fires=2,
+        )])
+        any_injector = FaultInjector(any_plan)
+        any_injector.fsync_hook("pushlog")
+        assert len(any_injector.injected) == 1
+
+    def test_fsync_stall_delays_pushlog_group_commit(self, tmp_path):
+        import time
+
+        from elasticdl_tpu.storage.pushlog import PushLog
+
+        plan = FaultPlan(events=[FaultEvent(
+            kind="fsync_stall", target="pushlog", at_call=1,
+            delay_secs=0.15,
+        )])
+        injector = FaultInjector(plan)
+        log = PushLog(str(tmp_path / "wal"), group_ms=0.0)
+        try:
+            with injector:
+                t0 = time.monotonic()
+                ticket = log.append(
+                    version=1, client="w0", seq=1, table="emb",
+                    ids=np.arange(2, dtype=np.int64),
+                    grads=np.zeros((2, 4), np.float32),
+                    applied_at=0.0, map_version=0,
+                )
+                ticket.wait(timeout=10.0)
+                elapsed = time.monotonic() - t0
+        finally:
+            log.close()
+        assert elapsed >= 0.15
+        assert [e["kind"] for e in injector.injected] == ["fsync_stall"]
+        assert injector.injected[0]["site"] == "pushlog"
+        # max_fires=1: the stall window over, later commits are clean.
+        assert injector.fault_counts() == {"fsync_stall": 1}
+
 
 # ---- invariant checkers caught red-handed ------------------------------
 

@@ -1,0 +1,554 @@
+"""Overload plane semantics (ISSUE 20): ambient deadlines and their
+edge cases over real RPC, priority admission control, retry budgets,
+circuit breakers, and hedged reads (comm/deadline.py +
+comm/overload.py + the comm/rpc.py integration).
+
+The brownout drill (chaos/brownout_drill.py) exercises the whole plane
+against a live fleet; these tests pin the unit semantics and the
+client/server contract edges the drill's aggregate gates would blur —
+expired-on-arrival never reaching a handler, the non-retryable detail
+contract, nested scopes only shrinking, the shed/budget/breaker state
+machines.
+"""
+
+import threading
+import time
+
+import pytest
+
+from elasticdl_tpu.comm import deadline
+from elasticdl_tpu.comm import overload
+from elasticdl_tpu.comm.overload import (
+    AdmissionController,
+    BACKGROUND_PURPOSES,
+    BREAKER_CLOSED,
+    BREAKER_HALF_OPEN,
+    BREAKER_OPEN,
+    CircuitBreaker,
+    HedgeTimer,
+    RetryBudget,
+    hedged_call,
+    parse_retry_after,
+    tier_of,
+)
+from elasticdl_tpu.comm.rpc import (
+    EXPIRED_DETAIL,
+    RpcError,
+    RpcServer,
+    RpcStub,
+)
+from elasticdl_tpu.observability import default_registry
+from elasticdl_tpu.observability import principal
+
+
+@pytest.fixture(autouse=True)
+def _fresh_controls():
+    overload.reset_retry_budgets()
+    overload.reset_breakers()
+    yield
+    overload.reset_retry_budgets()
+    overload.reset_breakers()
+    overload.set_controls_enabled(True)
+
+
+def _counter_value(name: str, labels=()):
+    """Current value of one labeled series, 0.0 if absent — snapshot
+    lookup so tests never have to re-state a family's help text."""
+    for family in default_registry().snapshot()["families"]:
+        if family["name"] != f"edl_tpu_{name}":
+            continue
+        for series in family["series"]:
+            if tuple(series.get("labels") or ()) == tuple(labels):
+                return float(series["value"])
+    return 0.0
+
+
+# ---- deadline scopes ------------------------------------------------------
+
+
+class TestDeadlineScopes:
+    def test_no_scope_is_inert(self):
+        assert deadline.current() is None
+        assert deadline.remaining() is None
+        assert not deadline.expired()
+        assert deadline.hop_timeout(None) is None
+        assert deadline.hop_timeout(2.5) == 2.5
+
+    def test_running_out_and_remaining(self):
+        with deadline.running_out(5.0):
+            left = deadline.remaining()
+            assert 4.5 < left <= 5.0
+            assert not deadline.expired()
+        assert deadline.current() is None
+
+    def test_nested_scope_only_shrinks(self):
+        with deadline.running_out(5.0) as outer:
+            # A LOOSER child clamps to the parent: a callee can never
+            # outlive its caller's patience.
+            with deadline.running_at(outer + 60.0) as inner:
+                assert inner == outer
+            # A TIGHTER child wins.
+            with deadline.running_out(0.5):
+                assert deadline.remaining() <= 0.5
+            # Back to the outer budget afterwards.
+            assert deadline.remaining() > 4.0
+
+    def test_none_scope_is_noop(self):
+        with deadline.running_at(None) as instant:
+            assert instant is None
+            assert deadline.current() is None
+
+    def test_expired_after_instant_passes(self):
+        with deadline.running_at(time.time() - 0.01):
+            assert deadline.expired()
+            assert deadline.remaining() <= 0.0
+
+    def test_hop_timeout_min_of_explicit_and_ambient(self):
+        with deadline.running_out(10.0):
+            assert deadline.hop_timeout(0.25) == 0.25
+            assert deadline.hop_timeout(None) <= 10.0
+            assert deadline.hop_timeout(60.0) <= 10.0
+        # Nearly-spent budgets still get one floored attempt instead
+        # of a zero/negative gRPC timeout.
+        with deadline.running_at(time.time() - 1.0):
+            assert (deadline.hop_timeout(5.0)
+                    == deadline.MIN_HOP_TIMEOUT_SECS)
+
+    def test_bind_carries_deadline_to_pool_thread(self):
+        seen = {}
+
+        def probe():
+            seen["remaining"] = deadline.remaining()
+
+        with deadline.running_out(5.0):
+            bound = deadline.bind(probe)
+        # Thread-locals do NOT flow into other threads; the bound
+        # closure re-establishes the captured instant there.
+        t = threading.Thread(target=bound)
+        t.start()
+        t.join()
+        assert seen["remaining"] is not None
+        assert 0.0 < seen["remaining"] <= 5.0
+
+        seen.clear()
+        t = threading.Thread(target=probe)
+        t.start()
+        t.join()
+        assert seen["remaining"] is None
+
+
+# ---- deadlines over real RPC ----------------------------------------------
+
+
+class TestDeadlineOverRpc:
+    def _server(self, handlers, **kwargs):
+        return RpcServer("localhost:0", {"Echo": handlers},
+                         **kwargs).start()
+
+    def test_expired_on_arrival_never_reaches_handler(self):
+        called = []
+        server = self._server({"echo": lambda req: called.append(1)})
+        stub = RpcStub(f"localhost:{server.port}", "Echo",
+                       max_retries=2)
+        try:
+            # Wire-level expired deadline with NO ambient scope: the
+            # client would short-circuit its own expired scope, so
+            # stamping the field directly is what isolates the
+            # SERVER-side rejection (before the handler, and by
+            # detail contract non-retryable — one attempt only).
+            before = _counter_value(
+                "rpc_retries_total",
+                ("Echo", "echo", "DEADLINE_EXCEEDED"),
+            )
+            with pytest.raises(RpcError) as err:
+                stub.call("echo", timeout=5.0,
+                          _deadline=time.time() - 1.0)
+            assert err.value.code == "DEADLINE_EXCEEDED"
+            assert EXPIRED_DETAIL in str(err.value)
+            assert not called
+            assert _counter_value(
+                "rpc_retries_total",
+                ("Echo", "echo", "DEADLINE_EXCEEDED"),
+            ) == before
+        finally:
+            stub.close()
+            server.stop(0)
+
+    def test_expired_ambient_scope_never_sends(self):
+        called = []
+        server = self._server({"echo": lambda req: called.append(1)})
+        stub = RpcStub(f"localhost:{server.port}", "Echo",
+                       max_retries=2)
+        try:
+            with deadline.running_at(time.time() - 0.5):
+                with pytest.raises(RpcError) as err:
+                    stub.call("echo", timeout=5.0)
+            assert err.value.code == "DEADLINE_EXCEEDED"
+            assert "not sent" in str(err.value)
+            assert not called
+        finally:
+            stub.close()
+            server.stop(0)
+
+    def test_handler_inherits_ambient_deadline(self):
+        seen = {}
+
+        def probe(_req):
+            seen["remaining"] = deadline.remaining()
+            return {}
+
+        server = self._server({"probe": probe})
+        stub = RpcStub(f"localhost:{server.port}", "Echo",
+                       max_retries=0)
+        try:
+            with deadline.running_out(5.0):
+                stub.call("probe")
+            assert seen["remaining"] is not None
+            assert 0.0 < seen["remaining"] <= 5.0
+            # Without a scope nothing is propagated or invented.
+            stub.call("probe")
+            assert seen["remaining"] is None
+        finally:
+            stub.close()
+            server.stop(0)
+
+    def test_slow_handler_deadline_is_terminal_not_retried(self):
+        calls = []
+
+        def slow(_req):
+            calls.append(1)
+            time.sleep(0.5)
+            return {}
+
+        server = self._server({"slow": slow})
+        stub = RpcStub(f"localhost:{server.port}", "Echo",
+                       max_retries=3)
+        try:
+            t0 = time.monotonic()
+            with deadline.running_out(0.2):
+                with pytest.raises(RpcError) as err:
+                    stub.call("slow")
+            # DEADLINE_EXCEEDED is retryable in general (a per-call
+            # timeout may just have been tight) but NOT once the
+            # ambient budget is spent: one attempt, no retry sleeps,
+            # prompt surfacing.
+            assert err.value.code == "DEADLINE_EXCEEDED"
+            assert len(calls) == 1
+            assert time.monotonic() - t0 < 0.45
+        finally:
+            stub.close()
+            server.stop(0)
+
+    def test_chaos_delay_consumes_budget_before_send(self):
+        from elasticdl_tpu.chaos.faults import FaultEvent, FaultPlan
+        from elasticdl_tpu.chaos.interceptors import FaultInjector
+
+        called = []
+        server = self._server({"echo": lambda req: called.append(1)})
+        stub = RpcStub(f"localhost:{server.port}", "Echo",
+                       max_retries=2)
+        injector = FaultInjector(FaultPlan(events=[FaultEvent(
+            kind="rpc_delay", target="Echo", method="echo",
+            probability=1.0, delay_secs=0.3, max_fires=0,
+        )], seed=3))
+        injector.install()
+        try:
+            # The injected client-site delay models queue time: it
+            # burns the whole 150ms budget, so the attempt goes out
+            # with the floored hop timeout and comes back
+            # DEADLINE_EXCEEDED — never retried (budget spent).
+            with deadline.running_out(0.15):
+                with pytest.raises(RpcError) as err:
+                    stub.call("echo")
+            assert err.value.code == "DEADLINE_EXCEEDED"
+            assert not called
+        finally:
+            injector.uninstall()
+            stub.close()
+            server.stop(0)
+
+
+# ---- priority admission ---------------------------------------------------
+
+
+class TestAdmissionController:
+    def test_limit_must_be_positive(self):
+        with pytest.raises(ValueError):
+            AdmissionController(0)
+
+    def test_tier_thresholds_monotone_and_floored(self):
+        ctl = AdmissionController(10)
+        ts = [ctl.threshold(t) for t in range(4)]
+        assert ts == sorted(ts, reverse=True)
+        assert ts[0] == 10
+        # Background tiers keep strictly less headroom than serving.
+        assert ts[3] < ts[0]
+        # A tiny limit still admits one request per tier on an idle
+        # server (canaries must not starve outright).
+        tiny = AdmissionController(1)
+        assert all(tiny.threshold(t) == 1 for t in range(4))
+
+    def test_shed_order_follows_tiers(self):
+        ctl = AdmissionController(4)  # thresholds 4 / 3 / 2 / 2
+        for _ in range(ctl.threshold(tier_of("training"))):
+            assert ctl.try_acquire("training")
+        # Tier-1 full: more training sheds, serving still admitted.
+        assert not ctl.try_acquire("training")
+        assert not ctl.try_acquire("canary")
+        assert ctl.try_acquire("serving_read")
+        assert ctl.inflight == 4
+        # Fully saturated: serving sheds too (the last thing to go).
+        assert not ctl.try_acquire("serving_read")
+        for _ in range(4):
+            ctl.release()
+        assert ctl.inflight == 0
+        assert ctl.try_acquire("canary")
+        ctl.release()
+
+    def test_shed_verdict_round_trips_retry_after(self):
+        ctl = AdmissionController(2, retry_after_base=0.1)
+        code, detail = ctl.shed_verdict("canary")
+        assert code == "RESOURCE_EXHAUSTED"
+        hint = parse_retry_after(detail)
+        # Lower tiers are told to stay away longer.
+        assert hint == pytest.approx(
+            0.1 * (tier_of("canary") + 1)
+        )
+        assert hint > parse_retry_after(
+            ctl.shed_verdict("training")[1]
+        )
+        # Non-shed details parse to None (plain RESOURCE_EXHAUSTED
+        # from elsewhere must not be mistaken for a hinted shed).
+        assert parse_retry_after("quota exceeded") is None
+
+    def test_shed_and_depth_metrics(self):
+        ctl = AdmissionController(1, tag="t")
+        before = _counter_value("overload_shed_total", ("replay",))
+        assert ctl.try_acquire("training")
+        assert not ctl.try_acquire("replay")
+        assert _counter_value(
+            "overload_shed_total", ("replay",)
+        ) == before + 1
+        ctl.release()
+
+    def test_unknown_purpose_rides_with_training(self):
+        assert tier_of(None) == tier_of("training")
+        assert tier_of("no-such-purpose") == tier_of("training")
+        for purpose in BACKGROUND_PURPOSES:
+            assert tier_of(purpose) > tier_of("serving_read")
+
+
+class TestAdmissionOverRpc:
+    def test_background_shed_serving_admitted(self):
+        release = threading.Event()
+        entered = threading.Event()
+
+        def slow(_req):
+            entered.set()
+            release.wait(timeout=10.0)
+            return {}
+
+        def fast(_req):
+            return {"ok": True}
+
+        server = RpcServer(
+            "localhost:0",
+            {"Echo": {"slow": slow, "fast": fast}},
+            admission=AdmissionController(2),
+        ).start()  # thresholds: serving 2, training 1, background 1
+        stubs = [RpcStub(f"localhost:{server.port}", "Echo",
+                         max_retries=0) for _ in range(3)]
+        occupant = threading.Thread(
+            target=lambda: stubs[0].call("slow", timeout=10.0)
+        )
+        try:
+            with principal.pushed(job="j", component="c",
+                                  purpose="training"):
+                occupant.start()
+                assert entered.wait(timeout=5.0)
+            # One training request in flight fills every background
+            # tier; a canary shed is an immediate retryable
+            # RESOURCE_EXHAUSTED carrying the hint...
+            with principal.pushed(job="j", component="c",
+                                  purpose="canary"):
+                with pytest.raises(RpcError) as err:
+                    stubs[1].call("fast", timeout=5.0)
+            assert err.value.code == "RESOURCE_EXHAUSTED"
+            assert parse_retry_after(str(err.value)) is not None
+            # ...while a serving read on the SAME saturated server is
+            # admitted and served.
+            with principal.pushed(job="j", component="c",
+                                  purpose="serving_read"):
+                assert stubs[2].call(
+                    "fast", timeout=5.0
+                )["ok"] is True
+        finally:
+            release.set()
+            occupant.join(timeout=10.0)
+            for stub in stubs:
+                stub.close()
+            server.stop(0)
+
+
+# ---- retry budget ---------------------------------------------------------
+
+
+class TestRetryBudget:
+    def test_exhaustion_and_metric(self):
+        budget = RetryBudget(capacity=2.0, refill_per_sec=0.0,
+                             success_refill=0.0, key="svc-x")
+        before = _counter_value(
+            "rpc_retry_budget_exhausted_total", ("svc-x",)
+        )
+        assert budget.try_spend()
+        assert budget.try_spend()
+        assert not budget.try_spend()
+        assert _counter_value(
+            "rpc_retry_budget_exhausted_total", ("svc-x",)
+        ) == before + 1
+
+    def test_success_refills(self):
+        budget = RetryBudget(capacity=4.0, refill_per_sec=0.0,
+                             success_refill=0.5)
+        while budget.try_spend():
+            pass
+        budget.on_success()
+        budget.on_success()
+        assert budget.try_spend()
+        assert not budget.try_spend()
+
+    def test_time_refill_capped_at_capacity(self):
+        budget = RetryBudget(capacity=1.0, refill_per_sec=1000.0)
+        assert budget.try_spend()
+        time.sleep(0.01)
+        assert budget.tokens() == pytest.approx(1.0)
+
+    def test_shared_per_service_and_reset(self):
+        a = overload.retry_budget_for("RowService")
+        assert overload.retry_budget_for("RowService") is a
+        assert overload.retry_budget_for("Master") is not a
+        overload.reset_retry_budgets()
+        assert overload.retry_budget_for("RowService") is not a
+
+
+# ---- circuit breaker ------------------------------------------------------
+
+
+class TestCircuitBreaker:
+    def test_trip_probe_and_close(self):
+        # rand=0.0 pins the jittered cooldown at 0.5 * cooldown_secs.
+        b = CircuitBreaker("t:1", failure_threshold=3,
+                           cooldown_secs=0.1, rand=lambda: 0.0)
+        for _ in range(2):
+            b.on_failure()
+        assert b.state == BREAKER_CLOSED and b.allow()
+        b.on_failure()
+        assert b.state == BREAKER_OPEN
+        assert not b.allow()
+        time.sleep(0.06)
+        # Exactly ONE caller is admitted as the half-open probe.
+        assert b.allow()
+        assert b.state == BREAKER_HALF_OPEN
+        assert not b.allow()
+        b.on_success()
+        assert b.state == BREAKER_CLOSED and b.allow()
+
+    def test_failed_probe_reopens(self):
+        b = CircuitBreaker("t:2", failure_threshold=1,
+                           cooldown_secs=0.1, rand=lambda: 0.0)
+        b.on_failure()
+        time.sleep(0.06)
+        assert b.allow()
+        b.on_failure()  # the probe failed
+        assert b.state == BREAKER_OPEN
+        assert not b.allow()
+
+    def test_success_resets_consecutive_count(self):
+        b = CircuitBreaker("t:3", failure_threshold=2)
+        b.on_failure()
+        b.on_success()
+        b.on_failure()
+        assert b.state == BREAKER_CLOSED
+
+    def test_state_gauge_tracks_transitions(self):
+        b = CircuitBreaker("t:gauge", failure_threshold=1,
+                           cooldown_secs=30.0)
+        assert _counter_value(
+            "rpc_breaker_state", ("t:gauge",)
+        ) == BREAKER_CLOSED
+        b.on_failure()
+        assert _counter_value(
+            "rpc_breaker_state", ("t:gauge",)
+        ) == BREAKER_OPEN
+
+    def test_breaker_for_shared_and_reset(self):
+        a = overload.breaker_for("host:9")
+        assert overload.breaker_for("host:9") is a
+        overload.reset_breakers()
+        assert overload.breaker_for("host:9") is not a
+
+
+# ---- hedged reads ---------------------------------------------------------
+
+
+class TestHedgedCall:
+    def test_no_secondary_is_a_plain_call(self):
+        assert hedged_call(lambda: 41, None, 0.01) == 41
+
+    def test_slow_primary_hedged_second_wins(self):
+        release = threading.Event()
+
+        def slow_primary():
+            release.wait(timeout=5.0)
+            return "primary"
+
+        before = _counter_value("rpc_hedge_wins_total", ("S", "m"))
+        result = hedged_call(slow_primary, lambda: "secondary",
+                             delay_secs=0.02, service="S", method="m")
+        release.set()
+        assert result == "secondary"
+        assert _counter_value(
+            "rpc_hedge_wins_total", ("S", "m")
+        ) == before + 1
+
+    def test_fast_primary_wins_no_hedge(self):
+        before = _counter_value(
+            "rpc_hedge_attempts_total", ("S", "fast")
+        )
+        assert hedged_call(lambda: "primary", lambda: "secondary",
+                           delay_secs=1.0, service="S",
+                           method="fast") == "primary"
+        assert _counter_value(
+            "rpc_hedge_attempts_total", ("S", "fast")
+        ) == before
+
+    def test_failed_primary_falls_back(self):
+        def boom():
+            raise RuntimeError("down")
+
+        assert hedged_call(boom, lambda: "secondary",
+                           delay_secs=5.0) == "secondary"
+
+    def test_both_failing_surfaces_primary_error(self):
+        # Primary outlives the hedge delay before failing, so this is
+        # the true hedged path (not the fast-fail fallback, which by
+        # design surfaces the secondary's error instead).
+        def slow_boom():
+            time.sleep(0.05)
+            raise RuntimeError("primary down")
+
+        def boom_b():
+            raise RuntimeError("secondary down")
+
+        with pytest.raises(RuntimeError, match="primary down"):
+            hedged_call(slow_boom, boom_b, delay_secs=0.01)
+
+    def test_hedge_timer_clamps_and_tracks(self):
+        timer = HedgeTimer(floor=0.01, cap=0.5)
+        assert timer.delay() == 0.5  # no samples: never hedge early
+        for _ in range(100):
+            timer.observe(0.002)
+        assert timer.delay() == 0.01  # clamped to the floor
+        for _ in range(200):
+            timer.observe(0.2)
+        assert timer.delay() == pytest.approx(0.2, abs=0.05)

@@ -10,6 +10,7 @@ Usage::
     python tools/dump_metrics.py localhost:8080 --profile rowservice-0
     python tools/dump_metrics.py localhost:8080 --usage   # + /usage
     python tools/dump_metrics.py localhost:8080 --probes  # + /probes
+    python tools/dump_metrics.py localhost:8080 --overload # shed view
     python tools/dump_metrics.py localhost:8080 --watch 5  # live redraw
     make metrics METRICS_ADDR=localhost:8080
 
@@ -116,6 +117,45 @@ def pretty_print(text: str, out=None):
         else:
             for name, labels, value in samples:
                 out.write(f"    {name}{labels} = {value}\n")
+        out.write("\n")
+
+
+# Overload-plane families (suffix match: the registry namespaces
+# them, e.g. edl_tpu_overload_shed_total). docs/fault_tolerance.md
+# "Graceful degradation".
+_OVERLOAD_FAMILIES = (
+    "overload_shed_total",
+    "overload_queue_depth",
+    "rpc_retries_total",
+    "rpc_retry_budget_exhausted_total",
+    "rpc_breaker_state",
+    "rpc_hedge_attempts_total",
+    "rpc_hedge_wins_total",
+    "row_push_durable_wait_timeouts_total",
+)
+
+
+def print_overload(text: str, out=None):
+    """The overload-plane slice of a scrape: who is being shed (by
+    purpose), queue depth against the admission limit, retry volume
+    and budget exhaustions, breaker states, hedge traffic — the
+    at-a-glance brownout dashboard."""
+    out = out if out is not None else sys.stdout
+    order, families, helps, types = parse_samples(text)
+    hits = [f for f in order if f.endswith(_OVERLOAD_FAMILIES)]
+    if not hits:
+        out.write("  (no overload-plane families in this scrape — "
+                  "nothing shed, retried, or broken yet)\n")
+        return
+    for family in hits:
+        kind = types.get(family, "untyped")
+        out.write(f"{family}  [{kind}]  {helps.get(family, '')}\n")
+        for name, labels, value in families[family]:
+            if kind == "histogram" and not (
+                name.endswith("_count") or name.endswith("_sum")
+            ):
+                continue
+            out.write(f"    {name}{labels} = {value}\n")
         out.write("\n")
 
 
@@ -548,6 +588,9 @@ def dump_once(args) -> int:
         sys.stdout.write(text)
     else:
         pretty_print(text)
+    if args.overload:
+        sys.stdout.write("\n---- overload ----\n")
+        print_overload(text)
     if args.traces:
         try:
             spans = fetch_traces(args.addr, timeout=args.timeout)
@@ -655,6 +698,11 @@ def main(argv=None) -> int:
                              "synthetic-probe table (green/red, "
                              "success ratio, latency, last failure "
                              "reason)")
+    parser.add_argument("--overload", action="store_true",
+                        help="Also print the overload-plane slice of "
+                             "the scrape (sheds by purpose, queue "
+                             "depth, retry budgets, breaker states, "
+                             "hedges) as its own section")
     parser.add_argument("--profile", default=None, metavar="COMPONENT",
                         help="Also fetch /profile for this component "
                              "('' = the master itself, '3' = worker "

@@ -24,6 +24,7 @@ dispatches by artifact signature:
 - ``SCHED_DRILL.json``               → check_sched (gang-sched drill)
 - ``STREAM_DRILL.json``              → check_stream (streaming drill)
 - ``PROBE_DRILL.json``               → check_probe (synthetic probes)
+- ``BROWNOUT_DRILL.json``            → check_overload (brownout drill)
 
 Exits nonzero if any validator fails. A root with no artifacts passes
 (there is nothing to corrupt). Importable: ``run_fsck(root)``.
@@ -76,6 +77,11 @@ def _classify(root: str) -> List[Tuple[str, str]]:
                 ("probe",
                  os.path.join(dirpath, "PROBE_DRILL.json"))
             )
+        if "BROWNOUT_DRILL.json" in filenames:
+            found.append(
+                ("overload",
+                 os.path.join(dirpath, "BROWNOUT_DRILL.json"))
+            )
         if "MANIFEST.json" in filenames:
             try:
                 with open(
@@ -125,6 +131,7 @@ def run_fsck(root: str) -> Tuple[List[str], dict]:
     from check_checkpoint import check_checkpoint
     from check_incident import check_incident
     from check_journal import check_journal
+    from check_overload import check_overload
     from check_probe import check_probe
     from check_pushlog import check_one_log
     from check_reshard import check_reshard
@@ -137,7 +144,8 @@ def run_fsck(root: str) -> Tuple[List[str], dict]:
     errors: List[str] = []
     checked = {"journal": 0, "checkpoint": 0, "store": 0,
                "pushlog": 0, "incident": 0, "reshard": 0,
-               "usage": 0, "sched": 0, "stream": 0, "probe": 0}
+               "usage": 0, "sched": 0, "stream": 0, "probe": 0,
+               "overload": 0}
     for kind, path in artifacts:
         checked[kind] += 1
         try:
@@ -161,6 +169,8 @@ def run_fsck(root: str) -> Tuple[List[str], dict]:
                 errs, _report = check_stream(path)
             elif kind == "probe":
                 errs, _report = check_probe(path)
+            elif kind == "overload":
+                errs, _report = check_overload(path)
             else:  # reshard
                 errs, _report = check_reshard(path)
         except BaseException as exc:
